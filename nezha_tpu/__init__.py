@@ -1,0 +1,39 @@
+"""nezha_tpu — a TPU-native deep-learning training framework.
+
+A ground-up rebuild of the capabilities of fast-ml/nezha (a Go distributed
+training framework with a cgo CUDA/NCCL backend) designed TPU-first:
+
+- compute is JAX/XLA (the MXU does the GEMMs/convs cuBLAS/cuDNN did),
+- collectives are XLA collectives over ICI (psum / reduce-scatter /
+  all-gather / ppermute) in place of cgo NCCL ring collectives,
+- device memory is XLA/PJRT device buffers in place of cudaMalloc,
+- the op graph lowers to StableHLO and is JIT-compiled (SURVEY.md §0
+  "north_star"), with an explicit graph IR in `nezha_tpu.graph`,
+- hot ops get Pallas TPU kernels in `nezha_tpu.ops.pallas`,
+- scale-out is a `jax.sharding.Mesh` + shard_map (DP, ZeRO-1, tensor,
+  and sequence/ring-attention parallelism) in `nezha_tpu.parallel`,
+- the host-side runtime mirrors the reference's goroutine pool + gRPC
+  coordinator (SURVEY.md §1): a prefetching worker pool in
+  `nezha_tpu.runtime` and a native C++ coordinator/loader under `csrc/`.
+
+Reference parity note: /root/reference was EMPTY when surveyed (see
+SURVEY.md blocker note), so parity citations point at SURVEY.md sections,
+which were derived from BASELINE.json.
+"""
+
+__version__ = "0.1.0"
+
+from nezha_tpu import nn, ops, optim, parallel, models, data, train, graph, runtime
+
+__all__ = [
+    "nn",
+    "ops",
+    "optim",
+    "parallel",
+    "models",
+    "data",
+    "train",
+    "graph",
+    "runtime",
+    "__version__",
+]

@@ -1,0 +1,3 @@
+"""Training CLI — the counterpart of the reference's `cmd/nezha-train`
+(SURVEY.md §1: flag parsing, config -> model/backend/world-size, launches
+the training loop)."""

@@ -1,0 +1,234 @@
+"""`nezha-train`: run any of the five benchmark configs end-to-end.
+
+    python -m nezha_tpu.cli.train --config mlp_mnist --steps 200
+    python -m nezha_tpu.cli.train --config resnet50_imagenet --mesh dp=8 \
+        --batch-size 256 --steps 50 --platform cpu
+
+Configs mirror BASELINE.json (SURVEY.md §0): mlp_mnist (single-process),
+resnet50_imagenet (DP all-reduce), gpt2_124m (bf16 GEMM), bert_base_zero1
+(ZeRO-1 reduce-scatter/all-gather), wrn101_large_batch (mixed bf16/fp32).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Callable, Dict, Iterator, Optional
+
+import numpy as np
+
+
+def _parse_mesh(spec: Optional[str]) -> Optional[Dict[str, int]]:
+    if not spec:
+        return None
+    axes = {}
+    for part in spec.split(","):
+        name, _, size = part.partition("=")
+        axes[name.strip()] = int(size)
+    return axes
+
+
+class Config:
+    def __init__(self, build_model: Callable, loss_fn: Callable,
+                 batches: Callable[[int], Iterator[dict]],
+                 build_optimizer: Callable, default_batch: int,
+                 parallel_mode: str = "dp", default_mesh: str = "dp=-1"):
+        self.build_model = build_model
+        self.loss_fn = loss_fn
+        self.batches = batches
+        self.build_optimizer = build_optimizer
+        self.default_batch = default_batch
+        self.parallel_mode = parallel_mode  # "single" | "dp" | "zero1"
+        self.default_mesh = default_mesh
+
+
+def _configs() -> Dict[str, Config]:
+    # Imports deferred so `--help` stays instant.
+    from nezha_tpu import data, models, ops, optim
+    from nezha_tpu.models import bert as bert_mod
+    from nezha_tpu.models import gpt2 as gpt2_mod
+    from nezha_tpu.tensor import bf16_policy
+
+    ce = lambda logits, b: ops.softmax_cross_entropy_with_integer_labels(
+        logits, b["label"])
+
+    return {
+        "mlp_mnist": Config(
+            build_model=lambda: models.MLP(),
+            loss_fn=ce,
+            batches=lambda bs: data.mnist_batches(bs),
+            build_optimizer=lambda steps: optim.momentum(0.1),
+            default_batch=128,
+            parallel_mode="single"),
+        "resnet50_imagenet": Config(
+            build_model=lambda: models.resnet50(policy=bf16_policy()),
+            loss_fn=ce,
+            batches=lambda bs: data.synthetic_image_batches(bs),
+            build_optimizer=lambda steps: optim.momentum(
+                optim.warmup_cosine_schedule(0.4, 5 * 312, max(steps, 10)),
+                beta=0.9, weight_decay=1e-4),
+            default_batch=256,
+            parallel_mode="dp"),
+        "gpt2_124m": Config(
+            build_model=lambda: models.gpt2_124m(),
+            loss_fn=gpt2_mod.lm_loss,
+            batches=lambda bs: data.synthetic_token_batches(bs, seq_len=1024),
+            build_optimizer=lambda steps: optim.adamw(
+                optim.warmup_cosine_schedule(6e-4, 100, max(steps, 200)),
+                weight_decay=0.1),
+            default_batch=8,
+            parallel_mode="dp"),
+        "bert_base_zero1": Config(
+            build_model=lambda: models.bert_base(),
+            loss_fn=bert_mod.mlm_loss,
+            batches=lambda bs: data.synthetic_mlm_batches(bs, seq_len=512),
+            build_optimizer=lambda steps: optim.adamw(
+                optim.warmup_cosine_schedule(1e-4, 100, max(steps, 200)),
+                weight_decay=0.01),
+            default_batch=16,
+            parallel_mode="zero1"),
+        "wrn101_large_batch": Config(
+            build_model=lambda: models.wide_resnet101(policy=bf16_policy()),
+            loss_fn=ce,
+            batches=lambda bs: data.synthetic_image_batches(bs),
+            build_optimizer=lambda steps: optim.momentum(
+                optim.warmup_cosine_schedule(1.6, 500, max(steps, 1000)),
+                beta=0.9, weight_decay=1e-4),
+            default_batch=512,
+            parallel_mode="dp"),
+    }
+
+
+def run(args) -> Dict[str, float]:
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+
+    from nezha_tpu import parallel
+    from nezha_tpu.runtime import Prefetcher
+    from nezha_tpu.train import checkpoint as ckpt
+    from nezha_tpu.train.loop import init_train_state, make_train_step
+
+    cfg = _configs()[args.config]
+    batch_size = args.batch_size or cfg.default_batch
+    model = cfg.build_model()
+    optimizer = cfg.build_optimizer(args.steps)
+    rng = jax.random.PRNGKey(args.seed)
+
+    mode = cfg.parallel_mode if len(jax.devices()) > 1 else "single"
+    mesh = None
+    if mode != "single":
+        mesh_axes = _parse_mesh(args.mesh) or _parse_mesh(cfg.default_mesh)
+        mesh = parallel.make_mesh(mesh_axes)
+
+    # --- state ------------------------------------------------------------
+    state = init_train_state(model, optimizer, rng)
+    start_step = 0
+    if args.ckpt_dir:
+        restored, start_step = ckpt.try_restore(args.ckpt_dir, state)
+        if restored is not None:
+            state = restored
+            print(f"resumed from step {start_step}", file=sys.stderr)
+
+    if mode == "single":
+        step_fn = make_train_step(model, optimizer, cfg.loss_fn)
+        shard = lambda b: b
+    elif mode == "dp":
+        state = parallel.replicate(mesh, state)
+        step_fn = parallel.make_dp_train_step(model, optimizer, cfg.loss_fn, mesh)
+        shard = lambda b: parallel.shard_batch(mesh, b)
+    elif mode == "zero1":
+        variables = state["variables"]
+        state = {
+            "variables": parallel.replicate(mesh, variables),
+            "opt_state": parallel.zero1_init_opt_state(
+                optimizer, variables["params"], mesh),
+            "rng": parallel.replicate(mesh, state["rng"]),
+        }
+        step_fn = parallel.make_zero1_train_step(model, optimizer,
+                                                 cfg.loss_fn, mesh)
+        shard = lambda b: parallel.shard_batch(mesh, b)
+    else:
+        raise ValueError(mode)
+
+    # --- loop -------------------------------------------------------------
+    source = cfg.batches(batch_size)
+    prefetch = Prefetcher(source, depth=args.prefetch)
+    metrics_file = open(args.metrics_file, "a") if args.metrics_file else None
+
+    if args.profile_dir:
+        jax.profiler.start_trace(args.profile_dir)
+
+    last: Dict[str, float] = {}
+    t0 = time.perf_counter()
+    window_t0, window_examples = t0, 0
+    try:
+        for i in range(args.steps):
+            batch = shard(next(prefetch))
+            state, metrics = step_fn(state, batch)
+            window_examples += batch_size
+            step_no = start_step + i + 1
+            if step_no % args.log_every == 0:
+                now = time.perf_counter()
+                last = {k: float(v) for k, v in metrics.items()}
+                last["examples_per_sec"] = window_examples / (now - window_t0)
+                last["step"] = step_no
+                window_t0, window_examples = now, 0
+                line = json.dumps(last)
+                print(line, file=sys.stderr)
+                if metrics_file:
+                    metrics_file.write(line + "\n")
+                    metrics_file.flush()
+            if (args.ckpt_every and args.ckpt_dir
+                    and step_no % args.ckpt_every == 0):
+                ckpt.save_checkpoint(args.ckpt_dir, state, step_no)
+    finally:
+        prefetch.close()
+        if args.profile_dir:
+            jax.profiler.stop_trace()
+        if metrics_file:
+            metrics_file.close()
+    if args.ckpt_dir:
+        ckpt.save_checkpoint(args.ckpt_dir, state, start_step + args.steps)
+    return last
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="nezha-train",
+        description="TPU-native training CLI (configs mirror BASELINE.json)")
+    p.add_argument("--config", required=True,
+                   choices=["mlp_mnist", "resnet50_imagenet", "gpt2_124m",
+                            "bert_base_zero1", "wrn101_large_batch"])
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--batch-size", type=int, default=None,
+                   help="global batch (default: per-config)")
+    p.add_argument("--mesh", default=None,
+                   help='mesh axes, e.g. "dp=8" or "dp=4,sp=2" (-1 = rest)')
+    p.add_argument("--platform", default=None,
+                   help="force a jax platform (e.g. cpu)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--prefetch", type=int, default=2)
+    p.add_argument("--ckpt-dir", default=None)
+    p.add_argument("--ckpt-every", type=int, default=0)
+    p.add_argument("--metrics-file", default=None,
+                   help="append JSONL metrics here")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture an XLA/TPU profiler trace here")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    last = run(args)
+    print(json.dumps({"final": last}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

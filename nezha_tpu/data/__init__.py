@@ -1,0 +1,15 @@
+"""Input pipelines — MNIST, ImageNet-format, and LM token streams
+(SURVEY.md §1 "Models & data": MNIST + ImageNet + text loaders), fed through
+the prefetching worker pool in `nezha_tpu.runtime`."""
+
+from nezha_tpu.data.mnist import load_mnist, mnist_batches
+from nezha_tpu.data.synthetic import (
+    synthetic_image_batches,
+    synthetic_token_batches,
+    synthetic_mlm_batches,
+)
+
+__all__ = [
+    "load_mnist", "mnist_batches",
+    "synthetic_image_batches", "synthetic_token_batches", "synthetic_mlm_batches",
+]

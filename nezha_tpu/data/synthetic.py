@@ -1,0 +1,76 @@
+"""Synthetic benchmark input pipelines.
+
+The perf benchmarks (BASELINE.json configs 2-5) measure device throughput,
+not dataset IO, and this image has no network egress — so ImageNet-shaped
+image batches, GPT-2 token streams, and BERT MLM batches are generated
+host-side deterministically. Real datasets drop in by replacing these
+iterators; everything downstream (prefetcher, sharding, train step) is
+identical.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+
+def synthetic_image_batches(batch_size: int, image_size: int = 224,
+                            num_classes: int = 1000, seed: int = 0,
+                            nchw: bool = False) -> Iterator[dict]:
+    """ImageNet-shaped {"image": [B,H,W,3] f32, "label": [B] i32} batches."""
+    r = np.random.RandomState(seed)
+    # A small pool of pre-generated batches re-yielded forever: IO cost ~0,
+    # matching how perf harnesses avoid input-bound numbers.
+    pool = []
+    for _ in range(4):
+        shape = ((batch_size, 3, image_size, image_size) if nchw
+                 else (batch_size, image_size, image_size, 3))
+        pool.append({
+            "image": r.rand(*shape).astype(np.float32),
+            "label": r.randint(0, num_classes, size=batch_size).astype(np.int32),
+        })
+    i = 0
+    while True:
+        yield pool[i % len(pool)]
+        i += 1
+
+
+def synthetic_token_batches(batch_size: int, seq_len: int = 1024,
+                            vocab_size: int = 50257, seed: int = 0) -> Iterator[dict]:
+    """GPT-2-style LM batches: {"tokens": [B,S+1] i32}; model shifts for
+    inputs/targets."""
+    r = np.random.RandomState(seed)
+    pool = [
+        {"tokens": r.randint(0, vocab_size, size=(batch_size, seq_len + 1)).astype(np.int32)}
+        for _ in range(4)
+    ]
+    i = 0
+    while True:
+        yield pool[i % len(pool)]
+        i += 1
+
+
+def synthetic_mlm_batches(batch_size: int, seq_len: int = 512,
+                          vocab_size: int = 30522, mask_rate: float = 0.15,
+                          seed: int = 0, mask_token: int = 103) -> Iterator[dict]:
+    """BERT MLM batches: tokens with [MASK]s, labels -100 where unmasked."""
+    r = np.random.RandomState(seed)
+    pool = []
+    for _ in range(4):
+        tokens = r.randint(0, vocab_size, size=(batch_size, seq_len)).astype(np.int32)
+        labels = np.full_like(tokens, -100)
+        mask = r.rand(batch_size, seq_len) < mask_rate
+        labels[mask] = tokens[mask]
+        tokens = tokens.copy()
+        tokens[mask] = mask_token
+        pool.append({
+            "tokens": tokens,
+            "labels": labels,
+            "segment_ids": np.zeros_like(tokens),
+            "padding_mask": np.ones((batch_size, seq_len), dtype=bool),
+        })
+    i = 0
+    while True:
+        yield pool[i % len(pool)]
+        i += 1

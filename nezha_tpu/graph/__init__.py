@@ -1,0 +1,14 @@
+"""Internal op graph IR.
+
+The reference builds an internal op graph and the north star is to "lower
+the internal op graph to StableHLO and JIT via XLA" (SURVEY.md §0). This
+package is that component: a small explicit graph IR (`Graph`, `Node`) whose
+programs trace through JAX to StableHLO text/bytecode and compile to XLA
+executables, with autograd derived on the same graph via `jax.grad`.
+"""
+
+from nezha_tpu.graph.graph import Graph, Node
+from nezha_tpu.graph.lower import to_callable, lower_stablehlo, compile_graph, grad_callable
+
+__all__ = ["Graph", "Node", "to_callable", "lower_stablehlo", "compile_graph",
+           "grad_callable"]

@@ -1,0 +1,167 @@
+"""Graph IR: nodes, ops, graph construction.
+
+A deliberately small SSA-ish IR: `Node`s name an op with input nodes and
+static attributes; a `Graph` owns nodes, placeholders (inputs), and outputs.
+No shapes are inferred here — shape/dtype checking happens when the graph is
+traced by JAX during lowering (`nezha_tpu.graph.lower`), which reuses XLA's
+own checking rather than duplicating it (SURVEY.md §1 "Op graph & autograd").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# Op registry: name -> callable(jax-arrays..., **attrs). Populated by lower.py.
+OP_SET = (
+    "placeholder", "constant",
+    "add", "sub", "mul", "div", "neg", "pow",
+    "matmul", "conv2d",
+    "relu", "gelu", "tanh", "exp", "log", "sigmoid",
+    "softmax", "log_softmax", "layernorm",
+    "reshape", "transpose", "broadcast_to", "sum", "mean", "max",
+    "cast", "concat", "slice", "take",
+    "all_reduce", "reduce_scatter", "all_gather",  # collective graph ops
+)
+
+
+@dataclasses.dataclass
+class Node:
+    id: int
+    op: str
+    inputs: Tuple[int, ...]
+    attrs: Dict[str, Any]
+    name: str
+
+    def __repr__(self):
+        ins = ", ".join(f"%{i}" for i in self.inputs)
+        return f"%{self.id} = {self.op}({ins}) {self.attrs or ''}".rstrip()
+
+
+class Graph:
+    """Builder + container. Methods return `Node`s; operators are overloaded
+    on a thin `Sym` wrapper for ergonomic construction."""
+
+    def __init__(self, name: str = "graph"):
+        self.name = name
+        self.nodes: List[Node] = []
+        self.placeholders: List[int] = []
+        self.outputs: List[int] = []
+
+    # -- construction ------------------------------------------------------
+
+    def _add(self, op: str, inputs: Sequence["Sym | Node | int"],
+             attrs: Optional[dict] = None, name: str = "") -> "Sym":
+        if op not in OP_SET:
+            raise ValueError(f"unknown op {op!r}")
+        ids = tuple(self._node_id(i) for i in inputs)
+        node = Node(len(self.nodes), op, ids, attrs or {}, name or op)
+        self.nodes.append(node)
+        return Sym(self, node.id)
+
+    @staticmethod
+    def _node_id(x) -> int:
+        if isinstance(x, Sym):
+            return x.id
+        if isinstance(x, Node):
+            return x.id
+        return int(x)
+
+    def placeholder(self, shape: Sequence[int], dtype: str = "float32",
+                    name: str = "") -> "Sym":
+        sym = self._add("placeholder", [],
+                        {"shape": tuple(shape), "dtype": dtype}, name or "input")
+        self.placeholders.append(sym.id)
+        return sym
+
+    def constant(self, value, name: str = "") -> "Sym":
+        return self._add("constant", [], {"value": np.asarray(value)}, name or "const")
+
+    def output(self, *syms: "Sym") -> None:
+        self.outputs.extend(self._node_id(s) for s in syms)
+
+    # -- op helpers --------------------------------------------------------
+
+    def matmul(self, a, b):
+        return self._add("matmul", [a, b])
+
+    def conv2d(self, x, w, stride=(1, 1), padding="SAME", groups=1):
+        return self._add("conv2d", [x, w],
+                         {"stride": tuple(stride), "padding": padding,
+                          "groups": groups})
+
+    def relu(self, x):
+        return self._add("relu", [x])
+
+    def gelu(self, x):
+        return self._add("gelu", [x])
+
+    def softmax(self, x, axis=-1):
+        return self._add("softmax", [x], {"axis": axis})
+
+    def layernorm(self, x, scale, bias, eps=1e-5):
+        return self._add("layernorm", [x, scale, bias], {"eps": eps})
+
+    def reshape(self, x, shape):
+        return self._add("reshape", [x], {"shape": tuple(shape)})
+
+    def transpose(self, x, perm):
+        return self._add("transpose", [x], {"perm": tuple(perm)})
+
+    def sum(self, x, axis=None, keepdims=False):
+        return self._add("sum", [x], {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, x, axis=None, keepdims=False):
+        return self._add("mean", [x], {"axis": axis, "keepdims": keepdims})
+
+    def cast(self, x, dtype: str):
+        return self._add("cast", [x], {"dtype": dtype})
+
+    def all_reduce(self, x, axis_name: str = "dp"):
+        return self._add("all_reduce", [x], {"axis_name": axis_name})
+
+    def reduce_scatter(self, x, axis_name: str = "dp"):
+        return self._add("reduce_scatter", [x], {"axis_name": axis_name})
+
+    def all_gather(self, x, axis_name: str = "dp"):
+        return self._add("all_gather", [x], {"axis_name": axis_name})
+
+    # -- introspection -----------------------------------------------------
+
+    def __repr__(self):
+        lines = [f"graph {self.name}:"]
+        lines += [f"  {n!r}" for n in self.nodes]
+        lines.append(f"  outputs: {['%%%d' % o for o in self.outputs]}")
+        return "\n".join(lines)
+
+
+@dataclasses.dataclass(frozen=True)
+class Sym:
+    """Handle to a node within a graph, with operator sugar."""
+    graph: Graph
+    id: int
+
+    def _bin(self, op, other):
+        if not isinstance(other, Sym):
+            other = self.graph.constant(other)
+        return self.graph._add(op, [self, other])
+
+    def __add__(self, other):
+        return self._bin("add", other)
+
+    def __sub__(self, other):
+        return self._bin("sub", other)
+
+    def __mul__(self, other):
+        return self._bin("mul", other)
+
+    def __truediv__(self, other):
+        return self._bin("div", other)
+
+    def __matmul__(self, other):
+        return self._bin("matmul", other)
+
+    def __neg__(self):
+        return self.graph._add("neg", [self])

@@ -1,0 +1,164 @@
+"""Lowering: Graph IR -> JAX callable -> StableHLO -> XLA executable.
+
+This is the north-star path (SURVEY.md §0: "lower the internal op graph to
+StableHLO and JIT-compile via XLA"). The graph interprets into pure JAX ops
+(one topological pass — the graph is already in SSA order), `jax.jit.lower`
+produces StableHLO, and `.compile()` yields the XLA executable whose
+lifetime the runtime's `Executor` caches. Autograd: `grad_callable` wraps
+the interpreted function with `jax.grad`, so the backward graph is derived
+from the same IR.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from nezha_tpu.graph.graph import Graph
+from nezha_tpu.ops import activations
+
+
+def _eval_node(node, vals, feeds):
+    op, attrs = node.op, node.attrs
+    x = [vals[i] for i in node.inputs]
+    if op == "placeholder":
+        return feeds[node.id]
+    if op == "constant":
+        return jnp.asarray(attrs["value"])
+    if op == "add":
+        return x[0] + x[1]
+    if op == "sub":
+        return x[0] - x[1]
+    if op == "mul":
+        return x[0] * x[1]
+    if op == "div":
+        return x[0] / x[1]
+    if op == "neg":
+        return -x[0]
+    if op == "pow":
+        return x[0] ** x[1]
+    if op == "matmul":
+        return x[0] @ x[1]
+    if op == "conv2d":
+        return lax.conv_general_dilated(
+            x[0], x[1], window_strides=attrs["stride"], padding=attrs["padding"],
+            feature_group_count=attrs.get("groups", 1),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    if op == "relu":
+        return jnp.maximum(x[0], 0)
+    if op == "gelu":
+        return activations.gelu(x[0])
+    if op == "tanh":
+        return jnp.tanh(x[0])
+    if op == "exp":
+        return jnp.exp(x[0])
+    if op == "log":
+        return jnp.log(x[0])
+    if op == "sigmoid":
+        return lax.logistic(x[0])
+    if op == "softmax":
+        return activations.softmax(x[0], axis=attrs.get("axis", -1))
+    if op == "log_softmax":
+        return activations.log_softmax(x[0], axis=attrs.get("axis", -1))
+    if op == "layernorm":
+        xf = jnp.asarray(x[0], jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        y = (xf - mean) * lax.rsqrt(var + attrs["eps"])
+        return (y * x[1] + x[2]).astype(x[0].dtype)
+    if op == "reshape":
+        return jnp.reshape(x[0], attrs["shape"])
+    if op == "transpose":
+        return jnp.transpose(x[0], attrs["perm"])
+    if op == "broadcast_to":
+        return jnp.broadcast_to(x[0], attrs["shape"])
+    if op == "sum":
+        return jnp.sum(x[0], axis=attrs["axis"], keepdims=attrs["keepdims"])
+    if op == "mean":
+        return jnp.mean(x[0], axis=attrs["axis"], keepdims=attrs["keepdims"])
+    if op == "max":
+        return jnp.max(x[0], axis=attrs["axis"], keepdims=attrs["keepdims"])
+    if op == "cast":
+        return x[0].astype(attrs["dtype"])
+    if op == "concat":
+        return jnp.concatenate(x, axis=attrs.get("axis", 0))
+    if op == "slice":
+        return lax.slice(x[0], attrs["start"], attrs["limit"],
+                         attrs.get("strides"))
+    if op == "take":
+        return jnp.take(x[0], x[1], axis=attrs.get("axis", 0))
+    if op == "all_reduce":
+        return lax.psum(x[0], attrs["axis_name"])
+    if op == "reduce_scatter":
+        return lax.psum_scatter(x[0], attrs["axis_name"], scatter_dimension=0,
+                                tiled=True)
+    if op == "all_gather":
+        return lax.all_gather(x[0], attrs["axis_name"], axis=0, tiled=True)
+    raise NotImplementedError(op)
+
+
+def to_callable(graph: Graph) -> Callable:
+    """Interpret the graph as a pure function of its placeholders (in
+    declaration order). Single output -> value; multiple -> tuple."""
+
+    def fn(*args):
+        if len(args) != len(graph.placeholders):
+            raise TypeError(
+                f"graph {graph.name} takes {len(graph.placeholders)} inputs, "
+                f"got {len(args)}")
+        feeds = dict(zip(graph.placeholders, args))
+        vals: List = [None] * len(graph.nodes)
+        for node in graph.nodes:  # SSA order by construction
+            vals[node.id] = _eval_node(node, vals, feeds)
+        outs = tuple(vals[i] for i in graph.outputs)
+        return outs[0] if len(outs) == 1 else outs
+
+    fn.__name__ = graph.name
+    return fn
+
+
+def _example_args(graph: Graph):
+    args = []
+    for pid in graph.placeholders:
+        attrs = graph.nodes[pid].attrs
+        args.append(jax.ShapeDtypeStruct(attrs["shape"], jnp.dtype(attrs["dtype"])))
+    return args
+
+
+def lower_stablehlo(graph: Graph, example_args: Sequence = None) -> str:
+    """Graph -> StableHLO module text."""
+    fn = to_callable(graph)
+    args = list(example_args) if example_args is not None else _example_args(graph)
+    lowered = jax.jit(fn).lower(*args)
+    return str(lowered.compiler_ir(dialect="stablehlo"))
+
+
+def compile_graph(graph: Graph, example_args: Sequence = None):
+    """Graph -> XLA executable (callable on device arrays)."""
+    fn = to_callable(graph)
+    args = list(example_args) if example_args is not None else _example_args(graph)
+    return jax.jit(fn).lower(*args).compile()
+
+
+def grad_callable(graph: Graph, wrt: Sequence[int] = (0,)) -> Callable:
+    """d(first output)/d(placeholders[wrt]); the first output must be a
+    scalar (a loss). Raises at trace time otherwise."""
+    fn = to_callable(graph)
+    argnums = tuple(wrt)
+    if len(argnums) == 1:
+        argnums = argnums[0]  # single grad, not a 1-tuple
+
+    def scalar_loss(*a):
+        out = fn(*a)
+        loss = out[0] if isinstance(out, tuple) else out
+        if getattr(loss, "ndim", 0) != 0:
+            raise ValueError(
+                f"grad_callable needs a scalar first output, got shape "
+                f"{getattr(loss, 'shape', None)} from graph {graph.name!r}")
+        return loss
+
+    return jax.grad(scalar_loss, argnums=argnums)

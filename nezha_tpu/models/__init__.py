@@ -1,0 +1,22 @@
+"""Model zoo — the five benchmark workloads of BASELINE.json (SURVEY.md §2):
+MLP, ResNet-50, Wide-ResNet-101, GPT-2 124M, BERT-base."""
+
+from nezha_tpu.models.mlp import MLP
+
+__all__ = ["MLP"]
+
+
+_LAZY = {
+    "ResNet": "resnet", "resnet50": "resnet", "wide_resnet101": "resnet",
+    "GPT2": "gpt2", "GPT2Config": "gpt2", "gpt2_124m": "gpt2",
+    "Bert": "bert", "BertConfig": "bert", "bert_base": "bert",
+}
+
+
+def __getattr__(name):
+    # Lazy imports keep `import nezha_tpu` fast; heavy models load on demand.
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(f"nezha_tpu.models.{_LAZY[name]}")
+        return getattr(mod, name)
+    raise AttributeError(name)

@@ -1,0 +1,153 @@
+"""BERT-base with an MLM head — benchmark config 4 (SURVEY.md §0:
+"BERT-base — grad reduce-scatter + weight all-gather (ZeRO-1-style)").
+
+Bidirectional encoder; padding handled with an additive mask; MLM loss masks
+to the 15% corrupted positions via ``ignore_index=-100`` labels. Train with
+`nezha_tpu.parallel.make_zero1_train_step` for the ZeRO-1 benchmark path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from nezha_tpu import nn, ops
+from nezha_tpu.nn import initializers as init_lib
+from nezha_tpu.nn.module import Module, Variables, child_vars, run_child
+from nezha_tpu.tensor.policy import DEFAULT_POLICY, Policy, bf16_policy
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    max_positions: int = 512
+    type_vocab_size: int = 2
+    num_layers: int = 12
+    num_heads: int = 12
+    hidden_size: int = 768
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+
+
+class EncoderLayer(Module):
+    """Post-LN transformer encoder layer (original BERT topology)."""
+
+    def __init__(self, cfg: BertConfig, policy: Policy):
+        h = cfg.hidden_size
+        self.cfg = cfg
+        self.qkv = nn.Linear(h, 3 * h, kernel_init=init_lib.normal(0.02),
+                             policy=policy)
+        self.attn_out = nn.Linear(h, h, kernel_init=init_lib.normal(0.02),
+                                  policy=policy)
+        self.attn_ln = nn.LayerNorm(h, policy=policy)
+        self.fc = nn.Linear(h, h * cfg.mlp_ratio,
+                            kernel_init=init_lib.normal(0.02), policy=policy)
+        self.fc_out = nn.Linear(h * cfg.mlp_ratio, h,
+                                kernel_init=init_lib.normal(0.02), policy=policy)
+        self.out_ln = nn.LayerNorm(h, policy=policy)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def apply(self, variables: Variables, x, mask=None, training: bool = False,
+              rng=None):
+        cfg = self.cfg
+        b, s, h = x.shape
+        d = h // cfg.num_heads
+        states: dict = {}
+        qkv = run_child(self.qkv, "qkv", variables, states, x, training=training)
+        qkv = qkv.reshape(b, s, 3, cfg.num_heads, d).transpose(2, 0, 3, 1, 4)
+        att = ops.dot_product_attention(qkv[0], qkv[1], qkv[2], mask=mask)
+        att = att.transpose(0, 2, 1, 3).reshape(b, s, h)
+        att = run_child(self.attn_out, "attn_out", variables, states, att,
+                        training=training)
+        att = run_child(self.drop, "drop", variables, states, att,
+                        training=training, rng=rng)
+        x = run_child(self.attn_ln, "attn_ln", variables, states, x + att,
+                      training=training)
+        y = run_child(self.fc, "fc", variables, states, x, training=training)
+        y = ops.gelu(y)
+        y = run_child(self.fc_out, "fc_out", variables, states, y,
+                      training=training)
+        return run_child(self.out_ln, "out_ln", variables, states, x + y,
+                         training=training), states
+
+
+class Bert(Module):
+    """Returns MLM logits [B, S, vocab] (decoder tied to token embeddings).
+
+    ``batch``: {"tokens": [B,S], "segment_ids": [B,S], "padding_mask": [B,S]
+    bool, "labels": [B,S] with -100 at unmasked positions}.
+    """
+
+    def __init__(self, cfg: BertConfig = BertConfig(),
+                 policy: Policy = DEFAULT_POLICY):
+        self.cfg = cfg
+        self.policy = policy
+        h = cfg.hidden_size
+        self.tok_emb = nn.Embedding(cfg.vocab_size, h, policy=policy)
+        self.pos_emb = nn.Embedding(cfg.max_positions, h,
+                                    embedding_init=init_lib.normal(0.02),
+                                    policy=policy)
+        self.type_emb = nn.Embedding(cfg.type_vocab_size, h, policy=policy)
+        self.emb_ln = nn.LayerNorm(h, policy=policy)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.layers = [EncoderLayer(cfg, policy) for _ in range(cfg.num_layers)]
+        # MLM head: transform + LN, decoder tied to tok_emb with a free bias.
+        self.mlm_dense = nn.Linear(h, h, kernel_init=init_lib.normal(0.02),
+                                   policy=policy)
+        self.mlm_ln = nn.LayerNorm(h, policy=policy)
+
+    def init(self, rng: jax.Array) -> Variables:
+        v = super().init(rng)
+        v["params"]["mlm_bias"] = jnp.zeros((self.cfg.vocab_size,),
+                                            self.policy.param_dtype)
+        return v
+
+    def apply(self, variables: Variables, batch, training: bool = False, rng=None):
+        tokens = batch["tokens"]
+        segment_ids = batch.get("segment_ids")
+        padding_mask = batch.get("padding_mask")
+        states: dict = {}
+        s = tokens.shape[1]
+        if s > self.cfg.max_positions:
+            # Without this, the position-embedding gather silently clamps.
+            raise ValueError(
+                f"sequence length {s} exceeds max_positions "
+                f"{self.cfg.max_positions}")
+        pos = jnp.arange(s)[None, :]
+        x = run_child(self.tok_emb, "tok_emb", variables, states, tokens,
+                      training=training)
+        x = x + run_child(self.pos_emb, "pos_emb", variables, states, pos,
+                          training=training)
+        if segment_ids is not None:
+            x = x + run_child(self.type_emb, "type_emb", variables, states,
+                              segment_ids, training=training)
+        x = run_child(self.emb_ln, "emb_ln", variables, states, x,
+                      training=training)
+        x = run_child(self.drop, "drop", variables, states, x,
+                      training=training, rng=rng)
+        mask = (ops.make_attention_mask(padding_mask)
+                if padding_mask is not None else None)
+        for i, layer in enumerate(self.layers):
+            x = run_child(layer, f"layers{i}", variables, states, x,
+                          mask=mask, training=training, rng=rng)
+        y = run_child(self.mlm_dense, "mlm_dense", variables, states, x,
+                      training=training)
+        y = ops.gelu(y)
+        y = run_child(self.mlm_ln, "mlm_ln", variables, states, y,
+                      training=training)
+        logits = self.tok_emb.attend(child_vars(variables, "tok_emb"), y)
+        logits = logits + self.policy.cast_to_compute(
+            variables["params"]["mlm_bias"])
+        return jnp.asarray(logits, jnp.float32), states
+
+
+def bert_base(policy: Policy | None = None, **overrides) -> Bert:
+    cfg = BertConfig(**overrides)
+    return Bert(cfg, policy=policy or bf16_policy())
+
+
+def mlm_loss(logits, batch):
+    return ops.softmax_cross_entropy_with_integer_labels(
+        logits, batch["labels"], ignore_index=-100)

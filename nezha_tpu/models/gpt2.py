@@ -1,0 +1,171 @@
+"""GPT-2 (124M default) — benchmark config 3 (SURVEY.md §0: "GPT-2 124M —
+GEMM-heavy transformer; exercises bf16").
+
+TPU-first: pre-LN blocks whose QKV/proj/MLP matmuls are large bf16 GEMMs on
+the MXU; attention softmax accumulates fp32; weights tied between the token
+embedding and the LM head; causal mask built once per forward (static
+shapes). Sequence parallelism hooks: ``attn_impl='ring'``/``'ulysses'``
+switch attention to `nezha_tpu.parallel` collectives for long context
+(call inside shard_map with the ``sp`` axis).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from nezha_tpu import nn, ops
+from nezha_tpu.nn import initializers as init_lib
+from nezha_tpu.nn.module import Module, Variables, child_rng, child_vars, run_child
+from nezha_tpu.tensor.policy import DEFAULT_POLICY, Policy, bf16_policy
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    max_positions: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    hidden_size: int = 768
+    mlp_ratio: int = 4
+    dropout: float = 0.0  # 0 for throughput benchmarking; 0.1 for GPT-2 paper
+    attn_impl: str = "xla"  # "xla" | "ring" | "ulysses"
+    sp_axis: str = "sp"
+
+
+class Attention(Module):
+    def __init__(self, cfg: GPT2Config, policy: Policy):
+        h = cfg.hidden_size
+        self.cfg = cfg
+        self.qkv = nn.Linear(h, 3 * h, kernel_init=init_lib.normal(0.02),
+                             policy=policy)
+        self.proj = nn.Linear(
+            h, h, kernel_init=init_lib.normal(0.02 / (2 * cfg.num_layers) ** 0.5),
+            policy=policy)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def apply(self, variables: Variables, x, training: bool = False, rng=None):
+        cfg = self.cfg
+        b, s, h = x.shape
+        d = h // cfg.num_heads
+        states: dict = {}
+        qkv = run_child(self.qkv, "qkv", variables, states, x, training=training)
+        qkv = qkv.reshape(b, s, 3, cfg.num_heads, d).transpose(2, 0, 3, 1, 4)
+        q, k, v = qkv[0], qkv[1], qkv[2]  # each [B, H, S, D]
+
+        if cfg.attn_impl == "ring":
+            from nezha_tpu.parallel.ring import ring_attention
+            out = ring_attention(q, k, v, cfg.sp_axis, causal=True)
+        elif cfg.attn_impl == "ulysses":
+            from nezha_tpu.parallel.sequence_parallel import ulysses_attention
+            out = ulysses_attention(q, k, v, cfg.sp_axis, causal=True)
+        else:
+            mask = ops.causal_mask(s, s)
+            out = ops.dot_product_attention(q, k, v, mask=mask)
+
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, h)
+        out = run_child(self.proj, "proj", variables, states, out,
+                        training=training)
+        out = run_child(self.drop, "drop", variables, states, out,
+                        training=training, rng=rng)
+        return out, states
+
+
+class MLPBlock(Module):
+    def __init__(self, cfg: GPT2Config, policy: Policy):
+        h, m = cfg.hidden_size, cfg.hidden_size * cfg.mlp_ratio
+        self.fc = nn.Linear(h, m, kernel_init=init_lib.normal(0.02),
+                            policy=policy)
+        self.proj = nn.Linear(
+            m, h, kernel_init=init_lib.normal(0.02 / (2 * cfg.num_layers) ** 0.5),
+            policy=policy)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def apply(self, variables: Variables, x, training: bool = False, rng=None):
+        states: dict = {}
+        x = run_child(self.fc, "fc", variables, states, x, training=training)
+        x = ops.gelu(x)
+        x = run_child(self.proj, "proj", variables, states, x, training=training)
+        x = run_child(self.drop, "drop", variables, states, x,
+                      training=training, rng=rng)
+        return x, states
+
+
+class Block(Module):
+    def __init__(self, cfg: GPT2Config, policy: Policy):
+        h = cfg.hidden_size
+        self.ln_1 = nn.LayerNorm(h, policy=policy)
+        self.attn = Attention(cfg, policy)
+        self.ln_2 = nn.LayerNorm(h, policy=policy)
+        self.mlp = MLPBlock(cfg, policy)
+
+    def apply(self, variables: Variables, x, training: bool = False, rng=None):
+        states: dict = {}
+        y = run_child(self.ln_1, "ln_1", variables, states, x, training=training)
+        y = run_child(self.attn, "attn", variables, states, y,
+                      training=training, rng=rng)
+        x = x + y
+        y = run_child(self.ln_2, "ln_2", variables, states, x, training=training)
+        y = run_child(self.mlp, "mlp", variables, states, y,
+                      training=training, rng=rng)
+        return x + y, states
+
+
+class GPT2(Module):
+    """Returns LM logits [B, S, vocab]; weight-tied head.
+
+    ``batch`` may be {"tokens": [B, S+1]} (inputs are tokens[:, :-1] — the
+    LM-loss convention used by `lm_loss`) or a raw [B, S] int array.
+    """
+
+    def __init__(self, cfg: GPT2Config = GPT2Config(),
+                 policy: Policy = DEFAULT_POLICY):
+        self.cfg = cfg
+        self.policy = policy
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size, policy=policy)
+        self.wpe = nn.Embedding(cfg.max_positions, cfg.hidden_size,
+                                embedding_init=init_lib.normal(0.01),
+                                policy=policy)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.h = [Block(cfg, policy) for _ in range(cfg.num_layers)]
+        self.ln_f = nn.LayerNorm(cfg.hidden_size, policy=policy)
+
+    def apply(self, variables: Variables, batch, training: bool = False, rng=None):
+        if isinstance(batch, dict):
+            tokens = batch["tokens"][:, :-1]
+        else:
+            tokens = batch
+        states: dict = {}
+        s = tokens.shape[1]
+        if s > self.cfg.max_positions:
+            # Without this, the position-embedding gather silently clamps.
+            raise ValueError(
+                f"sequence length {s} exceeds max_positions "
+                f"{self.cfg.max_positions}")
+        pos = jnp.arange(s)[None, :]
+        x = run_child(self.wte, "wte", variables, states, tokens,
+                      training=training)
+        x = x + run_child(self.wpe, "wpe", variables, states, pos,
+                          training=training)
+        x = run_child(self.drop, "drop", variables, states, x,
+                      training=training, rng=rng)
+        for i, block in enumerate(self.h):
+            x = run_child(block, f"h{i}", variables, states, x,
+                          training=training, rng=rng)
+        x = run_child(self.ln_f, "ln_f", variables, states, x,
+                      training=training)
+        logits = self.wte.attend(child_vars(variables, "wte"), x)
+        return jnp.asarray(logits, jnp.float32), states
+
+
+def gpt2_124m(policy: Policy | None = None, **overrides) -> GPT2:
+    cfg = GPT2Config(**overrides)
+    return GPT2(cfg, policy=policy or bf16_policy())
+
+
+def lm_loss(logits, batch):
+    """Next-token CE over {"tokens": [B, S+1]} batches."""
+    targets = batch["tokens"][:, 1:]
+    return ops.softmax_cross_entropy_with_integer_labels(logits, targets)

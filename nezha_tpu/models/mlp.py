@@ -1,0 +1,43 @@
+"""3-layer MLP — benchmark config 1 (SURVEY.md §0: "3-layer MLP on MNIST,
+single-process CPU path"). Smallest end-to-end slice of the framework."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+
+from nezha_tpu import nn, ops
+from nezha_tpu.nn.module import Module, Variables, child_rng, child_vars, make_variables
+from nezha_tpu.tensor.policy import DEFAULT_POLICY, Policy
+
+
+class MLP(Module):
+    def __init__(self, in_features: int = 784,
+                 hidden: Sequence[int] = (256, 256),
+                 num_classes: int = 10,
+                 policy: Policy = DEFAULT_POLICY):
+        dims = [in_features, *hidden]
+        self.layers = [
+            nn.Linear(dims[i], dims[i + 1], policy=policy, name=f"fc{i}")
+            for i in range(len(dims) - 1)
+        ]
+        self.head = nn.Linear(dims[-1], num_classes, policy=policy, name="head")
+        self.policy = policy
+
+    def init(self, rng: jax.Array) -> Variables:
+        params = {}
+        for i, layer in enumerate(self.layers):
+            params[f"fc{i}"] = layer.init(child_rng(rng, f"fc{i}"))["params"]
+        params["head"] = self.head.init(child_rng(rng, "head"))["params"]
+        return make_variables(params)
+
+    def apply(self, variables: Variables, batch, training: bool = False, rng=None):
+        del rng
+        x = batch["image"] if isinstance(batch, dict) else batch
+        x = x.reshape(x.shape[0], -1)
+        for i, layer in enumerate(self.layers):
+            x, _ = layer.apply(child_vars(variables, f"fc{i}"), x, training=training)
+            x = ops.relu(x)
+        x, _ = self.head.apply(child_vars(variables, "head"), x, training=training)
+        return x, {}

@@ -1,0 +1,124 @@
+"""ResNet family: ResNet-50 and Wide-ResNet-101-2.
+
+Benchmark configs 2 and 5 (SURVEY.md §0: "ResNet-50 / ImageNet
+data-parallel, all-reduce" and "Wide-ResNet-101, large-batch mixed
+bf16/fp32"). TPU-first choices: NHWC layout throughout (XLA:TPU's native
+conv layout), BatchNorm stats in fp32 under the bf16 policy, zero-init of
+each block's last BN scale (standard large-batch trick), and a single
+residual topology XLA fuses aggressively.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from nezha_tpu import nn
+from nezha_tpu.nn import initializers as init_lib
+from nezha_tpu.nn.module import Module, Variables, child_rng, child_vars, run_child
+from nezha_tpu.tensor.policy import DEFAULT_POLICY, Policy
+
+
+class Bottleneck(Module):
+    """1x1 -> 3x3 (stride) -> 1x1 with projection shortcut when needed."""
+
+    def __init__(self, in_ch: int, width: int, out_ch: int, stride: int,
+                 policy: Policy = DEFAULT_POLICY):
+        self.conv1 = nn.Conv2d(in_ch, width, 1, use_bias=False, policy=policy)
+        self.bn1 = nn.BatchNorm(width, policy=policy)
+        self.conv2 = nn.Conv2d(width, width, 3, stride=stride, use_bias=False,
+                               policy=policy)
+        self.bn2 = nn.BatchNorm(width, policy=policy)
+        self.conv3 = nn.Conv2d(width, out_ch, 1, use_bias=False, policy=policy)
+        self.bn3 = nn.BatchNorm(out_ch, policy=policy)
+        self.needs_proj = (in_ch != out_ch) or (stride != 1)
+        if self.needs_proj:
+            self.proj = nn.Conv2d(in_ch, out_ch, 1, stride=stride,
+                                  use_bias=False, policy=policy)
+            self.proj_bn = nn.BatchNorm(out_ch, policy=policy)
+
+    def init(self, rng: jax.Array) -> Variables:
+        v = super().init(rng)
+        # Zero-init the last BN scale so each block starts as identity —
+        # improves large-batch trainability (used by the WRN-101 config).
+        v["params"]["bn3"]["scale"] = jnp.zeros_like(v["params"]["bn3"]["scale"])
+        return v
+
+    def apply(self, variables: Variables, x, training: bool = False, rng=None):
+        states: dict = {}
+        y = run_child(self.conv1, "conv1", variables, states, x, training=training)
+        y = run_child(self.bn1, "bn1", variables, states, y, training=training)
+        y = jnp.maximum(y, 0)
+        y = run_child(self.conv2, "conv2", variables, states, y, training=training)
+        y = run_child(self.bn2, "bn2", variables, states, y, training=training)
+        y = jnp.maximum(y, 0)
+        y = run_child(self.conv3, "conv3", variables, states, y, training=training)
+        y = run_child(self.bn3, "bn3", variables, states, y, training=training)
+        if self.needs_proj:
+            sc = run_child(self.proj, "proj", variables, states, x, training=training)
+            sc = run_child(self.proj_bn, "proj_bn", variables, states, sc,
+                           training=training)
+        else:
+            sc = x
+        return jnp.maximum(y + sc, 0), states
+
+
+class ResNet(Module):
+    """Generic bottleneck ResNet over NHWC images.
+
+    ``width_factor=2`` gives the Wide-ResNet variants (inner bottleneck
+    width doubled, output channels unchanged).
+    """
+
+    def __init__(self, stage_sizes: Sequence[int], num_classes: int = 1000,
+                 width_factor: int = 1, in_channels: int = 3,
+                 policy: Policy = DEFAULT_POLICY):
+        self.stage_sizes = tuple(stage_sizes)
+        self.policy = policy
+        self.stem_conv = nn.Conv2d(in_channels, 64, 7, stride=2,
+                                   use_bias=False, policy=policy)
+        self.stem_bn = nn.BatchNorm(64, policy=policy)
+
+        self.blocks = []
+        in_ch = 64
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            base = 64 * (2 ** stage)
+            width = base * width_factor
+            out_ch = base * 4
+            for b in range(n_blocks):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                self.blocks.append(
+                    Bottleneck(in_ch, width, out_ch, stride, policy=policy))
+                in_ch = out_ch
+        self.head = nn.Linear(in_ch, num_classes,
+                              kernel_init=init_lib.zeros, policy=policy)
+
+    def apply(self, variables: Variables, batch, training: bool = False, rng=None):
+        x = batch["image"] if isinstance(batch, dict) else batch
+        states: dict = {}
+        x = run_child(self.stem_conv, "stem_conv", variables, states, x,
+                      training=training)
+        x = run_child(self.stem_bn, "stem_bn", variables, states, x,
+                      training=training)
+        x = jnp.maximum(x, 0)
+        x = nn.max_pool(x, 3, 2, "SAME")
+        for i, block in enumerate(self.blocks):
+            x = run_child(block, f"blocks{i}", variables, states, x,
+                          training=training)
+        x = nn.global_avg_pool(x)
+        logits = run_child(self.head, "head", variables, states, x,
+                           training=training)
+        return jnp.asarray(logits, jnp.float32), states
+
+
+def resnet50(num_classes: int = 1000, policy: Policy = DEFAULT_POLICY) -> ResNet:
+    return ResNet((3, 4, 6, 3), num_classes=num_classes, policy=policy)
+
+
+def wide_resnet101(num_classes: int = 1000,
+                   policy: Policy = DEFAULT_POLICY) -> ResNet:
+    """Wide-ResNet-101-2 (bottleneck width x2) — benchmark config 5."""
+    return ResNet((3, 4, 23, 3), num_classes=num_classes, width_factor=2,
+                  policy=policy)

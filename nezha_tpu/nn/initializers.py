@@ -1,0 +1,66 @@
+"""Parameter initializers (fan-aware), mirroring what the reference's model
+builders need (SURVEY.md §2 models: MLP, ResNets, GPT-2, BERT)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def zeros(rng, shape, dtype=jnp.float32):
+    del rng
+    return jnp.zeros(shape, dtype)
+
+
+def ones(rng, shape, dtype=jnp.float32):
+    del rng
+    return jnp.ones(shape, dtype)
+
+
+def normal(stddev: float = 0.02):
+    def init(rng, shape, dtype=jnp.float32):
+        return (jax.random.normal(rng, shape) * stddev).astype(dtype)
+    return init
+
+
+def truncated_normal(stddev: float = 0.02):
+    def init(rng, shape, dtype=jnp.float32):
+        return (jax.random.truncated_normal(rng, -2.0, 2.0, shape) * stddev).astype(dtype)
+    return init
+
+
+def _fans(shape):
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:  # (in, out) linear
+        return shape[0], shape[1]
+    # conv HWIO: receptive field * channels
+    receptive = math.prod(shape[:-2])
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+def he_normal():
+    """Kaiming/He normal — standard for ReLU nets (ResNets)."""
+    def init(rng, shape, dtype=jnp.float32):
+        fan_in, _ = _fans(shape)
+        std = math.sqrt(2.0 / fan_in)
+        return (jax.random.normal(rng, shape) * std).astype(dtype)
+    return init
+
+
+def lecun_normal():
+    def init(rng, shape, dtype=jnp.float32):
+        fan_in, _ = _fans(shape)
+        std = math.sqrt(1.0 / fan_in)
+        return (jax.random.normal(rng, shape) * std).astype(dtype)
+    return init
+
+
+def xavier_uniform():
+    def init(rng, shape, dtype=jnp.float32):
+        fan_in, fan_out = _fans(shape)
+        limit = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, minval=-limit, maxval=limit).astype(dtype)
+    return init

@@ -1,0 +1,155 @@
+"""Minimal functional module system.
+
+This is the framework's parameter-management layer — the TPU-native
+counterpart of the reference's op-graph/parameter handling (SURVEY.md §1
+"Op graph & autograd"). Design principles, chosen for XLA:
+
+- **Purely functional**: a ``Module`` holds only hyperparameters. Trainable
+  parameters and mutable state (e.g. BatchNorm running stats) live in plain
+  pytrees passed in and out of ``apply``. Autograd is ``jax.grad`` over the
+  pure apply function; the traced jaxpr IS the op graph XLA compiles.
+- **No tracing magic**: composition is explicit dicts keyed by child name, so
+  parameter pytrees are stable, inspectable, and shardable with
+  ``jax.sharding`` partition specs by path.
+- **Static hyperparameters**: module config never enters jit, so every apply
+  traces to a static-shape XLA program.
+
+Variables layout::
+
+    variables = {"params": <pytree>, "state": <pytree>}
+    out, new_state = module.apply(variables, x, training=True, rng=rng)
+
+Stateless modules return ``{}`` for ``new_state``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+
+Params = Any
+State = Any
+Variables = Dict[str, Any]
+
+
+def make_variables(params: Params = None, state: State = None) -> Variables:
+    return {"params": {} if params is None else params,
+            "state": {} if state is None else state}
+
+
+def child_vars(variables: Variables, name: str) -> Variables:
+    """Slice the variables of a named child module out of a parent's."""
+    return {
+        "params": variables.get("params", {}).get(name, {}),
+        "state": variables.get("state", {}).get(name, {}),
+    }
+
+
+def child_rng(rng: Optional[jax.Array], name: str) -> Optional[jax.Array]:
+    """Deterministically derive a child RNG from a parent's by child name."""
+    if rng is None:
+        return None
+    return jax.random.fold_in(rng, _stable_hash(name))
+
+
+def _stable_hash(name: str) -> int:
+    # Python's hash() is salted per-process; use a stable FNV-1a instead so
+    # RNG derivation is reproducible across runs and hosts.
+    h = 0x811C9DC5
+    for b in name.encode():
+        h = ((h ^ b) * 0x01000193) & 0xFFFFFFFF
+    return h
+
+
+class Module:
+    """Base class. Subclasses implement ``init`` and ``apply``.
+
+    Composite modules get a default ``init`` for free: it collects every
+    attribute that is a Module (or list/tuple of Modules, named ``attr{i}``)
+    and initializes each under its attribute name. ``apply`` stays explicit —
+    dataflow is the model's logic.
+    """
+
+    def _children(self) -> Dict[str, "Module"]:
+        out: Dict[str, Module] = {}
+        for k, v in vars(self).items():
+            if isinstance(v, Module):
+                out[k] = v
+            elif isinstance(v, (list, tuple)):
+                for i, m in enumerate(v):
+                    if isinstance(m, Module):
+                        out[f"{k}{i}"] = m
+        return out
+
+    def init(self, rng: jax.Array) -> Variables:
+        children = self._children()
+        if not children:
+            raise NotImplementedError(
+                f"{type(self).__name__} has no child modules; implement init()")
+        params, state = {}, {}
+        for name, child in children.items():
+            v = child.init(child_rng(rng, name))
+            if v["params"]:
+                params[name] = v["params"]
+            if v["state"]:
+                state[name] = v["state"]
+        return make_variables(params, state)
+
+    def apply(self, variables: Variables, *args, training: bool = False,
+              rng: Optional[jax.Array] = None, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, variables: Variables, *args, **kwargs):
+        return self.apply(variables, *args, **kwargs)
+
+    # -- conveniences -----------------------------------------------------
+
+    def init_params(self, rng: jax.Array) -> Params:
+        return self.init(rng)["params"]
+
+    def param_count(self, rng_or_vars) -> int:
+        if isinstance(rng_or_vars, dict):
+            variables = rng_or_vars
+        else:
+            variables = self.init(rng_or_vars)
+        return sum(x.size for x in jax.tree_util.tree_leaves(variables["params"]))
+
+
+def run_child(child: Module, name: str, variables: Variables, states: Dict,
+              *args, training: bool = False, rng: Optional[jax.Array] = None,
+              **kwargs):
+    """Apply a named child, recording its state update into ``states``."""
+    out, st = child.apply(child_vars(variables, name), *args,
+                          training=training, rng=child_rng(rng, name), **kwargs)
+    if st:
+        states[name] = st
+    return out
+
+
+class Sequential(Module):
+    """Chain of modules applied in order. Children are named ``"0"``, ``"1"``, …"""
+
+    def __init__(self, layers: Sequence[Module]):
+        self.layers = list(layers)
+
+    def init(self, rng: jax.Array) -> Variables:
+        params, state = {}, {}
+        for i, layer in enumerate(self.layers):
+            v = layer.init(child_rng(rng, str(i)))
+            if v["params"]:
+                params[str(i)] = v["params"]
+            if v["state"]:
+                state[str(i)] = v["state"]
+        return make_variables(params, state)
+
+    def apply(self, variables: Variables, x, training: bool = False,
+              rng: Optional[jax.Array] = None):
+        new_state: Dict[str, Any] = {}
+        for i, layer in enumerate(self.layers):
+            name = str(i)
+            x, st = layer.apply(child_vars(variables, name), x,
+                                training=training, rng=child_rng(rng, name))
+            if st:
+                new_state[name] = st
+        return x, new_state

@@ -1,0 +1,28 @@
+"""Functional op library.
+
+The TPU-native analogue of the reference's kernel layer (`pkg/cuda`:
+cuBLAS/cuDNN GEMM+conv plus custom elementwise/softmax/layernorm kernels —
+SURVEY.md §2). Here the "kernels" are jax.numpy/lax compositions XLA fuses
+onto MXU/VPU, with Pallas TPU kernels for the hot fused ops in
+`nezha_tpu.ops.pallas`.
+"""
+
+from nezha_tpu.ops.activations import relu, gelu, silu, softmax, log_softmax
+from nezha_tpu.ops.losses import (
+    cross_entropy_with_logits,
+    softmax_cross_entropy_with_integer_labels,
+    mse_loss,
+    accuracy,
+)
+from nezha_tpu.ops.attention import (
+    dot_product_attention,
+    causal_mask,
+    make_attention_mask,
+)
+
+__all__ = [
+    "relu", "gelu", "silu", "softmax", "log_softmax",
+    "cross_entropy_with_logits", "softmax_cross_entropy_with_integer_labels",
+    "mse_loss", "accuracy",
+    "dot_product_attention", "causal_mask", "make_attention_mask",
+]

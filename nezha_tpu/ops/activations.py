@@ -1,0 +1,41 @@
+"""Elementwise activations and numerically-stable softmax.
+
+These map to VPU ops and fuse into neighbouring MXU ops under XLA; no
+hand-scheduling needed (SURVEY.md §2: the reference's custom CUDA
+elementwise/softmax kernels).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def relu(x):
+    return jnp.maximum(x, 0)
+
+
+def gelu(x, approximate: bool = True):
+    """GPT-2/BERT use the tanh approximation."""
+    if approximate:
+        c = math.sqrt(2.0 / math.pi)
+        return 0.5 * x * (1.0 + jnp.tanh(c * (x + 0.044715 * x * x * x)))
+    return 0.5 * x * (1.0 + lax.erf(x / math.sqrt(2.0)))
+
+
+def silu(x):
+    return x * lax.logistic(x)
+
+
+def softmax(x, axis: int = -1):
+    x_max = jnp.max(x, axis=axis, keepdims=True)
+    unnorm = jnp.exp(x - lax.stop_gradient(x_max))
+    return unnorm / jnp.sum(unnorm, axis=axis, keepdims=True)
+
+
+def log_softmax(x, axis: int = -1):
+    x_max = jnp.max(x, axis=axis, keepdims=True)
+    shifted = x - lax.stop_gradient(x_max)
+    return shifted - jnp.log(jnp.sum(jnp.exp(shifted), axis=axis, keepdims=True))

@@ -1,0 +1,41 @@
+"""Scaled dot-product attention (reference composition).
+
+The reference composes attention out of graph ops (SURVEY.md §1: "attention-
+as-composed-ops"); here the baseline path is einsum+softmax that XLA fuses
+on the MXU. A Pallas flash-attention kernel (`nezha_tpu.ops.pallas`) serves
+as the fused production path on TPU where available. Softmax accumulates in
+fp32 regardless of compute dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def causal_mask(q_len: int, kv_len: int, dtype=jnp.float32):
+    """Additive mask: 0 where attendable, -inf above the diagonal."""
+    i = jnp.arange(q_len)[:, None]
+    j = jnp.arange(kv_len)[None, :]
+    offset = kv_len - q_len  # supports q being a suffix of kv (decoding)
+    return jnp.where(j <= i + offset, 0.0, -jnp.inf).astype(dtype)
+
+
+def make_attention_mask(padding_mask):
+    """[B, S] boolean (True = real token) -> [B, 1, 1, S] additive mask."""
+    m = jnp.where(padding_mask, 0.0, -jnp.inf).astype(jnp.float32)
+    return m[:, None, None, :]
+
+
+def dot_product_attention(q, k, v, mask: Optional[jnp.ndarray] = None,
+                          scale: Optional[float] = None):
+    """q,k,v: [B, H, S, D]. ``mask`` additive, broadcastable to [B,H,Sq,Sk]."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        scores = scores + mask
+    weights = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+    weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v.dtype), v)

@@ -1,0 +1,38 @@
+"""Losses and metrics. Loss math always in fp32 even under a bf16 policy."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from nezha_tpu.ops.activations import log_softmax
+
+
+def cross_entropy_with_logits(logits, labels_onehot):
+    """Mean CE; ``labels_onehot`` may be soft (label smoothing)."""
+    logits = jnp.asarray(logits, jnp.float32)
+    logp = log_softmax(logits)
+    return -jnp.mean(jnp.sum(labels_onehot * logp, axis=-1))
+
+
+def softmax_cross_entropy_with_integer_labels(logits, labels, ignore_index: int | None = None):
+    """Mean CE over integer labels; positions equal to ``ignore_index`` are
+    masked out (BERT MLM uses this for unmasked positions)."""
+    logits = jnp.asarray(logits, jnp.float32)
+    logp = log_softmax(logits)
+    safe_labels = jnp.where(labels == (ignore_index if ignore_index is not None else -1),
+                            0, labels)
+    picked = jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    if ignore_index is None:
+        return -jnp.mean(picked)
+    mask = (labels != ignore_index).astype(jnp.float32)
+    return -jnp.sum(picked * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def mse_loss(pred, target):
+    pred = jnp.asarray(pred, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    return jnp.mean((pred - target) ** 2)
+
+
+def accuracy(logits, labels):
+    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))

@@ -1,0 +1,16 @@
+"""Pallas TPU kernels for the hot fused ops.
+
+The reference's equivalent layer is its custom CUDA kernels (softmax,
+layernorm, fused elementwise — SURVEY.md §2 `pkg/cuda`). Here the hot ops
+are Mosaic/Pallas kernels tiled for MXU/VPU and VMEM:
+
+- `flash_attention`: blockwise attention, online softmax, O(S) memory.
+- `fused_layer_norm`: single-pass normalization on VMEM rows.
+
+All kernels run in interpret mode on CPU (tests) and compile on TPU.
+"""
+
+from nezha_tpu.ops.pallas.flash_attention import flash_attention
+from nezha_tpu.ops.pallas.layer_norm import fused_layer_norm
+
+__all__ = ["flash_attention", "fused_layer_norm"]

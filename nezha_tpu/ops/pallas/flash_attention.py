@@ -1,0 +1,163 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Blockwise attention with online softmax: grid = (B, H, Q-blocks, K-blocks)
+with the K dimension sequential ("arbitrary" semantics), VMEM scratch
+carrying the running max/denominator/accumulator across K blocks, and causal
+blocks skipped entirely before the diagonal. Q·Kᵀ and P·V hit the MXU in
+fp32 accumulation; memory per program is O(block_q · block_k), never the
+full S×S score matrix. (Reference composes attention from graph ops —
+SURVEY.md §1; this is the TPU-fused production path.)
+
+Backward: `jax.custom_vjp` with a recompute-based backward (standard
+composed-op attention under `jax.vjp`). That keeps training numerically
+exact; a fused backward kernel is a further optimization, the forward is
+where inference/serving wins land.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_BIG = -1e30
+
+
+def _pick_block(size: int, target: int) -> int:
+    """Largest divisor of ``size`` that is <= target (block shapes must tile
+    the sequence exactly)."""
+    b = min(size, target)
+    while size % b:
+        b -= 1
+    return b
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  scale: float, causal: bool, block_q: int, block_k: int):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_BIG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: skip blocks strictly above the diagonal.
+    run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[0, 0].astype(jnp.float32) * scale          # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)                  # [bk, d]
+        v = v_ref[0, 0].astype(jnp.float32)                  # [bk, d]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            qpos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, _NEG_BIG)
+
+        m_prev = m_scr[:, :1]                                # [bq, 1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                               # [bq, bk]
+        corr = jnp.exp(m_prev - m_new)                       # [bq, 1]
+        l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == pl.num_programs(3) - 1)
+    def _finalize():
+        denom = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal: bool = True,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """q, k, v: [B, H, S, D] -> [B, H, S, D].
+
+    ``interpret=None`` auto-selects: compiled on TPU backends, interpreter
+    elsewhere (so CPU tests run the same kernel code).
+    """
+    return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)[0]
+
+
+def _flash_call(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    bq = _pick_block(s_q, block_q)
+    bk = _pick_block(s_k, block_k)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    grid = (b, h, s_q // bq, s_k // bk)
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               block_q=bq, block_k=bk)
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"))
+    scratch = [pltpu.VMEM((bq, 128), jnp.float32),
+               pltpu.VMEM((bq, 128), jnp.float32),
+               pltpu.VMEM((bq, d), jnp.float32)]
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=scratch,
+        interpret=interpret,
+        **kwargs,
+    )(q, k, v)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_call(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _reference_attention(q, k, v, causal, scale):
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        s_q, s_k = s.shape[-2], s.shape[-1]
+        i = jnp.arange(s_q)[:, None]
+        j = jnp.arange(s_k)[None, :]
+        s = jnp.where(j <= i + (s_k - s_q), s, _NEG_BIG)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w.astype(v.dtype), v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _reference_attention(q_, k_, v_, causal, scale),
+        q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -1,0 +1,57 @@
+"""Fused LayerNorm as a Pallas kernel: one VMEM pass computes statistics and
+applies scale/shift (the reference fused this in a custom CUDA kernel —
+SURVEY.md §2). Rows are tiled over the grid; statistics in fp32."""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _ln_kernel(x_ref, scale_ref, bias_ref, o_ref, *, eps: float):
+    x = x_ref[:].astype(jnp.float32)                      # [bn, D]
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    y = y * scale_ref[:].astype(jnp.float32) + bias_ref[:].astype(jnp.float32)
+    o_ref[:] = y.astype(o_ref.dtype)
+
+
+def _pick_block(size: int, target: int) -> int:
+    b = min(size, target)
+    while size % b:
+        b -= 1
+    return b
+
+
+def fused_layer_norm(x, scale, bias, eps: float = 1e-5,
+                     interpret: Optional[bool] = None):
+    """x: [..., D]; scale, bias: [D]. Returns layernorm(x) in x.dtype."""
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    rows = 1
+    for dim in orig_shape[:-1]:
+        rows *= dim
+    x2 = x.reshape(rows, d)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bn = _pick_block(rows, 256)
+    out = pl.pallas_call(
+        functools.partial(_ln_kernel, eps=eps),
+        grid=(rows // bn,),
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bn, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
+        interpret=interpret,
+    )(x2, scale.reshape(1, d), bias.reshape(1, d))
+    return out.reshape(orig_shape)

@@ -1,0 +1,32 @@
+"""Optimizers and LR schedules.
+
+The reference updates weights inside its op graph with optimizer kernels
+(SURVEY.md §2: custom CUDA optimizer kernels). Here optimizers are pure
+pytree transforms — (grads, state, params) -> (updates, state) — which jit
+into the training step so XLA fuses the whole update. The ZeRO-1 sharded
+variant lives in `nezha_tpu.parallel.zero1` and wraps any optimizer here.
+"""
+
+from nezha_tpu.optim.optimizers import (
+    Optimizer,
+    sgd,
+    momentum,
+    adam,
+    adamw,
+    apply_updates,
+    global_norm,
+    clip_by_global_norm,
+)
+from nezha_tpu.optim.schedules import (
+    constant_schedule,
+    cosine_decay_schedule,
+    warmup_cosine_schedule,
+    linear_warmup_schedule,
+)
+
+__all__ = [
+    "Optimizer", "sgd", "momentum", "adam", "adamw", "apply_updates",
+    "global_norm", "clip_by_global_norm",
+    "constant_schedule", "cosine_decay_schedule", "warmup_cosine_schedule",
+    "linear_warmup_schedule",
+]

@@ -1,0 +1,153 @@
+"""Pure-pytree optimizers: SGD, momentum, Adam, AdamW.
+
+Every optimizer is ``init(params) -> state`` plus
+``update(grads, state, params) -> (updates, new_state)``; ``updates`` are
+deltas applied by ``apply_updates``. States are pytrees, so ZeRO-1 sharding
+(`nezha_tpu.parallel.zero1`) can shard them over the data-parallel axis
+unchanged. Optimizer math runs in fp32 on the master params even when the
+forward is bf16 (mixed-precision path — SURVEY.md §2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def _as_schedule(lr) -> Schedule:
+    if callable(lr):
+        return lr
+    return lambda step: jnp.asarray(lr, jnp.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class Optimizer:
+    init: Callable[[Any], Any]
+    update: Callable[[Any, Any, Any], Tuple[Any, Any]]
+
+
+def apply_updates(params, updates):
+    return jax.tree_util.tree_map(
+        lambda p, u: (p + u.astype(p.dtype)) if u is not None else p,
+        params, updates)
+
+
+def global_norm(tree) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves))
+
+
+def clip_by_global_norm(tree, max_norm: float):
+    norm = global_norm(tree)
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree_util.tree_map(lambda x: x * scale, tree), norm
+
+
+def sgd(lr) -> Optimizer:
+    sched = _as_schedule(lr)
+
+    def init(params):
+        return {"step": jnp.zeros((), jnp.int32)}
+
+    def update(grads, state, params=None):
+        del params
+        step = state["step"]
+        lr_t = sched(step)
+        updates = jax.tree_util.tree_map(
+            lambda g: -lr_t * g.astype(jnp.float32), grads)
+        return updates, {"step": step + 1}
+
+    return Optimizer(init, update)
+
+
+def momentum(lr, beta: float = 0.9, nesterov: bool = False,
+             weight_decay: float = 0.0) -> Optimizer:
+    """SGD+momentum — the classic ResNet-50/ImageNet optimizer.
+
+    ``weight_decay`` here is coupled (L2 added to the gradient), matching the
+    standard ResNet recipe.
+    """
+    sched = _as_schedule(lr)
+
+    def init(params):
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "velocity": jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p, jnp.float32), params),
+        }
+
+    def update(grads, state, params):
+        step = state["step"]
+        lr_t = sched(step)
+
+        def upd(g, v, p):
+            g = g.astype(jnp.float32)
+            if weight_decay:
+                g = g + weight_decay * p.astype(jnp.float32)
+            v_new = beta * v + g
+            d = (g + beta * v_new) if nesterov else v_new
+            return -lr_t * d, v_new
+
+        flat = jax.tree_util.tree_map(upd, grads, state["velocity"], params)
+        updates = jax.tree_util.tree_map(lambda t: t[0], flat,
+                                         is_leaf=lambda t: isinstance(t, tuple))
+        velocity = jax.tree_util.tree_map(lambda t: t[1], flat,
+                                          is_leaf=lambda t: isinstance(t, tuple))
+        return updates, {"step": step + 1, "velocity": velocity}
+
+    return Optimizer(init, update)
+
+
+def adam(lr, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8) -> Optimizer:
+    return adamw(lr, b1=b1, b2=b2, eps=eps, weight_decay=0.0)
+
+
+def adamw(lr, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+          weight_decay: float = 0.01,
+          mask: Optional[Callable[[Any], Any]] = None) -> Optimizer:
+    """AdamW (decoupled weight decay) — GPT-2/BERT optimizer.
+
+    ``mask(params)`` may return a matching pytree of bools selecting which
+    leaves get weight decay (norm scales/biases usually don't).
+    """
+    sched = _as_schedule(lr)
+
+    def init(params):
+        zeros = lambda p: jnp.zeros_like(p, jnp.float32)
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "mu": jax.tree_util.tree_map(zeros, params),
+            "nu": jax.tree_util.tree_map(zeros, params),
+        }
+
+    def update(grads, state, params):
+        step = state["step"] + 1
+        lr_t = sched(state["step"])
+        c1 = 1.0 - b1 ** step.astype(jnp.float32)
+        c2 = 1.0 - b2 ** step.astype(jnp.float32)
+        wd_mask = mask(params) if mask is not None else jax.tree_util.tree_map(
+            lambda p: True, params)
+
+        def upd(g, m, v, p, use_wd):
+            g = g.astype(jnp.float32)
+            m_new = b1 * m + (1 - b1) * g
+            v_new = b2 * v + (1 - b2) * jnp.square(g)
+            m_hat = m_new / c1
+            v_hat = v_new / c2
+            d = m_hat / (jnp.sqrt(v_hat) + eps)
+            if weight_decay:
+                d = d + jnp.where(use_wd, weight_decay, 0.0) * p.astype(jnp.float32)
+            return -lr_t * d, m_new, v_new
+
+        flat = jax.tree_util.tree_map(upd, grads, state["mu"], state["nu"],
+                                      params, wd_mask)
+        pick = lambda i: jax.tree_util.tree_map(
+            lambda t: t[i], flat, is_leaf=lambda t: isinstance(t, tuple))
+        return pick(0), {"step": step, "mu": pick(1), "nu": pick(2)}
+
+    return Optimizer(init, update)

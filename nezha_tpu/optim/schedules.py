@@ -1,0 +1,36 @@
+"""Learning-rate schedules (pure functions of the int step)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def constant_schedule(value: float):
+    return lambda step: jnp.asarray(value, jnp.float32)
+
+
+def cosine_decay_schedule(init_value: float, decay_steps: int, alpha: float = 0.0):
+    def sched(step):
+        t = jnp.clip(step.astype(jnp.float32) / decay_steps, 0.0, 1.0)
+        cosine = 0.5 * (1.0 + jnp.cos(jnp.pi * t))
+        return init_value * ((1 - alpha) * cosine + alpha)
+    return sched
+
+
+def linear_warmup_schedule(peak: float, warmup_steps: int):
+    def sched(step):
+        s = step.astype(jnp.float32)
+        return peak * jnp.minimum(1.0, (s + 1.0) / max(warmup_steps, 1))
+    return sched
+
+
+def warmup_cosine_schedule(peak: float, warmup_steps: int, total_steps: int,
+                           end_value: float = 0.0):
+    """Linear warmup then cosine decay — GPT-2/BERT standard."""
+    def sched(step):
+        s = step.astype(jnp.float32)
+        warm = peak * jnp.minimum(1.0, (s + 1.0) / max(warmup_steps, 1))
+        t = jnp.clip((s - warmup_steps) / max(total_steps - warmup_steps, 1), 0.0, 1.0)
+        cos = end_value + (peak - end_value) * 0.5 * (1.0 + jnp.cos(jnp.pi * t))
+        return jnp.where(s < warmup_steps, warm, cos)
+    return sched

@@ -1,0 +1,46 @@
+"""Parallelism layer: device meshes, XLA collectives, data parallelism,
+ZeRO-1 optimizer sharding, and sequence/ring-attention parallelism.
+
+TPU-native replacement for the reference's `pkg/nccl` cgo ring collectives
+(SURVEY.md §2: ring all-reduce/all-gather, reduce-scatter for ZeRO-1).
+Design: collectives are never hand-scheduled rings — they are XLA collective
+ops (`psum`, `psum_scatter`, `all_gather`, `ppermute`) emitted inside
+`shard_map` over a `jax.sharding.Mesh`, compiled by XLA to ride ICI.
+"""
+
+from nezha_tpu.parallel.mesh import make_mesh, make_cpu_mesh, local_mesh_axes
+from nezha_tpu.parallel.collectives import (
+    all_reduce_mean,
+    all_reduce_sum,
+    all_gather,
+    reduce_scatter,
+    ring_permute,
+    barrier,
+)
+from nezha_tpu.parallel.data_parallel import (
+    make_dp_train_step,
+    shard_batch,
+    replicate,
+    sync_batch_stats,
+)
+from nezha_tpu.parallel.zero1 import make_zero1_train_step, zero1_init_opt_state
+
+__all__ = [
+    "make_mesh", "make_cpu_mesh", "local_mesh_axes",
+    "all_reduce_mean", "all_reduce_sum", "all_gather", "reduce_scatter",
+    "ring_permute", "barrier",
+    "make_dp_train_step", "shard_batch", "replicate", "sync_batch_stats",
+    "make_zero1_train_step", "zero1_init_opt_state",
+]
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in ("ring_attention", "ring_self_attention"):
+        mod = importlib.import_module("nezha_tpu.parallel.ring")
+        return getattr(mod, name)
+    if name in ("ulysses_attention",):
+        mod = importlib.import_module("nezha_tpu.parallel.sequence_parallel")
+        return getattr(mod, name)
+    raise AttributeError(name)

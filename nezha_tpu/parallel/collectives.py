@@ -1,0 +1,79 @@
+"""Collective primitives (to be used inside shard_map over a named mesh axis).
+
+TPU-native replacement for `pkg/nccl`'s cgo ring collectives (SURVEY.md §2).
+Each wrapper emits the XLA collective HLO; XLA's collective scheduler picks
+the ring/tree algorithm and overlaps it with compute — nothing is
+hand-scheduled. Bus-bandwidth accounting helpers mirror the reference's
+"all-reduce bus bw" metric of record (BASELINE.json `metric`).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def all_reduce_sum(tree: Any, axis_name: str) -> Any:
+    return jax.tree_util.tree_map(lambda x: lax.psum(x, axis_name), tree)
+
+
+def all_reduce_mean(tree: Any, axis_name: str) -> Any:
+    return jax.tree_util.tree_map(lambda x: lax.pmean(x, axis_name), tree)
+
+
+def all_gather(tree: Any, axis_name: str, axis: int = 0, tiled: bool = True) -> Any:
+    """Gather shards along ``axis`` from every rank (concatenated if tiled)."""
+    return jax.tree_util.tree_map(
+        lambda x: lax.all_gather(x, axis_name, axis=axis, tiled=tiled), tree)
+
+
+def reduce_scatter(tree: Any, axis_name: str, axis: int = 0) -> Any:
+    """Sum-reduce then scatter shards along ``axis`` (ZeRO-1 gradient path)."""
+    return jax.tree_util.tree_map(
+        lambda x: lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True),
+        tree)
+
+
+def ring_permute(x, axis_name: str, shift: int = 1):
+    """Send to the next rank on the ring (ring attention / pipeline edges)."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name: str):
+    return lax.axis_index(axis_name)
+
+
+def barrier(mesh) -> None:
+    """Host-level device barrier: an all-reduce of one scalar per device.
+
+    The reference used its gRPC coordinator for barriers (SURVEY.md §1); on
+    TPU a trivial psum over the whole mesh is the native equivalent.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from nezha_tpu.parallel._compat import shard_map
+
+    ones = jnp.ones((mesh.devices.size,), jnp.float32)
+    axes = tuple(mesh.axis_names)
+
+    def _sum(x):
+        s = x
+        for a in axes:
+            s = lax.psum(s, a)
+        return s
+
+    out = jax.jit(shard_map(_sum, mesh=mesh, in_specs=P(axes), out_specs=P(axes)))(ones)
+    jax.block_until_ready(out)
+
+
+def allreduce_bus_bandwidth(payload_bytes: int, seconds: float, world: int) -> float:
+    """NCCL-convention bus bandwidth for ring all-reduce:
+    busBW = (bytes * 2*(n-1)/n) / time."""
+    if seconds <= 0:
+        return 0.0
+    return payload_bytes * (2.0 * (world - 1) / world) / seconds

@@ -1,0 +1,105 @@
+"""Data parallelism.
+
+TPU-native version of the reference's DP all-reduce path (SURVEY.md §3 call
+stack 2: backward -> pkg/nccl all-reduce on grads -> optimizer update):
+the whole step runs in one ``shard_map`` over the ``dp`` mesh axis, the
+gradient all-reduce is a ``lax.pmean`` XLA schedules onto ICI and overlaps
+with backward compute, and the optimizer update happens replicated.
+BatchNorm running stats are pmean-synced each step (cheap: stats are tiny).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nezha_tpu.nn.module import Module
+from nezha_tpu.optim.optimizers import Optimizer, apply_updates
+from nezha_tpu.parallel._compat import shard_map
+from nezha_tpu.train.loop import TrainState, merge_state
+
+
+def shard_batch(mesh: Mesh, batch: Any, axis: str = "dp") -> Any:
+    """Place a host batch with its leading dim sharded over ``axis`` —
+    arrays land already distributed, so no resharding inside the step."""
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def replicate(mesh: Mesh, tree: Any) -> Any:
+    sharding = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def make_dp_train_step(model: Module, optimizer: Optimizer,
+                       loss_fn: Callable[[Any, dict], Any],
+                       mesh: Mesh, axis: str = "dp", donate: bool = True):
+    """Build ``step(state, batch) -> (state, metrics)`` with the batch
+    sharded over ``axis`` and params/optimizer state replicated."""
+
+    def per_replica(state: TrainState, batch: dict):
+        variables, opt_state = state["variables"], state["opt_state"]
+        rng, next_rng = jax.random.split(state["rng"])
+        # Per-replica dropout keys; params stay replicated.
+        step_rng = jax.random.fold_in(rng, lax.axis_index(axis))
+
+        def compute_loss(params):
+            out, new_state = model.apply(
+                {"params": params, "state": variables["state"]},
+                batch, training=True, rng=step_rng)
+            return jnp.asarray(loss_fn(out, batch), jnp.float32), new_state
+
+        (loss, new_state), grads = jax.value_and_grad(
+            compute_loss, has_aux=True)(variables["params"])
+
+        # The DP collective: mean over the dp axis (reference: NCCL ring
+        # all-reduce). XLA overlaps this with the tail of backward.
+        grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, axis), grads)
+        loss = lax.pmean(loss, axis)
+        new_state = jax.tree_util.tree_map(lambda s: lax.pmean(s, axis), new_state)
+
+        updates, opt_state = optimizer.update(grads, opt_state, variables["params"])
+        params = apply_updates(variables["params"], updates)
+        new_variables = {"params": params,
+                         "state": merge_state(variables["state"], new_state)}
+        new_train_state = {"variables": new_variables, "opt_state": opt_state,
+                           "rng": next_rng}
+        return new_train_state, {"loss": loss}
+
+    def specs_like(tree, spec):
+        return jax.tree_util.tree_map(lambda _: spec, tree)
+
+    def build(state_template, batch_template):
+        state_spec = specs_like(state_template, P())
+        batch_spec = specs_like(batch_template, P(axis))
+        mapped = shard_map(per_replica, mesh=mesh,
+                           in_specs=(state_spec, batch_spec),
+                           out_specs=(state_spec, P()))
+        return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+    _cache = {}
+
+    def step(state: TrainState, batch: dict):
+        key = tuple((k, tuple(v.shape), str(v.dtype)) for k, v in sorted(
+            batch.items(), key=lambda kv: kv[0]))
+        if key not in _cache:
+            _cache[key] = build(state, batch)
+        return _cache[key](state, batch)
+
+    return step
+
+
+def sync_batch_stats(stacked_state: Any) -> Any:
+    """Average per-replica BatchNorm running stats.
+
+    For custom train steps that keep per-replica stats as pmap-style stacked
+    arrays (leading axis = replica): mean over that axis before eval. The
+    built-in DP/ZeRO-1 steps pmean running stats every step, so they never
+    need this.
+    """
+    return jax.tree_util.tree_map(
+        lambda s: jnp.mean(jnp.asarray(s, jnp.float32), axis=0), stacked_state)

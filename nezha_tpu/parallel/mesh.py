@@ -1,0 +1,57 @@
+"""Device-mesh construction.
+
+The mesh is the TPU-native replacement for the reference's NCCL communicator
+setup (SURVEY.md §1 "Collectives": communicator setup via rendezvous): axes
+are named (dp/fsdp/tp/sp), shardings are `PartitionSpec`s over those names,
+and XLA lays collectives onto ICI rings for each axis.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(axes: Dict[str, int], devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh with named axes, e.g. ``make_mesh({"dp": 4, "tp": 2})``.
+
+    An axis size of -1 means "all remaining devices". Axis order in ``axes``
+    is the device-grid order (outermost first); keep fast-collective axes
+    (tp/sp) innermost so their groups map to adjacent ICI neighbours.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    sizes = dict(axes)
+    unknown = [k for k, v in sizes.items() if v == -1]
+    known = math.prod(v for v in sizes.values() if v != -1)
+    if len(unknown) > 1:
+        raise ValueError("at most one axis may be -1")
+    if unknown:
+        if len(devs) % known:
+            raise ValueError(f"{len(devs)} devices not divisible by {known}")
+        sizes[unknown[0]] = len(devs) // known
+    total = math.prod(sizes.values())
+    if total > len(devs):
+        raise ValueError(f"mesh needs {total} devices, have {len(devs)}")
+    grid = np.array(devs[:total]).reshape(tuple(sizes.values()))
+    return Mesh(grid, tuple(sizes.keys()))
+
+
+def make_cpu_mesh(axes: Dict[str, int]) -> Mesh:
+    """Mesh over host-platform (CPU) devices — the multi-device test rig
+    (requires ``--xla_force_host_platform_device_count=N``)."""
+    cpus = [d for d in jax.devices() if d.platform == "cpu"]
+    if not cpus:
+        cpus = jax.devices("cpu")
+    return make_mesh(axes, devices=cpus)
+
+
+def local_mesh_axes(mesh: Mesh) -> Dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))

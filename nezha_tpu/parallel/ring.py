@@ -1,0 +1,89 @@
+"""Ring attention — sequence/context parallelism for long sequences.
+
+Not attested in the reference (SURVEY.md §0: only DP + ZeRO-1 observed), but
+first-class here per the build brief: long-context must scale past one chip.
+
+Design (blockwise attention on a ring, log-sum-exp stable):
+the sequence axis is sharded over mesh axis ``sp``; each rank holds its
+Q/K/V block. For ``world`` steps, every rank computes attention of its Q
+block against the K/V block it currently holds, folds the partial result
+into online-softmax accumulators, and passes the K/V block to its ring
+neighbour with ``lax.ppermute`` (XLA lowers this to ICI neighbour DMA,
+overlapped with the block matmuls). HBM per chip stays O(S/world); no rank
+ever materialises full attention scores.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_BIG = -1e30  # finite "-inf" so fully-masked rows stay NaN-free
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = True,
+                   scale: float | None = None):
+    """q,k,v: local blocks [B, H, S_local, D]; sequence sharded over
+    ``axis_name``. Returns the local output block [B, H, S_local, D].
+    Must be called inside shard_map with ``axis_name`` a mesh axis.
+    """
+    world = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    q32 = q.astype(jnp.float32)
+    local_pos = jnp.arange(s_local)
+    q_pos = idx * s_local + local_pos  # global positions of our queries
+
+    perm = [(i, (i + 1) % world) for i in range(world)]
+
+    def body(i, carry):
+        m, l, acc, k_cur, v_cur = carry
+        # After i hops, the block we hold originated at rank (idx - i) mod world.
+        src = (idx - i) % world
+        k_pos = src * s_local + local_pos
+
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q32,
+                            k_cur.astype(jnp.float32)) * scale
+        if causal:
+            allowed = k_pos[None, :] <= q_pos[:, None]  # [Sq, Sk] global causal
+            scores = jnp.where(allowed[None, None], scores, _NEG_BIG)
+
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32))
+
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return m_new, l_new, acc_new, k_next, v_next
+
+    m0 = jnp.full((b, h, s_local, 1), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((b, h, s_local, 1), jnp.float32)
+    acc0 = jnp.zeros((b, h, s_local, d), jnp.float32)
+    m, l, acc, _, _ = lax.fori_loop(0, world, body, (m0, l0, acc0, k, v))
+
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_self_attention(mesh, q, k, v, axis: str = "sp", causal: bool = True):
+    """Convenience wrapper: shard [B,H,S,D] tensors over ``axis`` on the
+    sequence dim and run ring attention, returning the full output."""
+    from jax.sharding import PartitionSpec as P
+
+    from nezha_tpu.parallel._compat import shard_map
+
+    fn = shard_map(
+        partial(ring_attention, axis_name=axis, causal=causal),
+        mesh=mesh,
+        in_specs=(P(None, None, axis, None),) * 3,
+        out_specs=P(None, None, axis, None),
+    )
+    return jax.jit(fn)(q, k, v)

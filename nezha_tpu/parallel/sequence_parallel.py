@@ -1,0 +1,42 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence exchange.
+
+Complements ring attention (`nezha_tpu.parallel.ring_attention`): instead of
+rotating K/V blocks, a single ``lax.all_to_all`` re-shards activations from
+sequence-sharded to head-sharded, each rank runs FULL-sequence attention for
+its subset of heads (dense MXU work, no per-hop latency), and a second
+all-to-all restores sequence sharding. Preferred when num_heads %% world == 0
+and the full sequence fits per-chip for 1/world of the heads.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax import lax
+
+from nezha_tpu.ops.attention import causal_mask, dot_product_attention
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = True):
+    """q,k,v local: [B, H, S_local, D] sequence-sharded. Must run inside
+    shard_map. Requires H % world == 0."""
+    world = lax.axis_size(axis_name)
+    b, h, s_local, d = q.shape
+    if h % world:
+        raise ValueError(f"heads {h} not divisible by sequence world {world}")
+
+    def seq_to_heads(x):
+        # [B,H,S_loc,D] -> all_to_all: split heads across ranks, gather seq.
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)  # [B,H/w,S,D]
+    s_global = qh.shape[2]
+    mask = causal_mask(s_global, s_global) if causal else None
+    out = dot_product_attention(qh, kh, vh, mask=mask)
+    return heads_to_seq(out)  # back to [B,H,S_loc,D]

@@ -1,0 +1,15 @@
+"""Execution runtime: compile cache, async dispatch, prefetching workers.
+
+The reference's execution runtime is a goroutine worker pool dispatching
+ready graph nodes to CUDA streams (SURVEY.md §1 "Execution runtime"). On
+TPU, XLA fuses the graph into a handful of executables and the device runs
+them asynchronously, so the runtime's real jobs become: executable lifetime
++ compile caching (`Executor`), keeping the device fed (host worker pool /
+prefetcher — Python threads staging batches; a native C++ loader under
+`csrc/` can feed it), and tracing/profiling hooks.
+"""
+
+from nezha_tpu.runtime.executor import Executor, CompileCache
+from nezha_tpu.runtime.prefetch import Prefetcher, prefetch_to_device
+
+__all__ = ["Executor", "CompileCache", "Prefetcher", "prefetch_to_device"]

@@ -1,0 +1,110 @@
+"""Executor: compile-cached dispatch of graphs/functions.
+
+The reference's worker pool kept the GPU busy by dispatching graph nodes to
+streams; XLA's runtime already pipelines dispatch (async, ahead-of-device),
+so the executor's job is executable lifetime: compile once per (graph,
+shapes, shardings), reuse forever (SURVEY.md §7 item (c): per-step graph
+capture + executable cache).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Hashable, Optional, Tuple
+
+import jax
+
+from nezha_tpu.graph.graph import Graph
+from nezha_tpu.graph.lower import to_callable
+
+
+def _graph_fingerprint(graph: Graph) -> Hashable:
+    """Structural identity of a graph: ops, edges, and attrs — so distinct
+    graphs never share a compiled executable even if same-named/sized."""
+    import hashlib
+
+    import numpy as np
+
+    def attr_val(v):
+        if isinstance(v, np.ndarray):
+            # repr() truncates big arrays; hash the actual bytes instead.
+            h = hashlib.sha256()
+            h.update(str(v.dtype).encode())
+            h.update(str(v.shape).encode())
+            h.update(np.ascontiguousarray(v).tobytes())
+            return ("ndarray", h.hexdigest())
+        return repr(v)
+
+    def attr_sig(attrs):
+        return tuple(sorted((k, attr_val(v)) for k, v in attrs.items()))
+
+    return (
+        tuple((n.op, n.inputs, attr_sig(n.attrs)) for n in graph.nodes),
+        tuple(graph.placeholders),
+        tuple(graph.outputs),
+    )
+
+
+def _signature(args: Tuple, kwargs: Dict) -> Hashable:
+    def leaf_sig(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return ("arr", tuple(x.shape), str(x.dtype))
+        return ("lit", x)
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    return (treedef, tuple(leaf_sig(l) for l in leaves))
+
+
+class CompileCache:
+    """Thread-safe (signature -> compiled executable) cache with stats."""
+
+    def __init__(self):
+        self._cache: Dict[Hashable, Any] = {}
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get_or_build(self, key: Hashable, build: Callable[[], Any]) -> Any:
+        with self._lock:
+            if key in self._cache:
+                self.hits += 1
+                return self._cache[key]
+        built = build()  # compile outside the lock; dup compiles are benign
+        with self._lock:
+            self._cache.setdefault(key, built)
+            self.misses += 1
+            return self._cache[key]
+
+    def __len__(self):
+        return len(self._cache)
+
+
+class Executor:
+    """Runs functions or Graph IR programs with jit + compile caching.
+
+    ``run`` is async like the device: it returns device arrays immediately;
+    call ``jax.block_until_ready`` (or read values) to synchronize —
+    mirroring how the reference's pool overlapped host work with kernels.
+    """
+
+    def __init__(self, donate_argnums: Tuple[int, ...] = ()):
+        self.cache = CompileCache()
+        self.donate_argnums = donate_argnums
+
+    def run(self, fn_or_graph, *args, **kwargs):
+        if isinstance(fn_or_graph, Graph):
+            fn = to_callable(fn_or_graph)
+            base_key = ("graph", _graph_fingerprint(fn_or_graph))
+        else:
+            fn = fn_or_graph
+            # Key by the function object itself: hashable, and the cache
+            # entry keeps it alive so ids can't be recycled.
+            base_key = ("fn", fn_or_graph)
+        key = (base_key, _signature(args, kwargs))
+        jitted = self.cache.get_or_build(
+            key, lambda: jax.jit(fn, donate_argnums=self.donate_argnums))
+        return jitted(*args, **kwargs)
+
+    def stats(self) -> dict:
+        return {"entries": len(self.cache), "hits": self.cache.hits,
+                "misses": self.cache.misses}

@@ -1,0 +1,104 @@
+"""Host-side prefetching worker pool.
+
+Python counterpart of the reference's goroutine worker pool for the input
+path (SURVEY.md §1 "Execution runtime"): N worker threads pull batches from
+the source iterator into a bounded queue and stage them onto device (with a
+target sharding) while the previous step runs. For decode-heavy pipelines a
+native C++ loader (under `csrc/`) can sit underneath as the source iterator;
+numpy-producing iterators release the GIL during copies, so threads suffice
+for staging.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Iterator, Optional
+
+import jax
+
+
+class Prefetcher:
+    """Bounded-depth background prefetcher; iterate to get device batches."""
+
+    _DONE = object()
+
+    def __init__(self, source: Iterator[Any], depth: int = 2,
+                 sharding: Optional[jax.sharding.Sharding] = None,
+                 num_workers: int = 1):
+        self._source = source
+        self._sharding = sharding
+        # +num_workers slots so every worker can always enqueue its exit
+        # sentinel without blocking, even with no consumer draining.
+        self._q: "queue.Queue" = queue.Queue(
+            maxsize=max(depth, 1) + max(num_workers, 1))
+        self._src_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._done_seen = 0
+        self._threads = [
+            threading.Thread(target=self._work, daemon=True,
+                             name=f"nezha-prefetch-{i}")
+            for i in range(max(num_workers, 1))
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _stage(self, batch):
+        if self._sharding is None:
+            return jax.device_put(batch)
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, self._sharding), batch)
+
+    def _work(self):
+        # Every worker enqueues exactly one _DONE on exit; the consumer stops
+        # only after collecting all of them, so one worker finishing early
+        # can't truncate batches other workers are still staging.
+        try:
+            while not self._stop.is_set():
+                try:
+                    with self._src_lock:
+                        batch = next(self._source)
+                except StopIteration:
+                    return
+                except BaseException as e:  # surface in consumer
+                    self._error = e
+                    return
+                self._q.put(self._stage(batch))
+        finally:
+            self._q.put(self._DONE)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while True:
+            item = self._q.get()
+            if item is self._DONE:
+                self._done_seen += 1
+                if self._done_seen >= len(self._threads):
+                    if self._error is not None:
+                        raise self._error
+                    raise StopIteration
+                continue
+            return item
+
+    def close(self, timeout: float = 5.0):
+        self._stop.set()
+        # Keep draining until every worker has exited: a worker blocked in
+        # put() needs space to wake up, check _stop, and enqueue its sentinel.
+        deadline = time.monotonic() + timeout
+        while (any(t.is_alive() for t in self._threads)
+               and time.monotonic() < deadline):
+            try:
+                self._q.get(timeout=0.05)
+            except queue.Empty:
+                pass
+        for t in self._threads:
+            t.join(timeout=0.1)
+
+
+def prefetch_to_device(source: Iterator[Any], depth: int = 2,
+                       sharding: Optional[jax.sharding.Sharding] = None) -> Iterator[Any]:
+    return Prefetcher(source, depth=depth, sharding=sharding)

@@ -1,0 +1,28 @@
+"""Tensor & memory layer.
+
+TPU-native equivalent of the reference's `pkg/tensor` (SURVEY.md §1: tensor
+type, device allocator, host<->device copies, fp32/bf16 dtypes). On TPU the
+device allocator is XLA/PJRT's — `jax.Array` IS the device buffer — so this
+layer provides what remains framework-level: dtype policies for mixed
+precision, explicit host<->device transfer helpers, buffer donation helpers,
+and device/memory introspection.
+"""
+
+from nezha_tpu.tensor.policy import Policy, DEFAULT_POLICY, bf16_policy, f32_policy
+from nezha_tpu.tensor.memory import (
+    to_device,
+    to_host,
+    device_memory_stats,
+    tree_bytes,
+)
+
+__all__ = [
+    "Policy",
+    "DEFAULT_POLICY",
+    "bf16_policy",
+    "f32_policy",
+    "to_device",
+    "to_host",
+    "device_memory_stats",
+    "tree_bytes",
+]

@@ -1,0 +1,50 @@
+"""Host<->device transfer and device-memory introspection.
+
+Reference parity: `pkg/tensor`'s device allocator + H2D/D2H copies
+(SURVEY.md §2). On TPU, allocation is XLA/PJRT's job; the framework-level
+concerns that remain are explicit placement (with shardings), transfer, and
+accounting.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def to_device(tree: Any, sharding: Optional[jax.sharding.Sharding] = None) -> Any:
+    """Move a pytree of host arrays onto device(s).
+
+    With a ``sharding``, arrays land already laid out across the mesh so no
+    resharding copy happens inside the first jit'd step.
+    """
+    if sharding is None:
+        return jax.device_put(tree)
+    return jax.device_put(tree, sharding)
+
+
+def to_host(tree: Any) -> Any:
+    """Fetch a pytree of device arrays back to host numpy (blocking)."""
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
+def tree_bytes(tree: Any) -> int:
+    """Total bytes of all array leaves in a pytree."""
+    sizes = [
+        x.size * x.dtype.itemsize
+        for x in jax.tree_util.tree_leaves(tree)
+        if hasattr(x, "dtype")
+    ]
+    return int(sum(sizes))
+
+
+def device_memory_stats(device: Optional[jax.Device] = None) -> dict:
+    """Per-device memory stats when the backend exposes them (TPU does)."""
+    dev = device or jax.devices()[0]
+    try:
+        stats = dev.memory_stats()
+    except Exception:  # CPU backend has none
+        stats = None
+    return stats or {}

@@ -1,0 +1,54 @@
+"""Mixed-precision dtype policies.
+
+Reference parity: nezha's bf16 compute / fp32 master-weight path exercised by
+the GPT-2 and Wide-ResNet-101 benchmark configs (SURVEY.md §2 "mixed
+precision"). TPU-first design: parameters live in fp32 (master copy), compute
+runs in bf16 so matmuls/convs hit the MXU at full rate, and reductions /
+normalization statistics stay in fp32 for numerical safety. bf16 on TPU needs
+no loss scaling (8-bit exponent), unlike fp16; a dynamic loss-scale is still
+provided in `nezha_tpu.train.mixed_precision` for parity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """What dtype each class of value uses.
+
+    - ``param_dtype``: storage dtype of trainable parameters (master copy).
+    - ``compute_dtype``: dtype activations and weights are cast to for the
+      forward/backward math (bf16 keeps the MXU at full throughput).
+    - ``output_dtype``: dtype of layer outputs (normally compute dtype).
+    """
+
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.float32
+    output_dtype: Any = None  # None -> same as compute_dtype
+
+    def cast_to_compute(self, x):
+        return jnp.asarray(x, self.compute_dtype)
+
+    def cast_to_param(self, x):
+        return jnp.asarray(x, self.param_dtype)
+
+    def cast_output(self, x):
+        out = self.output_dtype or self.compute_dtype
+        return jnp.asarray(x, out)
+
+
+def f32_policy() -> Policy:
+    return Policy(jnp.float32, jnp.float32)
+
+
+def bf16_policy() -> Policy:
+    """fp32 master params, bf16 compute — the standard TPU training policy."""
+    return Policy(jnp.float32, jnp.bfloat16)
+
+
+DEFAULT_POLICY = f32_policy()

@@ -1,0 +1,17 @@
+"""Training runtime: step builders, the Trainer loop, mixed precision,
+checkpointing — the TPU-native counterpart of the reference's per-rank
+training loop (SURVEY.md §3 call stack 2)."""
+
+from nezha_tpu.train.loop import TrainState, make_train_step, merge_state, Trainer
+
+__all__ = ["TrainState", "make_train_step", "merge_state", "Trainer"]
+
+
+def __getattr__(name):
+    if name in ("save_checkpoint", "restore_checkpoint", "latest_step"):
+        from nezha_tpu.train import checkpoint
+        return getattr(checkpoint, name)
+    if name in ("DynamicLossScale", "NoOpLossScale"):
+        from nezha_tpu.train import mixed_precision
+        return getattr(mixed_precision, name)
+    raise AttributeError(name)

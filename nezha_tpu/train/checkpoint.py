@@ -1,0 +1,95 @@
+"""Checkpoint save/restore + resume.
+
+Flat-key ``.npz`` snapshots of the full TrainState (params, optimizer state,
+BatchNorm stats, RNG) with atomic rename, plus ``try_restore`` for
+crash-resume (aux subsystem per the build brief; the reference's equivalent
+was not observable — SURVEY.md §5). Format is plain numpy so checkpoints are
+portable and inspectable without the framework.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tempfile
+from pathlib import Path
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def _flatten(tree: Any) -> dict:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = "/".join(_path_str(p) for p in path)
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def _path_str(p) -> str:
+    if hasattr(p, "key"):
+        return str(p.key)
+    if hasattr(p, "idx"):
+        return str(p.idx)
+    return str(p)
+
+
+def _unflatten(template: Any, flat: dict) -> Any:
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(template)
+    new_leaves = []
+    for path, leaf in leaves_with_path:
+        key = "/".join(_path_str(p) for p in path)
+        if key not in flat:
+            raise KeyError(f"checkpoint missing leaf {key!r}")
+        val = flat[key]
+        if hasattr(leaf, "dtype") and val.dtype != leaf.dtype:
+            val = val.astype(leaf.dtype)
+        new_leaves.append(val)
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def save_checkpoint(ckpt_dir: str, state: Any, step: int) -> str:
+    """Atomically write ``step_<N>.npz``; returns the path."""
+    d = Path(ckpt_dir)
+    d.mkdir(parents=True, exist_ok=True)
+    flat = _flatten(jax.device_get(state))
+    final = d / f"step_{step:08d}.npz"
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **flat)
+        os.replace(tmp, final)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return str(final)
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    d = Path(ckpt_dir)
+    if not d.exists():
+        return None
+    steps = [int(m.group(1)) for p in d.glob("step_*.npz")
+             if (m := re.match(r"step_(\d+)\.npz$", p.name))]
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(ckpt_dir: str, template: Any, step: Optional[int] = None) -> Tuple[Any, int]:
+    """Restore into the structure of ``template`` (a freshly-init'd state)."""
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {ckpt_dir}")
+    path = Path(ckpt_dir) / f"step_{step:08d}.npz"
+    with np.load(path) as z:
+        flat = {k: z[k] for k in z.files}
+    return _unflatten(template, flat), step
+
+
+def try_restore(ckpt_dir: str, template: Any) -> Tuple[Optional[Any], int]:
+    step = latest_step(ckpt_dir)
+    if step is None:
+        return None, 0
+    state, step = restore_checkpoint(ckpt_dir, template, step)
+    return state, step

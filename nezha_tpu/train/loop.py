@@ -1,0 +1,137 @@
+"""Training step construction and the host-side training loop.
+
+The reference's hot loop (SURVEY.md §3: forward over op graph -> loss ->
+backward -> collective -> optimizer update) becomes ONE jit'd function here:
+XLA sees forward+backward+update as a single program, fuses it, and overlaps
+the DP gradient collective with backward compute. Buffer donation makes the
+parameter/optimizer-state update in-place in HBM (the TPU analogue of the
+reference's in-place CUDA optimizer kernels).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+
+from nezha_tpu.nn.module import Module, Variables
+from nezha_tpu.optim.optimizers import Optimizer, apply_updates
+
+TrainState = Dict[str, Any]  # {"variables": Variables, "opt_state": Any, "rng": key}
+
+
+def merge_state(old: Any, new: Any) -> Any:
+    """Overlay partial state updates (e.g. BatchNorm stats) onto old state."""
+    if not isinstance(new, dict) or not isinstance(old, dict):
+        return new if new is not None else old
+    out = dict(old)
+    for k, v in new.items():
+        out[k] = merge_state(old.get(k), v) if k in old else v
+    return out
+
+
+def init_train_state(model: Module, optimizer: Optimizer, rng: jax.Array) -> TrainState:
+    variables = model.init(rng)
+    return {
+        "variables": variables,
+        "opt_state": optimizer.init(variables["params"]),
+        "rng": rng,
+    }
+
+
+def make_train_step(model: Module, optimizer: Optimizer,
+                    loss_fn: Callable[[Any, dict, Variables], Any],
+                    jit: bool = True, donate: bool = True):
+    """Build the fused train step.
+
+    ``loss_fn(model_out, batch)`` -> scalar loss. The model is called as
+    ``model.apply(variables, batch, training=True, rng=...)`` — models take the
+    whole batch dict or its main tensor; see each model's ``apply``.
+
+    Returns ``step(state, batch) -> (state, metrics)``.
+    """
+
+    def step(state: TrainState, batch: dict):
+        variables, opt_state = state["variables"], state["opt_state"]
+        rng, step_rng = jax.random.split(state["rng"])
+
+        def compute_loss(params):
+            out, new_state = model.apply(
+                {"params": params, "state": variables["state"]},
+                batch, training=True, rng=step_rng)
+            loss = loss_fn(out, batch)
+            return jnp.asarray(loss, jnp.float32), (new_state, out)
+
+        (loss, (new_state, _)), grads = jax.value_and_grad(
+            compute_loss, has_aux=True)(variables["params"])
+        updates, opt_state = optimizer.update(grads, opt_state, variables["params"])
+        params = apply_updates(variables["params"], updates)
+        new_variables = {"params": params,
+                         "state": merge_state(variables["state"], new_state)}
+        metrics = {"loss": loss}
+        return ({"variables": new_variables, "opt_state": opt_state, "rng": rng},
+                metrics)
+
+    if jit:
+        step = jax.jit(step, donate_argnums=(0,) if donate else ())
+    return step
+
+
+class Trainer:
+    """Host-side loop: pulls batches, dispatches jit'd steps (async — JAX
+    queues steps ahead while the host prepares the next batch), logs metrics,
+    periodically checkpoints."""
+
+    def __init__(self, model: Module, optimizer: Optimizer, loss_fn,
+                 rng: Optional[jax.Array] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 0,
+                 log_every: int = 10,
+                 metric_logger: Optional[Callable[[int, dict], None]] = None):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = checkpoint_every
+        self.log_every = log_every
+        self.metric_logger = metric_logger
+        self.step_fn = make_train_step(model, optimizer, loss_fn)
+        self.state: Optional[TrainState] = None
+        self.global_step = 0
+
+    def initialize(self, resume: bool = True):
+        from nezha_tpu.train import checkpoint as ckpt
+        state = init_train_state(self.model, self.optimizer, self.rng)
+        if resume and self.checkpoint_dir:
+            restored, step = ckpt.try_restore(self.checkpoint_dir, state)
+            if restored is not None:
+                state, self.global_step = restored, step
+        self.state = state
+        return state
+
+    def fit(self, batches: Iterator[dict], steps: int) -> Dict[str, float]:
+        from nezha_tpu.train import checkpoint as ckpt
+        if self.state is None:
+            self.initialize()
+        last_metrics: Dict[str, float] = {}
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            batch = next(batches)
+            self.state, metrics = self.step_fn(self.state, batch)
+            self.global_step += 1
+            if self.log_every and self.global_step % self.log_every == 0:
+                last_metrics = {k: float(v) for k, v in metrics.items()}
+                last_metrics["steps_per_sec"] = self.log_every / max(
+                    time.perf_counter() - t0, 1e-9)
+                t0 = time.perf_counter()
+                if self.metric_logger:
+                    self.metric_logger(self.global_step, last_metrics)
+            if (self.checkpoint_every and self.checkpoint_dir
+                    and self.global_step % self.checkpoint_every == 0):
+                ckpt.save_checkpoint(self.checkpoint_dir, self.state, self.global_step)
+        if not last_metrics and steps:
+            last_metrics = {k: float(v) for k, v in metrics.items()}
+        return last_metrics

@@ -1,0 +1,84 @@
+"""Loss scaling for mixed-precision training.
+
+bf16 (the TPU default, `nezha_tpu.tensor.bf16_policy`) needs NO loss scaling
+— its exponent range matches fp32 — so the standard path uses `NoOpLossScale`.
+`DynamicLossScale` exists for fp16-style parity with the reference's mixed
+bf16/fp32 configs (SURVEY.md §2 "mixed precision") and for any future dtype
+with a narrow exponent: scale the loss up, unscale grads, skip the step and
+halve the scale on inf/nan, double it after a clean streak.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _all_finite(tree: Any) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(tree)
+    ok = jnp.array(True)
+    for leaf in leaves:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(leaf)))
+    return ok
+
+
+@dataclasses.dataclass(frozen=True)
+class NoOpLossScale:
+    """bf16/fp32 path: identity. Keeps train-step code uniform."""
+
+    def scale(self, loss):
+        return loss
+
+    def unscale(self, grads):
+        return grads
+
+    def adjust(self, grads) -> Tuple[Any, "NoOpLossScale", jnp.ndarray]:
+        """Returns (grads, new_self, grads_are_finite)."""
+        return grads, self, _all_finite(grads)
+
+
+@dataclasses.dataclass(frozen=True)
+class DynamicLossScale:
+    """Classic dynamic loss scaling (a pure value — thread it through the
+    jit'd step like optimizer state)."""
+
+    scale_value: jnp.ndarray = dataclasses.field(
+        default_factory=lambda: jnp.float32(2.0 ** 15))
+    growth_interval: int = 2000
+    counter: jnp.ndarray = dataclasses.field(
+        default_factory=lambda: jnp.int32(0))
+
+    def scale(self, loss):
+        return loss * self.scale_value.astype(loss.dtype)
+
+    def unscale(self, grads):
+        inv = 1.0 / self.scale_value
+        return jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32) * inv, grads)
+
+    def adjust(self, grads) -> Tuple[Any, "DynamicLossScale", jnp.ndarray]:
+        """Unscale grads; on overflow halve the scale (caller should skip the
+        update when ``finite`` is False), else grow after the interval."""
+        grads = self.unscale(grads)
+        finite = _all_finite(grads)
+        new_counter = jnp.where(finite, self.counter + 1, 0)
+        grow = new_counter >= self.growth_interval
+        new_scale = jnp.where(
+            finite,
+            jnp.where(grow, self.scale_value * 2.0, self.scale_value),
+            jnp.maximum(self.scale_value * 0.5, 1.0))
+        new_counter = jnp.where(grow, 0, new_counter)
+        new_self = DynamicLossScale(new_scale, self.growth_interval, new_counter)
+        return grads, new_self, finite
+
+
+jax.tree_util.register_pytree_node(
+    DynamicLossScale,
+    lambda ls: ((ls.scale_value, ls.counter), ls.growth_interval),
+    lambda interval, children: DynamicLossScale(children[0], interval, children[1]),
+)
+jax.tree_util.register_pytree_node(
+    NoOpLossScale, lambda ls: ((), None), lambda _, __: NoOpLossScale())

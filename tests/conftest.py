@@ -1,0 +1,29 @@
+"""Test rig: force an 8-device virtual CPU platform BEFORE jax initializes,
+so collectives/sharding tests run the real multi-chip code paths on any host
+(SURVEY.md §4 test strategy)."""
+
+import os
+
+# Force CPU regardless of the ambient platform (the dev box exports
+# JAX_PLATFORMS=axon for its single real TPU chip; tests need 8 virtual
+# devices for the multi-chip paths). Plugins (jaxtyping) import jax before
+# this conftest runs, so the env default is already baked — override via
+# jax.config, which works any time before backend initialization.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

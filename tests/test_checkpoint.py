@@ -1,0 +1,53 @@
+"""Checkpoint save/restore/resume round-trips."""
+
+import jax
+import numpy as np
+
+from nezha_tpu import data, ops, optim
+from nezha_tpu.models.mlp import MLP
+from nezha_tpu.train import checkpoint as ckpt
+from nezha_tpu.train.loop import Trainer, init_train_state, make_train_step
+
+
+def _loss_fn(logits, batch):
+    return ops.softmax_cross_entropy_with_integer_labels(logits, batch["label"])
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    model = MLP(hidden=(16,))
+    opt = optim.adamw(1e-3)
+    state = init_train_state(model, opt, jax.random.PRNGKey(0))
+    step = make_train_step(model, opt, _loss_fn, donate=False)
+    batches = data.mnist_batches(32)
+    for _ in range(3):
+        state, _ = step(state, next(batches))
+
+    path = ckpt.save_checkpoint(str(tmp_path), state, step=3)
+    assert path.endswith("step_00000003.npz")
+    assert ckpt.latest_step(str(tmp_path)) == 3
+
+    template = init_train_state(model, opt, jax.random.PRNGKey(0))
+    restored, at = ckpt.restore_checkpoint(str(tmp_path), template)
+    assert at == 3
+    for a, b in zip(jax.tree_util.tree_leaves(state),
+                    jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_trainer_resume(tmp_path):
+    model = MLP(hidden=(16,))
+    opt = optim.momentum(0.05)
+
+    t1 = Trainer(model, opt, _loss_fn, rng=jax.random.PRNGKey(7),
+                 checkpoint_dir=str(tmp_path), checkpoint_every=5, log_every=5)
+    t1.initialize(resume=False)
+    t1.fit(data.mnist_batches(32, seed=1), steps=5)
+    saved_params = jax.device_get(t1.state["variables"]["params"])
+
+    t2 = Trainer(model, opt, _loss_fn, rng=jax.random.PRNGKey(7),
+                 checkpoint_dir=str(tmp_path))
+    t2.initialize(resume=True)
+    assert t2.global_step == 5
+    for a, b in zip(jax.tree_util.tree_leaves(saved_params),
+                    jax.tree_util.tree_leaves(t2.state["variables"]["params"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -1,0 +1,64 @@
+"""Mixed-precision tests: policies, dynamic loss scale, SAME avg_pool."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nezha_tpu import nn
+from nezha_tpu.tensor import bf16_policy
+from nezha_tpu.train.mixed_precision import DynamicLossScale, NoOpLossScale
+
+
+def test_noop_loss_scale():
+    ls = NoOpLossScale()
+    grads = {"w": jnp.ones(3)}
+    g, ls2, finite = ls.adjust(grads)
+    assert bool(finite)
+    np.testing.assert_array_equal(np.asarray(g["w"]), 1.0)
+
+
+def test_dynamic_loss_scale_halves_on_overflow():
+    ls = DynamicLossScale(scale_value=jnp.float32(1024.0))
+    bad = {"w": jnp.array([jnp.inf])}
+    _, ls2, finite = ls.adjust(bad)
+    assert not bool(finite)
+    assert float(ls2.scale_value) == 512.0
+    # Counter resets on overflow.
+    assert int(ls2.counter) == 0
+
+
+def test_dynamic_loss_scale_grows_after_interval():
+    ls = DynamicLossScale(scale_value=jnp.float32(8.0), growth_interval=2)
+    g = {"w": jnp.array([8.0])}  # scaled grad
+    g1, ls, f1 = ls.adjust(g)
+    np.testing.assert_allclose(np.asarray(g1["w"]), [1.0])  # unscaled
+    _, ls, _ = ls.adjust(g)
+    assert float(ls.scale_value) == 16.0  # doubled after 2 clean steps
+
+
+def test_loss_scale_is_pytree():
+    ls = DynamicLossScale()
+    leaves = jax.tree_util.tree_leaves(ls)
+    assert len(leaves) == 2  # scale + counter thread through jit
+
+
+def test_loss_scale_scale_unscale_roundtrip():
+    ls = DynamicLossScale(scale_value=jnp.float32(64.0))
+    loss = jnp.float32(2.0)
+    assert float(ls.scale(loss)) == 128.0
+    g = ls.unscale({"w": jnp.array([64.0])})
+    np.testing.assert_allclose(np.asarray(g["w"]), [1.0])
+
+
+def test_avg_pool_same_divides_by_true_count():
+    x = jnp.ones((1, 4, 4, 1))
+    y = nn.avg_pool(x, 3, 1, "SAME")
+    # All-ones input: correct SAME average pooling returns exactly 1 even at
+    # corners (4-element windows), not 4/9.
+    np.testing.assert_allclose(np.asarray(y), 1.0, rtol=1e-6)
+
+
+def test_bf16_policy_casts():
+    p = bf16_policy()
+    assert p.cast_to_compute(jnp.ones(2, jnp.float32)).dtype == jnp.bfloat16
+    assert p.cast_to_param(jnp.ones(2, jnp.bfloat16)).dtype == jnp.float32

@@ -1,0 +1,163 @@
+"""Model-family tests: shapes, gradient flow, a few training steps on tiny
+configs (SURVEY.md §2 models: ResNet-50, WRN-101, GPT-2, BERT)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nezha_tpu import data, models, ops, optim
+from nezha_tpu.models.bert import Bert, BertConfig, mlm_loss
+from nezha_tpu.models.gpt2 import GPT2, GPT2Config, lm_loss
+from nezha_tpu.models.resnet import ResNet, resnet50, wide_resnet101
+from nezha_tpu.train.loop import init_train_state, make_train_step
+
+
+def tiny_resnet(**kw):
+    return ResNet((1, 1), num_classes=10, **kw)
+
+
+def tiny_gpt2(**kw):
+    return GPT2(GPT2Config(vocab_size=128, max_positions=32, num_layers=2,
+                           num_heads=2, hidden_size=32, **kw))
+
+
+def tiny_bert(**kw):
+    return Bert(BertConfig(vocab_size=128, max_positions=32, num_layers=2,
+                           num_heads=2, hidden_size=32, **kw))
+
+
+def test_resnet_forward_shapes():
+    model = tiny_resnet()
+    v = model.init(jax.random.PRNGKey(0))
+    x = jnp.ones((2, 32, 32, 3))
+    logits, states = model.apply(v, x, training=True)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+    # Every BatchNorm contributed a state update in training mode.
+    assert "stem_bn" in states and "blocks0" in states
+
+
+def test_resnet50_structure():
+    model = resnet50()
+    # 3+4+6+3 bottlenecks, ImageNet head.
+    assert len(model.blocks) == 16
+    assert model.head.out_features == 1000
+    wrn = wide_resnet101(num_classes=5)
+    assert len(wrn.blocks) == 33
+    # Wide: first-stage bottleneck inner width is 128 (64*2).
+    assert wrn.blocks[0].conv1.out_channels == 128
+    # Output channels unchanged by widening.
+    assert wrn.blocks[0].conv3.out_channels == 256
+
+
+def test_resnet_zero_init_last_bn():
+    model = tiny_resnet()
+    v = model.init(jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(
+        np.asarray(v["params"]["blocks0"]["bn3"]["scale"]), 0.0)
+
+
+def test_resnet_trains():
+    model = tiny_resnet()
+    opt = optim.momentum(0.05)
+    loss_fn = lambda logits, b: ops.softmax_cross_entropy_with_integer_labels(
+        logits, b["label"])
+    state = init_train_state(model, opt, jax.random.PRNGKey(0))
+    step = make_train_step(model, opt, loss_fn)
+    r = np.random.RandomState(0)
+    losses = []
+    for i in range(8):
+        batch = {"image": r.rand(8, 32, 32, 3).astype(np.float32),
+                 "label": (r.rand(8) * 10).astype(np.int32)}
+        # Same 2 batches repeated -> memorization must drop the loss.
+        batch = jax.tree_util.tree_map(jnp.asarray, batch) if i < 2 else batch
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all()
+
+
+def test_gpt2_forward_and_causality():
+    model = tiny_gpt2()
+    v = model.init(jax.random.PRNGKey(0))
+    tokens = jnp.ones((2, 9), jnp.int32)
+    logits, _ = model.apply(v, {"tokens": tokens}, training=False)
+    assert logits.shape == (2, 8, 128)
+
+    # Causality: changing a late token must not affect earlier logits.
+    t1 = jnp.zeros((1, 8), jnp.int32)
+    t2 = t1.at[0, 7].set(5)
+    l1, _ = model.apply(v, t1)
+    l2, _ = model.apply(v, t2)
+    np.testing.assert_allclose(np.asarray(l1[:, :7]), np.asarray(l2[:, :7]),
+                               atol=1e-5)
+    assert not np.allclose(np.asarray(l1[:, 7]), np.asarray(l2[:, 7]))
+
+
+def test_gpt2_124m_param_count():
+    model = models.gpt2_124m()
+    v = model.init(jax.random.PRNGKey(0))
+    n = sum(x.size for x in jax.tree_util.tree_leaves(v["params"]))
+    # GPT-2 124M: ~124.4M with tied head.
+    assert 123e6 < n < 126e6, n
+
+
+def test_gpt2_trains_on_repeated_batch():
+    model = tiny_gpt2()
+    opt = optim.adamw(1e-2, weight_decay=0.0)
+    state = init_train_state(model, opt, jax.random.PRNGKey(0))
+    step = make_train_step(model, opt, lm_loss)
+    batch = {"tokens": jnp.asarray(
+        np.random.RandomState(0).randint(0, 128, (4, 17)), jnp.int32)}
+    first = last = None
+    for i in range(15):
+        state, m = step(state, batch)
+        if i == 0:
+            first = float(m["loss"])
+        last = float(m["loss"])
+    assert last < first * 0.5, (first, last)
+
+
+def test_bert_forward_padding_and_mlm():
+    model = tiny_bert()
+    v = model.init(jax.random.PRNGKey(0))
+    batch = next(data.synthetic_mlm_batches(2, seq_len=16, vocab_size=128))
+    logits, _ = model.apply(v, batch, training=False)
+    assert logits.shape == (2, 16, 128)
+
+    # Padding positions must not influence real positions.
+    tokens = jnp.asarray(batch["tokens"])
+    pm = jnp.ones((2, 16), bool).at[:, 8:].set(False)
+    b1 = {"tokens": tokens, "padding_mask": pm}
+    b2 = {"tokens": tokens.at[:, 12].set(7), "padding_mask": pm}
+    l1, _ = model.apply(v, b1)
+    l2, _ = model.apply(v, b2)
+    np.testing.assert_allclose(np.asarray(l1[:, :8]), np.asarray(l2[:, :8]),
+                               atol=1e-5)
+
+    loss = mlm_loss(logits, batch)
+    assert np.isfinite(float(loss))
+
+
+def test_bert_zero1_trains(devices8):
+    """The benchmark-config-4 path: BERT + ZeRO-1 on the 8-device mesh."""
+    from nezha_tpu import parallel
+    mesh = parallel.make_mesh({"dp": 8})
+    model = tiny_bert()
+    opt = optim.adamw(1e-3)
+    variables = model.init(jax.random.PRNGKey(0))
+    state = {
+        "variables": parallel.replicate(mesh, variables),
+        "opt_state": parallel.zero1_init_opt_state(opt, variables["params"], mesh),
+        "rng": parallel.replicate(mesh, jax.random.PRNGKey(1)),
+    }
+    step = parallel.make_zero1_train_step(model, opt, mlm_loss, mesh,
+                                          donate=False)
+    batch = parallel.shard_batch(
+        mesh, next(data.synthetic_mlm_batches(16, seq_len=16, vocab_size=128)))
+    losses = []
+    for _ in range(6):
+        state, m = step(state, batch)  # same batch: memorization must help
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]

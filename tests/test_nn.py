@@ -1,0 +1,133 @@
+"""Layer unit tests against numpy references."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nezha_tpu import nn, ops
+from nezha_tpu.tensor.policy import bf16_policy
+
+
+def test_linear_matches_numpy():
+    layer = nn.Linear(8, 4)
+    v = layer.init(jax.random.PRNGKey(0))
+    x = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+    y, _ = layer.apply(v, jnp.asarray(x))
+    expected = x @ np.asarray(v["params"]["w"]) + np.asarray(v["params"]["b"])
+    np.testing.assert_allclose(np.asarray(y), expected, rtol=1e-5)
+
+
+def test_linear_bf16_policy_keeps_master_params_f32():
+    layer = nn.Linear(8, 4, policy=bf16_policy())
+    v = layer.init(jax.random.PRNGKey(0))
+    assert v["params"]["w"].dtype == jnp.float32
+    y, _ = layer.apply(v, jnp.ones((2, 8)))
+    assert y.dtype == jnp.bfloat16
+
+
+def test_conv2d_shapes_and_stride():
+    conv = nn.Conv2d(3, 16, 3, stride=2, padding="SAME")
+    v = conv.init(jax.random.PRNGKey(0))
+    y, _ = conv.apply(v, jnp.ones((2, 8, 8, 3)))
+    assert y.shape == (2, 4, 4, 16)
+
+
+def test_conv2d_matches_lax_direct():
+    conv = nn.Conv2d(2, 3, 3, stride=1, padding="VALID", use_bias=False)
+    v = conv.init(jax.random.PRNGKey(1))
+    x = jax.random.normal(jax.random.PRNGKey(2), (1, 5, 5, 2))
+    y, _ = conv.apply(v, x)
+    ref = jax.lax.conv_general_dilated(
+        x, v["params"]["w"], (1, 1), "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5)
+
+
+def test_batchnorm_normalizes_and_updates_stats():
+    bn = nn.BatchNorm(4, momentum=0.5)
+    v = bn.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 2, 2, 4)) * 3 + 1
+    y, new_state = bn.apply(v, x, training=True)
+    np.testing.assert_allclose(np.asarray(jnp.mean(y, axis=(0, 1, 2))),
+                               np.zeros(4), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(jnp.std(y, axis=(0, 1, 2))),
+                               np.ones(4), atol=1e-3)
+    assert not np.allclose(np.asarray(new_state["mean"]), 0.0)
+    # Eval mode uses running stats and returns no update.
+    v2 = {"params": v["params"], "state": new_state}
+    _, s2 = bn.apply(v2, x, training=False)
+    assert s2 == {}
+
+
+def test_layernorm_zero_mean_unit_var():
+    ln = nn.LayerNorm(16)
+    v = ln.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 16)) * 5 + 2
+    y, _ = ln.apply(v, x)
+    np.testing.assert_allclose(np.asarray(jnp.mean(y, axis=-1)), np.zeros(4),
+                               atol=1e-4)
+
+
+def test_embedding_lookup_and_attend():
+    emb = nn.Embedding(10, 6)
+    v = emb.init(jax.random.PRNGKey(0))
+    ids = jnp.array([[1, 2], [3, 4]])
+    y, _ = emb.apply(v, ids)
+    assert y.shape == (2, 2, 6)
+    logits = emb.attend(v, y)
+    assert logits.shape == (2, 2, 10)
+
+
+def test_dropout_train_vs_eval():
+    d = nn.Dropout(0.5)
+    v = d.init(jax.random.PRNGKey(0))
+    x = jnp.ones((100, 100))
+    y_eval, _ = d.apply(v, x, training=False)
+    np.testing.assert_array_equal(np.asarray(y_eval), np.asarray(x))
+    y_train, _ = d.apply(v, x, training=True, rng=jax.random.PRNGKey(1))
+    frac_zero = float(jnp.mean(y_train == 0))
+    assert 0.4 < frac_zero < 0.6
+    # Inverted scaling keeps the expectation.
+    assert abs(float(jnp.mean(y_train)) - 1.0) < 0.1
+
+
+def test_sequential_and_pools():
+    model = nn.Sequential([nn.Linear(4, 8), nn.Linear(8, 2)])
+    v = model.init(jax.random.PRNGKey(0))
+    y, _ = model.apply(v, jnp.ones((3, 4)))
+    assert y.shape == (3, 2)
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    assert nn.max_pool(x, 2, 2).shape == (1, 2, 2, 1)
+    assert nn.avg_pool(x, 2, 2).shape == (1, 2, 2, 1)
+    assert nn.global_avg_pool(x).shape == (1, 1)
+
+
+def test_softmax_and_losses():
+    logits = jnp.array([[1.0, 2.0, 3.0], [1.0, 1.0, 1.0]])
+    p = ops.softmax(logits)
+    np.testing.assert_allclose(np.asarray(jnp.sum(p, -1)), [1.0, 1.0], rtol=1e-6)
+    labels = jnp.array([2, 1])
+    ce = ops.softmax_cross_entropy_with_integer_labels(logits, labels)
+    onehot = jax.nn.one_hot(labels, 3)
+    ce2 = ops.cross_entropy_with_logits(logits, onehot)
+    np.testing.assert_allclose(float(ce), float(ce2), rtol=1e-6)
+    # Row 0 argmax==2 (correct); row 1 ties -> argmax 0 != 1 (wrong).
+    assert float(ops.accuracy(logits, labels)) == 0.5
+
+
+def test_masked_ce_ignore_index():
+    logits = jnp.zeros((2, 3, 5))
+    labels = jnp.array([[1, -100, 2], [-100, -100, 0]])
+    loss = ops.softmax_cross_entropy_with_integer_labels(
+        logits, labels, ignore_index=-100)
+    np.testing.assert_allclose(float(loss), np.log(5), rtol=1e-5)
+
+
+def test_causal_mask_and_attention():
+    q = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 8, 16))
+    out = ops.dot_product_attention(q, q, q, mask=ops.causal_mask(8, 8))
+    assert out.shape == (2, 4, 8, 16)
+    # First position can only attend to itself -> output == v[0].
+    np.testing.assert_allclose(np.asarray(out[:, :, 0]),
+                               np.asarray(q[:, :, 0]), rtol=1e-4)

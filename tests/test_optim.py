@@ -1,0 +1,81 @@
+"""Optimizer tests: update math vs closed-form / reference behavior."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nezha_tpu import optim
+
+
+def _quad_grads(params):
+    # d/dp of 0.5*p^2 is p
+    return jax.tree_util.tree_map(lambda p: p, params)
+
+
+def test_sgd_step():
+    opt = optim.sgd(0.1)
+    params = {"w": jnp.array([1.0, -2.0])}
+    state = opt.init(params)
+    updates, state = opt.update(_quad_grads(params), state, params)
+    new = optim.apply_updates(params, updates)
+    np.testing.assert_allclose(np.asarray(new["w"]), [0.9, -1.8], rtol=1e-6)
+    assert int(state["step"]) == 1
+
+
+def test_momentum_accumulates_velocity():
+    opt = optim.momentum(0.1, beta=0.9)
+    params = {"w": jnp.array([1.0])}
+    state = opt.init(params)
+    u1, state = opt.update({"w": jnp.array([1.0])}, state, params)
+    u2, state = opt.update({"w": jnp.array([1.0])}, state, params)
+    # v1 = 1, v2 = 0.9*1 + 1 = 1.9
+    np.testing.assert_allclose(np.asarray(u1["w"]), [-0.1], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(u2["w"]), [-0.19], rtol=1e-6)
+
+
+def test_adamw_first_step_is_lr_sized():
+    opt = optim.adamw(1e-3, weight_decay=0.0)
+    params = {"w": jnp.array([10.0])}
+    state = opt.init(params)
+    updates, _ = opt.update({"w": jnp.array([0.5])}, state, params)
+    # After bias correction the first step is ~ -lr * sign(grad).
+    np.testing.assert_allclose(np.asarray(updates["w"]), [-1e-3], rtol=1e-3)
+
+
+def test_adamw_weight_decay_mask():
+    mask = lambda p: {"w": True, "b": False}
+    opt = optim.adamw(1.0, weight_decay=0.1, mask=mask)
+    params = {"w": jnp.array([1.0]), "b": jnp.array([1.0])}
+    state = opt.init(params)
+    zero_grads = {"w": jnp.array([0.0]), "b": jnp.array([0.0])}
+    updates, _ = opt.update(zero_grads, state, params)
+    assert float(updates["w"][0]) != 0.0  # decayed
+    np.testing.assert_allclose(np.asarray(updates["b"]), [0.0], atol=1e-9)
+
+
+def test_optimizers_minimize_quadratic():
+    for make in (lambda: optim.sgd(0.2), lambda: optim.momentum(0.05),
+                 lambda: optim.adamw(0.2, weight_decay=0.0)):
+        opt = make()
+        params = {"w": jnp.array([3.0, -4.0])}
+        state = opt.init(params)
+        for _ in range(100):
+            updates, state = opt.update(_quad_grads(params), state, params)
+            params = optim.apply_updates(params, updates)
+        assert float(jnp.max(jnp.abs(params["w"]))) < 0.1, make
+
+
+def test_clip_by_global_norm():
+    tree = {"a": jnp.array([3.0]), "b": jnp.array([4.0])}
+    clipped, norm = optim.clip_by_global_norm(tree, 1.0)
+    np.testing.assert_allclose(float(norm), 5.0, rtol=1e-5)
+    np.testing.assert_allclose(float(optim.global_norm(clipped)), 1.0, rtol=1e-4)
+
+
+def test_schedules():
+    s = optim.warmup_cosine_schedule(1.0, warmup_steps=10, total_steps=110)
+    assert float(s(jnp.array(0))) < 0.2
+    np.testing.assert_allclose(float(s(jnp.array(9))), 1.0, rtol=1e-6)
+    assert float(s(jnp.array(110))) < 1e-6
+    c = optim.cosine_decay_schedule(2.0, 100)
+    np.testing.assert_allclose(float(c(jnp.array(0))), 2.0, rtol=1e-6)

@@ -1,0 +1,162 @@
+"""Multi-device tests on the 8-device virtual CPU mesh: collectives, DP
+equivalence with single-device training, ZeRO-1 equivalence with plain DP.
+(SURVEY.md §4: test collectives on multi-device CPU XLA.)"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from nezha_tpu import data, ops, optim, parallel
+from nezha_tpu.models.mlp import MLP
+from nezha_tpu.parallel._compat import shard_map
+from nezha_tpu.train.loop import init_train_state, make_train_step
+
+
+def _loss_fn(logits, batch):
+    return ops.softmax_cross_entropy_with_integer_labels(logits, batch["label"])
+
+
+def test_make_mesh_axes(devices8):
+    mesh = parallel.make_mesh({"dp": 2, "tp": 4})
+    assert parallel.local_mesh_axes(mesh) == {"dp": 2, "tp": 4}
+    mesh2 = parallel.make_mesh({"dp": -1})
+    assert parallel.local_mesh_axes(mesh2)["dp"] == len(jax.devices())
+
+
+def test_collectives_roundtrip(devices8):
+    mesh = parallel.make_mesh({"dp": 8})
+
+    def f(x):
+        s = parallel.all_reduce_sum(x, "dp")
+        g = parallel.all_gather(x, "dp")
+        rs = parallel.reduce_scatter(g, "dp")
+        return s, g, rs
+
+    x = jnp.arange(8.0)
+    mapped = shard_map(f, mesh=mesh, in_specs=P("dp"),
+                       out_specs=(P("dp"), P("dp"), P("dp")))
+    s, g, rs = jax.jit(mapped)(x)
+    np.testing.assert_allclose(np.asarray(s), np.full(8, 28.0))  # sum 0..7
+    # Every rank gathered the full vector -> concat is 8 tiled copies.
+    np.testing.assert_allclose(np.asarray(g), np.tile(np.arange(8.0), 8))
+    # reduce_scatter of the replicated gather: each rank gets 8 * its element.
+    np.testing.assert_allclose(np.asarray(rs), 8.0 * np.arange(8.0))
+
+
+def test_ring_permute(devices8):
+    mesh = parallel.make_mesh({"sp": 8})
+    mapped = shard_map(lambda x: parallel.ring_permute(x, "sp"),
+                       mesh=mesh, in_specs=P("sp"), out_specs=P("sp"))
+    out = jax.jit(mapped)(jnp.arange(8.0))
+    np.testing.assert_allclose(np.asarray(out), np.roll(np.arange(8.0), 1))
+
+
+def test_barrier_runs(devices8):
+    parallel.barrier(parallel.make_mesh({"dp": 8}))
+
+
+def test_dp_matches_single_device(devices8):
+    """DP over 8 devices must produce the same params as one big-batch step."""
+    mesh = parallel.make_mesh({"dp": 8})
+    model = MLP(hidden=(32,))
+    opt = optim.sgd(0.1)
+
+    state_single = init_train_state(model, opt, jax.random.PRNGKey(0))
+    state_dp = jax.tree_util.tree_map(jnp.copy, state_single)
+    state_dp = parallel.replicate(mesh, state_dp)
+
+    batch = next(data.mnist_batches(64, seed=3))
+
+    single_step = make_train_step(model, opt, _loss_fn, donate=False)
+    dp_step = parallel.make_dp_train_step(model, opt, _loss_fn, mesh,
+                                          donate=False)
+
+    state_single, m1 = single_step(state_single, batch)
+    state_dp, m2 = dp_step(state_dp, parallel.shard_batch(mesh, batch))
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(state_single["variables"]),
+                    jax.tree_util.tree_leaves(state_dp["variables"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                                   atol=1e-5)
+
+
+def test_zero1_matches_dp(devices8):
+    """ZeRO-1 sharded optimizer must track plain DP step-for-step."""
+    mesh = parallel.make_mesh({"dp": 8})
+    model = MLP(hidden=(32,))
+    opt = optim.adamw(1e-2, weight_decay=0.01)
+
+    state = init_train_state(model, opt, jax.random.PRNGKey(0))
+    dp_state = parallel.replicate(mesh, jax.tree_util.tree_map(jnp.copy, state))
+
+    z_vars = jax.tree_util.tree_map(jnp.copy, state["variables"])
+    zero_state = {
+        "variables": parallel.replicate(mesh, z_vars),
+        "opt_state": parallel.zero1_init_opt_state(opt, z_vars["params"], mesh),
+        "rng": parallel.replicate(mesh, state["rng"]),
+    }
+
+    dp_step = parallel.make_dp_train_step(model, opt, _loss_fn, mesh, donate=False)
+    z_step = parallel.make_zero1_train_step(model, opt, _loss_fn, mesh, donate=False)
+
+    batches = data.mnist_batches(64, seed=4)
+    for _ in range(3):
+        batch = parallel.shard_batch(mesh, next(batches))
+        dp_state, m_dp = dp_step(dp_state, batch)
+        zero_state, m_z = z_step(zero_state, batch)
+
+    np.testing.assert_allclose(float(m_dp["loss"]), float(m_z["loss"]), rtol=1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(dp_state["variables"]["params"]),
+                    jax.tree_util.tree_leaves(zero_state["variables"]["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                                   atol=2e-5)
+
+
+def test_zero1_opt_state_is_sharded(devices8):
+    mesh = parallel.make_mesh({"dp": 8})
+    model = MLP(hidden=(32,))
+    opt = optim.adamw(1e-2)
+    variables = model.init(jax.random.PRNGKey(0))
+    opt_state = parallel.zero1_init_opt_state(opt, variables["params"], mesh)
+    mu_leaf = jax.tree_util.tree_leaves(opt_state["mu"])[0]
+    # Each device holds 1/8th of the flat stat.
+    shard_shapes = {s.data.shape for s in mu_leaf.addressable_shards}
+    assert all(s[0] == mu_leaf.shape[0] // 8 for s in shard_shapes)
+
+
+def test_ring_attention_matches_full(devices8):
+    mesh = parallel.make_mesh({"sp": 8})
+    b, h, s, d = 2, 4, 64, 16
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (b, h, s, d))
+    k = jax.random.normal(kk, (b, h, s, d))
+    v = jax.random.normal(kv, (b, h, s, d))
+
+    for causal in (True, False):
+        out = parallel.ring_self_attention(mesh, q, k, v, causal=causal)
+        mask = ops.causal_mask(s, s) if causal else None
+        ref = ops.dot_product_attention(q, k, v, mask=mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_matches_full(devices8):
+    from nezha_tpu.parallel.sequence_parallel import ulysses_attention
+    mesh = parallel.make_mesh({"sp": 8})
+    b, h, s, d = 2, 8, 64, 16
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(kq, (b, h, s, d))
+    k = jax.random.normal(kk, (b, h, s, d))
+    v = jax.random.normal(kv, (b, h, s, d))
+
+    fn = shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, "sp", causal=True),
+        mesh=mesh,
+        in_specs=(P(None, None, "sp", None),) * 3,
+        out_specs=P(None, None, "sp", None))
+    out = jax.jit(fn)(q, k, v)
+    ref = ops.dot_product_attention(q, k, v, mask=ops.causal_mask(s, s))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)

@@ -1,0 +1,96 @@
+"""Runtime tests: compile cache, executor dispatch, prefetcher."""
+
+import itertools
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from nezha_tpu.graph import Graph
+from nezha_tpu.runtime import Executor, Prefetcher, prefetch_to_device
+
+
+def test_executor_caches_compilations():
+    ex = Executor()
+
+    def f(x):
+        return x * 2
+
+    a = ex.run(f, jnp.ones((4,)))
+    b = ex.run(f, jnp.ones((4,)))
+    c = ex.run(f, jnp.ones((8,)))  # new shape -> new compile
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    assert c.shape == (8,)
+    stats = ex.stats()
+    assert stats["hits"] == 1 and stats["misses"] == 2
+
+
+def test_executor_runs_graph():
+    g = Graph("double")
+    x = g.placeholder((4,), name="x")
+    g.output(x + x)
+    ex = Executor()
+    out = ex.run(g, jnp.arange(4.0))
+    np.testing.assert_allclose(np.asarray(out), [0.0, 2.0, 4.0, 6.0])
+    ex.run(g, jnp.arange(4.0))
+    assert ex.stats()["hits"] == 1
+
+
+def test_prefetcher_yields_all_and_overlaps():
+    def slow_source():
+        for i in range(10):
+            time.sleep(0.01)
+            yield {"x": np.full((2,), i, np.float32)}
+
+    got = [int(b["x"][0]) for b in Prefetcher(slow_source(), depth=4)]
+    assert got == list(range(10))
+
+
+def test_prefetcher_multiworker_delivers_all_batches():
+    # One worker hitting StopIteration must not truncate batches that other
+    # workers are still staging.
+    def source():
+        for i in range(20):
+            yield {"x": np.full((2,), i, np.float32)}
+
+    got = sorted(int(b["x"][0]) for b in Prefetcher(source(), depth=2,
+                                                    num_workers=3))
+    assert got == list(range(20))
+
+
+def test_executor_distinguishes_same_shaped_graphs():
+    from nezha_tpu.graph import Graph
+
+    g1 = Graph("g")
+    x1 = g1.placeholder((4,))
+    g1.output(x1 + x1)
+    g2 = Graph("g")
+    x2 = g2.placeholder((4,))
+    g2.output(x2 * x2)
+    ex = Executor()
+    a = ex.run(g1, jnp.full((4,), 3.0))
+    b = ex.run(g2, jnp.full((4,), 3.0))
+    np.testing.assert_allclose(np.asarray(a), 6.0)
+    np.testing.assert_allclose(np.asarray(b), 9.0)
+    assert ex.stats()["misses"] == 2
+
+
+def test_prefetcher_propagates_errors():
+    def bad_source():
+        yield {"x": np.zeros(2, np.float32)}
+        raise RuntimeError("boom")
+
+    it = prefetch_to_device(bad_source())
+    next(it)
+    try:
+        next(it)
+    except RuntimeError as e:
+        assert "boom" in str(e)
+    else:
+        raise AssertionError("error not propagated")
+
+
+def test_prefetcher_close_mid_stream():
+    p = Prefetcher(itertools.count(), depth=2)
+    next(p)
+    p.close()  # must not hang
