@@ -1,0 +1,94 @@
+"""Headline benchmark: GPT-2 124M training throughput, tokens/sec/chip.
+
+Runs the FULL training step (forward + backward + AdamW, bf16 compute /
+fp32 master) on whatever platform jax selects — the real TPU chip under the
+driver. Prints exactly ONE JSON line:
+
+    {"metric": "gpt2_124m_tokens_per_sec_per_chip", "value": N,
+     "unit": "tokens/s/chip", "vs_baseline": R}
+
+``vs_baseline`` compares against BASELINE.json's published number when one
+exists; the reference published none (BASELINE.md: "no published numbers
+were recoverable"), so the fallback baseline is this repo's own recorded
+first measurement (bench_baseline.json), making the ratio a regression
+tracker. With no record at all it reports 1.0 and writes the record.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nezha_tpu import optim
+    from nezha_tpu.models.gpt2 import GPT2, GPT2Config, lm_loss
+    from nezha_tpu.tensor import bf16_policy
+    from nezha_tpu.train.loop import init_train_state, make_train_step
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform in ("tpu", "axon")
+
+    batch, seq = (8, 1024) if on_tpu else (2, 256)
+    steps_target = 20 if on_tpu else 3
+    cfg = GPT2Config() if on_tpu else GPT2Config(num_layers=4)
+
+    model = GPT2(cfg, policy=bf16_policy())
+    opt = optim.adamw(6e-4, weight_decay=0.1)
+    state = init_train_state(model, opt, jax.random.PRNGKey(0))
+    step = make_train_step(model, opt, lm_loss)
+
+    tokens = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32)
+    b = {"tokens": jnp.asarray(tokens)}
+
+    # Warmup (compile + first dispatch).
+    for _ in range(2):
+        state, m = step(state, b)
+    jax.block_until_ready(m["loss"])
+
+    t0 = time.perf_counter()
+    done = 0
+    while done < steps_target and (time.perf_counter() - t0) < 60.0:
+        state, m = step(state, b)
+        done += 1
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * done / dt
+
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "bench_baseline.json")
+    vs_baseline = 1.0
+    try:
+        with open(baseline_path) as f:
+            recorded = json.load(f)
+        base = recorded.get("gpt2_124m_tokens_per_sec_per_chip")
+        if base:
+            vs_baseline = tokens_per_sec / base
+    except FileNotFoundError:
+        if on_tpu:  # record the first real-chip measurement
+            try:
+                with open(baseline_path, "w") as f:
+                    json.dump({"gpt2_124m_tokens_per_sec_per_chip":
+                               tokens_per_sec, "platform": platform}, f)
+            except OSError:
+                pass
+
+    print(json.dumps({
+        "metric": "gpt2_124m_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

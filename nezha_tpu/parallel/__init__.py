@@ -24,6 +24,13 @@ from nezha_tpu.parallel.data_parallel import (
     sync_batch_stats,
 )
 from nezha_tpu.parallel.zero1 import make_zero1_train_step, zero1_init_opt_state
+from nezha_tpu.parallel.gspmd import (
+    GPT2_TP_RULES,
+    BERT_TP_RULES,
+    param_specs_from_rules,
+    shard_train_state,
+    make_gspmd_train_step,
+)
 
 __all__ = [
     "make_mesh", "make_cpu_mesh", "local_mesh_axes",
@@ -31,6 +38,8 @@ __all__ = [
     "ring_permute", "barrier",
     "make_dp_train_step", "shard_batch", "replicate", "sync_batch_stats",
     "make_zero1_train_step", "zero1_init_opt_state",
+    "GPT2_TP_RULES", "BERT_TP_RULES", "param_specs_from_rules",
+    "shard_train_state", "make_gspmd_train_step",
 ]
 
 

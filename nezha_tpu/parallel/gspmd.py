@@ -1,0 +1,164 @@
+"""GSPMD sharded training: annotate shardings, let XLA insert collectives.
+
+Beyond the reference's DP-only scope (SURVEY.md §0: only DP + ZeRO-1
+attested), this module is the idiomatic TPU scaling path: parameters carry
+Megatron-style `PartitionSpec`s over a ``tp`` mesh axis, the batch shards
+over ``dp``, the whole step is `jax.jit` with explicit in/out shardings, and
+XLA's SPMD partitioner inserts the all-reduces/all-gathers onto ICI — no
+hand-written collectives.
+
+Rule tables map parameter *paths* (regexes over ``a/b/c`` flattened names)
+to specs; unmatched leaves replicate.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nezha_tpu.nn.module import Module
+from nezha_tpu.optim.optimizers import Optimizer, apply_updates
+from nezha_tpu.train.loop import TrainState, merge_state
+
+Rules = List[Tuple[str, P]]
+
+# Megatron-style GPT-2 sharding: column-parallel qkv/fc (shard the output
+# features), row-parallel proj (shard the input features), vocab-sharded
+# embedding. LayerNorms and biases of row-parallel layers replicate.
+GPT2_TP_RULES: Rules = [
+    (r".*/qkv/w$", P(None, "tp")),
+    (r".*/qkv/b$", P("tp")),
+    (r".*/attn/proj/w$", P("tp", None)),
+    (r".*/mlp/fc/w$", P(None, "tp")),
+    (r".*/mlp/fc/b$", P("tp")),
+    (r".*/mlp/proj/w$", P("tp", None)),
+    (r"^wte/embedding$", P("tp", None)),
+]
+
+BERT_TP_RULES: Rules = [
+    (r".*/qkv/w$", P(None, "tp")),
+    (r".*/qkv/b$", P("tp")),
+    (r".*/attn_out/w$", P("tp", None)),
+    (r".*/fc/w$", P(None, "tp")),
+    (r".*/fc/b$", P("tp")),
+    (r".*/fc_out/w$", P("tp", None)),
+    (r"^tok_emb/embedding$", P("tp", None)),
+]
+
+
+def _leaf_path(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def param_specs_from_rules(params: Any, rules: Rules) -> Any:
+    """Pytree of PartitionSpecs matching ``params`` via first-match rules."""
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_for(path, leaf):
+        name = _leaf_path(path)
+        for pat, spec in compiled:
+            if pat.match(name):
+                return spec
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def _opt_state_specs(opt_state: Any, param_specs: Any) -> Any:
+    """Optimizer stats inherit their parameter's spec; scalars replicate."""
+    out = {}
+    for key, sub in opt_state.items():
+        if hasattr(sub, "ndim") and sub.ndim == 0:
+            out[key] = P()
+        elif isinstance(sub, dict) and jax.tree_util.tree_structure(
+                sub) == jax.tree_util.tree_structure(param_specs):
+            out[key] = param_specs
+        else:
+            out[key] = jax.tree_util.tree_map(lambda _: P(), sub)
+    return out
+
+
+def shard_train_state(state: TrainState, mesh: Mesh, param_specs: Any) -> TrainState:
+    """Lay out an initialized TrainState across the mesh per the specs."""
+
+    def put(tree, specs):
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs)
+
+    return {
+        "variables": {
+            "params": put(state["variables"]["params"], param_specs),
+            "state": jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, NamedSharding(mesh, P())),
+                state["variables"]["state"]),
+        },
+        "opt_state": put(state["opt_state"],
+                         _opt_state_specs(state["opt_state"], param_specs)),
+        "rng": jax.device_put(state["rng"], NamedSharding(mesh, P())),
+    }
+
+
+def make_gspmd_train_step(model: Module, optimizer: Optimizer,
+                          loss_fn: Callable[[Any, dict], Any],
+                          mesh: Mesh, param_specs: Any,
+                          batch_axis: str = "dp", donate: bool = True):
+    """jit-with-shardings train step: DP over ``batch_axis``, TP per
+    ``param_specs``; XLA inserts every collective."""
+
+    def step(state: TrainState, batch: dict):
+        variables, opt_state = state["variables"], state["opt_state"]
+        rng, next_rng = jax.random.split(state["rng"])
+
+        def compute_loss(params):
+            out, new_state = model.apply(
+                {"params": params, "state": variables["state"]},
+                batch, training=True, rng=rng)
+            return jnp.asarray(loss_fn(out, batch), jnp.float32), new_state
+
+        (loss, new_state), grads = jax.value_and_grad(
+            compute_loss, has_aux=True)(variables["params"])
+        updates, new_opt = optimizer.update(grads, opt_state, variables["params"])
+        params = apply_updates(variables["params"], updates)
+        return ({"variables": {"params": params,
+                               "state": merge_state(variables["state"], new_state)},
+                 "opt_state": new_opt, "rng": next_rng},
+                {"loss": loss})
+
+    def shardings_of(tree):
+        # Reuse the committed layout of the (already-placed) state/batch.
+        return jax.tree_util.tree_map(lambda x: x.sharding, tree)
+
+    _cache: Dict = {}
+
+    def stepper(state: TrainState, batch: dict):
+        key = tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                           for k, v in batch.items()))
+        if key not in _cache:
+            state_sh = shardings_of(state)
+            batch_sh = jax.tree_util.tree_map(
+                lambda v: NamedSharding(mesh, P(batch_axis)), batch)
+            _cache[key] = jax.jit(
+                step,
+                in_shardings=(state_sh, batch_sh),
+                out_shardings=(state_sh, NamedSharding(mesh, P())),
+                donate_argnums=(0,) if donate else ())
+        return _cache[key](state, batch)
+
+    return stepper
+
+
+def shard_batch_gspmd(mesh: Mesh, batch: Any, axis: str = "dp") -> Any:
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)

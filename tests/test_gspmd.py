@@ -1,0 +1,74 @@
+"""GSPMD dp×tp tests: spec rules hit the right leaves, the sharded step
+matches single-device numerics, params actually land sharded."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from nezha_tpu import optim, parallel
+from nezha_tpu.models.gpt2 import GPT2, GPT2Config, lm_loss
+from nezha_tpu.train.loop import init_train_state, make_train_step
+
+
+def tiny_gpt2():
+    return GPT2(GPT2Config(vocab_size=128, max_positions=32, num_layers=2,
+                           num_heads=4, hidden_size=32))
+
+
+def test_param_specs_rules():
+    model = tiny_gpt2()
+    params = model.init(jax.random.PRNGKey(0))["params"]
+    specs = parallel.param_specs_from_rules(params, parallel.GPT2_TP_RULES)
+    assert specs["h0"]["attn"]["qkv"]["w"] == P(None, "tp")
+    assert specs["h0"]["attn"]["proj"]["w"] == P("tp", None)
+    assert specs["h1"]["mlp"]["fc"]["b"] == P("tp")
+    assert specs["wte"]["embedding"] == P("tp", None)
+    assert specs["ln_f"]["scale"] == P()
+    assert specs["wpe"]["embedding"] == P()
+
+
+def test_gspmd_step_matches_single_device(devices8):
+    mesh = parallel.make_mesh({"dp": 2, "tp": 4})
+    model = tiny_gpt2()
+    opt = optim.adamw(1e-3, weight_decay=0.0)
+
+    state0 = init_train_state(model, opt, jax.random.PRNGKey(0))
+    batch = {"tokens": jnp.asarray(
+        np.random.RandomState(0).randint(0, 128, (8, 17)), jnp.int32)}
+
+    # Single device reference.
+    ref_step = make_train_step(model, opt, lm_loss, donate=False)
+    ref_state, ref_m = ref_step(jax.tree_util.tree_map(jnp.copy, state0), batch)
+
+    # dp=2 x tp=4 GSPMD.
+    specs = parallel.param_specs_from_rules(
+        state0["variables"]["params"], parallel.GPT2_TP_RULES)
+    sharded = parallel.shard_train_state(state0, mesh, specs)
+    step = parallel.make_gspmd_train_step(model, opt, lm_loss, mesh, specs,
+                                          donate=False)
+    new_state, m = step(sharded, parallel.gspmd.shard_batch_gspmd(mesh, batch))
+
+    np.testing.assert_allclose(float(ref_m["loss"]), float(m["loss"]),
+                               rtol=1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(ref_state["variables"]["params"]),
+                    jax.tree_util.tree_leaves(new_state["variables"]["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4,
+                                   atol=5e-5)
+
+
+def test_gspmd_params_are_physically_sharded(devices8):
+    mesh = parallel.make_mesh({"dp": 2, "tp": 4})
+    model = tiny_gpt2()
+    opt = optim.adamw(1e-3)
+    state = init_train_state(model, opt, jax.random.PRNGKey(0))
+    specs = parallel.param_specs_from_rules(
+        state["variables"]["params"], parallel.GPT2_TP_RULES)
+    sharded = parallel.shard_train_state(state, mesh, specs)
+    qkv_w = sharded["variables"]["params"]["h0"]["attn"]["qkv"]["w"]
+    # (32, 96) sharded over tp=4 on dim 1 -> local (32, 24) per device.
+    shapes = {s.data.shape for s in qkv_w.addressable_shards}
+    assert shapes == {(32, 24)}
+    # Optimizer stats follow the param layout (mu of qkv/w also sharded).
+    mu = sharded["opt_state"]["mu"]["h0"]["attn"]["qkv"]["w"]
+    assert {s.data.shape for s in mu.addressable_shards} == {(32, 24)}
