@@ -48,17 +48,20 @@ def main() -> int:
         0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32)
     b = {"tokens": jnp.asarray(tokens)}
 
-    # Warmup (compile + first dispatch).
+    # Warmup (compile + first dispatch). Synchronize by fetching the loss to
+    # host (device_get): on the tunneled `axon` platform block_until_ready
+    # returns before the computation finishes, which once inflated this
+    # number ~30x — only a host fetch is a true barrier there.
     for _ in range(2):
         state, m = step(state, b)
-    jax.block_until_ready(m["loss"])
+    float(m["loss"])
 
     t0 = time.perf_counter()
     done = 0
     while done < steps_target and (time.perf_counter() - t0) < 60.0:
         state, m = step(state, b)
         done += 1
-    jax.block_until_ready(m["loss"])
+    float(m["loss"])
     dt = time.perf_counter() - t0
 
     tokens_per_sec = batch * seq * done / dt
@@ -80,6 +83,8 @@ def main() -> int:
                                tokens_per_sec, "platform": platform}, f)
             except OSError:
                 pass
+    except (ValueError, AttributeError, OSError):
+        pass  # corrupt/partial record: report vs_baseline=1.0, don't crash
 
     print(json.dumps({
         "metric": "gpt2_124m_tokens_per_sec_per_chip",

@@ -52,4 +52,14 @@ def __getattr__(name):
     if name in ("ulysses_attention",):
         mod = importlib.import_module("nezha_tpu.parallel.sequence_parallel")
         return getattr(mod, name)
+    if name in ("PipelineSpec", "pipeline_blocks", "pipelined_forward",
+                "init_pipeline_state", "make_pipeline_train_step",
+                "merge_pipeline_params", "gpt2_pipeline_spec",
+                "stack_block_params", "unstack_block_params"):
+        mod = importlib.import_module("nezha_tpu.parallel.pipeline")
+        return getattr(mod, name)
+    if name in ("MoE", "MoEConfig", "MOE_EP_RULES", "shard_moe_params",
+                "dryrun_moe_step"):
+        mod = importlib.import_module("nezha_tpu.parallel.expert")
+        return getattr(mod, name)
     raise AttributeError(name)

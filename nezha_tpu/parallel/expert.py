@@ -1,0 +1,192 @@
+"""Mixture-of-experts with expert parallelism over an ``ep`` mesh axis.
+
+Not attested in the reference (SURVEY.md §0: only DP + ZeRO-1 observed);
+included per the build brief (dp/tp/pp/sp/ep are all first-class).
+
+TPU-first design — the Mesh-TensorFlow/Flaxformer dense-dispatch
+formulation rather than gather/scatter token shuffling:
+
+- Routing produces *static-shape* one-hot dispatch/combine tensors
+  [T, E, C] (top-k gating, fixed capacity C per expert). No dynamic shapes,
+  so the whole layer stays inside one XLA program.
+- Dispatch, expert compute, and combine are einsums — MXU work, not
+  scalar indexing.
+- Expert weights are stacked [E, d, f] and sharded over ``ep`` with GSPMD
+  PartitionSpecs; XLA's SPMD partitioner inserts the token all-to-alls
+  between the dp-sharded token axis and the ep-sharded expert axis (the
+  TPU-native equivalent of NCCL all-to-all in GPU MoE stacks).
+- Tokens over capacity are *dropped* (standard Switch behavior) and the
+  load-balance auxiliary loss keeps the router near-uniform.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nezha_tpu import nn
+from nezha_tpu.nn import initializers as init_lib
+from nezha_tpu.nn.module import Module, Variables, make_variables
+from nezha_tpu.tensor.policy import DEFAULT_POLICY, Policy
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    d_model: int
+    d_ff: int
+    num_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.5
+    aux_loss_weight: float = 0.01
+
+
+def _top_k_gating(router_logits: jax.Array, top_k: int, num_experts: int,
+                  capacity: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Return (dispatch [T,E,C] one-hot, combine [T,E,C], aux_loss scalar)."""
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)  # [T,E]
+    t = probs.shape[0]
+
+    gate_list, mask_list = [], []
+    remaining = probs
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)                       # [T]
+        onehot = jax.nn.one_hot(idx, num_experts, dtype=probs.dtype)
+        gate_list.append(jnp.sum(probs * onehot, axis=-1))         # [T]
+        mask_list.append(onehot)
+        remaining = remaining * (1.0 - onehot)
+
+    # Position of each token within its expert's capacity buffer: cumsum of
+    # the selection mask over tokens, counting earlier top-k passes first.
+    dispatch = jnp.zeros((t, num_experts, capacity), probs.dtype)
+    combine = jnp.zeros((t, num_experts, capacity), probs.dtype)
+    prior = jnp.zeros((num_experts,), probs.dtype)
+    for gate, mask in zip(gate_list, mask_list):
+        pos = jnp.cumsum(mask, axis=0) - mask + prior[None, :]     # [T,E]
+        in_cap = (pos < capacity) & (mask > 0)
+        pos_clamped = jnp.minimum(pos, capacity - 1).astype(jnp.int32)
+        onehot_cap = jax.nn.one_hot(pos_clamped, capacity, dtype=probs.dtype)
+        sel = onehot_cap * in_cap[..., None] * mask[..., None]     # [T,E,C]
+        dispatch = dispatch + sel
+        combine = combine + sel * gate[:, None, None]
+        prior = prior + jnp.sum(mask, axis=0)
+
+    # Switch-style load-balance loss: E * sum_e fraction_e * router_prob_e.
+    frac = jnp.mean(mask_list[0], axis=0)          # top-1 assignment fraction
+    prob = jnp.mean(probs, axis=0)
+    aux = num_experts * jnp.sum(frac * prob)
+    return dispatch, combine, aux
+
+
+class MoE(Module):
+    """Top-k routed mixture of expert MLPs (GELU two-layer experts).
+
+    ``apply`` returns ``(y, state)`` where ``state['aux_loss']`` carries the
+    load-balance loss — add ``cfg.aux_loss_weight * aux_loss`` to the
+    training objective.
+    """
+
+    def __init__(self, cfg: MoEConfig, policy: Policy = DEFAULT_POLICY,
+                 name: Optional[str] = None):
+        self.cfg = cfg
+        self.policy = policy
+        self.router = nn.Linear(cfg.d_model, cfg.num_experts,
+                                kernel_init=init_lib.normal(0.02),
+                                use_bias=False, policy=policy)
+
+    def init(self, rng: jax.Array) -> Variables:
+        cfg = self.cfg
+        r_router, r_in, r_out = jax.random.split(rng, 3)
+        k_in = init_lib.normal(0.02)(
+            r_in, (cfg.num_experts, cfg.d_model, cfg.d_ff), jnp.float32)
+        k_out = init_lib.normal(0.02)(
+            r_out, (cfg.num_experts, cfg.d_ff, cfg.d_model), jnp.float32)
+        return make_variables({
+            "router": self.router.init(r_router)["params"],
+            "w_in": k_in,
+            "w_out": k_out,
+        })
+
+    def capacity(self, num_tokens: int) -> int:
+        cfg = self.cfg
+        return max(1, int(cfg.capacity_factor * cfg.top_k * num_tokens
+                          / cfg.num_experts))
+
+    def apply(self, variables: Variables, x, training: bool = False, rng=None):
+        cfg = self.cfg
+        params = variables["params"]
+        b, s, d = x.shape
+        tokens = x.reshape(b * s, d)
+        num_tokens = b * s
+        cap = self.capacity(num_tokens)
+
+        logits, _ = self.router.apply({"params": params["router"], "state": {}},
+                                      tokens)
+        dispatch, combine, aux = _top_k_gating(
+            logits, cfg.top_k, cfg.num_experts, cap)
+
+        compute_dtype = self.policy.compute_dtype
+        xin = jnp.einsum("tec,td->ecd", dispatch.astype(compute_dtype),
+                         tokens.astype(compute_dtype))
+        h = jnp.einsum("ecd,edf->ecf", xin,
+                       params["w_in"].astype(compute_dtype))
+        h = jax.nn.gelu(h)
+        out = jnp.einsum("ecf,efd->ecd", h,
+                         params["w_out"].astype(compute_dtype))
+        y = jnp.einsum("tec,ecd->td", combine.astype(compute_dtype), out)
+        y = y.reshape(b, s, d).astype(x.dtype)
+        return y, {"aux_loss": aux}
+
+
+def moe_ep_rules(ep_axis: str = "ep"):
+    """GSPMD rules: stacked expert weights shard over ``ep_axis`` on the
+    expert axis; the router (and everything else) replicates."""
+    return [
+        (r".*w_in$", P(ep_axis, None, None)),
+        (r".*w_out$", P(ep_axis, None, None)),
+    ]
+
+
+MOE_EP_RULES = moe_ep_rules()
+
+
+def shard_moe_params(params: Any, mesh: Mesh, ep_axis: str = "ep") -> Any:
+    """Place a MoE param tree per ``moe_ep_rules`` (single source of truth
+    with the exported rule table)."""
+    from nezha_tpu.parallel.gspmd import param_specs_from_rules
+
+    specs = param_specs_from_rules(params, moe_ep_rules(ep_axis))
+    return jax.tree_util.tree_map(
+        lambda leaf, s: jax.device_put(leaf, NamedSharding(mesh, s)),
+        params, specs)
+
+
+def dryrun_moe_step(mesh: Mesh, n_experts: int, ep_axis: str = "ep",
+                    dp_axis: str = "dp") -> float:
+    """One expert-parallel MoE train step on tiny shapes (driver dry-run):
+    dp-sharded tokens x ep-sharded experts, full fwd+bwd+SGD update."""
+    cfg = MoEConfig(d_model=16, d_ff=32, num_experts=n_experts)
+    layer = MoE(cfg)
+    variables = layer.init(jax.random.PRNGKey(0))
+    params = shard_moe_params(variables["params"], mesh, ep_axis)
+
+    dp = mesh.shape.get(dp_axis, 1)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2 * dp, 8, cfg.d_model))
+    x = jax.device_put(x, NamedSharding(mesh, P(dp_axis)))
+
+    def loss_fn(p, x):
+        y, st = layer.apply({"params": p, "state": {}}, x)
+        return jnp.mean((y - x) ** 2) + cfg.aux_loss_weight * st["aux_loss"]
+
+    @jax.jit
+    def step(p, x):
+        loss, grads = jax.value_and_grad(loss_fn)(p, x)
+        p = jax.tree_util.tree_map(lambda w, g: w - 1e-2 * g, p, grads)
+        return loss, p
+
+    loss, params = step(params, x)
+    jax.block_until_ready(loss)
+    return float(loss)

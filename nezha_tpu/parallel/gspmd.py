@@ -76,8 +76,10 @@ def param_specs_from_rules(params: Any, rules: Rules) -> Any:
     return jax.tree_util.tree_map_with_path(spec_for, params)
 
 
-def _opt_state_specs(opt_state: Any, param_specs: Any) -> Any:
-    """Optimizer stats inherit their parameter's spec; scalars replicate."""
+def opt_state_specs(opt_state: Any, param_specs: Any) -> Any:
+    """Optimizer stats inherit their parameter's spec; scalars replicate.
+
+    Shared by the GSPMD and pipeline state-placement paths."""
     out = {}
     for key, sub in opt_state.items():
         if hasattr(sub, "ndim") and sub.ndim == 0:
@@ -105,7 +107,7 @@ def shard_train_state(state: TrainState, mesh: Mesh, param_specs: Any) -> TrainS
                 state["variables"]["state"]),
         },
         "opt_state": put(state["opt_state"],
-                         _opt_state_specs(state["opt_state"], param_specs)),
+                         opt_state_specs(state["opt_state"], param_specs)),
         "rng": jax.device_put(state["rng"], NamedSharding(mesh, P())),
     }
 

@@ -1,0 +1,259 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a ``pp`` mesh axis.
+
+Not attested in the reference (SURVEY.md §0: only DP + ZeRO-1 observed), but
+first-class here per the build brief: model depth must scale past one chip.
+
+TPU-first design — the whole schedule is ONE SPMD program, not a host-side
+scheduler like GPU pipeline runtimes:
+
+- Block parameters are *stacked* along a leading layer axis and sharded over
+  the ``pp`` mesh axis, so each pipeline rank holds a contiguous slab of
+  layers (its *stage*) and the optimizer update for its slab stays local.
+- The schedule is a ``lax.scan`` over ticks inside ``shard_map``. Each tick,
+  every rank applies its stage to the activation it holds and hands the
+  result to its ring neighbour with ``lax.ppermute`` (XLA lowers this to an
+  ICI neighbour DMA overlapped with the next tick's matmuls).
+- Backward is plain ``jax.grad`` through the scan: shard_map transposes
+  ``ppermute`` to the reverse hop, so the backward pipeline runs the ring in
+  the opposite direction automatically — no hand-written backward schedule.
+- The embed/head ("outer") parameters run replicated outside the pipelined
+  region under normal GSPMD, so they compose with dp sharding of the batch.
+
+Bubble fraction is the usual GPipe (S-1)/(M+S-1); raise ``num_microbatches``
+to amortize.
+"""
+
+from __future__ import annotations
+
+import re
+from functools import partial
+from typing import Any, Callable, Dict, List, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nezha_tpu.optim.optimizers import Optimizer, apply_updates
+from nezha_tpu.parallel._compat import shard_map
+
+PyTree = Any
+
+
+class PipelineSpec(NamedTuple):
+    """How to pipeline a model of shape embed -> N identical blocks -> head.
+
+    - ``embed_fn(outer_params, batch) -> x``: pre-pipeline compute (token +
+      position embedding), replicated over pp, GSPMD-sharded over dp.
+    - ``block_fn(block_params, x) -> x``: apply ONE block; scanned over each
+      stage's layer slab inside the pipeline.
+    - ``head_fn(outer_params, x) -> out``: post-pipeline compute (final norm
+      + LM head).
+    - ``split(params) -> (outer, [block_params, ...])`` and
+      ``merge(outer, [block_params, ...]) -> params`` convert between the
+      model's native param tree and the pipelined layout.
+    """
+
+    embed_fn: Callable[[PyTree, Any], jax.Array]
+    block_fn: Callable[[PyTree, jax.Array], jax.Array]
+    head_fn: Callable[[PyTree, jax.Array], jax.Array]
+    split: Callable[[PyTree], Tuple[PyTree, List[PyTree]]]
+    merge: Callable[[PyTree, List[PyTree]], PyTree]
+
+
+def stack_block_params(blocks: List[PyTree]) -> PyTree:
+    """Stack per-layer param trees into leading-axis arrays [L, ...]."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
+
+
+def unstack_block_params(stacked: PyTree) -> List[PyTree]:
+    n = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    return [jax.tree_util.tree_map(lambda a: a[i], stacked) for i in range(n)]
+
+
+def pipeline_blocks(stage_params: PyTree, x: jax.Array,
+                    block_fn: Callable[[PyTree, jax.Array], jax.Array],
+                    num_microbatches: int, axis_name: str = "pp") -> jax.Array:
+    """The SPMD pipeline body. Call inside shard_map over ``axis_name``.
+
+    ``stage_params``: this rank's slab of stacked layer params [L_stage, ...].
+    ``x``: the local batch of activations [B_local, ...]; split into
+    ``num_microbatches`` microbatches internally. Returns [B_local, ...].
+    """
+    world = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    m = num_microbatches
+    b_local = x.shape[0]
+    if b_local % m:
+        raise ValueError(f"local batch {b_local} not divisible by "
+                         f"num_microbatches {m}")
+    xs = x.reshape(m, b_local // m, *x.shape[1:])
+
+    def stage_fn(params_slab, h):
+        def body(h, layer_params):
+            return block_fn(layer_params, h), None
+        h, _ = lax.scan(body, h, params_slab)
+        return h
+
+    perm = [(i, (i + 1) % world) for i in range(world)]
+    ticks = m + world - 1
+
+    def tick(carry, t):
+        state, outputs = carry
+        x_in = lax.dynamic_index_in_dim(xs, jnp.clip(t, 0, m - 1), 0,
+                                        keepdims=False)
+        inp = jnp.where(stage == 0, x_in, state)
+        out = stage_fn(stage_params, inp)
+        out_idx = jnp.clip(t - (world - 1), 0, m - 1)
+        valid = jnp.logical_and(stage == world - 1, t >= world - 1)
+        cur = lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(valid, out, cur), out_idx, 0)
+        state = lax.ppermute(out, axis_name, perm)
+        return (state, outputs), None
+
+    state0 = jnp.zeros_like(xs[0])
+    outputs0 = jnp.zeros_like(xs)
+    (_, outputs), _ = lax.scan(tick, (state0, outputs0), jnp.arange(ticks))
+
+    # Only the last stage's buffer is real; broadcast it to every pp rank
+    # (masked psum — the transpose under grad is the matching masked psum).
+    outputs = lax.psum(
+        jnp.where(stage == world - 1, outputs, jnp.zeros_like(outputs)),
+        axis_name)
+    return outputs.reshape(b_local, *x.shape[1:])
+
+
+def pipelined_forward(spec: PipelineSpec, pparams: Dict[str, PyTree],
+                      batch_inputs: Any, mesh: Mesh, num_microbatches: int,
+                      pp_axis: str = "pp", dp_axis: str = "dp") -> jax.Array:
+    """Full forward: embed (GSPMD) -> pipelined blocks (shard_map) -> head.
+
+    ``pparams``: {"outer": outer_params, "blocks": stacked [L, ...] tree}.
+    """
+    x = spec.embed_fn(pparams["outer"], batch_inputs)
+    dp_in_mesh = dp_axis in mesh.axis_names
+    xspec = P(dp_axis) if dp_in_mesh else P()
+    run = shard_map(
+        partial(pipeline_blocks, block_fn=spec.block_fn,
+                num_microbatches=num_microbatches, axis_name=pp_axis),
+        mesh=mesh, in_specs=(P(pp_axis), xspec), out_specs=xspec)
+    y = run(pparams["blocks"], x)
+    return spec.head_fn(pparams["outer"], y)
+
+
+def init_pipeline_state(variables: PyTree, spec: PipelineSpec,
+                        optimizer: Optimizer, mesh: Mesh, rng: jax.Array,
+                        pp_axis: str = "pp") -> Dict[str, Any]:
+    """Build + place the pipelined TrainState.
+
+    Outer params replicate; stacked block params shard over ``pp`` on the
+    layer axis (each rank gets its stage slab); optimizer slots follow their
+    parameter's layout.
+    """
+    outer, blocks = spec.split(variables["params"])
+    if len(blocks) % mesh.shape[pp_axis]:
+        raise ValueError(f"{len(blocks)} layers not divisible by pp="
+                         f"{mesh.shape[pp_axis]}")
+    pparams = {"outer": outer, "blocks": stack_block_params(blocks)}
+    opt_state = optimizer.init(pparams)
+
+    def specs_like(tree, is_blocks):
+        sp = P(pp_axis) if is_blocks else P()
+        return jax.tree_util.tree_map(lambda _: sp, tree)
+
+    def place(tree, spec_tree):
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            tree, spec_tree)
+
+    param_specs = {"outer": specs_like(outer, False),
+                   "blocks": specs_like(pparams["blocks"], True)}
+
+    from nezha_tpu.parallel.gspmd import opt_state_specs
+
+    return {
+        "pparams": place(pparams, param_specs),
+        "opt_state": place(opt_state, opt_state_specs(opt_state, param_specs)),
+        "rng": jax.device_put(rng, NamedSharding(mesh, P())),
+    }
+
+
+def make_pipeline_train_step(spec: PipelineSpec, optimizer: Optimizer,
+                             loss_fn: Callable[[jax.Array, dict], jax.Array],
+                             mesh: Mesh, num_microbatches: int,
+                             pp_axis: str = "pp", dp_axis: str = "dp",
+                             donate: bool = True):
+    """jit'd train step over {"pparams", "opt_state", "rng"} state.
+
+    Batch dicts shard over ``dp_axis`` (when present in the mesh); grads of
+    stage slabs stay pp-local, grads of outer params are psum'd by the SPMD
+    partitioner. Returns ``step(state, batch) -> (state, metrics)``.
+    """
+
+    def step(state, batch):
+        rng, next_rng = jax.random.split(state["rng"])
+
+        def compute_loss(pparams):
+            out = pipelined_forward(spec, pparams, batch, mesh,
+                                    num_microbatches, pp_axis, dp_axis)
+            return jnp.asarray(loss_fn(out, batch), jnp.float32)
+
+        loss, grads = jax.value_and_grad(compute_loss)(state["pparams"])
+        updates, new_opt = optimizer.update(grads, state["opt_state"],
+                                            state["pparams"])
+        pparams = apply_updates(state["pparams"], updates)
+        return ({"pparams": pparams, "opt_state": new_opt, "rng": next_rng},
+                {"loss": loss})
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def merge_pipeline_params(spec: PipelineSpec, pparams: Dict[str, PyTree]) -> PyTree:
+    """Back to the model's native param tree (for eval/checkpoint export)."""
+    return spec.merge(pparams["outer"], unstack_block_params(pparams["blocks"]))
+
+
+# ---------------------------------------------------------------------------
+# Model adapters
+
+
+def gpt2_pipeline_spec(model) -> PipelineSpec:
+    """PipelineSpec for ``nezha_tpu.models.gpt2.GPT2`` (stateless path:
+    dropout off inside the pipelined region)."""
+    from nezha_tpu.nn.module import child_vars
+
+    cfg = model.cfg
+    template = model.h[0]
+
+    def embed_fn(outer, batch):
+        tokens = batch["tokens"][:, :-1] if isinstance(batch, dict) else batch
+        variables = {"params": outer, "state": {}}
+        pos = jnp.arange(tokens.shape[1])[None, :]
+        x, _ = model.wte.apply(child_vars(variables, "wte"), tokens)
+        pe, _ = model.wpe.apply(child_vars(variables, "wpe"), pos)
+        return x + pe
+
+    def block_fn(block_params, x):
+        out, _ = template.apply({"params": block_params, "state": {}}, x)
+        return out
+
+    def head_fn(outer, x):
+        variables = {"params": outer, "state": {}}
+        x, _ = model.ln_f.apply(child_vars(variables, "ln_f"), x)
+        logits = model.wte.attend(child_vars(variables, "wte"), x)
+        return jnp.asarray(logits, jnp.float32)
+
+    def split(params):
+        pat = re.compile(r"^h(\d+)$")
+        blocks = [params[f"h{i}"] for i in range(cfg.num_layers)]
+        outer = {k: v for k, v in params.items() if not pat.match(k)}
+        return outer, blocks
+
+    def merge(outer, blocks):
+        p = dict(outer)
+        for i, b in enumerate(blocks):
+            p[f"h{i}"] = b
+        return p
+
+    return PipelineSpec(embed_fn, block_fn, head_fn, split, merge)
