@@ -83,7 +83,7 @@ def main() -> int:
                                tokens_per_sec, "platform": platform}, f)
             except OSError:
                 pass
-    except (ValueError, AttributeError, OSError):
+    except (ValueError, TypeError, AttributeError, OSError):
         pass  # corrupt/partial record: report vs_baseline=1.0, don't crash
 
     print(json.dumps({

@@ -1,0 +1,612 @@
+// nezha_tpu native coordinator.
+//
+// TPU-native counterpart of the reference's gRPC coordinator (SURVEY.md §1
+// "Distributed runtime", §2 "gRPC coordinator"): rank rendezvous, a small
+// key/value store for topology exchange (the role NCCL-unique-id broadcast
+// played in the reference; here it carries PJRT/jax.distributed addresses
+// or any rendezvous blob), a world barrier, and heartbeat-based failure
+// detection.  Plain TCP with a length-prefixed binary protocol — no RPC
+// framework dependency — exposed through a C ABI for Python ctypes.
+//
+// Threading model: the server runs an accept loop plus one thread per
+// connection (world sizes are the number of *hosts*, small); shared state
+// is one mutex + condition_variable.  Blocking semantics (GET waits for a
+// key, BARRIER waits for the world) are implemented as cv waits on the
+// connection's thread, so the protocol stays strictly request/reply.
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+// ---------------------------------------------------------------- protocol
+enum MsgType : uint32_t {
+  MSG_HELLO = 1,      // val: int32 rank_hint (-1 = assign any)
+  MSG_PUT = 2,        // key + val
+  MSG_GET = 3,        // key; val: int64 timeout_ms
+  MSG_BARRIER = 4,    // val: int64 timeout_ms
+  MSG_HEARTBEAT = 5,  // no payload
+  MSG_FAILED = 6,     // no payload -> VAL int32[] failed ranks
+  MSG_LEAVE = 7,      // graceful departure
+  MSG_OK = 100,
+  MSG_VAL = 101,
+  MSG_ERR = 102,
+  MSG_ASSIGN = 103,  // val: int32 rank, int32 world
+};
+
+struct Header {
+  uint32_t type;
+  uint32_t klen;
+  uint32_t vlen;
+};
+
+thread_local std::string g_error;
+
+void set_error(const std::string& e) { g_error = e; }
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_msg(int fd, uint32_t type, const std::string& key,
+              const std::string& val) {
+  Header h{type, static_cast<uint32_t>(key.size()),
+           static_cast<uint32_t>(val.size())};
+  if (!write_full(fd, &h, sizeof(h))) return false;
+  if (!key.empty() && !write_full(fd, key.data(), key.size())) return false;
+  if (!val.empty() && !write_full(fd, val.data(), val.size())) return false;
+  return true;
+}
+
+// 64 MiB cap on any single payload — rendezvous blobs are tiny; this is a
+// guard against a corrupt header, not a real limit.
+constexpr uint32_t kMaxPayload = 64u << 20;
+
+bool recv_msg(int fd, uint32_t* type, std::string* key, std::string* val) {
+  Header h;
+  if (!read_full(fd, &h, sizeof(h))) return false;
+  if (h.klen > kMaxPayload || h.vlen > kMaxPayload) return false;
+  key->resize(h.klen);
+  val->resize(h.vlen);
+  if (h.klen && !read_full(fd, &(*key)[0], h.klen)) return false;
+  if (h.vlen && !read_full(fd, &(*val)[0], h.vlen)) return false;
+  *type = h.type;
+  return true;
+}
+
+std::string pack_i32(int32_t a) {
+  std::string s(4, '\0');
+  std::memcpy(&s[0], &a, 4);
+  return s;
+}
+
+std::string pack_i32x2(int32_t a, int32_t b) {
+  std::string s(8, '\0');
+  std::memcpy(&s[0], &a, 4);
+  std::memcpy(&s[4], &b, 4);
+  return s;
+}
+
+int64_t unpack_i64(const std::string& s, int64_t dflt) {
+  if (s.size() < 8) return dflt;
+  int64_t v;
+  std::memcpy(&v, s.data(), 8);
+  return v;
+}
+
+// ------------------------------------------------------------------ server
+class CoordServer {
+ public:
+  CoordServer(int port, int world, int hb_timeout_ms)
+      : world_(world), hb_timeout_ms_(hb_timeout_ms) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
+        0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return;
+    }
+    socklen_t len = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    port_ = ntohs(addr.sin_port);
+    ::listen(listen_fd_, 128);
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+  }
+
+  ~CoordServer() { Stop(); }
+
+  bool ok() const { return listen_fd_ >= 0; }
+  int port() const { return port_; }
+
+  void Stop() {
+    bool expected = false;
+    if (!stopping_.compare_exchange_strong(expected, true)) return;
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+    }
+    cv_.notify_all();
+    if (accept_thread_.joinable()) accept_thread_.join();
+    for (auto& t : conn_threads_)
+      if (t.joinable()) t.join();
+  }
+
+ private:
+  void AcceptLoop() {
+    while (!stopping_) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> lk(mu_);
+      conn_fds_.insert(fd);
+      conn_threads_.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+
+  void Serve(int fd) {
+    int rank = -1;       // set by HELLO
+    uint64_t gen = 0;    // this connection's claim on the rank
+    bool disconnected = false;
+    uint32_t type = 0;
+    std::string key, val;
+    while (!stopping_ && !disconnected && recv_msg(fd, &type, &key, &val)) {
+      switch (type) {
+        case MSG_HELLO: {
+          std::unique_lock<std::mutex> lk(mu_);
+          int32_t hint = -1;
+          if (val.size() >= 4) std::memcpy(&hint, val.data(), 4);
+          if (hint >= 0 && hint < world_ && !assigned_.count(hint)) {
+            rank = hint;
+          } else {
+            for (int r = 0; r < world_; ++r)
+              if (!assigned_.count(r)) {
+                rank = r;
+                break;
+              }
+          }
+          if (rank < 0) {
+            lk.unlock();
+            send_msg(fd, MSG_ERR, "", "world full");
+            continue;
+          }
+          assigned_.insert(rank);
+          last_seen_[rank] = Clock::now();
+          // A rank slot freed by crash or LEAVE is reclaimable (restart
+          // workflow: supervisor relaunches the rank, it rejoins).
+          failed_.erase(rank);
+          left_.erase(rank);
+          gen = ++conn_gen_[rank];
+          lk.unlock();
+          send_msg(fd, MSG_ASSIGN, "", pack_i32x2(rank, world_));
+          break;
+        }
+        case MSG_PUT: {
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            kv_[key] = val;
+            Touch(rank);
+          }
+          cv_.notify_all();
+          send_msg(fd, MSG_OK, "", "");
+          break;
+        }
+        case MSG_GET: {
+          int64_t timeout_ms = unpack_i64(val, -1);
+          std::unique_lock<std::mutex> lk(mu_);
+          Touch(rank);
+          auto pred = [&] { return stopping_ || kv_.count(key) > 0; };
+          int w = WaitBlocking(lk, fd, rank, timeout_ms, pred);
+          if (stopping_) return;
+          if (w < 0) { disconnected = true; break; }
+          if (w == 0) {
+            lk.unlock();
+            send_msg(fd, MSG_ERR, "", "get timeout: " + key);
+            break;
+          }
+          std::string out = kv_[key];
+          lk.unlock();
+          send_msg(fd, MSG_VAL, "", out);
+          break;
+        }
+        case MSG_BARRIER: {
+          int64_t timeout_ms = unpack_i64(val, -1);
+          std::unique_lock<std::mutex> lk(mu_);
+          Touch(rank);
+          uint64_t my_epoch = barrier_epoch_;
+          if (++barrier_count_ == world_) {
+            barrier_count_ = 0;
+            ++barrier_epoch_;
+            cv_.notify_all();
+          }
+          auto pred = [&] { return stopping_ || barrier_epoch_ > my_epoch; };
+          int w = WaitBlocking(lk, fd, rank, timeout_ms, pred);
+          if (stopping_) return;
+          if (w <= 0) {
+            // Withdraw from the still-pending epoch so a later retry (or
+            // this rank's failure) doesn't double-count it.
+            if (barrier_epoch_ == my_epoch && barrier_count_ > 0)
+              --barrier_count_;
+            if (w < 0) { disconnected = true; break; }
+            lk.unlock();
+            send_msg(fd, MSG_ERR, "", "barrier timeout");
+            break;
+          }
+          lk.unlock();
+          send_msg(fd, MSG_OK, "", "");
+          break;
+        }
+        case MSG_HEARTBEAT: {
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            Touch(rank);
+          }
+          send_msg(fd, MSG_OK, "", "");
+          break;
+        }
+        case MSG_FAILED: {
+          std::string out;
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            Touch(rank);
+            auto now = Clock::now();
+            std::set<int> failed = failed_;
+            for (auto& kvp : last_seen_) {
+              auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                            now - kvp.second)
+                            .count();
+              if (ms > hb_timeout_ms_) failed.insert(kvp.first);
+            }
+            for (int r : failed) out += pack_i32(r);
+          }
+          send_msg(fd, MSG_VAL, "", out);
+          break;
+        }
+        case MSG_LEAVE: {
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            if (rank >= 0 && conn_gen_[rank] == gen) {
+              left_.insert(rank);
+              assigned_.erase(rank);  // slot reusable by a replacement
+              last_seen_.erase(rank);
+            }
+          }
+          send_msg(fd, MSG_OK, "", "");
+          break;
+        }
+        default:
+          send_msg(fd, MSG_ERR, "", "bad message type");
+      }
+    }
+    // Connection dropped: a rank that never sent LEAVE is failed. The gen
+    // check keeps a stale connection's teardown from clobbering a
+    // replacement process that already re-claimed the rank.
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      conn_fds_.erase(fd);
+      if (rank >= 0 && conn_gen_[rank] == gen && !left_.count(rank)) {
+        failed_.insert(rank);
+        assigned_.erase(rank);  // slot reusable by a replacement
+        last_seen_.erase(rank);
+      }
+    }
+    cv_.notify_all();
+    ::close(fd);
+  }
+
+  void Touch(int rank) {
+    if (rank >= 0) last_seen_[rank] = Clock::now();
+  }
+
+  // Wait for `pred` under `lk` in short slices. Each slice refreshes the
+  // rank's liveness — a connection whose thread is servicing a blocking
+  // GET/BARRIER is proof of life even though the client's heartbeat is
+  // queued behind the in-flight request — and probes the socket so a peer
+  // that dies mid-wait is detected instead of waited on forever.
+  // Returns 1 released, 0 timeout, -1 peer disconnected.
+  template <typename Pred>
+  int WaitBlocking(std::unique_lock<std::mutex>& lk, int fd, int rank,
+                   int64_t timeout_ms, Pred pred) {
+    const bool bounded = timeout_ms >= 0;
+    const auto deadline =
+        Clock::now() + std::chrono::milliseconds(bounded ? timeout_ms : 0);
+    while (!pred()) {
+      auto slice = std::chrono::milliseconds(200);
+      if (bounded) {
+        auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+            deadline - Clock::now());
+        if (left.count() <= 0) return 0;
+        slice = std::min(slice, left);
+      }
+      cv_.wait_for(lk, slice);
+      Touch(rank);
+      char probe;
+      ssize_t r = ::recv(fd, &probe, 1, MSG_PEEK | MSG_DONTWAIT);
+      if (r == 0) return -1;  // orderly shutdown by peer
+    }
+    return 1;
+  }
+
+  int listen_fd_ = -1;
+  int port_ = 0;
+  const int world_;
+  const int hb_timeout_ms_;
+  std::atomic<bool> stopping_{false};
+  std::thread accept_thread_;
+  std::vector<std::thread> conn_threads_;
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::set<int> conn_fds_;
+  std::set<int> assigned_;
+  std::set<int> left_;
+  std::set<int> failed_;
+  std::map<int, uint64_t> conn_gen_;
+  std::map<int, Clock::time_point> last_seen_;
+  std::map<std::string, std::string> kv_;
+  int barrier_count_ = 0;
+  uint64_t barrier_epoch_ = 0;
+};
+
+// ------------------------------------------------------------------ client
+class CoordClient {
+ public:
+  CoordClient(const char* host, int port, int rank_hint, int timeout_ms,
+              int hb_interval_ms) {
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    std::string port_s = std::to_string(port);
+    auto deadline = Clock::now() + std::chrono::milliseconds(timeout_ms);
+    // Retry connect until the deadline: clients may start before the
+    // coordinator (the reference's rendezvous tolerated launch skew).
+    while (fd_ < 0) {
+      if (::getaddrinfo(host, port_s.c_str(), &hints, &res) == 0) {
+        int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+        if (::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+          int one = 1;
+          ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          fd_ = fd;
+        } else {
+          ::close(fd);
+        }
+        ::freeaddrinfo(res);
+        res = nullptr;
+      }
+      if (fd_ < 0) {
+        if (Clock::now() >= deadline) {
+          set_error("connect timeout");
+          return;
+        }
+        std::this_thread::sleep_for(std::chrono::milliseconds(50));
+      }
+    }
+    uint32_t type = 0;
+    std::string key, val;
+    if (!Request(MSG_HELLO, "", pack_i32(rank_hint), &type, &val) ||
+        type != MSG_ASSIGN || val.size() < 8) {
+      set_error(type == MSG_ERR ? val : "rendezvous failed");
+      ::close(fd_);
+      fd_ = -1;
+      return;
+    }
+    std::memcpy(&rank_, val.data(), 4);
+    std::memcpy(&world_, val.data() + 4, 4);
+    if (hb_interval_ms > 0) {
+      hb_thread_ = std::thread([this, hb_interval_ms] {
+        while (!closing_) {
+          std::unique_lock<std::mutex> lk(hb_mu_);
+          hb_cv_.wait_for(lk, std::chrono::milliseconds(hb_interval_ms),
+                          [this] { return closing_.load(); });
+          if (closing_) return;
+          uint32_t t = 0;
+          std::string v;
+          if (!Request(MSG_HEARTBEAT, "", "", &t, &v)) return;
+        }
+      });
+    }
+  }
+
+  ~CoordClient() { Close(false); }
+
+  bool ok() const { return fd_ >= 0; }
+  int rank() const { return rank_; }
+  int world() const { return world_; }
+
+  bool Put(const std::string& key, const std::string& val) {
+    uint32_t type = 0;
+    std::string out;
+    if (!Request(MSG_PUT, key, val, &type, &out) || type != MSG_OK) {
+      set_error(type == MSG_ERR ? out : "put failed");
+      return false;
+    }
+    return true;
+  }
+
+  bool Get(const std::string& key, int64_t timeout_ms, std::string* out) {
+    std::string t(8, '\0');
+    std::memcpy(&t[0], &timeout_ms, 8);
+    uint32_t type = 0;
+    if (!Request(MSG_GET, key, t, &type, out) || type != MSG_VAL) {
+      set_error(type == MSG_ERR ? *out : "get failed");
+      return false;
+    }
+    return true;
+  }
+
+  bool Barrier(int64_t timeout_ms) {
+    std::string t(8, '\0');
+    std::memcpy(&t[0], &timeout_ms, 8);
+    uint32_t type = 0;
+    std::string out;
+    if (!Request(MSG_BARRIER, "", t, &type, &out) || type != MSG_OK) {
+      set_error(type == MSG_ERR ? out : "barrier failed");
+      return false;
+    }
+    return true;
+  }
+
+  bool Failed(std::vector<int32_t>* ranks) {
+    uint32_t type = 0;
+    std::string out;
+    if (!Request(MSG_FAILED, "", "", &type, &out) || type != MSG_VAL) {
+      set_error(type == MSG_ERR ? out : "failed query failed");
+      return false;
+    }
+    ranks->resize(out.size() / 4);
+    if (!out.empty()) std::memcpy(ranks->data(), out.data(), out.size());
+    return true;
+  }
+
+  void Close(bool leave) {
+    bool expected = false;
+    if (!closing_.compare_exchange_strong(expected, true)) return;
+    hb_cv_.notify_all();
+    if (hb_thread_.joinable()) hb_thread_.join();
+    if (fd_ >= 0) {
+      if (leave) {
+        uint32_t type = 0;
+        std::string out;
+        Request(MSG_LEAVE, "", "", &type, &out);
+      }
+      ::close(fd_);
+      fd_ = -1;
+    }
+  }
+
+ private:
+  // One request/reply at a time on the shared socket (user calls and the
+  // heartbeat thread interleave).
+  bool Request(uint32_t type, const std::string& key, const std::string& val,
+               uint32_t* rtype, std::string* rval) {
+    std::lock_guard<std::mutex> lk(req_mu_);
+    if (fd_ < 0) return false;
+    std::string rkey;
+    if (!send_msg(fd_, type, key, val)) return false;
+    if (!recv_msg(fd_, rtype, &rkey, rval)) return false;
+    return true;
+  }
+
+  int fd_ = -1;
+  int32_t rank_ = -1;
+  int32_t world_ = 0;
+  std::mutex req_mu_;
+  std::atomic<bool> closing_{false};
+  std::thread hb_thread_;
+  std::mutex hb_mu_;
+  std::condition_variable hb_cv_;
+};
+
+}  // namespace
+
+// ------------------------------------------------------------------- C ABI
+extern "C" {
+
+const char* nz_last_error() { return g_error.c_str(); }
+
+void* nz_coord_start(int port, int world, int hb_timeout_ms) {
+  auto* s = new CoordServer(port, world, hb_timeout_ms);
+  if (!s->ok()) {
+    set_error("bind/listen failed");
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int nz_coord_port(void* s) { return static_cast<CoordServer*>(s)->port(); }
+
+void nz_coord_stop(void* s) { delete static_cast<CoordServer*>(s); }
+
+void* nz_client_connect(const char* host, int port, int rank_hint,
+                        int timeout_ms, int hb_interval_ms) {
+  auto* c = new CoordClient(host, port, rank_hint, timeout_ms, hb_interval_ms);
+  if (!c->ok()) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+int nz_client_rank(void* c) { return static_cast<CoordClient*>(c)->rank(); }
+int nz_client_world(void* c) { return static_cast<CoordClient*>(c)->world(); }
+
+int nz_client_put(void* c, const char* key, const void* val, long vlen) {
+  return static_cast<CoordClient*>(c)->Put(
+             key, std::string(static_cast<const char*>(val),
+                              static_cast<size_t>(vlen)))
+             ? 0
+             : -1;
+}
+
+long nz_client_get(void* c, const char* key, void* out, long cap,
+                   long timeout_ms) {
+  std::string val;
+  if (!static_cast<CoordClient*>(c)->Get(key, timeout_ms, &val)) return -1;
+  long n = static_cast<long>(val.size());
+  if (n <= cap && n > 0) std::memcpy(out, val.data(), val.size());
+  return n;  // > cap means: retry with a bigger buffer
+}
+
+int nz_client_barrier(void* c, long timeout_ms) {
+  return static_cast<CoordClient*>(c)->Barrier(timeout_ms) ? 0 : -1;
+}
+
+long nz_client_failed(void* c, int* out, long cap) {
+  std::vector<int32_t> ranks;
+  if (!static_cast<CoordClient*>(c)->Failed(&ranks)) return -1;
+  long n = static_cast<long>(ranks.size());
+  for (long i = 0; i < n && i < cap; ++i) out[i] = ranks[i];
+  return n;
+}
+
+void nz_client_leave(void* c) { static_cast<CoordClient*>(c)->Close(true); }
+
+void nz_client_close(void* c) { delete static_cast<CoordClient*>(c); }
+
+}  // extern "C"

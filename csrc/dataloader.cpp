@@ -1,0 +1,340 @@
+// nezha_tpu native data loader.
+//
+// Host-side input pipeline in C++, the role the reference's goroutine
+// worker pool played on the data path (SURVEY.md §1 "Execution runtime",
+// §2 "worker pool runtime"): worker threads decode/assemble batches into a
+// bounded queue off the Python thread, so the accelerator never waits on
+// the GIL.  Two sources:
+//
+//   * MNIST IDX files (config 1 of BASELINE.json): big-endian IDX parsing,
+//     per-epoch shuffling, normalized float32 images + int32 labels.
+//   * Packed token files (configs 3/4, GPT-2/BERT-style LM data): a flat
+//     binary array of uint16/int32 token ids, sampled as [batch, seq+1]
+//     windows for next-token prediction.
+//
+// Batches are copied into caller-provided buffers (numpy arrays on the
+// Python side) — the ctypes call releases the GIL, workers keep producing.
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_loader_error;
+void set_loader_error(const std::string& e) { g_loader_error = e; }
+
+// ------------------------------------------------------------ batch queue
+struct Batch {
+  std::vector<float> f32;     // images
+  std::vector<int32_t> i32;   // labels / tokens
+  int count = 0;              // examples in this batch
+};
+
+class BatchQueue {
+ public:
+  explicit BatchQueue(size_t depth) : depth_(depth) {}
+
+  bool Push(Batch&& b) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_push_.wait(lk, [this] { return stopped_ || q_.size() < depth_; });
+    if (stopped_) return false;
+    q_.push_back(std::move(b));
+    cv_pop_.notify_one();
+    return true;
+  }
+
+  bool Pop(Batch* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_pop_.wait(lk, [this] { return stopped_ || !q_.empty(); });
+    if (q_.empty()) return false;  // stopped and drained
+    *out = std::move(q_.front());
+    q_.pop_front();
+    cv_push_.notify_one();
+    return true;
+  }
+
+  void Stop() {
+    std::lock_guard<std::mutex> lk(mu_);
+    stopped_ = true;
+    cv_push_.notify_all();
+    cv_pop_.notify_all();
+  }
+
+ private:
+  const size_t depth_;
+  std::mutex mu_;
+  std::condition_variable cv_push_, cv_pop_;
+  std::deque<Batch> q_;
+  bool stopped_ = false;
+};
+
+// --------------------------------------------------------------- base type
+class Loader {
+ public:
+  Loader(int batch, size_t depth) : batch_(batch), queue_(depth) {}
+  virtual ~Loader() { StopWorkers(); }
+
+  // Returns examples copied (== batch size), 0 on shutdown, -1 on error.
+  int Next(float* f32_out, int32_t* i32_out) {
+    Batch b;
+    if (!queue_.Pop(&b)) return error_.empty() ? 0 : -1;
+    if (f32_out && !b.f32.empty())
+      std::memcpy(f32_out, b.f32.data(), b.f32.size() * sizeof(float));
+    if (i32_out && !b.i32.empty())
+      std::memcpy(i32_out, b.i32.data(), b.i32.size() * sizeof(int32_t));
+    return b.count;
+  }
+
+  int batch() const { return batch_; }
+
+ protected:
+  void StartWorkers(int n) {
+    // num_workers_ and active_workers_ must be set before any thread runs:
+    // a thread can enter WorkerLoop before emplace_back even returns, so
+    // workers_.size() is not safe to read from the loop.
+    num_workers_ = n;
+    active_workers_ = n;
+    for (int i = 0; i < n; ++i)
+      workers_.emplace_back([this, i] { WorkerLoop(i); });
+  }
+
+  // Finite sources call this when a worker exhausts its share; the queue is
+  // only stopped once every worker is done, so no batch is dropped.
+  void WorkerDone() {
+    if (--active_workers_ == 0) queue_.Stop();
+  }
+
+  void StopWorkers() {
+    stopping_ = true;
+    queue_.Stop();
+    for (auto& t : workers_)
+      if (t.joinable()) t.join();
+    workers_.clear();
+  }
+
+  virtual void WorkerLoop(int worker_id) = 0;
+
+  const int batch_;
+  BatchQueue queue_;
+  std::atomic<bool> stopping_{false};
+  std::string error_;
+  int num_workers_ = 1;
+  std::atomic<int> active_workers_{0};
+  std::vector<std::thread> workers_;
+};
+
+// --------------------------------------------------------------- MNIST IDX
+uint32_t be32(const unsigned char* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+bool read_file(const std::string& path, std::vector<unsigned char>* out) {
+  FILE* f = std::fopen(path.c_str(), "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  long n = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  out->resize(static_cast<size_t>(n));
+  size_t got = n ? std::fread(out->data(), 1, static_cast<size_t>(n), f) : 0;
+  std::fclose(f);
+  return got == static_cast<size_t>(n);
+}
+
+class MnistLoader : public Loader {
+ public:
+  MnistLoader(const char* images_path, const char* labels_path, int batch,
+              uint64_t seed, int workers, size_t depth, int epochs)
+      : Loader(batch, depth), seed_(seed), epochs_(epochs) {
+    std::vector<unsigned char> img_raw, lbl_raw;
+    if (!read_file(images_path, &img_raw) ||
+        !read_file(labels_path, &lbl_raw)) {
+      error_ = "cannot read MNIST files";
+      return;
+    }
+    if (img_raw.size() < 16 || be32(img_raw.data()) != 2051 ||
+        lbl_raw.size() < 8 || be32(lbl_raw.data()) != 2049) {
+      error_ = "bad IDX magic";
+      return;
+    }
+    n_ = be32(img_raw.data() + 4);
+    rows_ = be32(img_raw.data() + 8);
+    cols_ = be32(img_raw.data() + 12);
+    if (be32(lbl_raw.data() + 4) != n_ ||
+        img_raw.size() < 16 + size_t(n_) * rows_ * cols_) {
+      error_ = "IDX size mismatch";
+      return;
+    }
+    pixels_.assign(img_raw.begin() + 16, img_raw.end());
+    labels_.assign(lbl_raw.begin() + 8, lbl_raw.end());
+    StartWorkers(std::max(workers, 1));
+  }
+
+  // Join workers before this class's members (pixels_, labels_) are
+  // destroyed — the base destructor would join too late.
+  ~MnistLoader() override { StopWorkers(); }
+
+  bool ok() const { return error_.empty(); }
+  const std::string& error() const { return error_; }
+  uint32_t n() const { return n_; }
+  uint32_t dim() const { return rows_ * cols_; }
+
+ protected:
+  void WorkerLoop(int worker_id) override {
+    const size_t dim = rows_ * cols_;
+    for (int epoch = 0; epochs_ <= 0 || epoch < epochs_; ++epoch) {
+      // All workers derive the same per-epoch permutation and take strided
+      // slices of it, so every example appears exactly once per epoch.
+      std::vector<uint32_t> perm(n_);
+      for (uint32_t i = 0; i < n_; ++i) perm[i] = i;
+      std::mt19937_64 rng(seed_ + static_cast<uint64_t>(epoch));
+      std::shuffle(perm.begin(), perm.end(), rng);
+      const size_t nbatch = n_ / batch_;  // drop remainder
+      for (size_t b = static_cast<size_t>(worker_id); b < nbatch;
+           b += static_cast<size_t>(num_workers_)) {
+        if (stopping_) return;
+        Batch out;
+        out.count = batch_;
+        out.f32.resize(static_cast<size_t>(batch_) * dim);
+        out.i32.resize(batch_);
+        for (int j = 0; j < batch_; ++j) {
+          uint32_t idx = perm[b * batch_ + j];
+          const unsigned char* src = pixels_.data() + size_t(idx) * dim;
+          float* dst = out.f32.data() + size_t(j) * dim;
+          for (size_t k = 0; k < dim; ++k)
+            dst[k] = static_cast<float>(src[k]) * (1.0f / 255.0f);
+          out.i32[j] = labels_[idx];
+        }
+        if (!queue_.Push(std::move(out))) return;
+      }
+    }
+    WorkerDone();  // finite epochs: last worker out signals end-of-data
+  }
+
+ private:
+  uint32_t n_ = 0, rows_ = 0, cols_ = 0;
+  std::vector<unsigned char> pixels_;
+  std::vector<unsigned char> labels_;
+  const uint64_t seed_;
+  const int epochs_;
+};
+
+// ------------------------------------------------------------ token files
+class TokenLoader : public Loader {
+ public:
+  // dtype_code: 2 = uint16, 4 = int32.
+  TokenLoader(const char* path, int dtype_code, int seq, int batch,
+              uint64_t seed, int workers, size_t depth)
+      : Loader(batch, depth), seq_(seq), seed_(seed) {
+    std::vector<unsigned char> raw;
+    if (!read_file(path, &raw)) {
+      error_ = "cannot read token file";
+      return;
+    }
+    if (dtype_code == 2) {
+      size_t n = raw.size() / 2;
+      tokens_.resize(n);
+      const uint16_t* p = reinterpret_cast<const uint16_t*>(raw.data());
+      for (size_t i = 0; i < n; ++i) tokens_[i] = p[i];
+    } else if (dtype_code == 4) {
+      size_t n = raw.size() / 4;
+      tokens_.resize(n);
+      std::memcpy(tokens_.data(), raw.data(), n * 4);
+    } else {
+      error_ = "dtype_code must be 2 (uint16) or 4 (int32)";
+      return;
+    }
+    if (tokens_.size() < static_cast<size_t>(seq) + 1) {
+      error_ = "token file shorter than seq+1";
+      return;
+    }
+    StartWorkers(std::max(workers, 1));
+  }
+
+  ~TokenLoader() override { StopWorkers(); }  // see MnistLoader note
+
+  bool ok() const { return error_.empty(); }
+  const std::string& error() const { return error_; }
+  size_t n_tokens() const { return tokens_.size(); }
+
+ protected:
+  void WorkerLoop(int worker_id) override {
+    // Random [seq+1] windows, GPT-style; stream is infinite.
+    std::mt19937_64 rng(seed_ * 6364136223846793005ULL +
+                        static_cast<uint64_t>(worker_id) + 1);
+    std::uniform_int_distribution<size_t> dist(
+        0, tokens_.size() - static_cast<size_t>(seq_) - 1);
+    const size_t w = static_cast<size_t>(seq_) + 1;
+    while (!stopping_) {
+      Batch out;
+      out.count = batch_;
+      out.i32.resize(static_cast<size_t>(batch_) * w);
+      for (int j = 0; j < batch_; ++j) {
+        size_t start = dist(rng);
+        std::memcpy(out.i32.data() + size_t(j) * w, tokens_.data() + start,
+                    w * sizeof(int32_t));
+      }
+      if (!queue_.Push(std::move(out))) return;
+    }
+  }
+
+ private:
+  const int seq_;
+  const uint64_t seed_;
+  std::vector<int32_t> tokens_;
+};
+
+}  // namespace
+
+// ------------------------------------------------------------------- C ABI
+extern "C" {
+
+const char* nz_loader_error() { return g_loader_error.c_str(); }
+
+void* nz_mnist_open(const char* images_path, const char* labels_path,
+                    int batch, uint64_t seed, int workers, int depth,
+                    int epochs, int* n_out, int* dim_out) {
+  auto* l = new MnistLoader(images_path, labels_path, batch, seed, workers,
+                            static_cast<size_t>(depth), epochs);
+  if (!l->ok()) {
+    set_loader_error(l->error());
+    delete l;
+    return nullptr;
+  }
+  if (n_out) *n_out = static_cast<int>(l->n());
+  if (dim_out) *dim_out = static_cast<int>(l->dim());
+  return l;
+}
+
+void* nz_tokens_open(const char* path, int dtype_code, int seq, int batch,
+                     uint64_t seed, int workers, int depth, long* n_tokens) {
+  auto* l = new TokenLoader(path, dtype_code, seq, batch, seed, workers,
+                            static_cast<size_t>(depth));
+  if (!l->ok()) {
+    set_loader_error(l->error());
+    delete l;
+    return nullptr;
+  }
+  if (n_tokens) *n_tokens = static_cast<long>(l->n_tokens());
+  return l;
+}
+
+// Blocks until a batch is ready; returns examples copied, 0 at end-of-data.
+int nz_loader_next(void* l, float* f32_out, int32_t* i32_out) {
+  return static_cast<Loader*>(l)->Next(f32_out, i32_out);
+}
+
+void nz_loader_close(void* l) { delete static_cast<Loader*>(l); }
+
+}  // extern "C"

@@ -24,6 +24,7 @@ which were derived from BASELINE.json.
 __version__ = "0.1.0"
 
 from nezha_tpu import nn, ops, optim, parallel, models, data, train, graph, runtime
+from nezha_tpu import dist
 
 __all__ = [
     "nn",
@@ -35,5 +36,6 @@ __all__ = [
     "train",
     "graph",
     "runtime",
+    "dist",
     "__version__",
 ]

@@ -3,6 +3,7 @@
 the prefetching worker pool in `nezha_tpu.runtime`."""
 
 from nezha_tpu.data.mnist import load_mnist, mnist_batches
+from nezha_tpu.data.native import MnistLoader, TokenLoader
 from nezha_tpu.data.synthetic import (
     synthetic_image_batches,
     synthetic_token_batches,
@@ -11,5 +12,6 @@ from nezha_tpu.data.synthetic import (
 
 __all__ = [
     "load_mnist", "mnist_batches",
+    "MnistLoader", "TokenLoader",
     "synthetic_image_batches", "synthetic_token_batches", "synthetic_mlm_batches",
 ]

@@ -1,0 +1,116 @@
+"""Python face of the native C++ batch loader (csrc/dataloader.cpp).
+
+Decode + shuffle + batch assembly happen on C++ worker threads into a
+bounded queue — the input-path role of the reference's goroutine worker
+pool (SURVEY.md §1 "Execution runtime") — and each ``next()`` is a single
+GIL-releasing copy into a numpy array. Feed the resulting iterator to
+``nezha_tpu.runtime.Prefetcher`` to overlap host→device staging with the
+running step.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Iterator, Optional
+
+import numpy as np
+
+from nezha_tpu.runtime.native import load_library
+
+
+class NativeLoaderError(RuntimeError):
+    pass
+
+
+class _Closable:
+    _h = None
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.nz_loader_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class MnistLoader(_Closable):
+    """Shuffled MNIST batches from IDX files, decoded by C++ workers.
+
+    Yields ``{"image": float32 [B, 784] in [0,1], "label": int32 [B]}``.
+    ``epochs <= 0`` streams forever (reshuffling each epoch).
+    """
+
+    def __init__(self, images_path: str, labels_path: str, batch_size: int,
+                 seed: int = 0, num_workers: int = 2, queue_depth: int = 4,
+                 epochs: int = 0):
+        self._lib = load_library()
+        n = ctypes.c_int()
+        dim = ctypes.c_int()
+        self._h = self._lib.nz_mnist_open(
+            str(images_path).encode(), str(labels_path).encode(),
+            int(batch_size), int(seed), int(num_workers), int(queue_depth),
+            int(epochs), ctypes.byref(n), ctypes.byref(dim))
+        if not self._h:
+            raise NativeLoaderError(self._lib.nz_loader_error().decode())
+        self.num_examples = n.value
+        self.example_dim = dim.value
+        self.batch_size = batch_size
+
+    def __iter__(self) -> Iterator[dict]:
+        while True:
+            images = np.empty((self.batch_size, self.example_dim), np.float32)
+            labels = np.empty((self.batch_size,), np.int32)
+            got = self._lib.nz_loader_next(
+                self._h,
+                images.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+            if got <= 0:
+                return
+            yield {"image": images, "label": labels}
+
+
+class TokenLoader(_Closable):
+    """Random ``[B, seq+1]`` windows from a flat binary token file
+    (uint16 or int32), GPT-style next-token batches. Infinite stream.
+
+    Yields ``{"tokens": int32 [B, seq+1]}``.
+    """
+
+    _DTYPES = {np.dtype(np.uint16): 2, np.dtype(np.int32): 4}
+
+    def __init__(self, path: str, seq_len: int, batch_size: int,
+                 dtype=np.uint16, seed: int = 0, num_workers: int = 2,
+                 queue_depth: int = 4):
+        self._lib = load_library()
+        code = self._DTYPES.get(np.dtype(dtype))
+        if code is None:
+            raise ValueError("dtype must be uint16 or int32")
+        n = ctypes.c_long()
+        self._h = self._lib.nz_tokens_open(
+            str(path).encode(), code, int(seq_len), int(batch_size),
+            int(seed), int(num_workers), int(queue_depth), ctypes.byref(n))
+        if not self._h:
+            raise NativeLoaderError(self._lib.nz_loader_error().decode())
+        self.num_tokens = n.value
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+
+    def __iter__(self) -> Iterator[dict]:
+        while True:
+            out = np.empty((self.batch_size, self.seq_len + 1), np.int32)
+            got = self._lib.nz_loader_next(
+                self._h, None,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+            if got <= 0:
+                return
+            yield {"tokens": out}

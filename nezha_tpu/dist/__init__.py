@@ -1,0 +1,27 @@
+"""Multi-process coordination — the reference's gRPC-coordinator role
+(SURVEY.md §1 "Distributed runtime", §2).
+
+The wire implementation is native C++ (csrc/coordinator.cpp) loaded via
+ctypes; this package is the Python face: rendezvous into a ``ProcessGroup``
+with rank/world, a key-value store for topology exchange (the job NCCL
+unique-id broadcast did in the reference — here it carries the
+jax.distributed / PJRT coordination address), barriers, broadcast /
+all-gather of small host blobs, and heartbeat-based failure detection.
+
+Device-side collectives stay in ``nezha_tpu.parallel`` (XLA over ICI);
+this layer is strictly host-side control plane.
+"""
+
+from nezha_tpu.dist.coordinator import (
+    Coordinator,
+    ProcessGroup,
+    join,
+)
+from nezha_tpu.dist.launch import initialize_jax_distributed
+
+__all__ = [
+    "Coordinator",
+    "ProcessGroup",
+    "join",
+    "initialize_jax_distributed",
+]

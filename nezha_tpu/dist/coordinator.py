@@ -1,0 +1,172 @@
+"""Python API over the native coordinator (csrc/coordinator.cpp).
+
+Mirrors the reference's gRPC coordinator semantics (SURVEY.md §3 call
+stack 1: "dial gRPC coordinator (rank/world rendezvous, NCCL unique-id
+exchange)"): processes ``join()`` a coordinator address, receive a rank,
+then use the group for barriers, KV-based topology exchange, and failure
+detection. All blocking native calls release the GIL, so the heartbeat
+and any Python-side work proceed concurrently.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional
+
+from nezha_tpu.runtime.native import load_library
+
+
+class CoordinatorError(RuntimeError):
+    pass
+
+
+class Coordinator:
+    """The rendezvous server. Run one instance per job (typically on the
+    rank-0 host, like the reference's coordinator process)."""
+
+    def __init__(self, world_size: int, port: int = 0,
+                 heartbeat_timeout_s: float = 10.0):
+        self._lib = load_library()
+        self._h = self._lib.nz_coord_start(
+            int(port), int(world_size), int(heartbeat_timeout_s * 1000))
+        if not self._h:
+            raise CoordinatorError(
+                self._lib.nz_last_error().decode() or "coordinator start failed")
+        self.world_size = world_size
+        self.port = self._lib.nz_coord_port(self._h)
+
+    def stop(self) -> None:
+        if self._h:
+            self._lib.nz_coord_stop(self._h)
+            self._h = None
+
+    def __enter__(self) -> "Coordinator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class ProcessGroup:
+    """A joined member of the world: rank, world size, and control-plane
+    primitives (barrier / put / get / broadcast / all_gather / failures)."""
+
+    def __init__(self, handle, lib):
+        self._h = handle
+        self._lib = lib
+        self.rank = lib.nz_client_rank(handle)
+        self.world_size = lib.nz_client_world(handle)
+        # Per-tag collective round counters. KV keys are never deleted, so
+        # repeated broadcast/all_gather calls must write fresh keys; like
+        # any collective, every rank must call them in the same order.
+        self._rounds: dict = {}
+
+    def _round(self, tag: str) -> int:
+        n = self._rounds.get(tag, 0)
+        self._rounds[tag] = n + 1
+        return n
+
+    # ---------------------------------------------------------------- KV
+    def put(self, key: str, value: bytes) -> None:
+        r = self._lib.nz_client_put(
+            self._h, key.encode(), value, len(value))
+        if r != 0:
+            raise CoordinatorError(self._lib.nz_last_error().decode())
+
+    def get(self, key: str, timeout_s: Optional[float] = None) -> bytes:
+        """Blocks until `key` exists (or timeout)."""
+        timeout_ms = -1 if timeout_s is None else int(timeout_s * 1000)
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.nz_client_get(
+                self._h, key.encode(), buf, cap, timeout_ms)
+            if n < 0:
+                raise CoordinatorError(self._lib.nz_last_error().decode())
+            if n <= cap:
+                return buf.raw[:n]
+            cap = n  # value larger than buffer: retry exactly-sized
+
+    # ----------------------------------------------------------- control
+    def barrier(self, timeout_s: Optional[float] = None) -> None:
+        timeout_ms = -1 if timeout_s is None else int(timeout_s * 1000)
+        if self._lib.nz_client_barrier(self._h, timeout_ms) != 0:
+            raise CoordinatorError(self._lib.nz_last_error().decode())
+
+    def broadcast(self, value: Optional[bytes], root: int = 0,
+                  timeout_s: Optional[float] = None,
+                  tag: str = "bcast") -> bytes:
+        """Root puts, everyone gets. Collective: all ranks must call, in
+        the same order relative to other collectives with the same tag."""
+        key = f"__{tag}/{self._round(tag)}/{root}"
+        if self.rank == root:
+            if value is None:
+                raise ValueError("root must provide a value")
+            self.put(key, value)
+        return self.get(key, timeout_s)
+
+    def all_gather(self, value: bytes, timeout_s: Optional[float] = None,
+                   tag: str = "gather") -> List[bytes]:
+        """Each rank contributes a blob; returns all blobs rank-ordered.
+        Collective: all ranks must call, in the same order."""
+        rnd = self._round(tag)
+        self.put(f"__{tag}/{rnd}/{self.rank}", value)
+        return [self.get(f"__{tag}/{rnd}/{r}", timeout_s)
+                for r in range(self.world_size)]
+
+    def failed_ranks(self) -> List[int]:
+        """Ranks the coordinator considers dead: dropped their connection
+        without leaving, or silent past the heartbeat timeout."""
+        cap = max(self.world_size, 1)
+        arr = (ctypes.c_int32 * cap)()
+        n = self._lib.nz_client_failed(self._h, arr, cap)
+        if n < 0:
+            raise CoordinatorError(self._lib.nz_last_error().decode())
+        return sorted(arr[i] for i in range(min(n, cap)))
+
+    # ---------------------------------------------------------- lifecycle
+    def leave(self) -> None:
+        """Graceful departure — not counted as a failure."""
+        if self._h:
+            self._lib.nz_client_leave(self._h)
+            self._lib.nz_client_close(self._h)
+            self._h = None
+
+    def close(self) -> None:
+        """Abrupt close — surviving ranks will see this rank as failed."""
+        if self._h:
+            self._lib.nz_client_close(self._h)
+            self._h = None
+
+    def __enter__(self) -> "ProcessGroup":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.leave()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def join(host: str, port: int, rank_hint: int = -1,
+         timeout_s: float = 60.0,
+         heartbeat_interval_s: float = 2.0) -> ProcessGroup:
+    """Join the coordinator at host:port; returns a ProcessGroup with an
+    assigned rank. Retries until the coordinator is up (launch skew)."""
+    lib = load_library()
+    h = lib.nz_client_connect(
+        host.encode(), int(port), int(rank_hint), int(timeout_s * 1000),
+        int(heartbeat_interval_s * 1000))
+    if not h:
+        raise CoordinatorError(
+            lib.nz_last_error().decode() or "join failed")
+    return ProcessGroup(h, lib)

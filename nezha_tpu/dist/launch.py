@@ -1,0 +1,52 @@
+"""Bridging the control plane to jax.distributed (multi-host TPU).
+
+The reference's coordinator broadcast an NCCL unique id so every rank could
+build the communicator (SURVEY.md §3 call stack 1). The TPU-native
+equivalent blob is the jax.distributed coordination address: rank 0 decides
+it, the coordinator KV store carries it, and every process calls
+``jax.distributed.initialize`` with it — after which XLA owns the
+collectives over ICI/DCN and no further host involvement is needed on the
+data path.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Optional
+
+from nezha_tpu.dist.coordinator import ProcessGroup
+
+
+def _my_ip() -> str:
+    # The address other hosts can reach us at: the source IP of a UDP
+    # "connection" to a public address (no packet is actually sent).
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 1))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def initialize_jax_distributed(group: ProcessGroup,
+                               coord_port: int = 8476,
+                               timeout_s: Optional[float] = 120.0) -> None:
+    """Initialize jax.distributed across the group's processes.
+
+    Rank 0 advertises ``<its-ip>:coord_port`` through the coordinator's KV
+    store; every rank then enters ``jax.distributed.initialize`` with the
+    same address, its coordinator-assigned rank, and the group size.
+    """
+    import jax
+
+    if group.rank == 0:
+        addr = f"{_my_ip()}:{coord_port}"
+        group.put("__jax_coord_addr", addr.encode())
+    addr = group.get("__jax_coord_addr", timeout_s).decode()
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=group.world_size,
+        process_id=group.rank,
+    )

@@ -192,7 +192,10 @@ def make_pipeline_train_step(spec: PipelineSpec, optimizer: Optimizer,
     """
 
     def step(state, batch):
-        rng, next_rng = jax.random.split(state["rng"])
+        # Pipelined forward is deterministic — no rng path through
+        # pipelined_forward (stage fns take no dropout key); the state rng
+        # advances so interleaving with stochastic steps stays reproducible.
+        next_rng = jax.random.fold_in(state["rng"], 0)
 
         def compute_loss(pparams):
             out = pipelined_forward(spec, pparams, batch, mesh,

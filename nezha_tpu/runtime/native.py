@@ -1,0 +1,107 @@
+"""ctypes bindings for the native runtime library (csrc/).
+
+Builds ``libnezha_rt.so`` on first use with the in-tree Makefile (g++ is
+part of the baked toolchain) and caches by source mtime. The library holds
+the TPU-native counterparts of the reference's native runtime pieces
+(SURVEY.md §2): the coordinator (gRPC coordinator role) and the threaded
+batch loader (goroutine worker pool role on the input path).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc")
+_LIB_PATH = os.path.join(_CSRC, "build", "libnezha_rt.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    for name in os.listdir(_CSRC):
+        if name.endswith((".cpp", ".h")):
+            if os.path.getmtime(os.path.join(_CSRC, name)) > lib_mtime:
+                return True
+    return False
+
+
+def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    lib.nz_last_error.restype = c.c_char_p
+    lib.nz_coord_start.restype = c.c_void_p
+    lib.nz_coord_start.argtypes = [c.c_int, c.c_int, c.c_int]
+    lib.nz_coord_port.restype = c.c_int
+    lib.nz_coord_port.argtypes = [c.c_void_p]
+    lib.nz_coord_stop.argtypes = [c.c_void_p]
+    lib.nz_client_connect.restype = c.c_void_p
+    lib.nz_client_connect.argtypes = [c.c_char_p, c.c_int, c.c_int, c.c_int,
+                                      c.c_int]
+    lib.nz_client_rank.restype = c.c_int
+    lib.nz_client_rank.argtypes = [c.c_void_p]
+    lib.nz_client_world.restype = c.c_int
+    lib.nz_client_world.argtypes = [c.c_void_p]
+    lib.nz_client_put.restype = c.c_int
+    lib.nz_client_put.argtypes = [c.c_void_p, c.c_char_p, c.c_void_p,
+                                  c.c_long]
+    lib.nz_client_get.restype = c.c_long
+    lib.nz_client_get.argtypes = [c.c_void_p, c.c_char_p, c.c_void_p,
+                                  c.c_long, c.c_long]
+    lib.nz_client_barrier.restype = c.c_int
+    lib.nz_client_barrier.argtypes = [c.c_void_p, c.c_long]
+    lib.nz_client_failed.restype = c.c_long
+    lib.nz_client_failed.argtypes = [c.c_void_p, c.POINTER(c.c_int32),
+                                     c.c_long]
+    lib.nz_client_leave.argtypes = [c.c_void_p]
+    lib.nz_client_close.argtypes = [c.c_void_p]
+
+    lib.nz_loader_error.restype = c.c_char_p
+    lib.nz_mnist_open.restype = c.c_void_p
+    lib.nz_mnist_open.argtypes = [c.c_char_p, c.c_char_p, c.c_int,
+                                  c.c_uint64, c.c_int, c.c_int, c.c_int,
+                                  c.POINTER(c.c_int), c.POINTER(c.c_int)]
+    lib.nz_tokens_open.restype = c.c_void_p
+    lib.nz_tokens_open.argtypes = [c.c_char_p, c.c_int, c.c_int, c.c_int,
+                                   c.c_uint64, c.c_int, c.c_int,
+                                   c.POINTER(c.c_long)]
+    lib.nz_loader_next.restype = c.c_int
+    lib.nz_loader_next.argtypes = [c.c_void_p, c.POINTER(c.c_float),
+                                   c.POINTER(c.c_int32)]
+    lib.nz_loader_close.argtypes = [c.c_void_p]
+    return lib
+
+
+def load_library() -> ctypes.CDLL:
+    """Build (if stale) and load the native runtime library. Thread-safe."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _needs_build():
+            proc = subprocess.run(
+                ["make", "-s"], cwd=_CSRC, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise NativeBuildError(
+                    f"native build failed:\n{proc.stdout}\n{proc.stderr}")
+        _lib = _declare(ctypes.CDLL(_LIB_PATH))
+        return _lib
+
+
+def native_available() -> bool:
+    """True if the native library is (or can be) built on this host."""
+    try:
+        load_library()
+        return True
+    except (NativeBuildError, OSError):
+        return False

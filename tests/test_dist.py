@@ -1,0 +1,279 @@
+"""Native coordinator tests — rendezvous, KV, barrier, broadcast,
+all-gather, failure detection (SURVEY.md §4: "test the coordinator with
+in-process ranks"). Clients run on threads; blocking calls are in C and
+release the GIL, so threads faithfully model separate ranks."""
+
+import threading
+import time
+
+import pytest
+
+from nezha_tpu.runtime.native import native_available
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native runtime library not buildable")
+
+from nezha_tpu import dist  # noqa: E402
+
+
+def _run_ranks(world, fn, **coord_kwargs):
+    """Start a coordinator, join `world` clients on threads, run fn(group)
+    on each, return rank-indexed results."""
+    with dist.Coordinator(world_size=world, **coord_kwargs) as coord:
+        results = [None] * world
+        errors = []
+        # Rank slots freed by leave() are reusable (elastic restart), so no
+        # rank may leave until every rank has joined and finished.
+        done = threading.Barrier(world)
+
+        def worker(i):
+            try:
+                with dist.join("127.0.0.1", coord.port) as g:
+                    results[g.rank] = fn(g)
+                    done.wait(timeout=30)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors
+        return results
+
+
+def test_rendezvous_assigns_unique_ranks():
+    ranks = _run_ranks(4, lambda g: (g.rank, g.world_size))
+    assert sorted(r for r, _ in ranks) == [0, 1, 2, 3]
+    assert all(w == 4 for _, w in ranks)
+
+
+def test_rank_hint_honored():
+    with dist.Coordinator(world_size=2) as coord:
+        g1 = dist.join("127.0.0.1", coord.port, rank_hint=1)
+        assert g1.rank == 1
+        g0 = dist.join("127.0.0.1", coord.port)
+        assert g0.rank == 0
+        g0.leave()
+        g1.leave()
+
+
+def test_kv_put_get_blocking():
+    def fn(g):
+        if g.rank == 0:
+            time.sleep(0.1)  # make rank 1 actually block on get
+            g.put("topo", b"mesh:2x2")
+        return g.get("topo", timeout_s=10)
+
+    assert _run_ranks(2, fn) == [b"mesh:2x2"] * 2
+
+
+def test_get_timeout_raises():
+    with dist.Coordinator(world_size=1) as coord:
+        with dist.join("127.0.0.1", coord.port) as g:
+            with pytest.raises(dist.coordinator.CoordinatorError):
+                g.get("never-put", timeout_s=0.2)
+
+
+def test_large_value_roundtrip():
+    blob = bytes(range(256)) * 1024  # 256 KiB > initial 64 KiB buffer
+
+    def fn(g):
+        if g.rank == 0:
+            g.put("big", blob)
+        return g.get("big", timeout_s=10)
+
+    assert _run_ranks(2, fn) == [blob] * 2
+
+
+def test_barrier_synchronizes():
+    order = []
+    lock = threading.Lock()
+
+    def fn(g):
+        # Stagger arrivals; nobody may pass until all have arrived.
+        time.sleep(0.05 * g.rank)
+        with lock:
+            order.append(("arrive", g.rank))
+        g.barrier(timeout_s=10)
+        with lock:
+            order.append(("pass", g.rank))
+        return True
+
+    assert all(_run_ranks(3, fn))
+    arrivals = [i for i, (ev, _) in enumerate(order) if ev == "arrive"]
+    passes = [i for i, (ev, _) in enumerate(order) if ev == "pass"]
+    assert max(arrivals) < min(passes)
+
+
+def test_barrier_reusable_across_epochs():
+    def fn(g):
+        for _ in range(5):
+            g.barrier(timeout_s=10)
+        return True
+
+    assert all(_run_ranks(4, fn))
+
+
+def test_broadcast_and_all_gather():
+    def fn(g):
+        b = g.broadcast(b"root-data" if g.rank == 0 else None,
+                        root=0, timeout_s=10)
+        ag = g.all_gather(f"rank{g.rank}".encode(), timeout_s=10)
+        return b, ag
+
+    for b, ag in _run_ranks(3, fn):
+        assert b == b"root-data"
+        assert ag == [b"rank0", b"rank1", b"rank2"]
+
+
+def test_failure_detection_on_drop():
+    with dist.Coordinator(world_size=2,
+                          heartbeat_timeout_s=0.5) as coord:
+        g0 = dist.join("127.0.0.1", coord.port,
+                       heartbeat_interval_s=0.1)
+        g1 = dist.join("127.0.0.1", coord.port,
+                       heartbeat_interval_s=0.1)
+        assert g0.failed_ranks() == []
+        g1.close()  # abrupt: no LEAVE
+        deadline = time.time() + 5
+        failed = []
+        while time.time() < deadline:
+            failed = g0.failed_ranks()
+            if failed:
+                break
+            time.sleep(0.05)
+        assert failed == [1]
+        g0.leave()
+
+
+def test_graceful_leave_is_not_failure():
+    with dist.Coordinator(world_size=2,
+                          heartbeat_timeout_s=0.5) as coord:
+        g0 = dist.join("127.0.0.1", coord.port,
+                       heartbeat_interval_s=0.1)
+        g1 = dist.join("127.0.0.1", coord.port,
+                       heartbeat_interval_s=0.1)
+        g1.leave()
+        time.sleep(1.0)  # well past the heartbeat timeout
+        assert g0.failed_ranks() == []
+        g0.leave()
+
+
+def test_client_connects_before_coordinator_up():
+    """Launch-skew tolerance: client retries until the server binds."""
+    port_holder = {}
+    result = {}
+
+    def late_client():
+        # Wait for the port, then join (connect itself also retries).
+        while "port" not in port_holder:
+            time.sleep(0.01)
+        g = dist.join("127.0.0.1", port_holder["port"], timeout_s=10)
+        result["rank"] = g.rank
+        g.leave()
+
+    t = threading.Thread(target=late_client)
+    t.start()
+    time.sleep(0.2)
+    with dist.Coordinator(world_size=1) as coord:
+        port_holder["port"] = coord.port
+        t.join(timeout=10)
+    assert result["rank"] == 0
+
+
+def test_crashed_rank_can_rejoin():
+    """Supervisor workflow: rank crashes, replacement process re-claims the
+    same rank slot and clears the failure."""
+    with dist.Coordinator(world_size=2, heartbeat_timeout_s=0.5) as coord:
+        g0 = dist.join("127.0.0.1", coord.port, heartbeat_interval_s=0.1)
+        g1 = dist.join("127.0.0.1", coord.port, heartbeat_interval_s=0.1)
+        rank1 = g1.rank
+        g1.close()  # crash
+        deadline = time.time() + 5
+        while time.time() < deadline and g0.failed_ranks() != [rank1]:
+            time.sleep(0.05)
+        assert g0.failed_ranks() == [rank1]
+        g1b = dist.join("127.0.0.1", coord.port, rank_hint=rank1)
+        assert g1b.rank == rank1
+        assert g0.failed_ranks() == []
+        g1b.leave()
+        g0.leave()
+
+
+def test_left_rank_slot_is_reusable():
+    with dist.Coordinator(world_size=1) as coord:
+        g = dist.join("127.0.0.1", coord.port)
+        assert g.rank == 0
+        g.leave()
+        g2 = dist.join("127.0.0.1", coord.port)
+        assert g2.rank == 0
+        g2.leave()
+
+
+def test_repeated_all_gather_rounds_fresh():
+    """Round counters: a second all_gather with the default tag must return
+    the second round's values, not stale KV entries."""
+    def fn(g):
+        r1 = g.all_gather(f"a{g.rank}".encode(), timeout_s=10)
+        r2 = g.all_gather(f"b{g.rank}".encode(), timeout_s=10)
+        return r1, r2
+
+    for r1, r2 in _run_ranks(2, fn):
+        assert r1 == [b"a0", b"a1"]
+        assert r2 == [b"b0", b"b1"]
+
+
+def test_blocking_wait_does_not_trip_failure_detector():
+    """A rank parked in a long get() must not be reported failed even
+    though its heartbeat thread is queued behind the blocking request."""
+    with dist.Coordinator(world_size=2, heartbeat_timeout_s=0.6) as coord:
+        g0 = dist.join("127.0.0.1", coord.port, heartbeat_interval_s=0.2)
+        g1 = dist.join("127.0.0.1", coord.port, heartbeat_interval_s=0.2)
+        got = {}
+
+        def waiter():
+            got["v"] = g1.get("slow-key", timeout_s=10)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(1.5)  # well past heartbeat_timeout while g1 blocks
+        assert g0.failed_ranks() == []
+        g0.put("slow-key", b"done")
+        t.join(timeout=10)
+        assert got["v"] == b"done"
+        g1.leave()
+        g0.leave()
+
+
+def test_peer_death_during_barrier_is_detected():
+    """A rank that dies while others wait in a barrier must be noticed by
+    the failure detector (socket probe inside the blocking wait)."""
+    with dist.Coordinator(world_size=2, heartbeat_timeout_s=0.5) as coord:
+        g0 = dist.join("127.0.0.1", coord.port, heartbeat_interval_s=0.1)
+        g1 = dist.join("127.0.0.1", coord.port, heartbeat_interval_s=0.1)
+        err = {}
+
+        def waiter():
+            try:
+                g0.barrier(timeout_s=5)
+            except dist.coordinator.CoordinatorError as e:
+                err["e"] = e
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.3)
+        g1.close()  # dies mid-barrier
+        deadline = time.time() + 5
+        failed = []
+        while time.time() < deadline:
+            failed = g0.failed_ranks()
+            if failed:
+                break
+            time.sleep(0.05)
+        assert failed == [1]
+        t.join(timeout=10)  # barrier times out; rank 0 survives to react
+        assert "e" in err
+        g0.leave()
