@@ -47,6 +47,7 @@ enum MsgType : uint32_t {
   MSG_HEARTBEAT = 5,  // no payload
   MSG_FAILED = 6,     // no payload -> VAL int32[] failed ranks
   MSG_LEAVE = 7,      // graceful departure
+  MSG_INCR = 8,       // key -> VAL int64 previous counter value
   MSG_OK = 100,
   MSG_VAL = 101,
   MSG_ERR = 102,
@@ -242,7 +243,7 @@ class CoordServer {
           Touch(rank);
           auto pred = [&] { return stopping_ || kv_.count(key) > 0; };
           int w = WaitBlocking(lk, fd, rank, timeout_ms, pred);
-          if (stopping_) return;
+          if (stopping_) break;  // fall out to cleanup: close fd, drop conn
           if (w < 0) { disconnected = true; break; }
           if (w == 0) {
             lk.unlock();
@@ -266,7 +267,7 @@ class CoordServer {
           }
           auto pred = [&] { return stopping_ || barrier_epoch_ > my_epoch; };
           int w = WaitBlocking(lk, fd, rank, timeout_ms, pred);
-          if (stopping_) return;
+          if (stopping_) break;  // fall out to cleanup: close fd, drop conn
           if (w <= 0) {
             // Withdraw from the still-pending epoch so a later retry (or
             // this rank's failure) doesn't double-count it.
@@ -279,6 +280,21 @@ class CoordServer {
           }
           lk.unlock();
           send_msg(fd, MSG_OK, "", "");
+          break;
+        }
+        case MSG_INCR: {
+          // Server-side fetch-and-increment. Collective round counters
+          // live here (not in the client) so a crashed-and-rejoined rank
+          // resumes at the world's current round instead of round 0.
+          int64_t old;
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            old = counters_[key]++;
+            Touch(rank);
+          }
+          std::string out(8, '\0');
+          std::memcpy(&out[0], &old, 8);
+          send_msg(fd, MSG_VAL, "", out);
           break;
         }
         case MSG_HEARTBEAT: {
@@ -389,6 +405,7 @@ class CoordServer {
   std::map<int, uint64_t> conn_gen_;
   std::map<int, Clock::time_point> last_seen_;
   std::map<std::string, std::string> kv_;
+  std::map<std::string, int64_t> counters_;
   int barrier_count_ = 0;
   uint64_t barrier_epoch_ = 0;
 };
@@ -477,6 +494,19 @@ class CoordClient {
       return false;
     }
     return true;
+  }
+
+  int64_t Incr(const std::string& key) {
+    uint32_t type = 0;
+    std::string out;
+    if (!Request(MSG_INCR, key, "", &type, &out) || type != MSG_VAL ||
+        out.size() < 8) {
+      set_error(type == MSG_ERR ? out : "incr failed");
+      return -1;
+    }
+    int64_t v;
+    std::memcpy(&v, out.data(), 8);
+    return v;
   }
 
   bool Barrier(int64_t timeout_ms) {
@@ -591,6 +621,10 @@ long nz_client_get(void* c, const char* key, void* out, long cap,
   long n = static_cast<long>(val.size());
   if (n <= cap && n > 0) std::memcpy(out, val.data(), val.size());
   return n;  // > cap means: retry with a bigger buffer
+}
+
+long nz_client_incr(void* c, const char* key) {
+  return static_cast<long>(static_cast<CoordClient*>(c)->Incr(key));
 }
 
 int nz_client_barrier(void* c, long timeout_ms) {

@@ -172,7 +172,8 @@ class MnistLoader : public Loader {
     rows_ = be32(img_raw.data() + 8);
     cols_ = be32(img_raw.data() + 12);
     if (be32(lbl_raw.data() + 4) != n_ ||
-        img_raw.size() < 16 + size_t(n_) * rows_ * cols_) {
+        img_raw.size() < 16 + size_t(n_) * rows_ * cols_ ||
+        lbl_raw.size() < 8 + size_t(n_)) {  // truncated label body
       error_ = "IDX size mismatch";
       return;
     }

@@ -24,7 +24,7 @@ which were derived from BASELINE.json.
 __version__ = "0.1.0"
 
 from nezha_tpu import nn, ops, optim, parallel, models, data, train, graph, runtime
-from nezha_tpu import dist
+from nezha_tpu import dist, utils
 
 __all__ = [
     "nn",
@@ -37,5 +37,6 @@ __all__ = [
     "graph",
     "runtime",
     "dist",
+    "utils",
     "__version__",
 ]

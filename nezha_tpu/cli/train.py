@@ -101,7 +101,34 @@ def _configs() -> Dict[str, Config]:
     }
 
 
+def _join_world(args):
+    """Multi-process launch: dial the coordinator before touching devices
+    (SURVEY.md §3 call stack 1 — the reference dialed its gRPC coordinator
+    for rank/world rendezvous, then initialized the device runtime).
+    Returns (group, coordinator) — either may be None."""
+    if not args.coordinator:
+        return None, None
+    from nezha_tpu import dist
+    from nezha_tpu.utils import get_logger, set_rank
+
+    host, _, port = args.coordinator.rpartition(":")
+    coord = None
+    if args.serve_coordinator:
+        coord = dist.Coordinator(world_size=args.world_size, port=int(port))
+    group = dist.join(host or "127.0.0.1", int(port),
+                      rank_hint=args.rank_hint)
+    set_rank(group.rank)
+    get_logger("nezha_tpu.cli").info(
+        "joined world: rank %d / %d", group.rank, group.world_size)
+    if group.world_size > 1:
+        # Rank 0 advertises the jax.distributed address; all ranks enter.
+        dist.initialize_jax_distributed(group)
+    return group, coord
+
+
 def run(args) -> Dict[str, float]:
+    group, coord = _join_world(args)
+
     import jax
 
     if args.platform:
@@ -192,6 +219,17 @@ def run(args) -> Dict[str, float]:
             jax.profiler.stop_trace()
         if metrics_file:
             metrics_file.close()
+        if group is not None:
+            try:
+                # All ranks finish before teardown. Best-effort: if we are
+                # unwinding an exception, peers may never arrive — don't
+                # let the barrier mask the real error or skip leave/stop.
+                group.barrier(timeout_s=600)
+            except Exception as e:
+                print(f"shutdown barrier skipped: {e}", file=sys.stderr)
+            group.leave()
+        if coord is not None:
+            coord.stop()
     if args.ckpt_dir:
         ckpt.save_checkpoint(args.ckpt_dir, state, start_step + args.steps)
     return last
@@ -220,6 +258,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="append JSONL metrics here")
     p.add_argument("--profile-dir", default=None,
                    help="capture an XLA/TPU profiler trace here")
+    p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="rendezvous address for multi-process launch")
+    p.add_argument("--serve-coordinator", action="store_true",
+                   help="also run the coordinator here (rank-0 host)")
+    p.add_argument("--world-size", type=int, default=1,
+                   help="processes in the job (with --serve-coordinator)")
+    p.add_argument("--rank-hint", type=int, default=-1,
+                   help="preferred rank (e.g. for restart-in-place)")
     return p
 
 

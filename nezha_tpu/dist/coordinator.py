@@ -62,15 +62,22 @@ class ProcessGroup:
         self._lib = lib
         self.rank = lib.nz_client_rank(handle)
         self.world_size = lib.nz_client_world(handle)
-        # Per-tag collective round counters. KV keys are never deleted, so
-        # repeated broadcast/all_gather calls must write fresh keys; like
-        # any collective, every rank must call them in the same order.
-        self._rounds: dict = {}
 
     def _round(self, tag: str) -> int:
-        n = self._rounds.get(tag, 0)
-        self._rounds[tag] = n + 1
-        return n
+        """This rank's collective round for ``tag``. KV keys are never
+        deleted, so repeated broadcast/all_gather calls must write fresh
+        keys. The counter is a server-side fetch-and-increment keyed by
+        (tag, rank): a crashed-and-rejoined rank resumes at the world's
+        current round instead of restarting from 0. Like any collective,
+        every rank must make these calls in the same order."""
+        return self.incr(f"__round/{tag}/{self.rank}")
+
+    def incr(self, key: str) -> int:
+        """Server-side atomic fetch-and-increment; returns previous value."""
+        v = self._lib.nz_client_incr(self._h, key.encode())
+        if v < 0:
+            raise CoordinatorError(self._lib.nz_last_error().decode())
+        return v
 
     # ---------------------------------------------------------------- KV
     def put(self, key: str, value: bytes) -> None:

@@ -58,6 +58,8 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.nz_client_get.restype = c.c_long
     lib.nz_client_get.argtypes = [c.c_void_p, c.c_char_p, c.c_void_p,
                                   c.c_long, c.c_long]
+    lib.nz_client_incr.restype = c.c_long
+    lib.nz_client_incr.argtypes = [c.c_void_p, c.c_char_p]
     lib.nz_client_barrier.restype = c.c_int
     lib.nz_client_barrier.argtypes = [c.c_void_p, c.c_long]
     lib.nz_client_failed.restype = c.c_long
@@ -83,17 +85,33 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
 
 
 def load_library() -> ctypes.CDLL:
-    """Build (if stale) and load the native runtime library. Thread-safe."""
+    """Build (if stale) and load the native runtime library.
+
+    Thread-safe in-process, and cross-process safe: multi-process launches
+    on one host all race here on a cold build, so the build runs under an
+    exclusive flock and the Makefile moves the .so into place atomically —
+    no rank can dlopen a half-written library.
+    """
     global _lib
     with _lock:
         if _lib is not None:
             return _lib
         if _needs_build():
-            proc = subprocess.run(
-                ["make", "-s"], cwd=_CSRC, capture_output=True, text=True)
-            if proc.returncode != 0:
-                raise NativeBuildError(
-                    f"native build failed:\n{proc.stdout}\n{proc.stderr}")
+            import fcntl
+            os.makedirs(os.path.join(_CSRC, "build"), exist_ok=True)
+            with open(os.path.join(_CSRC, "build", ".lock"), "w") as lockf:
+                fcntl.flock(lockf, fcntl.LOCK_EX)
+                try:
+                    if _needs_build():  # may have been built while we waited
+                        proc = subprocess.run(
+                            ["make", "-s"], cwd=_CSRC,
+                            capture_output=True, text=True)
+                        if proc.returncode != 0:
+                            raise NativeBuildError(
+                                "native build failed:\n"
+                                f"{proc.stdout}\n{proc.stderr}")
+                finally:
+                    fcntl.flock(lockf, fcntl.LOCK_UN)
         _lib = _declare(ctypes.CDLL(_LIB_PATH))
         return _lib
 

@@ -89,7 +89,11 @@ class Trainer:
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every: int = 0,
                  log_every: int = 10,
-                 metric_logger: Optional[Callable[[int, dict], None]] = None):
+                 metric_logger: Optional[Callable[[int, dict], None]] = None,
+                 tracer=None,
+                 process_group=None,
+                 failure_check_every: int = 0,
+                 on_failure: Optional[Callable[[list], None]] = None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -98,6 +102,14 @@ class Trainer:
         self.checkpoint_every = checkpoint_every
         self.log_every = log_every
         self.metric_logger = metric_logger
+        # Optional aux subsystems: a utils.Tracer to capture an XLA profile
+        # over a step window, and a dist.ProcessGroup polled for dead peers
+        # (reference coordinator heartbeat role, SURVEY.md §1) so a healthy
+        # rank can checkpoint-and-stop instead of hanging in a collective.
+        self.tracer = tracer
+        self.process_group = process_group
+        self.failure_check_every = failure_check_every
+        self.on_failure = on_failure
         self.step_fn = make_train_step(model, optimizer, loss_fn)
         self.state: Optional[TrainState] = None
         self.global_step = 0
@@ -122,6 +134,21 @@ class Trainer:
             batch = next(batches)
             self.state, metrics = self.step_fn(self.state, batch)
             self.global_step += 1
+            if self.tracer is not None:
+                self.tracer.maybe_trace(self.global_step)
+            if (self.failure_check_every and self.process_group is not None
+                    and self.global_step % self.failure_check_every == 0):
+                failed = self.process_group.failed_ranks()
+                if failed:
+                    if self.checkpoint_dir:  # preserve progress first
+                        ckpt.save_checkpoint(self.checkpoint_dir, self.state,
+                                             self.global_step)
+                    if self.on_failure is not None:
+                        self.on_failure(failed)
+                    else:
+                        raise RuntimeError(
+                            f"peer rank(s) {failed} failed at step "
+                            f"{self.global_step}")
             if self.log_every and self.global_step % self.log_every == 0:
                 last_metrics = {k: float(v) for k, v in metrics.items()}
                 last_metrics["steps_per_sec"] = self.log_every / max(

@@ -1,0 +1,19 @@
+"""Auxiliary subsystems: tracing/profiling, metrics, structured logging
+(SURVEY.md §5 — the reference's evidence here was thin, so this package is
+sized to what a training framework needs on TPU: XLA-aware profiling via
+jax.profiler, JSONL metrics with async-dispatch-aware step timing, and a
+rank-tagged logger)."""
+
+from nezha_tpu.utils.logging import get_logger, set_rank
+from nezha_tpu.utils.metrics import MetricsLogger, StepTimer
+from nezha_tpu.utils.profiling import Tracer, annotate, profile_trace
+
+__all__ = [
+    "get_logger",
+    "set_rank",
+    "MetricsLogger",
+    "StepTimer",
+    "Tracer",
+    "annotate",
+    "profile_trace",
+]

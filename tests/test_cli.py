@@ -45,3 +45,22 @@ def test_mesh_parsing():
     from nezha_tpu.cli.train import _parse_mesh
     assert _parse_mesh("dp=4,sp=2") == {"dp": 4, "sp": 2}
     assert _parse_mesh(None) is None
+
+
+def test_cli_with_coordinator(tmp_path):
+    """Single-process world through the real coordinator dial-in path."""
+    from nezha_tpu.runtime.native import native_available
+    if not native_available():
+        import pytest
+        pytest.skip("native runtime not available")
+    from nezha_tpu import dist
+    from nezha_tpu.cli.train import build_parser, run
+
+    with dist.Coordinator(world_size=1) as coord:
+        args = build_parser().parse_args([
+            "--config", "mlp_mnist", "--steps", "4", "--batch-size", "16",
+            "--platform", "cpu", "--log-every", "2",
+            "--coordinator", f"127.0.0.1:{coord.port}",
+        ])
+        last = run(args)
+    assert "loss" in last

@@ -277,3 +277,41 @@ def test_peer_death_during_barrier_is_detected():
         t.join(timeout=10)  # barrier times out; rank 0 survives to react
         assert "e" in err
         g0.leave()
+
+
+def test_incr_is_atomic_across_ranks():
+    def fn(g):
+        return [g.incr("ctr") for _ in range(10)]
+
+    vals = sum(_run_ranks(4, fn), [])
+    assert sorted(vals) == list(range(40))
+
+
+def test_rejoined_rank_resumes_collective_rounds():
+    """After one broadcast round, a crashed rank's replacement must join
+    round 1, not replay round 0's stale KV entry."""
+    with dist.Coordinator(world_size=2) as coord:
+        g0 = dist.join("127.0.0.1", coord.port)
+        g1 = dist.join("127.0.0.1", coord.port)
+        r0 = {}
+
+        def round_one():
+            r0["v"] = g0.broadcast(b"addr-v1", root=0, timeout_s=10)
+
+        t = threading.Thread(target=round_one)
+        t.start()
+        assert g1.broadcast(None, root=0, timeout_s=10) == b"addr-v1"
+        t.join(timeout=10)
+        g1.close()  # crash after round 0
+        g1b = dist.join("127.0.0.1", coord.port, rank_hint=1)
+
+        def round_two():
+            r0["v2"] = g0.broadcast(b"addr-v2", root=0, timeout_s=10)
+
+        t = threading.Thread(target=round_two)
+        t.start()
+        got = g1b.broadcast(None, root=0, timeout_s=10)
+        t.join(timeout=10)
+        assert got == b"addr-v2", "replacement read a stale round"
+        g1b.leave()
+        g0.leave()

@@ -129,3 +129,13 @@ def test_native_loader_through_prefetcher(tmp_path):
         batches = list(pf)
     assert len(batches) == 4
     assert all(isinstance(b["image"], jax.Array) for b in batches)
+
+
+def test_mnist_truncated_labels_rejected(tmp_path):
+    """Header says n examples but label body is shorter: must error, not
+    read out of bounds."""
+    img, lbl, _, _ = _write_idx(tmp_path, n=64)
+    raw = lbl.read_bytes()
+    lbl.write_bytes(raw[:8 + 10])  # keep header, truncate body
+    with pytest.raises(NativeLoaderError):
+        MnistLoader(img, lbl, batch_size=8)

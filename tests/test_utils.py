@@ -1,0 +1,175 @@
+"""Aux-subsystem tests: metrics JSONL, step timing, profiling wrappers,
+rank-tagged logging, and the Trainer's tracer/failure-detection hooks."""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nezha_tpu import utils
+from nezha_tpu.utils.metrics import read_metrics
+
+
+def test_metrics_logger_roundtrip(tmp_path):
+    path = tmp_path / "m.jsonl"
+    with utils.MetricsLogger(str(path)) as log:
+        log(1, {"loss": jnp.float32(2.5), "lr": 1e-3})
+        log(2, {"loss": 2.0})
+    recs = read_metrics(str(path))
+    assert [r["step"] for r in recs] == [1, 2]
+    assert recs[0]["loss"] == 2.5
+    assert recs[0]["lr"] == 1e-3
+    assert all("ts" in r for r in recs)
+
+
+def test_metrics_logger_appends(tmp_path):
+    path = tmp_path / "m.jsonl"
+    with utils.MetricsLogger(str(path)) as log:
+        log(1, {"a": 1})
+    with utils.MetricsLogger(str(path)) as log:
+        log(2, {"a": 2})
+    assert len(read_metrics(str(path))) == 2
+
+
+def test_step_timer_windows():
+    timer = utils.StepTimer(window=3)
+    x = jnp.float32(0.0)
+    assert timer.tick(x) is None  # opens window
+    assert timer.tick(x) is None
+    assert timer.tick(x) is None
+    rate = timer.tick(x)  # 3rd counted step closes window
+    assert rate is not None and rate > 0
+
+
+def test_annotate_and_profile_trace(tmp_path):
+    # Smoke: annotation composes with jit; trace produces files.
+    @jax.jit
+    def f(x):
+        with utils.annotate("double"):
+            return x * 2
+
+    with utils.profile_trace(str(tmp_path / "trace")):
+        f(jnp.ones((8, 8))).block_until_ready()
+    produced = []
+    for root, _, files in os.walk(tmp_path / "trace"):
+        produced += files
+    assert produced, "profiler wrote no trace files"
+
+
+def test_tracer_start_stop(tmp_path):
+    tracer = utils.Tracer(str(tmp_path / "t"), start_step=2, num_steps=2)
+    for step in range(1, 6):
+        tracer.maybe_trace(step)
+        jnp.ones(4).block_until_ready()
+    assert not tracer._active
+    produced = []
+    for root, _, files in os.walk(tmp_path / "t"):
+        produced += files
+    assert produced
+
+
+def test_tracer_disabled_is_noop():
+    tracer = utils.Tracer(None)
+    for step in range(5):
+        tracer.maybe_trace(step)  # must not raise or start anything
+    assert not tracer.enabled
+
+
+def test_rank_tagged_logging():
+    # Attach our own stream: the default handler binds sys.stderr at first
+    # configuration, which under pytest may be another test's capture.
+    import io
+    import logging as py_logging
+
+    from nezha_tpu.utils.logging import _RankFilter
+
+    utils.set_rank(3)
+    logger = utils.get_logger("nezha_tpu.test")
+    stream = io.StringIO()
+    handler = py_logging.StreamHandler(stream)
+    handler.setFormatter(py_logging.Formatter("[rank %(rank)s] %(message)s"))
+    handler.addFilter(_RankFilter())
+    logger.addHandler(handler)
+    try:
+        logger.info("hello from a pod")
+    finally:
+        logger.removeHandler(handler)
+        utils.set_rank(0)
+    assert "[rank 3] hello from a pod" in stream.getvalue()
+
+
+def test_trainer_failure_detection(tmp_path):
+    """A Trainer polling a ProcessGroup must checkpoint and raise when a
+    peer rank dies mid-training."""
+    from nezha_tpu.runtime.native import native_available
+    if not native_available():
+        pytest.skip("native runtime not available")
+    from nezha_tpu import dist, ops, optim
+    from nezha_tpu.models.mlp import MLP
+    from nezha_tpu.train.checkpoint import latest_step
+    from nezha_tpu.train.loop import Trainer
+
+    def loss_fn(logits, batch):
+        return ops.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"])
+
+    def batches():
+        rng = np.random.RandomState(0)
+        while True:
+            yield {"image": rng.rand(8, 784).astype(np.float32),
+                   "label": rng.randint(0, 10, 8).astype(np.int32)}
+
+    with dist.Coordinator(world_size=2, heartbeat_timeout_s=0.5) as coord:
+        g0 = dist.join("127.0.0.1", coord.port, heartbeat_interval_s=0.1)
+        g1 = dist.join("127.0.0.1", coord.port, heartbeat_interval_s=0.1)
+        trainer = Trainer(
+            MLP(hidden=(32,)), optim.sgd(1e-2), loss_fn,
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            process_group=g0, failure_check_every=1, log_every=0)
+        trainer.initialize()
+        # Train a few healthy steps, then kill the peer.
+        trainer.fit(batches(), steps=3)
+        g1.close()
+        time.sleep(1.0)  # past heartbeat timeout
+        with pytest.raises(RuntimeError, match=r"rank\(s\) \[1\] failed"):
+            trainer.fit(batches(), steps=50)
+        # Progress was preserved before raising.
+        assert latest_step(str(tmp_path / "ckpt")) == trainer.global_step
+        g0.leave()
+
+
+def test_trainer_resume_from_checkpoint(tmp_path):
+    """Checkpoint/resume: a new Trainer picks up step count and state."""
+    from nezha_tpu import ops, optim
+    from nezha_tpu.models.mlp import MLP
+    from nezha_tpu.train.loop import Trainer
+
+    def loss_fn(logits, batch):
+        return ops.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"])
+
+    def batches():
+        rng = np.random.RandomState(0)
+        while True:
+            yield {"image": rng.rand(8, 784).astype(np.float32),
+                   "label": rng.randint(0, 10, 8).astype(np.int32)}
+
+    def make(mldir):
+        return Trainer(MLP(hidden=(32,)), optim.sgd(1e-2), loss_fn,
+                       checkpoint_dir=str(mldir), checkpoint_every=5,
+                       log_every=0)
+
+    t1 = make(tmp_path)
+    t1.fit(batches(), steps=10)
+    w1 = t1.state["variables"]["params"]["head"]["w"]
+
+    t2 = make(tmp_path)
+    t2.initialize(resume=True)
+    assert t2.global_step == 10
+    np.testing.assert_allclose(
+        t2.state["variables"]["params"]["head"]["w"], w1, atol=0)
