@@ -296,6 +296,136 @@ class TokenLoader : public Loader {
   std::vector<int32_t> tokens_;
 };
 
+// ----------------------------------------------------------- image records
+// ImageNet-style path (SURVEY.md §1 "MNIST + ImageNet + text loaders"):
+// pre-decoded raw images in a flat record file — "NZR1" magic, then
+// int32 n, h, w, c (little-endian), then n records of (int32 label +
+// h*w*c uint8 HWC pixels). JPEG decode happens once at dataset-prep time
+// (no image codec in this runtime); the loader does the per-epoch work:
+// shuffle, random crop, horizontal flip, normalize — on worker threads.
+class ImageRecordLoader : public Loader {
+ public:
+  ImageRecordLoader(const char* path, int batch, int crop_h, int crop_w,
+                    uint64_t seed, int workers, size_t depth, int epochs,
+                    bool train_augment)
+      : Loader(batch, depth), crop_h_(crop_h), crop_w_(crop_w),
+        seed_(seed), epochs_(epochs), augment_(train_augment) {
+    if (!read_file(path, &raw_)) {
+      error_ = "cannot read record file";
+      return;
+    }
+    if (raw_.size() < 20 || std::memcmp(raw_.data(), "NZR1", 4) != 0) {
+      error_ = "bad NZR1 magic";
+      return;
+    }
+    int32_t dims[4];
+    std::memcpy(dims, raw_.data() + 4, 16);
+    n_ = dims[0]; h_ = dims[1]; w_ = dims[2]; c_ = dims[3];
+    // Bound each dim before multiplying: a crafted header could overflow
+    // the pixel product and slip past the size check into OOB reads.
+    if (n_ <= 0 || h_ <= 0 || w_ <= 0 || c_ <= 0 ||
+        h_ > (1 << 16) || w_ > (1 << 16) || c_ > 64) {
+      error_ = "NZR1 bad dimensions";
+      return;
+    }
+    record_ = 4 + size_t(h_) * w_ * c_;  // <= 2^38, no overflow
+    if (raw_.size() < 20 + size_t(n_) * record_) {
+      error_ = "NZR1 size mismatch";
+      return;
+    }
+    if (crop_h_ <= 0) crop_h_ = h_;
+    if (crop_w_ <= 0) crop_w_ = w_;
+    if (crop_h_ > h_ || crop_w_ > w_) {
+      error_ = "crop larger than stored image";
+      return;
+    }
+    StartWorkers(std::max(workers, 1));
+  }
+
+  ~ImageRecordLoader() override { StopWorkers(); }  // see MnistLoader note
+
+  bool ok() const { return error_.empty(); }
+  const std::string& error() const { return error_; }
+  int n() const { return n_; }
+  int h() const { return h_; }
+  int w() const { return w_; }
+  int c() const { return c_; }
+  int crop_h() const { return crop_h_; }
+  int crop_w() const { return crop_w_; }
+
+ protected:
+  void WorkerLoop(int worker_id) override {
+    const size_t out_px = size_t(crop_h_) * crop_w_ * c_;
+    for (int epoch = 0; epochs_ <= 0 || epoch < epochs_; ++epoch) {
+      std::vector<uint32_t> perm(n_);
+      for (int i = 0; i < n_; ++i) perm[i] = static_cast<uint32_t>(i);
+      std::mt19937_64 perm_rng(seed_ + static_cast<uint64_t>(epoch));
+      std::shuffle(perm.begin(), perm.end(), perm_rng);
+      const size_t nbatch = size_t(n_) / batch_;
+      for (size_t b = static_cast<size_t>(worker_id); b < nbatch;
+           b += static_cast<size_t>(num_workers_)) {
+        if (stopping_) return;
+        // Augmentation rng keyed by (seed, epoch, batch index): identical
+        // batches regardless of which worker drew them.
+        std::mt19937_64 rng((seed_ + 0x9e3779b97f4a7c15ULL * (epoch + 1)) ^
+                            (b + 1));
+        Batch out;
+        out.count = batch_;
+        out.f32.resize(static_cast<size_t>(batch_) * out_px);
+        out.i32.resize(batch_);
+        for (int j = 0; j < batch_; ++j) {
+          const unsigned char* rec =
+              raw_.data() + 20 + size_t(perm[b * batch_ + j]) * record_;
+          int32_t label;
+          std::memcpy(&label, rec, 4);
+          out.i32[j] = label;
+          const unsigned char* px = rec + 4;
+          int dy = 0, dx = 0;
+          bool flip = false;
+          if (augment_) {
+            if (h_ > crop_h_)
+              dy = static_cast<int>(rng() % (uint64_t)(h_ - crop_h_ + 1));
+            if (w_ > crop_w_)
+              dx = static_cast<int>(rng() % (uint64_t)(w_ - crop_w_ + 1));
+            flip = (rng() & 1) != 0;
+          } else {  // eval: deterministic center crop
+            dy = (h_ - crop_h_) / 2;
+            dx = (w_ - crop_w_) / 2;
+          }
+          float* dst = out.f32.data() + size_t(j) * out_px;
+          for (int y = 0; y < crop_h_; ++y) {
+            const unsigned char* row =
+                px + (size_t(y + dy) * w_ + dx) * c_;
+            float* drow = dst + size_t(y) * crop_w_ * c_;
+            if (!flip) {
+              for (int i = 0; i < crop_w_ * c_; ++i)
+                drow[i] = static_cast<float>(row[i]) * (1.0f / 255.0f);
+            } else {
+              for (int x = 0; x < crop_w_; ++x)
+                for (int ch = 0; ch < c_; ++ch)
+                  drow[size_t(x) * c_ + ch] =
+                      static_cast<float>(
+                          row[size_t(crop_w_ - 1 - x) * c_ + ch]) *
+                      (1.0f / 255.0f);
+            }
+          }
+        }
+        if (!queue_.Push(std::move(out))) return;
+      }
+    }
+    WorkerDone();
+  }
+
+ private:
+  int n_ = 0, h_ = 0, w_ = 0, c_ = 0;
+  int crop_h_, crop_w_;
+  size_t record_ = 0;
+  std::vector<unsigned char> raw_;
+  const uint64_t seed_;
+  const int epochs_;
+  const bool augment_;
+};
+
 }  // namespace
 
 // ------------------------------------------------------------------- C ABI
@@ -328,6 +458,25 @@ void* nz_tokens_open(const char* path, int dtype_code, int seq, int batch,
     return nullptr;
   }
   if (n_tokens) *n_tokens = static_cast<long>(l->n_tokens());
+  return l;
+}
+
+void* nz_records_open(const char* path, int batch, int crop_h, int crop_w,
+                      uint64_t seed, int workers, int depth, int epochs,
+                      int train_augment, int* n_out, int* h_out, int* w_out,
+                      int* c_out) {
+  auto* l = new ImageRecordLoader(path, batch, crop_h, crop_w, seed, workers,
+                                  static_cast<size_t>(depth), epochs,
+                                  train_augment != 0);
+  if (!l->ok()) {
+    set_loader_error(l->error());
+    delete l;
+    return nullptr;
+  }
+  if (n_out) *n_out = l->n();
+  if (h_out) *h_out = l->crop_h();
+  if (w_out) *w_out = l->crop_w();
+  if (c_out) *c_out = l->c();
   return l;
 }
 

@@ -185,9 +185,12 @@ def run(args) -> Dict[str, float]:
     # --- loop -------------------------------------------------------------
     source = cfg.batches(batch_size)
     prefetch = Prefetcher(source, depth=args.prefetch)
-    metrics_file = open(args.metrics_file, "a") if args.metrics_file else None
+    from nezha_tpu.utils import MetricsLogger
+    metrics_log = MetricsLogger(args.metrics_file) if args.metrics_file else None
 
     if args.profile_dir:
+        import os as _os
+        _os.makedirs(args.profile_dir, exist_ok=True)
         jax.profiler.start_trace(args.profile_dir)
 
     last: Dict[str, float] = {}
@@ -205,11 +208,9 @@ def run(args) -> Dict[str, float]:
                 last["examples_per_sec"] = window_examples / (now - window_t0)
                 last["step"] = step_no
                 window_t0, window_examples = now, 0
-                line = json.dumps(last)
-                print(line, file=sys.stderr)
-                if metrics_file:
-                    metrics_file.write(line + "\n")
-                    metrics_file.flush()
+                print(json.dumps(last), file=sys.stderr)
+                if metrics_log:
+                    metrics_log.log(step_no, last)
             if (args.ckpt_every and args.ckpt_dir
                     and step_no % args.ckpt_every == 0):
                 ckpt.save_checkpoint(args.ckpt_dir, state, step_no)
@@ -217,16 +218,18 @@ def run(args) -> Dict[str, float]:
         prefetch.close()
         if args.profile_dir:
             jax.profiler.stop_trace()
-        if metrics_file:
-            metrics_file.close()
+        if metrics_log:
+            metrics_log.close()
         if group is not None:
-            try:
-                # All ranks finish before teardown. Best-effort: if we are
-                # unwinding an exception, peers may never arrive — don't
-                # let the barrier mask the real error or skip leave/stop.
-                group.barrier(timeout_s=600)
-            except Exception as e:
-                print(f"shutdown barrier skipped: {e}", file=sys.stderr)
+            unwinding = sys.exc_info()[0] is not None
+            if not unwinding:
+                try:
+                    group.barrier(timeout_s=600)  # all ranks finish first
+                except Exception as e:
+                    print(f"shutdown barrier skipped: {e}", file=sys.stderr)
+            # Unwinding an exception: peers may never arrive — leave at
+            # once so survivors' failure detectors see a clean departure
+            # and the real error surfaces without a 600 s stall.
             group.leave()
         if coord is not None:
             coord.stop()

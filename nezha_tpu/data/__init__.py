@@ -3,7 +3,12 @@
 the prefetching worker pool in `nezha_tpu.runtime`."""
 
 from nezha_tpu.data.mnist import load_mnist, mnist_batches
-from nezha_tpu.data.native import MnistLoader, TokenLoader
+from nezha_tpu.data.native import (
+    ImageRecordLoader,
+    MnistLoader,
+    TokenLoader,
+    write_image_records,
+)
 from nezha_tpu.data.synthetic import (
     synthetic_image_batches,
     synthetic_token_batches,
@@ -13,5 +18,6 @@ from nezha_tpu.data.synthetic import (
 __all__ = [
     "load_mnist", "mnist_batches",
     "MnistLoader", "TokenLoader",
+    "ImageRecordLoader", "write_image_records",
     "synthetic_image_batches", "synthetic_token_batches", "synthetic_mlm_batches",
 ]

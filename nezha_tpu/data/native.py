@@ -79,6 +79,66 @@ class MnistLoader(_Closable):
             yield {"image": images, "label": labels}
 
 
+class ImageRecordLoader(_Closable):
+    """ImageNet-style batches from an NZR1 record file, decoded/augmented
+    by C++ workers (random crop + horizontal flip at train time, center
+    crop at eval). Yields ``{"image": float32 [B, ch, cw, C] in [0,1],
+    "label": int32 [B]}``. Write record files with
+    :func:`write_image_records`. ``epochs <= 0`` streams forever.
+    """
+
+    def __init__(self, path: str, batch_size: int, crop: int = 0,
+                 seed: int = 0, num_workers: int = 2, queue_depth: int = 4,
+                 epochs: int = 0, train_augment: bool = True):
+        self._lib = load_library()
+        n = ctypes.c_int()
+        h = ctypes.c_int()
+        w = ctypes.c_int()
+        c = ctypes.c_int()
+        self._h = self._lib.nz_records_open(
+            str(path).encode(), int(batch_size), int(crop), int(crop),
+            int(seed), int(num_workers), int(queue_depth), int(epochs),
+            1 if train_augment else 0,
+            ctypes.byref(n), ctypes.byref(h), ctypes.byref(w),
+            ctypes.byref(c))
+        if not self._h:
+            raise NativeLoaderError(self._lib.nz_loader_error().decode())
+        self.num_examples = n.value
+        self.shape = (h.value, w.value, c.value)
+        self.batch_size = batch_size
+
+    def __iter__(self) -> Iterator[dict]:
+        h, w, c = self.shape
+        while True:
+            images = np.empty((self.batch_size, h, w, c), np.float32)
+            labels = np.empty((self.batch_size,), np.int32)
+            got = self._lib.nz_loader_next(
+                self._h,
+                images.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+            if got <= 0:
+                return
+            yield {"image": images, "label": labels}
+
+
+def write_image_records(path: str, images: np.ndarray,
+                        labels: np.ndarray) -> None:
+    """Write an NZR1 record file: ``images`` uint8 [N,H,W,C] (pre-decoded,
+    pre-resized — JPEG decode is a dataset-prep step, not a loader step),
+    ``labels`` int [N]."""
+    images = np.ascontiguousarray(images, np.uint8)
+    labels = np.asarray(labels, np.int32)
+    if images.ndim != 4 or labels.shape[0] != images.shape[0]:
+        raise ValueError("images must be [N,H,W,C] with matching labels")
+    n, h, w, c = images.shape
+    with open(path, "wb") as f:
+        f.write(b"NZR1")
+        f.write(np.asarray([n, h, w, c], np.int32).tobytes())
+        for i in range(n):
+            f.write(labels[i].tobytes())
+            f.write(images[i].tobytes())
+
+
 class TokenLoader(_Closable):
     """Random ``[B, seq+1]`` windows from a flat binary token file
     (uint16 or int32), GPT-style next-token batches. Infinite stream.

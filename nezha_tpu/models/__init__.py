@@ -10,6 +10,7 @@ _LAZY = {
     "ResNet": "resnet", "resnet50": "resnet", "wide_resnet101": "resnet",
     "GPT2": "gpt2", "GPT2Config": "gpt2", "gpt2_124m": "gpt2",
     "Bert": "bert", "BertConfig": "bert", "bert_base": "bert",
+    "generate": "generate", "init_cache": "generate",
 }
 
 

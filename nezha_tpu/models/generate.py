@@ -1,0 +1,110 @@
+"""Autoregressive decoding for GPT-2 with a KV cache.
+
+One compiled prefill (whole prompt writes layer caches) + one compiled
+decode step reused for every generated token (`lax.scan`, static shapes,
+traced position scalar) — the XLA-friendly decode loop: no per-token
+recompilation, no growing shapes, cache updates via dynamic_update_slice.
+Sampling: greedy, temperature, and top-k.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from nezha_tpu.models.gpt2 import GPT2
+
+
+def init_cache(model: GPT2, batch_size: int, max_len: int,
+               dtype=jnp.bfloat16) -> list:
+    """Fixed-size per-layer K/V buffers: ``[B, H, max_len, D]`` each."""
+    cfg = model.cfg
+    d = cfg.hidden_size // cfg.num_heads
+    shape = (batch_size, cfg.num_heads, max_len, d)
+    return [{"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+            for _ in range(cfg.num_layers)]
+
+
+def _caches_from_states(model: GPT2, states: dict, prev: list) -> list:
+    return [states.get(f"h{i}", {}).get("attn", {}).get("cache", prev[i])
+            for i in range(model.cfg.num_layers)]
+
+
+def _sample(logits, rng, temperature: float, top_k: Optional[int]):
+    """logits [B, V] -> token ids [B]."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k is not None:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits).astype(jnp.int32)
+
+
+# The jitted programs are built once per (model, sampling config) and
+# cached: jax.jit keys on the function object, so closures created inside
+# generate() would retrace and recompile on every call. Models hash by
+# identity, which is exactly the lifetime of their compiled programs.
+@functools.lru_cache(maxsize=64)
+def _prefill_fn(model: GPT2):
+    @jax.jit
+    def prefill(variables, prompt, cache):
+        logits, states = model.apply(variables, prompt, training=False,
+                                     cache=cache, pos=jnp.int32(0))
+        return logits[:, -1, :], _caches_from_states(model, states, cache)
+
+    return prefill
+
+
+@functools.lru_cache(maxsize=64)
+def _decode_fn(model: GPT2, temperature: float, top_k: Optional[int],
+               max_new_tokens: int):
+    @jax.jit
+    def decode(variables, last_logits, cache, pos0, rng):
+        def step(carry, _):
+            logits, cache, pos, rng = carry
+            rng, sub = jax.random.split(rng)
+            tok = _sample(logits, sub, temperature, top_k)
+            out, states = model.apply(variables, tok[:, None],
+                                      training=False, cache=cache, pos=pos)
+            new_cache = _caches_from_states(model, states, cache)
+            return (out[:, -1, :], new_cache, pos + 1, rng), tok
+
+        init = (last_logits, cache, pos0, rng)
+        _, tokens = lax.scan(step, init, None, length=max_new_tokens)
+        return tokens.T  # [steps, B] -> [B, steps]
+
+    return decode
+
+
+def generate(model: GPT2, variables: dict, prompt: jax.Array,
+             max_new_tokens: int, temperature: float = 0.0,
+             top_k: Optional[int] = None,
+             rng: Optional[jax.Array] = None,
+             cache_dtype=jnp.bfloat16) -> jax.Array:
+    """Generate ``[B, prompt_len + max_new_tokens]`` token ids.
+
+    ``temperature=0`` is greedy decoding; otherwise categorical sampling
+    (optionally top-k truncated). Compiles exactly two programs per
+    (model, sampling config, shapes) — prefill and the scanned
+    single-token step — reused across calls.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, s = prompt.shape
+    max_len = s + max_new_tokens
+    if max_len > model.cfg.max_positions:
+        raise ValueError(
+            f"prompt+new = {max_len} exceeds max_positions "
+            f"{model.cfg.max_positions}")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    cache = init_cache(model, b, max_len, cache_dtype)
+    last_logits, cache = _prefill_fn(model)(variables, prompt, cache)
+    new_tokens = _decode_fn(model, temperature, top_k, max_new_tokens)(
+        variables, last_logits, cache, jnp.int32(s), rng)
+    return jnp.concatenate([prompt, new_tokens], axis=1)

@@ -31,7 +31,12 @@ class GPT2Config:
     hidden_size: int = 768
     mlp_ratio: int = 4
     dropout: float = 0.0  # 0 for throughput benchmarking; 0.1 for GPT-2 paper
-    attn_impl: str = "xla"  # "xla" | "ring" | "ulysses"
+    # "xla" (default): composed einsum+softmax that XLA fuses — measured
+    # faster than the Pallas flash kernel for *training* at bench shapes
+    # (fwd+bwd, S<=2048; the flash backward recomputes). "flash" is the
+    # memory-bound choice: long sequences / inference where the S x S score
+    # matrix would dominate HBM.
+    attn_impl: str = "xla"  # "xla" | "flash" | "auto" | "ring" | "ulysses"
     sp_axis: str = "sp"
 
 
@@ -46,7 +51,8 @@ class Attention(Module):
             policy=policy)
         self.drop = nn.Dropout(cfg.dropout)
 
-    def apply(self, variables: Variables, x, training: bool = False, rng=None):
+    def apply(self, variables: Variables, x, training: bool = False, rng=None,
+              cache=None, pos=None):
         cfg = self.cfg
         b, s, h = x.shape
         d = h // cfg.num_heads
@@ -55,12 +61,44 @@ class Attention(Module):
         qkv = qkv.reshape(b, s, 3, cfg.num_heads, d).transpose(2, 0, 3, 1, 4)
         q, k, v = qkv[0], qkv[1], qkv[2]  # each [B, H, S, D]
 
-        if cfg.attn_impl == "ring":
+        if cache is not None:
+            # Incremental decoding: append this chunk's K/V at `pos` in the
+            # fixed-size cache and attend causally over everything written
+            # so far. Static shapes throughout — `pos` is a traced scalar,
+            # so one compiled program serves every decode step.
+            import jax.lax as lax
+            zero = jnp.zeros((), jnp.int32)
+            k_all = lax.dynamic_update_slice(
+                cache["k"], k.astype(cache["k"].dtype),
+                (zero, zero, pos, zero))
+            v_all = lax.dynamic_update_slice(
+                cache["v"], v.astype(cache["v"].dtype),
+                (zero, zero, pos, zero))
+            L = k_all.shape[2]
+            abs_q = pos + jnp.arange(s)[:, None]       # absolute positions
+            attendable = jnp.arange(L)[None, :] <= abs_q
+            mask = jnp.where(attendable, 0.0, -jnp.inf).astype(jnp.float32)
+            out = ops.dot_product_attention(q, k_all.astype(q.dtype),
+                                            v_all.astype(q.dtype), mask=mask)
+            states["cache"] = {"k": k_all, "v": v_all}
+            out = out.transpose(0, 2, 1, 3).reshape(b, s, h)
+            out = run_child(self.proj, "proj", variables, states, out,
+                            training=training)
+            return out, states
+
+        impl = cfg.attn_impl
+        if impl == "auto":
+            import jax
+            impl = "flash" if jax.default_backend() == "tpu" else "xla"
+        if impl == "ring":
             from nezha_tpu.parallel.ring import ring_attention
             out = ring_attention(q, k, v, cfg.sp_axis, causal=True)
-        elif cfg.attn_impl == "ulysses":
+        elif impl == "ulysses":
             from nezha_tpu.parallel.sequence_parallel import ulysses_attention
             out = ulysses_attention(q, k, v, cfg.sp_axis, causal=True)
+        elif impl == "flash":
+            from nezha_tpu.ops.pallas import flash_attention
+            out = flash_attention(q, k, v, causal=True)
         else:
             mask = ops.causal_mask(s, s)
             out = ops.dot_product_attention(q, k, v, mask=mask)
@@ -101,11 +139,12 @@ class Block(Module):
         self.ln_2 = nn.LayerNorm(h, policy=policy)
         self.mlp = MLPBlock(cfg, policy)
 
-    def apply(self, variables: Variables, x, training: bool = False, rng=None):
+    def apply(self, variables: Variables, x, training: bool = False, rng=None,
+              cache=None, pos=None):
         states: dict = {}
         y = run_child(self.ln_1, "ln_1", variables, states, x, training=training)
         y = run_child(self.attn, "attn", variables, states, y,
-                      training=training, rng=rng)
+                      training=training, rng=rng, cache=cache, pos=pos)
         x = x + y
         y = run_child(self.ln_2, "ln_2", variables, states, x, training=training)
         y = run_child(self.mlp, "mlp", variables, states, y,
@@ -132,7 +171,8 @@ class GPT2(Module):
         self.h = [Block(cfg, policy) for _ in range(cfg.num_layers)]
         self.ln_f = nn.LayerNorm(cfg.hidden_size, policy=policy)
 
-    def apply(self, variables: Variables, batch, training: bool = False, rng=None):
+    def apply(self, variables: Variables, batch, training: bool = False,
+              rng=None, cache=None, pos=None):
         if isinstance(batch, dict):
             tokens = batch["tokens"][:, :-1]
         else:
@@ -144,16 +184,20 @@ class GPT2(Module):
             raise ValueError(
                 f"sequence length {s} exceeds max_positions "
                 f"{self.cfg.max_positions}")
-        pos = jnp.arange(s)[None, :]
+        if cache is not None:
+            positions = pos + jnp.arange(s)[None, :]
+        else:
+            positions = jnp.arange(s)[None, :]
         x = run_child(self.wte, "wte", variables, states, tokens,
                       training=training)
-        x = x + run_child(self.wpe, "wpe", variables, states, pos,
+        x = x + run_child(self.wpe, "wpe", variables, states, positions,
                           training=training)
         x = run_child(self.drop, "drop", variables, states, x,
                       training=training, rng=rng)
         for i, block in enumerate(self.h):
             x = run_child(block, f"h{i}", variables, states, x,
-                          training=training, rng=rng)
+                          training=training, rng=rng,
+                          cache=None if cache is None else cache[i], pos=pos)
         x = run_child(self.ln_f, "ln_f", variables, states, x,
                       training=training)
         logits = self.wte.attend(child_vars(variables, "wte"), x)

@@ -57,6 +57,7 @@ class Tracer:
         self.start_step = start_step
         self.stop_step = start_step + num_steps
         self._active = False
+        self._done = False
 
     @property
     def enabled(self) -> bool:
@@ -65,7 +66,10 @@ class Tracer:
     def maybe_trace(self, step: int) -> None:
         if not self.enabled:
             return
-        if not self._active and step == self.start_step:
+        # >= start (not ==): a resumed run whose step counter starts past
+        # start_step must still capture a window.
+        if (not self._active and not self._done
+                and step >= self.start_step and step < self.stop_step):
             os.makedirs(self.log_dir, exist_ok=True)
             jax.profiler.start_trace(self.log_dir)
             self._active = True
@@ -76,6 +80,7 @@ class Tracer:
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
+            self._done = True  # one window per Tracer
 
     def __del__(self):
         try:

@@ -161,3 +161,24 @@ def test_bert_zero1_trains(devices8):
         losses.append(float(m["loss"]))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_gpt2_flash_attention_matches_xla():
+    """attn_impl='flash' (Pallas, interpret on CPU) must match the composed
+    XLA attention path on the same weights."""
+    import jax
+    import numpy as np
+
+    from nezha_tpu.models.gpt2 import GPT2, GPT2Config
+
+    kw = dict(vocab_size=64, max_positions=32, num_layers=2, num_heads=4,
+              hidden_size=64)
+    m_xla = GPT2(GPT2Config(attn_impl="xla", **kw))
+    m_flash = GPT2(GPT2Config(attn_impl="flash", **kw))
+    variables = m_xla.init(jax.random.PRNGKey(0))
+    tokens = jax.numpy.asarray(
+        np.random.RandomState(0).randint(0, 64, (2, 32)), jax.numpy.int32)
+    out1, _ = m_xla.apply(variables, tokens, training=False)
+    out2, _ = m_flash.apply(variables, tokens, training=False)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                               atol=2e-5, rtol=2e-5)

@@ -139,3 +139,100 @@ def test_mnist_truncated_labels_rejected(tmp_path):
     lbl.write_bytes(raw[:8 + 10])  # keep header, truncate body
     with pytest.raises(NativeLoaderError):
         MnistLoader(img, lbl, batch_size=8)
+
+
+def _write_records(tmp_path, n=32, h=12, w=12, c=3, seed=0):
+    from nezha_tpu.data.native import write_image_records
+    rng = np.random.RandomState(seed)
+    images = rng.randint(0, 256, size=(n, h, w, c)).astype(np.uint8)
+    labels = (np.arange(n) % 7).astype(np.int32)
+    p = tmp_path / "data.nzr"
+    write_image_records(p, images, labels)
+    return p, images, labels
+
+
+def test_records_shapes_and_center_crop(tmp_path):
+    from nezha_tpu.data.native import ImageRecordLoader
+    p, images, labels = _write_records(tmp_path)
+    with ImageRecordLoader(p, batch_size=8, crop=8, epochs=1,
+                           train_augment=False, num_workers=1) as ld:
+        assert ld.num_examples == 32 and ld.shape == (8, 8, 3)
+        batch = next(iter(ld))
+    assert batch["image"].shape == (8, 8, 8, 3)
+    assert batch["image"].dtype == np.float32
+    # Center crop: each served image must equal the [2:10, 2:10] window of
+    # its source (identified by label order is shuffled — match by content).
+    flat_src = images[:, 2:10, 2:10, :].reshape(32, -1).astype(np.float32) / 255.0
+    for img, y in zip(batch["image"], batch["label"]):
+        row = img.reshape(-1)
+        idx = int(np.argmin(np.abs(flat_src - row).sum(axis=1)))
+        assert np.allclose(flat_src[idx], row, atol=1e-6)
+        assert labels[idx] == y
+
+
+def test_records_epoch_coverage(tmp_path):
+    from nezha_tpu.data.native import ImageRecordLoader
+    p, _, _ = _write_records(tmp_path, n=32)
+    with ImageRecordLoader(p, batch_size=8, epochs=1, num_workers=3,
+                           train_augment=False) as ld:
+        batches = list(ld)
+    assert len(batches) == 4
+    served = np.concatenate([b["label"] for b in batches])
+    assert sorted(served) == sorted((np.arange(32) % 7))
+
+
+def test_records_augment_crops_within_source(tmp_path):
+    """Random crop + flip: every served crop must appear somewhere in its
+    source image (possibly mirrored), and augmented epochs must differ."""
+    from nezha_tpu.data.native import ImageRecordLoader
+    p, images, labels = _write_records(tmp_path, n=8, h=10, w=10)
+    with ImageRecordLoader(p, batch_size=8, crop=6, epochs=2,
+                           train_augment=True, num_workers=1, seed=3) as ld:
+        it = iter(ld)
+        b1, b2 = next(it), next(it)
+    assert not np.array_equal(b1["image"], b2["image"])
+    src = images.astype(np.float32) / 255.0
+    for img, y in zip(b1["image"], b1["label"]):
+        found = False
+        for i in np.flatnonzero(labels == y):
+            for cand in (src[i], src[i, :, ::-1]):
+                for dy in range(5):
+                    for dx in range(5):
+                        if np.allclose(cand[dy:dy+6, dx:dx+6], img,
+                                       atol=1e-6):
+                            found = True
+        assert found, "served crop not found in any source window"
+
+
+def test_records_bad_magic(tmp_path):
+    from nezha_tpu.data.native import ImageRecordLoader
+    p = tmp_path / "bad.nzr"
+    p.write_bytes(b"XXXX" + b"\x00" * 64)
+    with pytest.raises(NativeLoaderError):
+        ImageRecordLoader(p, batch_size=4)
+
+
+def test_records_train_resnet_smoke(tmp_path):
+    """Record loader -> ResNet train step on CPU: loss is finite."""
+    import jax
+
+    from nezha_tpu import optim, ops
+    from nezha_tpu.models.resnet import ResNet
+    from nezha_tpu.train.loop import init_train_state, make_train_step
+
+    from nezha_tpu.data.native import ImageRecordLoader
+    p, _, _ = _write_records(tmp_path, n=16, h=36, w=36)
+
+    def loss_fn(logits, batch):
+        return ops.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"])
+
+    model = ResNet(stage_sizes=(1, 1, 1, 1), num_classes=7)
+    opt = optim.sgd(1e-2)
+    state = init_train_state(model, opt, jax.random.PRNGKey(0))
+    step = make_train_step(model, opt, loss_fn)
+    with ImageRecordLoader(p, batch_size=8, crop=32, epochs=1,
+                           num_workers=2) as ld:
+        for batch in ld:
+            state, m = step(state, batch)
+    assert np.isfinite(float(m["loss"]))
