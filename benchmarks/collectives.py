@@ -1,0 +1,130 @@
+"""Collective bus-bandwidth microbenchmark.
+
+One of BASELINE.json's metrics of record is "all-reduce bus bw" — the
+reference measured its NCCL ring (SURVEY.md §0). Here the collectives are
+XLA's over ICI; this harness times them through the same `shard_map`
+path the framework trains with and reports *bus* bandwidth with the
+standard ring-algorithm convention, so numbers are comparable with
+NCCL-style reports:
+
+    all-reduce      busBW = bytes * 2*(n-1)/n / time   (per device)
+    all-gather      busBW = bytes *   (n-1)/n / time
+    reduce-scatter  busBW = bytes *   (n-1)/n / time
+    ppermute        busBW = bytes             / time
+
+Run on a real multi-chip mesh for ICI numbers, or a virtual CPU mesh
+(`--xla_force_host_platform_device_count=N`) for plumbing validation.
+
+Usage::
+
+    python benchmarks/collectives.py [--sizes-mb 1 4 16 64] [--iters 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+# jax imports live inside functions: forcing a virtual CPU mesh
+# (--cpu-devices) must set platform/flags before the backend initializes,
+# and an ambient sitecustomize may import jax at interpreter startup —
+# jax.config.update after import is the reliable override (see
+# tests/conftest.py).
+
+
+def _force_cpu(n: int) -> None:
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               f" --xla_force_host_platform_device_count={n}")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _mesh():
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()), ("x",))
+
+
+def _collectives(mesh):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from nezha_tpu.parallel._compat import shard_map
+
+    n = mesh.devices.size
+    spec = P("x")
+
+    def wrap(f, in_spec=spec, out_spec=spec):
+        return jax.jit(shard_map(f, mesh=mesh, in_specs=(in_spec,),
+                                 out_specs=out_spec))
+
+    return {
+        # x: [n*k] sharded -> per-device psum of its [k] shard.
+        "all_reduce": (wrap(lambda x: jax.lax.psum(x, "x")),
+                       lambda b: b * 2 * (n - 1) / n),
+        "all_gather": (wrap(lambda x: jax.lax.all_gather(x, "x",
+                                                         tiled=True),
+                            spec, P()),
+                       lambda b: b * (n - 1) / n),
+        "reduce_scatter": (wrap(lambda x: jax.lax.psum_scatter(
+            x, "x", tiled=True)),
+                           lambda b: b * (n - 1) / n),
+        "ppermute": (wrap(lambda x: jax.lax.ppermute(
+            x, "x", [(i, (i + 1) % n) for i in range(n)])),
+                     lambda b: b),
+    }
+
+
+def run(sizes_mb, iters: int = 20) -> list:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh()
+    n = mesh.devices.size
+    results = []
+    for name, (fn, bus_bytes) in _collectives(mesh).items():
+        for mb in sizes_mb:
+            per_dev = int(mb * (1 << 20)) // 4  # f32 elements per device
+            x = jax.device_put(
+                jnp.arange(per_dev * n, dtype=jnp.float32),
+                NamedSharding(mesh, P("x")))
+            out = fn(x)  # compile + warm
+            np.asarray(jax.tree_util.tree_leaves(out)[0][:1])  # sync
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(x)
+            np.asarray(jax.tree_util.tree_leaves(out)[0][:1])  # sync
+            dt = (time.perf_counter() - t0) / iters
+            bus = bus_bytes(per_dev * 4) / dt
+            results.append({
+                "collective": name, "devices": n, "size_mb_per_dev": mb,
+                "time_ms": round(dt * 1e3, 3),
+                "bus_gbps": round(bus / 1e9, 3),
+            })
+    return results
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--sizes-mb", type=float, nargs="+",
+                    default=[1, 4, 16, 64])
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--cpu-devices", type=int, default=0,
+                    help="force an N-device virtual CPU mesh")
+    args = ap.parse_args(argv)
+    if args.cpu_devices:
+        _force_cpu(args.cpu_devices)
+    for rec in run(args.sizes_mb, args.iters):
+        print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

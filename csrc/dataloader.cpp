@@ -329,8 +329,13 @@ class ImageRecordLoader : public Loader {
       return;
     }
     record_ = 4 + size_t(h_) * w_ * c_;  // <= 2^38, no overflow
-    if (raw_.size() < 20 + size_t(n_) * record_) {
+    // Divide instead of multiplying: n_ * record_ could wrap 64 bits.
+    if (raw_.size() < 20 || size_t(n_) > (raw_.size() - 20) / record_) {
       error_ = "NZR1 size mismatch";
+      return;
+    }
+    if (batch > n_) {
+      error_ = "batch size exceeds number of records";
       return;
     }
     if (crop_h_ <= 0) crop_h_ = h_;

@@ -12,6 +12,7 @@ resnet50_imagenet (DP all-reduce), gpt2_124m (bf16 GEMM), bert_base_zero1
 from __future__ import annotations
 
 import argparse
+import itertools
 import json
 import sys
 import time
@@ -34,7 +35,9 @@ class Config:
     def __init__(self, build_model: Callable, loss_fn: Callable,
                  batches: Callable[[int], Iterator[dict]],
                  build_optimizer: Callable, default_batch: int,
-                 parallel_mode: str = "dp", default_mesh: str = "dp=-1"):
+                 parallel_mode: str = "dp", default_mesh: str = "dp=-1",
+                 eval_batches: Optional[Callable] = None,
+                 eval_stat: Optional[Callable] = None):
         self.build_model = build_model
         self.loss_fn = loss_fn
         self.batches = batches
@@ -42,6 +45,8 @@ class Config:
         self.default_batch = default_batch
         self.parallel_mode = parallel_mode  # "single" | "dp" | "zero1"
         self.default_mesh = default_mesh
+        self.eval_batches = eval_batches  # bs -> finite iterator, or None
+        self.eval_stat = eval_stat        # stat fn for train.eval.evaluate
 
 
 def _configs() -> Dict[str, Config]:
@@ -50,6 +55,7 @@ def _configs() -> Dict[str, Config]:
     from nezha_tpu.models import bert as bert_mod
     from nezha_tpu.models import gpt2 as gpt2_mod
     from nezha_tpu.tensor import bf16_policy
+    from nezha_tpu.train import eval as eval_mod
 
     ce = lambda logits, b: ops.softmax_cross_entropy_with_integer_labels(
         logits, b["label"])
@@ -61,7 +67,10 @@ def _configs() -> Dict[str, Config]:
             batches=lambda bs: data.mnist_batches(bs),
             build_optimizer=lambda steps: optim.momentum(0.1),
             default_batch=128,
-            parallel_mode="single"),
+            parallel_mode="single",
+            eval_batches=lambda bs: data.mnist_batches(bs, split="test",
+                                                       epochs=1),
+            eval_stat=eval_mod.accuracy),
         "resnet50_imagenet": Config(
             build_model=lambda: models.resnet50(policy=bf16_policy()),
             loss_fn=ce,
@@ -79,7 +88,10 @@ def _configs() -> Dict[str, Config]:
                 optim.warmup_cosine_schedule(6e-4, 100, max(steps, 200)),
                 weight_decay=0.1),
             default_batch=8,
-            parallel_mode="dp"),
+            parallel_mode="dp",
+            eval_batches=lambda bs: itertools.islice(
+                data.synthetic_token_batches(bs, seq_len=1024, seed=1), 8),
+            eval_stat=eval_mod.lm_token_stats),
         "bert_base_zero1": Config(
             build_model=lambda: models.bert_base(),
             loss_fn=bert_mod.mlm_loss,
@@ -235,6 +247,14 @@ def run(args) -> Dict[str, float]:
             coord.stop()
     if args.ckpt_dir:
         ckpt.save_checkpoint(args.ckpt_dir, state, start_step + args.steps)
+    if args.eval and cfg.eval_batches is not None:
+        from nezha_tpu.train.eval import evaluate
+        results = evaluate(model, state["variables"],
+                           cfg.eval_batches(batch_size),
+                           stat_fn=cfg.eval_stat,
+                           max_batches=args.eval_batches)
+        print(json.dumps({"eval": results}), file=sys.stderr)
+        last.update({f"eval_{k}": v for k, v in results.items()})
     return last
 
 
@@ -269,6 +289,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="processes in the job (with --serve-coordinator)")
     p.add_argument("--rank-hint", type=int, default=-1,
                    help="preferred rank (e.g. for restart-in-place)")
+    p.add_argument("--eval", action="store_true",
+                   help="run the config's eval split after training")
+    p.add_argument("--eval-batches", type=int, default=None,
+                   help="cap eval to N batches")
     return p
 
 

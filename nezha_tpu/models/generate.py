@@ -74,8 +74,15 @@ def _decode_fn(model: GPT2, temperature: float, top_k: Optional[int],
             new_cache = _caches_from_states(model, states, cache)
             return (out[:, -1, :], new_cache, pos + 1, rng), tok
 
+        # The last sampled token needs no forward pass (nothing consumes
+        # its logits), so scan N-1 steps and sample the final token from
+        # the carried logits — N-1 forwards for N tokens.
         init = (last_logits, cache, pos0, rng)
-        _, tokens = lax.scan(step, init, None, length=max_new_tokens)
+        (logits, _, _, rng), tokens = lax.scan(
+            step, init, None, length=max_new_tokens - 1)
+        _, sub = jax.random.split(rng)
+        final = _sample(logits, sub, temperature, top_k)
+        tokens = jnp.concatenate([tokens, final[None, :]], axis=0)
         return tokens.T  # [steps, B] -> [B, steps]
 
     return decode

@@ -1,0 +1,79 @@
+"""Evaluation: jit'd metric accumulation over a batch stream.
+
+The training counterpart lives in ``train.loop``; this is the read-only
+side — one compiled eval step, metrics accumulated on device (sums, not
+per-batch host fetches), a single host transfer at the end. Sharded
+evaluation works the same way: pass batches already placed with a mesh
+sharding and jit partitions the step like any other program.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+
+from nezha_tpu.nn.module import Module
+
+
+def accuracy(logits, batch) -> Dict[str, jax.Array]:
+    """Top-1 accuracy against ``batch["label"]``. Returns sum + count."""
+    pred = jnp.argmax(logits, axis=-1)
+    correct = (pred == batch["label"]).sum()
+    return {"correct": correct, "count": jnp.asarray(pred.size)}
+
+
+def lm_token_stats(logits, batch) -> Dict[str, jax.Array]:
+    """Next-token NLL sums over {"tokens": [B, S+1]} — yields perplexity."""
+    targets = batch["tokens"][:, 1:]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return {"nll_sum": nll.sum(), "count": jnp.asarray(targets.size)}
+
+
+def make_eval_step(model: Module, stat_fn: Callable):
+    """Build ``step(variables, batch, acc) -> acc`` accumulating sums."""
+
+    def widen(v):
+        v = jnp.asarray(v)
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            return v.astype(jnp.float32)
+        return v.astype(jnp.int32)
+
+    def step(variables, batch, acc):
+        out, _ = model.apply(variables, batch, training=False)
+        stats = {k: widen(v) for k, v in stat_fn(out, batch).items()}
+        if acc is None:
+            return stats
+        return {k: acc[k] + stats[k] for k in stats}
+
+    return jax.jit(step)
+
+
+def evaluate(model: Module, variables: dict, batches: Iterator[dict],
+             stat_fn: Callable = accuracy,
+             max_batches: Optional[int] = None) -> Dict[str, float]:
+    """Run the model over ``batches`` and reduce the accumulated stats.
+
+    Returns the raw sums plus derived metrics: ``accuracy`` when the
+    stat_fn produced correct/count, ``perplexity`` for nll_sum/count.
+    """
+    step = make_eval_step(model, stat_fn)
+    acc = None
+    n = 0
+    for batch in batches:
+        if max_batches is not None and n >= max_batches:
+            break
+        acc = step(variables, batch, acc)
+        n += 1
+    if acc is None:
+        raise ValueError("no batches to evaluate")
+    out = {k: float(v) for k, v in acc.items()}
+    if "correct" in out and out.get("count"):
+        out["accuracy"] = out["correct"] / out["count"]
+    if "nll_sum" in out and out.get("count"):
+        import math
+        out["perplexity"] = math.exp(out["nll_sum"] / out["count"])
+    out["batches"] = n
+    return out

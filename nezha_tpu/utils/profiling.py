@@ -55,6 +55,7 @@ class Tracer:
                  num_steps: int = 3):
         self.log_dir = log_dir
         self.start_step = start_step
+        self.num_steps = num_steps
         self.stop_step = start_step + num_steps
         self._active = False
         self._done = False
@@ -66,10 +67,12 @@ class Tracer:
     def maybe_trace(self, step: int) -> None:
         if not self.enabled:
             return
-        # >= start (not ==): a resumed run whose step counter starts past
-        # start_step must still capture a window.
-        if (not self._active and not self._done
-                and step >= self.start_step and step < self.stop_step):
+        # A resumed run's counter may start anywhere past start_step (e.g.
+        # restored global_step=5000 with start_step=10): rebase the window
+        # onto the first step actually observed at/after start_step, so a
+        # full num_steps window is always captured exactly once.
+        if not self._active and not self._done and step >= self.start_step:
+            self.stop_step = step + self.num_steps
             os.makedirs(self.log_dir, exist_ok=True)
             jax.profiler.start_trace(self.log_dir)
             self._active = True

@@ -1,0 +1,21 @@
+"""Collective bus-bandwidth harness runs on the virtual mesh and reports
+sane records (correct collectives are covered by tests/test_parallel.py;
+this validates the measurement plumbing)."""
+
+import sys
+
+
+def test_collectives_bench_runs():
+    sys.path.insert(0, "benchmarks")
+    try:
+        import collectives
+    finally:
+        sys.path.pop(0)
+    recs = collectives.run(sizes_mb=[0.25], iters=2)
+    names = {r["collective"] for r in recs}
+    assert names == {"all_reduce", "all_gather", "reduce_scatter",
+                     "ppermute"}
+    for r in recs:
+        assert r["devices"] == 8
+        assert r["time_ms"] > 0
+        assert r["bus_gbps"] > 0

@@ -236,3 +236,10 @@ def test_records_train_resnet_smoke(tmp_path):
         for batch in ld:
             state, m = step(state, batch)
     assert np.isfinite(float(m["loss"]))
+
+
+def test_records_batch_larger_than_dataset_rejected(tmp_path):
+    from nezha_tpu.data.native import ImageRecordLoader
+    p, _, _ = _write_records(tmp_path, n=8)
+    with pytest.raises(NativeLoaderError, match="batch"):
+        ImageRecordLoader(p, batch_size=64)

@@ -173,3 +173,19 @@ def test_trainer_resume_from_checkpoint(tmp_path):
     assert t2.global_step == 10
     np.testing.assert_allclose(
         t2.state["variables"]["params"]["head"]["w"], w1, atol=0)
+
+
+def test_tracer_rebases_window_on_resumed_steps(tmp_path):
+    """Resume at step 5000 with start_step=10: a full window must still be
+    captured, exactly once."""
+    import jax.numpy as jnp
+
+    tracer = utils.Tracer(str(tmp_path / "rt"), start_step=10, num_steps=2)
+    for step in range(5000, 5008):
+        tracer.maybe_trace(step)
+        jnp.ones(2).block_until_ready()
+    assert not tracer._active and tracer._done
+    produced = []
+    for root, _, files in os.walk(tmp_path / "rt"):
+        produced += files
+    assert produced
