@@ -1,0 +1,155 @@
+// Concurrency stress test for the native runtime, built with and without
+// ThreadSanitizer (`make stress` / `make stress-tsan`). This is the
+// counterpart of running the reference's goroutine runtime under Go's
+// -race detector (SURVEY.md §5): hammer the coordinator and loaders from
+// many threads and let TSAN prove the locking.
+//
+// Exit code 0 = clean; TSAN reports turn into a non-zero exit via
+// halt_on_error (set in the test harness's TSAN_OPTIONS).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+const char* nz_last_error();
+void* nz_coord_start(int port, int world, int hb_timeout_ms);
+int nz_coord_port(void* s);
+void nz_coord_stop(void* s);
+void* nz_client_connect(const char* host, int port, int rank_hint,
+                        int timeout_ms, int hb_interval_ms);
+int nz_client_rank(void* c);
+int nz_client_put(void* c, const char* key, const void* val, long vlen);
+long nz_client_get(void* c, const char* key, void* out, long cap,
+                   long timeout_ms);
+long nz_client_incr(void* c, const char* key);
+int nz_client_barrier(void* c, long timeout_ms);
+long nz_client_failed(void* c, int* out, long cap);
+void nz_client_leave(void* c);
+void nz_client_close(void* c);
+
+const char* nz_loader_error();
+void* nz_tokens_open(const char* path, int dtype_code, int seq, int batch,
+                     uint64_t seed, int workers, int depth, long* n_tokens);
+int nz_loader_next(void* l, float* f32_out, int32_t* i32_out);
+void nz_loader_close(void* l);
+}
+
+static std::atomic<int> g_failures{0};  // CHECKs fire from many threads
+
+#define CHECK(cond, msg)                                   \
+  do {                                                     \
+    if (!(cond)) {                                         \
+      std::fprintf(stderr, "FAIL: %s (%s:%d)\n", msg,      \
+                   __FILE__, __LINE__);                    \
+      ++g_failures;                                        \
+    }                                                      \
+  } while (0)
+
+static void coordinator_stress() {
+  const int kWorld = 8, kRounds = 30;
+  void* server = nz_coord_start(0, kWorld, 5000);
+  CHECK(server != nullptr, "coord start");
+  int port = nz_coord_port(server);
+
+  std::vector<std::thread> ranks;
+  for (int r = 0; r < kWorld; ++r) {
+    ranks.emplace_back([port, r] {
+      void* c = nz_client_connect("127.0.0.1", port, -1, 10000, 50);
+      CHECK(c != nullptr, "client connect");
+      if (!c) return;
+      int rank = nz_client_rank(c);
+      char key[64], buf[256];
+      for (int i = 0; i < kRounds; ++i) {
+        std::snprintf(key, sizeof(key), "k/%d/%d", rank, i);
+        std::snprintf(buf, sizeof(buf), "v-%d-%d", rank, i);
+        CHECK(nz_client_put(c, key, buf, std::strlen(buf)) == 0, "put");
+        // Read a peer's key from the previous round (blocking get).
+        if (i > 0) {
+          std::snprintf(key, sizeof(key), "k/%d/%d",
+                        (rank + 1) % kWorld, i - 1);
+          long n = nz_client_get(c, key, buf, sizeof(buf), 10000);
+          CHECK(n > 0, "get peer key");
+        }
+        long v = nz_client_incr(c, "shared-counter");
+        CHECK(v >= 0, "incr");
+        int failed[8];
+        CHECK(nz_client_failed(c, failed, 8) >= 0, "failed query");
+        CHECK(nz_client_barrier(c, 20000) == 0, "barrier");
+      }
+      nz_client_leave(c);
+      nz_client_close(c);
+    });
+  }
+  for (auto& t : ranks) t.join();
+
+  // The shared counter must have been incremented exactly world*rounds.
+  void* probe = nz_client_connect("127.0.0.1", port, -1, 5000, 0);
+  CHECK(probe != nullptr, "probe connect");
+  if (probe) {
+    long v = nz_client_incr(probe, "shared-counter");
+    CHECK(v == kWorld * kRounds, "counter total");
+    nz_client_leave(probe);
+    nz_client_close(probe);
+  }
+  nz_coord_stop(server);
+}
+
+static void loader_stress(const char* tmpdir) {
+  // Token file: 1M uint16 tokens.
+  std::string path = std::string(tmpdir) + "/stress_tokens.bin";
+  {
+    FILE* f = std::fopen(path.c_str(), "wb");
+    CHECK(f != nullptr, "open token file");
+    std::vector<uint16_t> toks(1 << 20);
+    for (size_t i = 0; i < toks.size(); ++i)
+      toks[i] = static_cast<uint16_t>(i & 0x7fff);
+    std::fwrite(toks.data(), 2, toks.size(), f);
+    std::fclose(f);
+  }
+  long n_tokens = 0;
+  void* l = nz_tokens_open(path.c_str(), 2, 128, 32, 7, 4, 8, &n_tokens);
+  CHECK(l != nullptr, "tokens open");
+  if (!l) return;
+  // Two consumer threads racing the 4 producer workers.
+  std::vector<std::thread> consumers;
+  for (int t = 0; t < 2; ++t) {
+    consumers.emplace_back([l] {
+      std::vector<int32_t> out(32 * 129);
+      for (int i = 0; i < 200; ++i) {
+        int got = nz_loader_next(l, nullptr, out.data());
+        CHECK(got == 32, "loader next");
+        // Every row's window must be consecutive (source is i & 0x7fff) —
+        // torn/interleaved rows are the symptom a loader race would show.
+        for (int row = 0; row < 32; ++row) {
+          const int32_t* w = out.data() + row * 129;
+          for (int j = 1; j < 129; ++j) {
+            bool ok = w[j] == ((w[j - 1] + 1) & 0x7fff);
+            CHECK(ok, "window continuity");
+            if (!ok) return;
+          }
+        }
+      }
+    });
+  }
+  for (auto& t : consumers) t.join();
+  nz_loader_close(l);
+  std::remove(path.c_str());
+}
+
+int main(int argc, char** argv) {
+  const char* tmpdir = argc > 1 ? argv[1] : "/tmp";
+  coordinator_stress();
+  loader_stress(tmpdir);
+  if (g_failures.load()) {
+    std::fprintf(stderr, "%d failures\n", g_failures.load());
+    return 1;
+  }
+  std::printf("stress OK\n");
+  return 0;
+}

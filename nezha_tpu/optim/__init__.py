@@ -16,6 +16,11 @@ from nezha_tpu.optim.optimizers import (
     apply_updates,
     global_norm,
     clip_by_global_norm,
+    lars,
+    lamb,
+    adafactor,
+    with_grad_clipping,
+    accumulate_gradients,
 )
 from nezha_tpu.optim.schedules import (
     constant_schedule,
@@ -27,6 +32,7 @@ from nezha_tpu.optim.schedules import (
 __all__ = [
     "Optimizer", "sgd", "momentum", "adam", "adamw", "apply_updates",
     "global_norm", "clip_by_global_norm",
+    "lars", "lamb", "adafactor", "with_grad_clipping", "accumulate_gradients",
     "constant_schedule", "cosine_decay_schedule", "warmup_cosine_schedule",
     "linear_warmup_schedule",
 ]

@@ -151,3 +151,209 @@ def adamw(lr, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
         return pick(0), {"step": step, "mu": pick(1), "nu": pick(2)}
 
     return Optimizer(init, update)
+
+
+def lars(lr, beta: float = 0.9, weight_decay: float = 0.0,
+         trust_coefficient: float = 0.001, eps: float = 1e-9,
+         skip_fn: Optional[Callable[[Any], Any]] = None) -> Optimizer:
+    """LARS — layerwise-adaptive SGD for very large batch CNN training
+    (the standard recipe for BASELINE config 5's large-batch WRN-101).
+
+    Each leaf's step is scaled by trust * |p| / (|g| + wd*|p|), so layers
+    with small weights aren't blown away by a global LR sized for the
+    large-batch regime. ``skip_fn(params)`` may return a bool pytree
+    marking leaves (biases, norm scales) that use plain momentum.
+    """
+    sched = _as_schedule(lr)
+
+    def init(params):
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "velocity": jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p, jnp.float32), params),
+        }
+
+    def update(grads, state, params):
+        step = state["step"]
+        lr_t = sched(step)
+        skip = (skip_fn(params) if skip_fn is not None
+                else jax.tree_util.tree_map(lambda p: False, params))
+
+        def upd(g, v, p, plain):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            # Skip-listed leaves (biases, norm scales) are excluded from
+            # weight decay as well as trust scaling, per the LARS recipe.
+            g = g + jnp.where(plain, 0.0, weight_decay) * p32
+            p_norm = jnp.linalg.norm(p32.reshape(-1))
+            g_norm = jnp.linalg.norm(g.reshape(-1))
+            trust = jnp.where(
+                (p_norm > 0) & (g_norm > 0),
+                trust_coefficient * p_norm / (g_norm + eps), 1.0)
+            scale = jnp.where(plain, 1.0, trust)
+            v_new = beta * v + scale * g
+            return -lr_t * v_new, v_new
+
+        flat = jax.tree_util.tree_map(upd, grads, state["velocity"], params,
+                                      skip)
+        pick = lambda i: jax.tree_util.tree_map(
+            lambda t: t[i], flat, is_leaf=lambda t: isinstance(t, tuple))
+        return pick(0), {"step": step + 1, "velocity": pick(1)}
+
+    return Optimizer(init, update)
+
+
+def lamb(lr, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-6,
+         weight_decay: float = 0.01,
+         mask: Optional[Callable[[Any], Any]] = None) -> Optimizer:
+    """LAMB — layerwise-adaptive AdamW for large-batch transformer
+    pretraining (the BERT 64k-batch recipe; pairs with config 4)."""
+    sched = _as_schedule(lr)
+
+    def init(params):
+        zeros = lambda p: jnp.zeros_like(p, jnp.float32)
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "mu": jax.tree_util.tree_map(zeros, params),
+            "nu": jax.tree_util.tree_map(zeros, params),
+        }
+
+    def update(grads, state, params):
+        step = state["step"] + 1
+        lr_t = sched(state["step"])
+        c1 = 1.0 - b1 ** step.astype(jnp.float32)
+        c2 = 1.0 - b2 ** step.astype(jnp.float32)
+        wd_mask = mask(params) if mask is not None else jax.tree_util.tree_map(
+            lambda p: True, params)
+
+        def upd(g, m, v, p, use_wd):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            m_new = b1 * m + (1 - b1) * g
+            v_new = b2 * v + (1 - b2) * jnp.square(g)
+            d = (m_new / c1) / (jnp.sqrt(v_new / c2) + eps)
+            d = d + jnp.where(use_wd, weight_decay, 0.0) * p32
+            p_norm = jnp.linalg.norm(p32.reshape(-1))
+            d_norm = jnp.linalg.norm(d.reshape(-1))
+            trust = jnp.where((p_norm > 0) & (d_norm > 0),
+                              p_norm / d_norm, 1.0)
+            return -lr_t * trust * d, m_new, v_new
+
+        flat = jax.tree_util.tree_map(upd, grads, state["mu"], state["nu"],
+                                      params, wd_mask)
+        pick = lambda i: jax.tree_util.tree_map(
+            lambda t: t[i], flat, is_leaf=lambda t: isinstance(t, tuple))
+        return pick(0), {"step": step, "mu": pick(1), "nu": pick(2)}
+
+    return Optimizer(init, update)
+
+
+def adafactor(lr, decay: float = 0.8, eps: float = 1e-30,
+              clip_threshold: float = 1.0,
+              weight_decay: float = 0.0) -> Optimizer:
+    """Adafactor (factored second moment, no first moment): optimizer
+    memory for a [m, n] matrix is m + n instead of 2*m*n — the standard
+    choice when optimizer state must not dominate HBM."""
+    sched = _as_schedule(lr)
+
+    def _factored(p):
+        return p.ndim >= 2
+
+    def init(params):
+        def slot(p):
+            if _factored(p):
+                return {"vr": jnp.zeros(p.shape[:-1], jnp.float32),
+                        "vc": jnp.zeros(p.shape[:-2] + p.shape[-1:],
+                                        jnp.float32)}
+            return {"v": jnp.zeros_like(p, jnp.float32)}
+
+        return {"step": jnp.zeros((), jnp.int32),
+                "slots": jax.tree_util.tree_map(slot, params)}
+
+    def update(grads, state, params):
+        step = state["step"] + 1
+        lr_t = sched(state["step"])
+        # Increasing decay schedule per the paper: 1 - step^-decay.
+        beta = 1.0 - step.astype(jnp.float32) ** (-decay)
+
+        def upd(g, slot, p):
+            g = g.astype(jnp.float32)
+            g2 = jnp.square(g) + eps
+            if _factored(p):
+                vr = beta * slot["vr"] + (1 - beta) * g2.mean(axis=-1)
+                vc = beta * slot["vc"] + (1 - beta) * g2.mean(axis=-2)
+                r = vr / jnp.maximum(vr.mean(axis=-1, keepdims=True), eps)
+                d = g / (jnp.sqrt(r)[..., None] * jnp.sqrt(vc)[..., None, :])
+                new_slot = {"vr": vr, "vc": vc}
+            else:
+                v = beta * slot["v"] + (1 - beta) * g2
+                d = g / jnp.sqrt(v)
+                new_slot = {"v": v}
+            rms = jnp.sqrt(jnp.mean(jnp.square(d)))
+            d = d / jnp.maximum(1.0, rms / clip_threshold)
+            if weight_decay:
+                d = d + weight_decay * p.astype(jnp.float32)
+            return -lr_t * d, new_slot
+
+        # No is_leaf: tree_map flattens to grads' structure and passes the
+        # matching slot subtree whole (prefix semantics) — an is_leaf
+        # keyed on dict keys would misfire on q/k/v-named param dicts.
+        flat = jax.tree_util.tree_map(upd, grads, state["slots"], params)
+        pick = lambda i: jax.tree_util.tree_map(
+            lambda t: t[i], flat, is_leaf=lambda t: isinstance(t, tuple))
+        return pick(0), {"step": step, "slots": pick(1)}
+
+    return Optimizer(init, update)
+
+
+def with_grad_clipping(opt: Optimizer, max_norm: float) -> Optimizer:
+    """Wrap an optimizer with global-norm gradient clipping."""
+
+    def update(grads, state, params):
+        grads, _ = clip_by_global_norm(grads, max_norm)
+        return opt.update(grads, state, params)
+
+    return Optimizer(opt.init, update)
+
+
+def accumulate_gradients(opt: Optimizer, every: int) -> Optimizer:
+    """Gradient accumulation: apply the wrapped optimizer once per `every`
+    micro-steps with the mean of the accumulated grads; in between, emit
+    zero updates. Effective batch = micro-batch * every, constant memory,
+    jit-compatible (lax.cond on the micro-step counter)."""
+    if every < 1:
+        raise ValueError("every must be >= 1")
+    if every == 1:
+        return opt
+
+    from jax import lax
+
+    def init(params):
+        return {
+            "inner": opt.init(params),
+            "acc": jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p, jnp.float32), params),
+            "count": jnp.zeros((), jnp.int32),
+        }
+
+    def update(grads, state, params):
+        acc = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(jnp.float32), state["acc"], grads)
+        count = state["count"] + 1
+
+        def flush(_):
+            mean = jax.tree_util.tree_map(lambda a: a / every, acc)
+            updates, inner = opt.update(mean, state["inner"], params)
+            zeroed = jax.tree_util.tree_map(jnp.zeros_like, acc)
+            return updates, {"inner": inner, "acc": zeroed,
+                             "count": jnp.zeros((), jnp.int32)}
+
+        def hold(_):
+            updates = jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p, jnp.float32), params)
+            return updates, {"inner": state["inner"], "acc": acc,
+                             "count": count}
+
+        return lax.cond(count >= every, flush, hold, None)
+
+    return Optimizer(init, update)

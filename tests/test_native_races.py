@@ -1,0 +1,38 @@
+"""Race detection for the native runtime: build and run the C++ stress
+harness under ThreadSanitizer (the reference's `go test -race` role,
+SURVEY.md §5). TSAN reports abort the binary via halt_on_error."""
+
+import os
+import subprocess
+
+import pytest
+
+CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "csrc")
+
+
+@pytest.mark.slow
+def test_stress_under_tsan(tmp_path):
+    build = subprocess.run(["make", "-s", "stress-tsan"], cwd=CSRC,
+                           capture_output=True, text=True)
+    if build.returncode != 0:
+        pytest.skip(f"tsan build unavailable: {build.stderr[-300:]}")
+    proc = subprocess.run(
+        [os.path.join(CSRC, "build", "stress_test_tsan"), str(tmp_path)],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "TSAN_OPTIONS": "halt_on_error=1"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "stress OK" in proc.stdout
+    assert "ThreadSanitizer" not in proc.stderr
+
+
+def test_stress_plain(tmp_path):
+    build = subprocess.run(["make", "-s", "stress"], cwd=CSRC,
+                           capture_output=True, text=True)
+    if build.returncode != 0:
+        pytest.skip(f"native build unavailable: {build.stderr[-300:]}")
+    proc = subprocess.run(
+        [os.path.join(CSRC, "build", "stress_test"), str(tmp_path)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "stress OK" in proc.stdout

@@ -79,3 +79,109 @@ def test_schedules():
     assert float(s(jnp.array(110))) < 1e-6
     c = optim.cosine_decay_schedule(2.0, 100)
     np.testing.assert_allclose(float(c(jnp.array(0))), 2.0, rtol=1e-6)
+
+
+class TestLargeBatchOptimizers:
+    def _quadratic_converges(self, opt, steps=200, tol=0.15):
+        """Minimize |Wx - y|^2; the optimizer must make steady progress."""
+        import jax
+
+        W = jnp.asarray(np.random.RandomState(0).randn(8, 8), jnp.float32)
+        x = jnp.asarray(np.random.RandomState(1).randn(8), jnp.float32)
+        y = W @ x
+        params = {"w": jnp.zeros((8, 8), jnp.float32),
+                  "b": jnp.zeros((8,), jnp.float32)}
+
+        def loss(p):
+            return jnp.mean((p["w"] @ x + p["b"] - y) ** 2)
+
+        state = opt.init(params)
+
+        @jax.jit
+        def step(params, state):
+            g = jax.grad(loss)(params)
+            updates, state = opt.update(g, state, params)
+            return optim.apply_updates(params, updates), state
+
+        l0 = float(loss(params))
+        for _ in range(steps):
+            params, state = step(params, state)
+        assert float(loss(params)) < tol * l0, float(loss(params))
+
+    def test_lars_converges(self):
+        # Small trust coefficient means small effective steps; give the
+        # tiny quadratic a matching LR and enough steps.
+        self._quadratic_converges(optim.lars(2.0, trust_coefficient=0.1),
+                                  steps=400)
+
+    def test_lamb_converges(self):
+        self._quadratic_converges(optim.lamb(0.1))
+
+    def test_adafactor_converges(self):
+        self._quadratic_converges(optim.adafactor(0.1), steps=500)
+
+    def test_adafactor_memory_is_factored(self):
+        params = {"w": jnp.zeros((64, 32), jnp.float32)}
+        state = optim.adafactor(1e-2).init(params)
+        slot = state["slots"]["w"]
+        assert slot["vr"].shape == (64,) and slot["vc"].shape == (32,)
+
+    def test_grad_clipping_wrapper_bounds_update(self):
+        opt = optim.with_grad_clipping(optim.sgd(1.0), max_norm=1.0)
+        params = {"w": jnp.zeros(4, jnp.float32)}
+        state = opt.init(params)
+        huge = {"w": jnp.full(4, 1e6, jnp.float32)}
+        updates, _ = opt.update(huge, state, params)
+        assert float(optim.global_norm(updates)) <= 1.0 + 1e-4
+
+    def test_accumulation_matches_big_batch(self):
+        """k micro-steps with accumulation == one step on the mean grad."""
+        import jax
+
+        base = optim.adamw(1e-2)
+        acc = optim.accumulate_gradients(optim.adamw(1e-2), every=4)
+        params = {"w": jnp.ones(6, jnp.float32)}
+        micro = [{"w": jnp.asarray(np.random.RandomState(i).randn(6),
+                                   jnp.float32)} for i in range(4)]
+        mean = {"w": sum(m["w"] for m in micro) / 4}
+
+        s_base = base.init(params)
+        u_ref, _ = base.update(mean, s_base, params)
+
+        s_acc = acc.init(params)
+        p = params
+        for m in micro:
+            u, s_acc = acc.update(m, s_acc, p)
+            p = optim.apply_updates(p, u)
+        # First 3 updates are zero; the 4th equals the big-batch update.
+        np.testing.assert_allclose(np.asarray(p["w"]),
+                                   np.asarray(optim.apply_updates(
+                                       params, u_ref)["w"]), rtol=1e-6)
+        # Counter reset: a second cycle flushes again at step 8.
+        for m in micro:
+            u, s_acc = acc.update(m, s_acc, p)
+        assert int(s_acc["count"]) == 0
+
+    def test_adafactor_handles_qkv_named_params(self):
+        """Param dicts with a 'v' key must not be mistaken for slots."""
+        import jax
+
+        params = {"attn": {"q": jnp.ones((4, 4)), "k": jnp.ones((4, 4)),
+                           "v": jnp.ones((4, 4))}}
+        opt = optim.adafactor(1e-2)
+        state = opt.init(params)
+        grads = jax.tree_util.tree_map(jnp.ones_like, params)
+        updates, state = opt.update(grads, state, params)
+        assert updates["attn"]["v"].shape == (4, 4)
+
+    def test_lars_skip_fn_excludes_weight_decay(self):
+        """Skip-listed leaves get neither trust scaling nor weight decay."""
+        opt = optim.lars(1.0, beta=0.0, weight_decay=0.5,
+                         skip_fn=lambda p: {"w": False, "b": True})
+        params = {"w": jnp.ones((2, 2)), "b": jnp.ones((2,))}
+        state = opt.init(params)
+        zero_g = {"w": jnp.zeros((2, 2)), "b": jnp.zeros((2,))}
+        updates, _ = opt.update(zero_g, state, params)
+        # Bias: no wd -> zero update. Weight: wd decays it.
+        np.testing.assert_allclose(np.asarray(updates["b"]), 0.0)
+        assert float(jnp.abs(updates["w"]).sum()) > 0
