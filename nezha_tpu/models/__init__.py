@@ -11,6 +11,9 @@ _LAZY = {
     "GPT2": "gpt2", "GPT2Config": "gpt2", "gpt2_124m": "gpt2",
     "Bert": "bert", "BertConfig": "bert", "bert_base": "bert",
     "generate": "generate", "init_cache": "generate",
+    "gpt2_from_hf": "convert", "bert_from_hf": "convert",
+    "gpt2_params_from_hf": "convert", "gpt2_params_to_hf": "convert",
+    "bert_params_from_hf": "convert",
 }
 
 

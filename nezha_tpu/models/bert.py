@@ -29,6 +29,9 @@ class BertConfig:
     hidden_size: int = 768
     mlp_ratio: int = 4
     dropout: float = 0.0
+    # Published BERT checkpoints use 1e-12 (HF layer_norm_eps); kept in the
+    # config so converted weights reproduce the torch reference exactly.
+    ln_eps: float = 1e-12
 
 
 class EncoderLayer(Module):
@@ -41,12 +44,12 @@ class EncoderLayer(Module):
                              policy=policy)
         self.attn_out = nn.Linear(h, h, kernel_init=init_lib.normal(0.02),
                                   policy=policy)
-        self.attn_ln = nn.LayerNorm(h, policy=policy)
+        self.attn_ln = nn.LayerNorm(h, eps=cfg.ln_eps, policy=policy)
         self.fc = nn.Linear(h, h * cfg.mlp_ratio,
                             kernel_init=init_lib.normal(0.02), policy=policy)
         self.fc_out = nn.Linear(h * cfg.mlp_ratio, h,
                                 kernel_init=init_lib.normal(0.02), policy=policy)
-        self.out_ln = nn.LayerNorm(h, policy=policy)
+        self.out_ln = nn.LayerNorm(h, eps=cfg.ln_eps, policy=policy)
         self.drop = nn.Dropout(cfg.dropout)
 
     def apply(self, variables: Variables, x, mask=None, training: bool = False,
@@ -66,7 +69,7 @@ class EncoderLayer(Module):
         x = run_child(self.attn_ln, "attn_ln", variables, states, x + att,
                       training=training)
         y = run_child(self.fc, "fc", variables, states, x, training=training)
-        y = ops.gelu(y)
+        y = ops.gelu(y, approximate=False)  # original BERT uses erf GELU
         y = run_child(self.fc_out, "fc_out", variables, states, y,
                       training=training)
         return run_child(self.out_ln, "out_ln", variables, states, x + y,
@@ -90,13 +93,13 @@ class Bert(Module):
                                     embedding_init=init_lib.normal(0.02),
                                     policy=policy)
         self.type_emb = nn.Embedding(cfg.type_vocab_size, h, policy=policy)
-        self.emb_ln = nn.LayerNorm(h, policy=policy)
+        self.emb_ln = nn.LayerNorm(h, eps=cfg.ln_eps, policy=policy)
         self.drop = nn.Dropout(cfg.dropout)
         self.layers = [EncoderLayer(cfg, policy) for _ in range(cfg.num_layers)]
         # MLM head: transform + LN, decoder tied to tok_emb with a free bias.
         self.mlm_dense = nn.Linear(h, h, kernel_init=init_lib.normal(0.02),
                                    policy=policy)
-        self.mlm_ln = nn.LayerNorm(h, policy=policy)
+        self.mlm_ln = nn.LayerNorm(h, eps=cfg.ln_eps, policy=policy)
 
     def init(self, rng: jax.Array) -> Variables:
         v = super().init(rng)
@@ -134,7 +137,7 @@ class Bert(Module):
                           mask=mask, training=training, rng=rng)
         y = run_child(self.mlm_dense, "mlm_dense", variables, states, x,
                       training=training)
-        y = ops.gelu(y)
+        y = ops.gelu(y, approximate=False)  # original BERT uses erf GELU
         y = run_child(self.mlm_ln, "mlm_ln", variables, states, y,
                       training=training)
         logits = self.tok_emb.attend(child_vars(variables, "tok_emb"), y)

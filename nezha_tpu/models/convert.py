@@ -1,0 +1,202 @@
+"""Weight interchange with the Hugging Face GPT-2 checkpoint format.
+
+``gpt2_params_from_hf`` maps a ``transformers.GPT2LMHeadModel`` state dict
+onto this framework's parameter pytree (HF's Conv1D already stores weights
+[in, out], matching ``nn.Linear``), so published GPT-2 checkpoints load
+directly and — the other direction — our trained params can be exported.
+The numerical contract (LayerNorm eps 1e-5, tanh-approx GELU, pre-norm
+blocks, tied LM head) is verified against the torch reference in
+tests/test_convert.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from nezha_tpu.models.gpt2 import GPT2, GPT2Config
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):  # torch tensor
+        return t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def gpt2_config_from_hf(hf_config) -> GPT2Config:
+    # n_inner=None means 4*n_embd (the HF default); a set value must divide
+    # evenly into a ratio or the config can't represent the checkpoint.
+    n_inner = getattr(hf_config, "n_inner", None)
+    if n_inner is None:
+        mlp_ratio = 4
+    elif n_inner % hf_config.n_embd == 0:
+        mlp_ratio = n_inner // hf_config.n_embd
+    else:
+        raise ValueError(
+            f"n_inner={n_inner} is not a multiple of n_embd="
+            f"{hf_config.n_embd}; GPT2Config.mlp_ratio cannot express it")
+    return GPT2Config(
+        vocab_size=hf_config.vocab_size,
+        max_positions=hf_config.n_positions,
+        num_layers=hf_config.n_layer,
+        num_heads=hf_config.n_head,
+        hidden_size=hf_config.n_embd,
+        mlp_ratio=mlp_ratio,
+        dropout=0.0,
+    )
+
+
+def gpt2_params_from_hf(state_dict: Dict[str, Any],
+                        num_layers: int) -> Dict[str, Any]:
+    """HF ``transformer.*`` state dict -> nezha_tpu GPT-2 params pytree."""
+    sd = {k: _np(v) for k, v in state_dict.items()}
+
+    def pre(k):  # checkpoints may or may not carry the "transformer." prefix
+        return sd[k if k in sd else f"transformer.{k}"]
+
+    params: Dict[str, Any] = {
+        "wte": {"embedding": pre("wte.weight")},
+        "wpe": {"embedding": pre("wpe.weight")},
+        "ln_f": {"scale": pre("ln_f.weight"), "bias": pre("ln_f.bias")},
+    }
+    for i in range(num_layers):
+        h = f"h.{i}"
+        params[f"h{i}"] = {
+            "ln_1": {"scale": pre(f"{h}.ln_1.weight"),
+                     "bias": pre(f"{h}.ln_1.bias")},
+            "attn": {
+                "qkv": {"w": pre(f"{h}.attn.c_attn.weight"),
+                        "b": pre(f"{h}.attn.c_attn.bias")},
+                "proj": {"w": pre(f"{h}.attn.c_proj.weight"),
+                         "b": pre(f"{h}.attn.c_proj.bias")},
+            },
+            "ln_2": {"scale": pre(f"{h}.ln_2.weight"),
+                     "bias": pre(f"{h}.ln_2.bias")},
+            "mlp": {
+                "fc": {"w": pre(f"{h}.mlp.c_fc.weight"),
+                       "b": pre(f"{h}.mlp.c_fc.bias")},
+                "proj": {"w": pre(f"{h}.mlp.c_proj.weight"),
+                         "b": pre(f"{h}.mlp.c_proj.bias")},
+            },
+        }
+    return params
+
+
+def gpt2_from_hf(hf_model) -> tuple:
+    """(model, variables) from a ``transformers.GPT2LMHeadModel``."""
+    import jax.numpy as jnp
+    import jax.tree_util as jtu
+
+    cfg = gpt2_config_from_hf(hf_model.config)
+    model = GPT2(cfg)
+    params = gpt2_params_from_hf(hf_model.state_dict(), cfg.num_layers)
+    params = jtu.tree_map(lambda x: jnp.asarray(x, jnp.float32), params)
+    return model, {"params": params, "state": {}}
+
+
+def gpt2_params_to_hf(params: Dict[str, Any],
+                      num_layers: int) -> Dict[str, np.ndarray]:
+    """Export back to the HF ``transformer.*`` key layout (numpy)."""
+    out = {
+        "transformer.wte.weight": _np(params["wte"]["embedding"]),
+        "transformer.wpe.weight": _np(params["wpe"]["embedding"]),
+        "transformer.ln_f.weight": _np(params["ln_f"]["scale"]),
+        "transformer.ln_f.bias": _np(params["ln_f"]["bias"]),
+        "lm_head.weight": _np(params["wte"]["embedding"]),  # tied
+    }
+    for i in range(num_layers):
+        blk = params[f"h{i}"]
+        h = f"transformer.h.{i}"
+        out[f"{h}.ln_1.weight"] = _np(blk["ln_1"]["scale"])
+        out[f"{h}.ln_1.bias"] = _np(blk["ln_1"]["bias"])
+        out[f"{h}.attn.c_attn.weight"] = _np(blk["attn"]["qkv"]["w"])
+        out[f"{h}.attn.c_attn.bias"] = _np(blk["attn"]["qkv"]["b"])
+        out[f"{h}.attn.c_proj.weight"] = _np(blk["attn"]["proj"]["w"])
+        out[f"{h}.attn.c_proj.bias"] = _np(blk["attn"]["proj"]["b"])
+        out[f"{h}.ln_2.weight"] = _np(blk["ln_2"]["scale"])
+        out[f"{h}.ln_2.bias"] = _np(blk["ln_2"]["bias"])
+        out[f"{h}.mlp.c_fc.weight"] = _np(blk["mlp"]["fc"]["w"])
+        out[f"{h}.mlp.c_fc.bias"] = _np(blk["mlp"]["fc"]["b"])
+        out[f"{h}.mlp.c_proj.weight"] = _np(blk["mlp"]["proj"]["w"])
+        out[f"{h}.mlp.c_proj.bias"] = _np(blk["mlp"]["proj"]["b"])
+    return out
+
+
+# ----------------------------------------------------------------- BERT
+def bert_config_from_hf(hf_config) -> "BertConfig":
+    from nezha_tpu.models.bert import BertConfig
+
+    if hf_config.intermediate_size % hf_config.hidden_size:
+        raise ValueError(
+            f"intermediate_size={hf_config.intermediate_size} is not a "
+            f"multiple of hidden_size={hf_config.hidden_size}")
+    return BertConfig(
+        vocab_size=hf_config.vocab_size,
+        max_positions=hf_config.max_position_embeddings,
+        type_vocab_size=hf_config.type_vocab_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        hidden_size=hf_config.hidden_size,
+        mlp_ratio=hf_config.intermediate_size // hf_config.hidden_size,
+        dropout=0.0,
+        ln_eps=hf_config.layer_norm_eps,
+    )
+
+
+def bert_params_from_hf(state_dict: Dict[str, Any],
+                        num_layers: int) -> Dict[str, Any]:
+    """HF ``BertForMaskedLM`` state dict -> nezha_tpu BERT params.
+
+    torch Linear stores [out, in]; ours stores [in, out] — transposed
+    here. The separate q/k/v projections concatenate into our fused qkv.
+    """
+    sd = {k: _np(v) for k, v in state_dict.items()}
+
+    def lin(k):  # torch Linear -> (w [in,out], b)
+        return {"w": sd[f"{k}.weight"].T, "b": sd[f"{k}.bias"]}
+
+    def ln(k):
+        return {"scale": sd[f"{k}.weight"], "bias": sd[f"{k}.bias"]}
+
+    params: Dict[str, Any] = {
+        "tok_emb": {"embedding":
+                    sd["bert.embeddings.word_embeddings.weight"]},
+        "pos_emb": {"embedding":
+                    sd["bert.embeddings.position_embeddings.weight"]},
+        "type_emb": {"embedding":
+                     sd["bert.embeddings.token_type_embeddings.weight"]},
+        "emb_ln": ln("bert.embeddings.LayerNorm"),
+        "mlm_dense": lin("cls.predictions.transform.dense"),
+        "mlm_ln": ln("cls.predictions.transform.LayerNorm"),
+        "mlm_bias": sd["cls.predictions.bias"],
+    }
+    for i in range(num_layers):
+        L = f"bert.encoder.layer.{i}"
+        q = lin(f"{L}.attention.self.query")
+        k = lin(f"{L}.attention.self.key")
+        v = lin(f"{L}.attention.self.value")
+        params[f"layers{i}"] = {
+            "qkv": {"w": np.concatenate([q["w"], k["w"], v["w"]], axis=1),
+                    "b": np.concatenate([q["b"], k["b"], v["b"]])},
+            "attn_out": lin(f"{L}.attention.output.dense"),
+            "attn_ln": ln(f"{L}.attention.output.LayerNorm"),
+            "fc": lin(f"{L}.intermediate.dense"),
+            "fc_out": lin(f"{L}.output.dense"),
+            "out_ln": ln(f"{L}.output.LayerNorm"),
+        }
+    return params
+
+
+def bert_from_hf(hf_model) -> tuple:
+    """(model, variables) from a ``transformers.BertForMaskedLM``."""
+    import jax.numpy as jnp
+    import jax.tree_util as jtu
+
+    from nezha_tpu.models.bert import Bert
+
+    cfg = bert_config_from_hf(hf_model.config)
+    model = Bert(cfg)
+    params = bert_params_from_hf(hf_model.state_dict(), cfg.num_layers)
+    params = jtu.tree_map(lambda x: jnp.asarray(x, jnp.float32), params)
+    return model, {"params": params, "state": {}}
