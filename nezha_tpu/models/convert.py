@@ -25,6 +25,19 @@ def _np(t) -> np.ndarray:
 
 
 def gpt2_config_from_hf(hf_config) -> GPT2Config:
+    # Reject config values the framework can't express — silent numeric
+    # divergence is worse than a conversion error.
+    act = getattr(hf_config, "activation_function", "gelu_new")
+    if act not in ("gelu_new", "gelu_pytorch_tanh"):
+        raise ValueError(f"unsupported activation_function={act!r}; "
+                         "the GPT-2 block uses tanh-approximate GELU")
+    eps = getattr(hf_config, "layer_norm_epsilon", 1e-5)
+    if abs(eps - 1e-5) > 1e-12:
+        raise ValueError(f"unsupported layer_norm_epsilon={eps}; "
+                         "GPT-2 layers use eps=1e-5")
+    for flag in ("scale_attn_by_inverse_layer_idx", "reorder_and_upcast_attn"):
+        if getattr(hf_config, flag, False):
+            raise ValueError(f"unsupported GPT2Config.{flag}=True")
     # n_inner=None means 4*n_embd (the HF default); a set value must divide
     # evenly into a ratio or the config can't represent the checkpoint.
     n_inner = getattr(hf_config, "n_inner", None)
@@ -127,6 +140,10 @@ def gpt2_params_to_hf(params: Dict[str, Any],
 def bert_config_from_hf(hf_config) -> "BertConfig":
     from nezha_tpu.models.bert import BertConfig
 
+    act = getattr(hf_config, "hidden_act", "gelu")
+    if act != "gelu":
+        raise ValueError(f"unsupported hidden_act={act!r}; "
+                         "the BERT block uses erf GELU")
     if hf_config.intermediate_size % hf_config.hidden_size:
         raise ValueError(
             f"intermediate_size={hf_config.intermediate_size} is not a "

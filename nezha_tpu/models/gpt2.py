@@ -88,8 +88,13 @@ class Attention(Module):
 
         impl = cfg.attn_impl
         if impl == "auto":
+            # Measured on v5e (fwd+bwd, bf16): XLA's fused attention wins
+            # up to S=16k; past that the S x S score matrix exhausts HBM
+            # (S=32k fails to compile) and the Pallas flash kernels are
+            # the only path. Interpret-mode flash is never auto-chosen.
             import jax
-            impl = "flash" if jax.default_backend() == "tpu" else "xla"
+            impl = ("flash" if jax.default_backend() == "tpu" and s > 16384
+                    else "xla")
         if impl == "ring":
             from nezha_tpu.parallel.ring import ring_attention
             out = ring_attention(q, k, v, cfg.sp_axis, causal=True)
