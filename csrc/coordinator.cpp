@@ -185,9 +185,26 @@ class CoordServer {
       int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       std::lock_guard<std::mutex> lk(mu_);
+      ReapFinishedLocked();  // bound thread growth across elastic restarts
       conn_fds_.insert(fd);
       conn_threads_.emplace_back([this, fd] { Serve(fd); });
     }
+  }
+
+  // Join connection threads that have announced exit (Serve pushes its id as
+  // its last action). A long-lived coordinator serving many reconnects would
+  // otherwise accumulate exited-but-joinable threads without bound.
+  void ReapFinishedLocked() {
+    for (auto id : done_ids_) {
+      for (auto it = conn_threads_.begin(); it != conn_threads_.end(); ++it) {
+        if (it->get_id() == id) {
+          it->join();
+          conn_threads_.erase(it);
+          break;
+        }
+      }
+    }
+    done_ids_.clear();
   }
 
   void Serve(int fd) {
@@ -350,6 +367,7 @@ class CoordServer {
         assigned_.erase(rank);  // slot reusable by a replacement
         last_seen_.erase(rank);
       }
+      done_ids_.push_back(std::this_thread::get_id());
     }
     cv_.notify_all();
     ::close(fd);
@@ -395,6 +413,7 @@ class CoordServer {
   std::atomic<bool> stopping_{false};
   std::thread accept_thread_;
   std::vector<std::thread> conn_threads_;
+  std::vector<std::thread::id> done_ids_;
 
   std::mutex mu_;
   std::condition_variable cv_;
@@ -537,13 +556,31 @@ class CoordClient {
     bool expected = false;
     if (!closing_.compare_exchange_strong(expected, true)) return;
     hb_cv_.notify_all();
+    if (fd_ >= 0 && leave) {
+      // Best-effort graceful LEAVE, bounded on both the lock and the recv:
+      // if the server died without FIN/RST the heartbeat thread may be
+      // wedged in recv() holding req_mu_, and our own recv could block
+      // forever — Close must terminate regardless. (Bounded try_lock poll,
+      // not timed_mutex: TSAN does not model pthread_mutex_timedlock.)
+      auto lock_deadline = Clock::now() + std::chrono::seconds(2);
+      bool locked = false;
+      while (!(locked = req_mu_.try_lock()) && Clock::now() < lock_deadline)
+        std::this_thread::sleep_for(std::chrono::milliseconds(10));
+      if (locked) {
+        timeval tv{2, 0};
+        ::setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+        uint32_t type = 0;
+        std::string rkey, rval;
+        if (send_msg(fd_, MSG_LEAVE, "", ""))
+          recv_msg(fd_, &type, &rkey, &rval);
+        req_mu_.unlock();
+      }
+    }
+    // Unblock a heartbeat thread stuck in recv() on a dead connection so
+    // the join below cannot hang.
+    if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
     if (hb_thread_.joinable()) hb_thread_.join();
     if (fd_ >= 0) {
-      if (leave) {
-        uint32_t type = 0;
-        std::string out;
-        Request(MSG_LEAVE, "", "", &type, &out);
-      }
       ::close(fd_);
       fd_ = -1;
     }

@@ -177,6 +177,12 @@ class MnistLoader : public Loader {
       error_ = "IDX size mismatch";
       return;
     }
+    if (batch_ <= 0 || static_cast<uint32_t>(batch_) > n_) {
+      // With batch > n, nbatch == 0 and infinite epochs would spin forever
+      // with no stopping_ check reachable (workers hang in join on close).
+      error_ = "batch size must be in [1, num examples]";
+      return;
+    }
     pixels_.assign(img_raw.begin() + 16, img_raw.end());
     labels_.assign(lbl_raw.begin() + 8, lbl_raw.end());
     StartWorkers(std::max(workers, 1));
@@ -194,7 +200,14 @@ class MnistLoader : public Loader {
  protected:
   void WorkerLoop(int worker_id) override {
     const size_t dim = rows_ * cols_;
+    // A worker whose stride never reaches a batch index can never produce;
+    // exit now instead of spinning shuffles forever under infinite epochs.
+    if (static_cast<size_t>(worker_id) >= size_t(n_) / batch_) {
+      WorkerDone();
+      return;
+    }
     for (int epoch = 0; epochs_ <= 0 || epoch < epochs_; ++epoch) {
+      if (stopping_) return;
       // All workers derive the same per-epoch permutation and take strided
       // slices of it, so every example appears exactly once per epoch.
       std::vector<uint32_t> perm(n_);
@@ -361,7 +374,12 @@ class ImageRecordLoader : public Loader {
  protected:
   void WorkerLoop(int worker_id) override {
     const size_t out_px = size_t(crop_h_) * crop_w_ * c_;
+    if (static_cast<size_t>(worker_id) >= size_t(n_) / batch_) {
+      WorkerDone();  // can never produce a batch; see MnistLoader note
+      return;
+    }
     for (int epoch = 0; epochs_ <= 0 || epoch < epochs_; ++epoch) {
+      if (stopping_) return;
       std::vector<uint32_t> perm(n_);
       for (int i = 0; i < n_; ++i) perm[i] = static_cast<uint32_t>(i);
       std::mt19937_64 perm_rng(seed_ + static_cast<uint64_t>(epoch));

@@ -112,6 +112,11 @@ def _flash_call(q, k, v, causal, scale, block_q, block_k, interpret,
                 return_lse: bool = False):
     b, h, s_q, d = q.shape
     s_k = k.shape[2]
+    if causal and s_q != s_k:
+        # _causal_mask has no (s_k - s_q) diagonal offset, so rectangular
+        # causal inputs would get a silently-wrong mask.
+        raise ValueError(
+            f"causal flash_attention requires s_q == s_k, got {s_q} != {s_k}")
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     bq = _pick_block(s_q, block_q)
     bk = _pick_block(s_k, block_k)
@@ -248,6 +253,9 @@ def _flash_bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k,
                     interpret):
     b, h, s_q, d = q.shape
     s_k = k.shape[2]
+    if causal and s_q != s_k:
+        raise ValueError(
+            f"causal flash_attention requires s_q == s_k, got {s_q} != {s_k}")
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     bq = _pick_block(s_q, block_q)
     bk = _pick_block(s_k, block_k)

@@ -59,6 +59,14 @@ def test_mnist_one_epoch_covers_every_example_once(tmp_path):
     assert sorted(seen) == list(range(64))
 
 
+def test_mnist_batch_larger_than_dataset_rejected(tmp_path):
+    # batch > n would make nbatch == 0; with infinite epochs the workers
+    # would spin forever and close() would hang in join.
+    img, lbl, _, _ = _write_idx(tmp_path, n=16)
+    with pytest.raises(NativeLoaderError, match="batch size must be in"):
+        MnistLoader(img, lbl, batch_size=32)
+
+
 def test_mnist_shuffles_between_epochs(tmp_path):
     img, lbl, _, _ = _write_idx(tmp_path, n=64)
     with MnistLoader(img, lbl, batch_size=64, epochs=2, num_workers=1) as ld:
