@@ -1,17 +1,25 @@
-"""Headline benchmark: GPT-2 124M training throughput, tokens/sec/chip.
+"""Headline benchmarks: GPT-2 124M tokens/sec/chip + ResNet-50 images/sec/chip.
 
-Runs the FULL training step (forward + backward + AdamW, bf16 compute /
-fp32 master) on whatever platform jax selects — the real TPU chip under the
-driver. Prints exactly ONE JSON line:
+Runs the FULL training steps (forward + backward + optimizer) on whatever
+platform jax selects — the real TPU chip under the driver. Prints exactly
+ONE JSON line; the headline metric stays GPT-2 tokens/s/chip (tracked by
+``vs_baseline``), with ResNet-50 images/s and MFU estimates carried as extra
+keys of the same object (BASELINE.md rows 1 and 3):
 
     {"metric": "gpt2_124m_tokens_per_sec_per_chip", "value": N,
-     "unit": "tokens/s/chip", "vs_baseline": R}
+     "unit": "tokens/s/chip", "vs_baseline": R, "mfu": F,
+     "extras": {"resnet50_images_per_sec_per_chip": M, "resnet50_mfu": F2}}
 
 ``vs_baseline`` compares against BASELINE.json's published number when one
 exists; the reference published none (BASELINE.md: "no published numbers
 were recoverable"), so the fallback baseline is this repo's own recorded
 first measurement (bench_baseline.json), making the ratio a regression
 tracker. With no record at all it reports 1.0 and writes the record.
+
+MFU = measured model FLOP/s divided by peak chip FLOP/s. Model FLOPs come
+from XLA's own cost analysis of the compiled step (fallback: the standard
+6*N_params + attention analytic estimate). Peak defaults to 197 TFLOP/s
+(v5e bf16); override with NEZHA_PEAK_TFLOPS for other chips.
 """
 
 from __future__ import annotations
@@ -22,7 +30,58 @@ import sys
 import time
 
 
-def main() -> int:
+def _aot_compile(step, *args):
+    """AOT-compile the step; return (callable, flops-per-XLA-cost-analysis).
+
+    The compiled executable is reused for timing (the jit dispatch cache is
+    separate from lower().compile(), so handing back `step` would compile
+    the identical program twice). Falls back to the jitted step with
+    flops=None when AOT/cost analysis is unavailable.
+    """
+    try:
+        compiled = step.lower(*args).compile()
+    except Exception:
+        return step, None
+    flops = None
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+            cost = cost[0]
+        flops = float(cost["flops"])
+        if flops <= 0:
+            flops = None
+    except Exception:
+        pass
+    return compiled, flops
+
+
+def _peak_flops(platform: str):
+    """Peak chip FLOP/s for MFU; None off-accelerator (MFU meaningless)."""
+    if platform not in ("tpu", "axon"):
+        return None
+    return float(os.environ.get("NEZHA_PEAK_TFLOPS", "197")) * 1e12
+
+
+def _time_steps(step, state, batch, steps_target: int, budget_s: float):
+    """Warm up, then time `steps_target` steps (host-fetch barrier).
+
+    On the tunneled `axon` platform block_until_ready can return before the
+    computation finishes — only a host fetch is a true barrier there.
+    """
+    for _ in range(2):
+        state, m = step(state, batch)
+    float(m["loss"])
+
+    t0 = time.perf_counter()
+    done = 0
+    while done < steps_target and (time.perf_counter() - t0) < budget_s:
+        state, m = step(state, batch)
+        done += 1
+    float(m["loss"])
+    return done, time.perf_counter() - t0
+
+
+def bench_gpt2(on_tpu: bool, peak):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -31,9 +90,6 @@ def main() -> int:
     from nezha_tpu.models.gpt2 import GPT2, GPT2Config, lm_loss
     from nezha_tpu.tensor import bf16_policy
     from nezha_tpu.train.loop import init_train_state, make_train_step
-
-    platform = jax.devices()[0].platform
-    on_tpu = platform in ("tpu", "axon")
 
     batch, seq = (8, 1024) if on_tpu else (2, 256)
     steps_target = 20 if on_tpu else 3
@@ -48,50 +104,113 @@ def main() -> int:
         0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32)
     b = {"tokens": jnp.asarray(tokens)}
 
-    # Warmup (compile + first dispatch). Synchronize by fetching the loss to
-    # host (device_get): on the tunneled `axon` platform block_until_ready
-    # returns before the computation finishes, which once inflated this
-    # number ~30x — only a host fetch is a true barrier there.
-    for _ in range(2):
-        state, m = step(state, b)
-    float(m["loss"])
+    step, step_flops = _aot_compile(step, state, b)
+    if step_flops is None and peak:
+        # 6*N per token fwd+bwd, + 12*L*d*S attention score/value FLOPs.
+        n_params = sum(x.size for x in jax.tree_util.tree_leaves(
+            state["variables"]["params"]))
+        step_flops = (6 * n_params +
+                      12 * cfg.num_layers * cfg.hidden_size * seq) * batch * seq
 
-    t0 = time.perf_counter()
-    done = 0
-    while done < steps_target and (time.perf_counter() - t0) < 60.0:
-        state, m = step(state, b)
-        done += 1
-    float(m["loss"])
-    dt = time.perf_counter() - t0
-
+    done, dt = _time_steps(step, state, b, steps_target, 60.0)
     tokens_per_sec = batch * seq * done / dt
+    mfu = (step_flops * done / dt / peak) if (peak and step_flops) else None
+    return tokens_per_sec, mfu
+
+
+def bench_resnet50(on_tpu: bool, peak):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nezha_tpu import ops, optim
+    from nezha_tpu.models.resnet import resnet50
+    from nezha_tpu.tensor import bf16_policy
+    from nezha_tpu.train.loop import init_train_state, make_train_step
+
+    batch, size = (128, 224) if on_tpu else (4, 64)
+    steps_target = 10 if on_tpu else 2
+
+    model = resnet50(policy=bf16_policy())
+    opt = optim.momentum(0.1, beta=0.9, weight_decay=1e-4)
+    state = init_train_state(model, opt, jax.random.PRNGKey(0))
+    ce = lambda logits, b_: ops.softmax_cross_entropy_with_integer_labels(
+        logits, b_["label"]).mean()
+    step = make_train_step(model, opt, ce)
+
+    rng = np.random.RandomState(0)
+    b = {"image": jnp.asarray(
+             rng.rand(batch, size, size, 3).astype(np.float32)),
+         "label": jnp.asarray(rng.randint(0, 1000, batch), jnp.int32)}
+
+    step, step_flops = _aot_compile(step, state, b)
+    done, dt = _time_steps(step, state, b, steps_target, 90.0)
+    images_per_sec = batch * done / dt
+    mfu = (step_flops * done / dt / peak) if (peak and step_flops) else None
+    return images_per_sec, mfu
+
+
+def main() -> int:
+    import jax
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform in ("tpu", "axon")
+    peak = _peak_flops(platform)
+
+    tokens_per_sec, gpt2_mfu = bench_gpt2(on_tpu, peak)
+    images_per_sec, rn50_mfu = bench_resnet50(on_tpu, peak)
 
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "bench_baseline.json")
     vs_baseline = 1.0
+    recorded = {}
     try:
         with open(baseline_path) as f:
             recorded = json.load(f)
-        base = recorded.get("gpt2_124m_tokens_per_sec_per_chip")
-        if base:
-            vs_baseline = tokens_per_sec / base
-    except FileNotFoundError:
-        if on_tpu:  # record the first real-chip measurement
+    except (FileNotFoundError, ValueError, OSError):
+        recorded = {}
+    if not isinstance(recorded, dict):  # corrupt record: track nothing
+        recorded = {}
+    base = recorded.get("gpt2_124m_tokens_per_sec_per_chip")
+    if isinstance(base, (int, float)) and base > 0:
+        vs_baseline = tokens_per_sec / base
+    else:
+        base = None
+    if on_tpu:
+        # Record first real-chip measurements (regression anchors); never
+        # overwrite an existing anchor.
+        updates = {}
+        if not base:
+            updates["gpt2_124m_tokens_per_sec_per_chip"] = tokens_per_sec
+        if not recorded.get("resnet50_images_per_sec_per_chip"):
+            updates["resnet50_images_per_sec_per_chip"] = images_per_sec
+        if updates:
+            recorded.update(updates, platform=platform)
             try:
                 with open(baseline_path, "w") as f:
-                    json.dump({"gpt2_124m_tokens_per_sec_per_chip":
-                               tokens_per_sec, "platform": platform}, f)
+                    json.dump(recorded, f)
             except OSError:
                 pass
-    except (ValueError, TypeError, AttributeError, OSError):
-        pass  # corrupt/partial record: report vs_baseline=1.0, don't crash
 
-    print(json.dumps({
+    rn50_base = recorded.get("resnet50_images_per_sec_per_chip")
+    extras = {
+        "resnet50_images_per_sec_per_chip": round(images_per_sec, 2),
+    }
+    if isinstance(rn50_base, (int, float)) and rn50_base > 0:
+        extras["resnet50_vs_baseline"] = round(images_per_sec / rn50_base, 4)
+    if rn50_mfu is not None:
+        extras["resnet50_mfu"] = round(rn50_mfu, 4)
+
+    out = {
         "metric": "gpt2_124m_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 2),
         "unit": "tokens/s/chip",
         "vs_baseline": round(vs_baseline, 4),
-    }))
+        "extras": extras,
+    }
+    if gpt2_mfu is not None:
+        out["mfu"] = round(gpt2_mfu, 4)
+    print(json.dumps(out))
     return 0
 
 

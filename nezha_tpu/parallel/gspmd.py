@@ -37,6 +37,12 @@ GPT2_TP_RULES: Rules = [
     (r".*/mlp/fc/b$", P("tp")),
     (r".*/mlp/proj/w$", P("tp", None)),
     (r"^wte/embedding$", P("tp", None)),
+    # Explicitly-replicated tail so strict mode can prove full coverage:
+    # row-parallel output biases, layernorms, position embeddings.
+    (r".*/(attn|mlp)/proj/b$", P()),
+    (r".*/ln_\d+/(scale|bias)$", P()),
+    (r"^ln_f/(scale|bias)$", P()),
+    (r"^wpe/embedding$", P()),
 ]
 
 BERT_TP_RULES: Rules = [
@@ -47,6 +53,11 @@ BERT_TP_RULES: Rules = [
     (r".*/fc/b$", P("tp")),
     (r".*/fc_out/w$", P("tp", None)),
     (r"^tok_emb/embedding$", P("tp", None)),
+    (r".*/(attn_out|fc_out)/b$", P()),
+    (r".*_ln/(scale|bias)$", P()),
+    (r"^(pos|type)_emb/embedding$", P()),
+    (r"^mlm_bias$", P()),
+    (r"^mlm_dense/(w|b)$", P()),
 ]
 
 
@@ -62,18 +73,42 @@ def _leaf_path(path) -> str:
     return "/".join(parts)
 
 
-def param_specs_from_rules(params: Any, rules: Rules) -> Any:
-    """Pytree of PartitionSpecs matching ``params`` via first-match rules."""
+def param_specs_from_rules(params: Any, rules: Rules,
+                           strict: bool = False) -> Any:
+    """Pytree of PartitionSpecs matching ``params`` via first-match rules.
+
+    Unmatched leaves replicate. With ``strict=True`` that silence becomes an
+    error: every rule must match at least one parameter and every
+    non-scalar parameter must be matched by some rule — a renamed layer
+    fails loudly instead of silently replicating (and an obsolete rule
+    can't linger in the table).
+    """
     compiled = [(re.compile(pat), spec) for pat, spec in rules]
+    hits = [0] * len(compiled)
+    unmatched: List[str] = []
 
     def spec_for(path, leaf):
         name = _leaf_path(path)
-        for pat, spec in compiled:
+        for i, (pat, spec) in enumerate(compiled):
             if pat.match(name):
+                hits[i] += 1
                 return spec
+        if getattr(leaf, "ndim", 1) > 0:
+            unmatched.append(name)
         return P()
 
-    return jax.tree_util.tree_map_with_path(spec_for, params)
+    specs = jax.tree_util.tree_map_with_path(spec_for, params)
+    if strict:
+        problems = []
+        dead = [rules[i][0] for i, h in enumerate(hits) if h == 0]
+        if dead:
+            problems.append(f"rules matching no parameter: {dead}")
+        if unmatched:
+            problems.append(f"parameters matched by no rule: {unmatched}")
+        if problems:
+            raise ValueError(
+                "strict sharding-rule check failed: " + "; ".join(problems))
+    return specs
 
 
 def opt_state_specs(opt_state: Any, param_specs: Any) -> Any:

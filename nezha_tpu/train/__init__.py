@@ -11,6 +11,10 @@ def __getattr__(name):
     if name in ("save_checkpoint", "restore_checkpoint", "latest_step"):
         from nezha_tpu.train import checkpoint
         return getattr(checkpoint, name)
+    if name in ("save_sharded", "restore_sharded", "try_restore_sharded",
+                "AsyncCheckpointer"):
+        from nezha_tpu.train import sharded_checkpoint
+        return getattr(sharded_checkpoint, name)
     if name in ("DynamicLossScale", "NoOpLossScale"):
         from nezha_tpu.train import mixed_precision
         return getattr(mixed_precision, name)

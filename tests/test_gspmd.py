@@ -28,6 +28,33 @@ def test_param_specs_rules():
     assert specs["wpe"]["embedding"] == P()
 
 
+def test_strict_rules_cover_gpt2_and_bert():
+    # The shipped tables fully enumerate their models (incl. the
+    # deliberately-replicated tail), so strict mode passes.
+    from nezha_tpu.models.bert import Bert, BertConfig
+    gpt2 = tiny_gpt2().init(jax.random.PRNGKey(0))["params"]
+    parallel.param_specs_from_rules(gpt2, parallel.GPT2_TP_RULES, strict=True)
+    bert = Bert(BertConfig(vocab_size=128, max_positions=32, num_layers=1,
+                           num_heads=2, hidden_size=32)).init(
+        jax.random.PRNGKey(0))["params"]
+    parallel.param_specs_from_rules(bert, parallel.BERT_TP_RULES, strict=True)
+
+
+def test_strict_rules_fail_loudly():
+    import pytest
+    params = tiny_gpt2().init(jax.random.PRNGKey(0))["params"]
+    # A renamed layer (rule no longer matches anything + param uncovered).
+    params["h0"]["attn"]["qkv_renamed"] = params["h0"]["attn"].pop("qkv")
+    with pytest.raises(ValueError, match="qkv_renamed"):
+        parallel.param_specs_from_rules(params, parallel.GPT2_TP_RULES,
+                                        strict=True)
+    # An obsolete rule matching nothing also fails.
+    with pytest.raises(ValueError, match="matching no parameter"):
+        parallel.param_specs_from_rules(
+            {"w": jnp.zeros((2, 2))},
+            [(r"^w$", P(None, "tp")), (r"^gone$", P("tp"))], strict=True)
+
+
 def test_gspmd_step_matches_single_device(devices8):
     mesh = parallel.make_mesh({"dp": 2, "tp": 4})
     model = tiny_gpt2()
