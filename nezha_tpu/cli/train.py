@@ -15,7 +15,6 @@ import argparse
 import itertools
 import json
 import sys
-import time
 from typing import Callable, Dict, Iterator, Optional
 
 import numpy as np
@@ -132,10 +131,48 @@ def _join_world(args):
     set_rank(group.rank)
     get_logger("nezha_tpu.cli").info(
         "joined world: rank %d / %d", group.rank, group.world_size)
-    if group.world_size > 1:
+    if group.world_size > 1 and not args.no_jax_distributed:
         # Rank 0 advertises the jax.distributed address; all ranks enter.
         dist.initialize_jax_distributed(group)
     return group, coord
+
+
+def _data_source(args, cfg, batch_size: int):
+    """Training batches: real records via the native C++ loaders when
+    ``--data-dir`` holds them (SURVEY.md §2 data loaders), synthetic
+    fallback otherwise. Returns (iterator, closer)."""
+    import os
+
+    if args.data_dir:
+        from nezha_tpu.data.native import ImageRecordLoader, TokenLoader
+        if args.config in ("resnet50_imagenet", "wrn101_large_batch"):
+            rec = os.path.join(args.data_dir, "train.nzr")
+            if os.path.exists(rec):
+                loader = ImageRecordLoader(rec, batch_size, crop=args.crop,
+                                           seed=args.seed, train_augment=True)
+                print(f"data: {loader.num_examples} image records from {rec}",
+                      file=sys.stderr)
+                return iter(loader), loader.close
+        elif args.config == "gpt2_124m":
+            for name, dtype in (("train.tokens.u16", np.uint16),
+                                ("train.tokens.i32", np.int32)):
+                tok = os.path.join(args.data_dir, name)
+                if os.path.exists(tok):
+                    loader = TokenLoader(tok, seq_len=1024,
+                                         batch_size=batch_size, dtype=dtype,
+                                         seed=args.seed)
+                    print(f"data: {loader.num_tokens} tokens from {tok}",
+                          file=sys.stderr)
+                    return iter(loader), loader.close
+        elif args.config == "mlp_mnist":
+            os.environ.setdefault("NEZHA_DATA_DIR", args.data_dir)
+            if os.path.isdir(os.path.join(args.data_dir, "mnist")):
+                print(f"data: MNIST IDX files from {args.data_dir}/mnist",
+                      file=sys.stderr)
+                return cfg.batches(batch_size), None
+        print(f"data: no records for {args.config} in {args.data_dir}; "
+              f"using synthetic data", file=sys.stderr)
+    return cfg.batches(batch_size), None
 
 
 def run(args) -> Dict[str, float]:
@@ -145,12 +182,12 @@ def run(args) -> Dict[str, float]:
 
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
-    import jax.numpy as jnp
 
     from nezha_tpu import parallel
     from nezha_tpu.runtime import Prefetcher
     from nezha_tpu.train import checkpoint as ckpt
-    from nezha_tpu.train.loop import init_train_state, make_train_step
+    from nezha_tpu.train import sharded_checkpoint as sckpt
+    from nezha_tpu.train.loop import Trainer, init_train_state, make_train_step
 
     cfg = _configs()[args.config]
     batch_size = args.batch_size or cfg.default_batch
@@ -158,47 +195,114 @@ def run(args) -> Dict[str, float]:
     optimizer = cfg.build_optimizer(args.steps)
     rng = jax.random.PRNGKey(args.seed)
 
-    mode = cfg.parallel_mode if len(jax.devices()) > 1 else "single"
+    mode = cfg.parallel_mode
+    if mode != "single" and len(jax.devices()) == 1:
+        # Degrade, but never silently: a mis-launched multi-host job would
+        # otherwise "succeed" at 1/Nth scale.
+        print(f"WARNING: config {args.config!r} requests parallel mode "
+              f"{mode!r} but only 1 device is visible; running single-device "
+              f"(check your mesh/launch if this is a multi-chip job)",
+              file=sys.stderr)
+        mode = "single"
     mesh = None
     if mode != "single":
         mesh_axes = _parse_mesh(args.mesh) or _parse_mesh(cfg.default_mesh)
         mesh = parallel.make_mesh(mesh_axes)
 
-    # --- state ------------------------------------------------------------
-    state = init_train_state(model, optimizer, rng)
-    start_step = 0
-    if args.ckpt_dir:
-        restored, start_step = ckpt.try_restore(args.ckpt_dir, state)
-        if restored is not None:
-            state = restored
-            print(f"resumed from step {start_step}", file=sys.stderr)
-
-    if mode == "single":
-        step_fn = make_train_step(model, optimizer, cfg.loss_fn)
-        shard = lambda b: b
-    elif mode == "dp":
-        state = parallel.replicate(mesh, state)
-        step_fn = parallel.make_dp_train_step(model, optimizer, cfg.loss_fn, mesh)
-        shard = lambda b: parallel.shard_batch(mesh, b)
-    elif mode == "zero1":
-        variables = state["variables"]
-        state = {
-            "variables": parallel.replicate(mesh, variables),
-            "opt_state": parallel.zero1_init_opt_state(
-                optimizer, variables["params"], mesh),
-            "rng": parallel.replicate(mesh, state["rng"]),
-        }
-        step_fn = parallel.make_zero1_train_step(model, optimizer,
-                                                 cfg.loss_fn, mesh)
-        shard = lambda b: parallel.shard_batch(mesh, b)
+    # --- graph-IR engine (north star: Graph -> StableHLO -> Executor) -----
+    if args.engine == "graph":
+        if args.config != "mlp_mnist":
+            raise SystemExit("--engine graph currently supports mlp_mnist "
+                             "(benchmark config 1)")
+        from nezha_tpu.graph import programs
+        dims = [784, 256, 256, 10]
+        state = programs.init_graph_mlp_state(dims, rng)
+        start_step = 0
+        if args.ckpt_dir:
+            restored, start_step = ckpt.try_restore(args.ckpt_dir, state)
+            if restored is not None:
+                state = restored
+                print(f"resumed from step {start_step}", file=sys.stderr)
+        step_fn = programs.make_mlp_graph_train_step(dims, batch_size, lr=0.1)
+        shard = programs.onehot_shard_fn(dims[-1])
+        save_fn = None
+        mode = "single"
     else:
-        raise ValueError(mode)
+        # --- state + per-mode step/shard/checkpoint format ----------------
+        # ZeRO-1 state is sharded by construction, so it uses the per-shard
+        # checkpoint format (restore needs the sharded template, hence after
+        # layout); the replicated modes restore plain npz before layout.
+        state = init_train_state(model, optimizer, rng)
+        start_step = 0
+        save_fn = None
+        if mode != "zero1" and args.ckpt_dir:
+            restored, start_step = ckpt.try_restore(args.ckpt_dir, state)
+            if restored is not None:
+                state = restored
+                print(f"resumed from step {start_step}", file=sys.stderr)
 
-    # --- loop -------------------------------------------------------------
-    source = cfg.batches(batch_size)
+        if mode == "single":
+            step_fn = make_train_step(model, optimizer, cfg.loss_fn)
+            shard = None
+        elif mode == "dp":
+            state = parallel.replicate(mesh, state)
+            step_fn = parallel.make_dp_train_step(model, optimizer,
+                                                  cfg.loss_fn, mesh)
+            shard = lambda b: parallel.shard_batch(mesh, b)
+        elif mode == "zero1":
+            variables = state["variables"]
+            state = {
+                "variables": parallel.replicate(mesh, variables),
+                "opt_state": parallel.zero1_init_opt_state(
+                    optimizer, variables["params"], mesh),
+                "rng": parallel.replicate(mesh, state["rng"]),
+            }
+            if args.ckpt_dir:
+                restored, start_step = sckpt.try_restore_sharded(
+                    args.ckpt_dir, state)
+                if restored is None:
+                    # Legacy dense zero1 checkpoints (pre-sharded-format
+                    # CLI) restore into the same laid-out template.
+                    restored, start_step = ckpt.try_restore(args.ckpt_dir,
+                                                            state)
+                if restored is not None:
+                    state = restored
+                    print(f"resumed from step {start_step} (sharded)",
+                          file=sys.stderr)
+            save_fn = sckpt.save_sharded
+            step_fn = parallel.make_zero1_train_step(model, optimizer,
+                                                     cfg.loss_fn, mesh)
+            shard = lambda b: parallel.shard_batch(mesh, b)
+        else:
+            raise ValueError(mode)
+
+    # --- loop (one shared Trainer for every mode, so failure detection /
+    # checkpoint-before-raise is live in real CLI runs) --------------------
+    source, close_source = _data_source(args, cfg, batch_size)
     prefetch = Prefetcher(source, depth=args.prefetch)
     from nezha_tpu.utils import MetricsLogger
     metrics_log = MetricsLogger(args.metrics_file) if args.metrics_file else None
+
+    def log_metrics(step_no: int, metrics: Dict[str, float]) -> None:
+        print(json.dumps(metrics), file=sys.stderr)
+        if metrics_log:
+            metrics_log.log(step_no, metrics)
+
+    trainer = Trainer(
+        model, optimizer, cfg.loss_fn,
+        checkpoint_dir=args.ckpt_dir,
+        checkpoint_every=args.ckpt_every,
+        log_every=args.log_every,
+        metric_logger=log_metrics,
+        process_group=group,
+        failure_check_every=args.failure_check_every if group is not None
+        else 0,
+        step_fn=step_fn,
+        shard_fn=shard,
+        save_fn=save_fn,
+        examples_per_step=batch_size)
+    trainer.state = state
+    trainer.global_step = start_step
 
     if args.profile_dir:
         import os as _os
@@ -206,28 +310,12 @@ def run(args) -> Dict[str, float]:
         jax.profiler.start_trace(args.profile_dir)
 
     last: Dict[str, float] = {}
-    t0 = time.perf_counter()
-    window_t0, window_examples = t0, 0
     try:
-        for i in range(args.steps):
-            batch = shard(next(prefetch))
-            state, metrics = step_fn(state, batch)
-            window_examples += batch_size
-            step_no = start_step + i + 1
-            if step_no % args.log_every == 0:
-                now = time.perf_counter()
-                last = {k: float(v) for k, v in metrics.items()}
-                last["examples_per_sec"] = window_examples / (now - window_t0)
-                last["step"] = step_no
-                window_t0, window_examples = now, 0
-                print(json.dumps(last), file=sys.stderr)
-                if metrics_log:
-                    metrics_log.log(step_no, last)
-            if (args.ckpt_every and args.ckpt_dir
-                    and step_no % args.ckpt_every == 0):
-                ckpt.save_checkpoint(args.ckpt_dir, state, step_no)
+        last = trainer.fit(prefetch, args.steps)
     finally:
         prefetch.close()
+        if close_source is not None:
+            close_source()
         if args.profile_dir:
             jax.profiler.stop_trace()
         if metrics_log:
@@ -246,10 +334,14 @@ def run(args) -> Dict[str, float]:
         if coord is not None:
             coord.stop()
     if args.ckpt_dir:
-        ckpt.save_checkpoint(args.ckpt_dir, state, start_step + args.steps)
+        trainer._save(start_step + args.steps)
     if args.eval and cfg.eval_batches is not None:
         from nezha_tpu.train.eval import evaluate
-        results = evaluate(model, state["variables"],
+        # Graph-engine state stores module-layout params without the
+        # variables wrapper; both engines eval through the same model.
+        variables = (trainer.state["variables"] if args.engine != "graph"
+                     else {"params": trainer.state["params"], "state": {}})
+        results = evaluate(model, variables,
                            cfg.eval_batches(batch_size),
                            stat_fn=cfg.eval_stat,
                            max_batches=args.eval_batches)
@@ -279,6 +371,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ckpt-every", type=int, default=0)
     p.add_argument("--metrics-file", default=None,
                    help="append JSONL metrics here")
+    p.add_argument("--data-dir", default=None,
+                   help="directory with real datasets (train.nzr image "
+                        "records / train.tokens.* / mnist IDX); synthetic "
+                        "fallback when absent")
+    p.add_argument("--crop", type=int, default=224,
+                   help="crop size for image-record training")
+    p.add_argument("--failure-check-every", type=int, default=10,
+                   help="poll the coordinator for dead peers every N steps "
+                        "(multi-process runs)")
     p.add_argument("--profile-dir", default=None,
                    help="capture an XLA/TPU profiler trace here")
     p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
@@ -289,6 +390,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="processes in the job (with --serve-coordinator)")
     p.add_argument("--rank-hint", type=int, default=-1,
                    help="preferred rank (e.g. for restart-in-place)")
+    p.add_argument("--no-jax-distributed", action="store_true",
+                   help="skip the jax.distributed bootstrap (single-host "
+                        "multi-process runs that share no accelerators)")
+    p.add_argument("--engine", choices=["module", "graph"], default="module",
+                   help="training engine: Module tracing (default) or the "
+                        "Graph IR -> StableHLO -> Executor path")
     p.add_argument("--eval", action="store_true",
                    help="run the config's eval split after training")
     p.add_argument("--eval-batches", type=int, default=None,

@@ -1,0 +1,42 @@
+"""Pack text files into flat binary token files for the native TokenLoader.
+
+Byte-level tokenization (vocab 256): no external vocab files needed (this
+image has no network egress for BPE downloads), ids are valid under any
+model vocab >= 256, and real text still yields a real next-token learning
+signal — the convergence evidence VERDICT round 1 item 10 asks for.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Iterable, Sequence
+
+import numpy as np
+
+
+def pack_text_files(paths: Iterable[str], out_path: str,
+                    dtype=np.uint16) -> int:
+    """Concatenate files as raw bytes -> ``out_path`` tokens; returns count."""
+    chunks = []
+    for p in sorted(str(p) for p in paths):
+        chunks.append(Path(p).read_bytes())
+        chunks.append(b"\n")
+    data = b"".join(chunks)
+    tokens = np.frombuffer(data, np.uint8).astype(dtype)
+    tokens.tofile(out_path)
+    return tokens.size
+
+
+def pack_tree(root: str, out_path: str,
+              suffixes: Sequence[str] = (".py", ".md"),
+              dtype=np.uint16) -> int:
+    """Pack every ``suffixes`` file under ``root`` (skipping VCS dirs)."""
+    paths = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in (".git", "__pycache__", ".pytest_cache")]
+        for f in filenames:
+            if any(f.endswith(s) for s in suffixes):
+                paths.append(os.path.join(dirpath, f))
+    return pack_text_files(paths, out_path, dtype=dtype)

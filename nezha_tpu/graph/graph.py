@@ -101,6 +101,9 @@ class Graph:
     def softmax(self, x, axis=-1):
         return self._add("softmax", [x], {"axis": axis})
 
+    def log_softmax(self, x, axis=-1):
+        return self._add("log_softmax", [x], {"axis": axis})
+
     def layernorm(self, x, scale, bias, eps=1e-5):
         return self._add("layernorm", [x, scale, bias], {"eps": eps})
 

@@ -1,0 +1,152 @@
+"""Training programs authored in the Graph IR.
+
+This makes the IR load-bearing for benchmark config 1 (`mlp_mnist` with
+``--engine graph``): the MLP forward, the cross-entropy loss, and the
+momentum update are all *built as graphs*, the backward comes from
+``jax.grad`` over the interpreted IR (the documented autograd path,
+`graph/lower.py:grad_callable`), and the whole step executes through the
+runtime ``Executor``'s compile cache — Graph -> StableHLO -> XLA end to end
+(the north star's "lower the internal op graph to StableHLO").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from nezha_tpu.graph.graph import Graph
+from nezha_tpu.graph.lower import to_callable
+from nezha_tpu.runtime.executor import Executor
+
+# Parameter order for an L-layer MLP: w0, b0, w1, b1, ..., wH, bH (head last)
+# — matches models.MLP's {"fc0": {"w","b"}, ..., "head": {"w","b"}} layout.
+
+
+def mlp_param_names(n_layers: int) -> Sequence[str]:
+    names = [f"fc{i}" for i in range(n_layers - 1)] + ["head"]
+    return names
+
+
+def mlp_loss_graph(dims: Sequence[int], batch: int) -> Graph:
+    """IR graph: (w0, b0, ..., image[B, in], onehot[B, classes]) -> loss.
+
+    The label one-hot is a placeholder (host-side data transform), keeping
+    the graph free of integer gather ops.
+    """
+    g = Graph("mlp_loss")
+    ws, bs = [], []
+    for i, (din, dout) in enumerate(zip(dims[:-1], dims[1:])):
+        ws.append(g.placeholder((din, dout), name=f"w{i}"))
+        bs.append(g.placeholder((dout,), name=f"b{i}"))
+    x = g.placeholder((batch, dims[0]), name="image")
+    onehot = g.placeholder((batch, dims[-1]), name="onehot")
+
+    h = x
+    for i, (w, b) in enumerate(zip(ws, bs)):
+        h = (h @ w) + b
+        if i < len(ws) - 1:
+            h = g.relu(h)
+    logp = g.log_softmax(h, axis=-1)
+    nll = -g.mean(g.sum(logp * onehot, axis=1))
+    g.output(nll)
+    return g
+
+
+def momentum_update_graph(shape: Sequence[int], lr: float,
+                          beta: float) -> Graph:
+    """IR graph: (param, velocity, grad) -> (new_param, new_velocity)."""
+    g = Graph("momentum_update")
+    p = g.placeholder(shape, name="param")
+    v = g.placeholder(shape, name="velocity")
+    grad = g.placeholder(shape, name="grad")
+    v_new = v * beta + grad
+    p_new = p - v_new * lr
+    g.output(p_new, v_new)
+    return g
+
+
+def make_mlp_graph_train_step(dims: Sequence[int], batch: int, lr: float,
+                              beta: float = 0.9,
+                              executor: Executor = None):
+    """Trainer-compatible ``step(state, batch) -> (state, metrics)`` whose
+    forward/loss/update are Graph IR programs.
+
+    ``state`` = {"params": {fcN/head: {"w","b"}}, "vel": same-shaped}.
+    ``batch`` = {"image": [B, in], "onehot": [B, classes]} (see
+    :func:`onehot_shard_fn`).
+    """
+    executor = executor or Executor()
+    loss_graph = mlp_loss_graph(dims, batch)
+    loss_fn = to_callable(loss_graph)
+    n_params = 2 * (len(dims) - 1)
+    vg = jax.value_and_grad(loss_fn, argnums=tuple(range(n_params)))
+
+    # One update graph per distinct parameter shape (placeholders are
+    # shape-typed); the Executor dedupes compiles by graph fingerprint.
+    shapes = [(din, dout) for din, dout in zip(dims[:-1], dims[1:])]
+    shapes += [(dout,) for dout in dims[1:]]
+    upd_fns: Dict[Tuple[int, ...], callable] = {}
+    for s in {tuple(s) for s in shapes}:
+        upd_fns[s] = to_callable(momentum_update_graph(s, lr, beta))
+
+    names = mlp_param_names(len(dims) - 1)
+
+    def flatten(tree) -> list:
+        return [tree[n][k] for n in names for k in ("w", "b")]
+
+    def unflatten(flat) -> dict:
+        it = iter(flat)
+        return {n: {"w": next(it), "b": next(it)} for n in names}
+
+    def whole_step(*flat_and_batch):
+        flat = flat_and_batch[:2 * n_params]
+        params, vels = flat[:n_params], flat[n_params:]
+        image, onehot = flat_and_batch[-2:]
+        loss, grads = vg(*params, image, onehot)
+        new_p, new_v = [], []
+        for p, v, gr in zip(params, vels, grads):
+            pn, vn = upd_fns[tuple(p.shape)](p, v, gr)
+            new_p.append(pn)
+            new_v.append(vn)
+        return (loss, *new_p, *new_v)
+
+    def step(state, b):
+        flat_p = flatten(state["params"])
+        flat_v = flatten(state["vel"])
+        out = executor.run(whole_step, *flat_p, *flat_v,
+                           b["image"], b["onehot"])
+        loss, rest = out[0], out[1:]
+        return ({"params": unflatten(rest[:n_params]),
+                 "vel": unflatten(rest[n_params:])},
+                {"loss": loss})
+
+    step.loss_graph = loss_graph  # for introspection/tests
+    step.executor = executor
+    return step
+
+
+def init_graph_mlp_state(dims: Sequence[int], rng) -> dict:
+    """Initialize IR-engine state with the SAME values as models.MLP.init
+    (so the two engines are numerically comparable)."""
+    from nezha_tpu.models.mlp import MLP
+
+    model = MLP(in_features=dims[0], hidden=tuple(dims[1:-1]),
+                num_classes=dims[-1])
+    params = model.init(rng)["params"]
+    vel = jax.tree_util.tree_map(lambda p: np.zeros_like(np.asarray(p)),
+                                 params)
+    return {"params": params, "vel": vel}
+
+
+def onehot_shard_fn(num_classes: int):
+    """Host-side batch transform: integer labels -> one-hot floats."""
+    eye = np.eye(num_classes, dtype=np.float32)
+
+    def shard(b):
+        img = np.asarray(b["image"], np.float32)
+        return {"image": img.reshape(img.shape[0], -1),
+                "onehot": eye[np.asarray(b["label"])]}
+
+    return shard

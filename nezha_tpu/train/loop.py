@@ -93,7 +93,11 @@ class Trainer:
                  tracer=None,
                  process_group=None,
                  failure_check_every: int = 0,
-                 on_failure: Optional[Callable[[list], None]] = None):
+                 on_failure: Optional[Callable[[list], None]] = None,
+                 step_fn=None,
+                 shard_fn: Optional[Callable[[dict], dict]] = None,
+                 save_fn: Optional[Callable[[str, Any, int], Any]] = None,
+                 examples_per_step: int = 0):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -110,30 +114,55 @@ class Trainer:
         self.process_group = process_group
         self.failure_check_every = failure_check_every
         self.on_failure = on_failure
-        self.step_fn = make_train_step(model, optimizer, loss_fn)
+        # Injection points so one loop serves every parallelism mode: a
+        # prebuilt sharded step (DP/ZeRO-1/GSPMD), a host-side batch-placement
+        # fn, and a checkpoint writer (e.g. sharded_checkpoint.save_sharded).
+        self.step_fn = step_fn if step_fn is not None else make_train_step(
+            model, optimizer, loss_fn)
+        self.shard_fn = shard_fn
+        self._save_fn = save_fn
+        self.examples_per_step = examples_per_step
         self.state: Optional[TrainState] = None
         self.global_step = 0
+
+    def _save(self, step: int) -> None:
+        if self._save_fn is not None:
+            self._save_fn(self.checkpoint_dir, self.state, step)
+        else:
+            from nezha_tpu.train import checkpoint as ckpt
+            ckpt.save_checkpoint(self.checkpoint_dir, self.state, step)
 
     def initialize(self, resume: bool = True):
         from nezha_tpu.train import checkpoint as ckpt
         state = init_train_state(self.model, self.optimizer, self.rng)
         if resume and self.checkpoint_dir:
-            restored, step = ckpt.try_restore(self.checkpoint_dir, state)
+            if self._save_fn is not None:
+                # A custom save_fn means a custom on-disk format; the only
+                # shipped one is the per-shard layout, so pair its restore.
+                from nezha_tpu.train import sharded_checkpoint as sck
+                restored, step = sck.try_restore_sharded(
+                    self.checkpoint_dir, state)
+            else:
+                restored, step = ckpt.try_restore(self.checkpoint_dir, state)
             if restored is not None:
                 state, self.global_step = restored, step
         self.state = state
         return state
 
     def fit(self, batches: Iterator[dict], steps: int) -> Dict[str, float]:
-        from nezha_tpu.train import checkpoint as ckpt
         if self.state is None:
             self.initialize()
         last_metrics: Dict[str, float] = {}
         t0 = time.perf_counter()
+        window_steps = 0  # actual steps this logging window (a resume can
+        # land mid-window, so log_every would overstate the first rate)
         for _ in range(steps):
             batch = next(batches)
+            if self.shard_fn is not None:
+                batch = self.shard_fn(batch)
             self.state, metrics = self.step_fn(self.state, batch)
             self.global_step += 1
+            window_steps += 1
             if self.tracer is not None:
                 self.tracer.maybe_trace(self.global_step)
             if (self.failure_check_every and self.process_group is not None
@@ -141,8 +170,7 @@ class Trainer:
                 failed = self.process_group.failed_ranks()
                 if failed:
                     if self.checkpoint_dir:  # preserve progress first
-                        ckpt.save_checkpoint(self.checkpoint_dir, self.state,
-                                             self.global_step)
+                        self._save(self.global_step)
                     if self.on_failure is not None:
                         self.on_failure(failed)
                     else:
@@ -151,14 +179,20 @@ class Trainer:
                             f"{self.global_step}")
             if self.log_every and self.global_step % self.log_every == 0:
                 last_metrics = {k: float(v) for k, v in metrics.items()}
-                last_metrics["steps_per_sec"] = self.log_every / max(
-                    time.perf_counter() - t0, 1e-9)
+                dt = max(time.perf_counter() - t0, 1e-9)
+                last_metrics["steps_per_sec"] = window_steps / dt
+                if self.examples_per_step:
+                    last_metrics["examples_per_sec"] = (
+                        window_steps * self.examples_per_step / dt)
+                last_metrics["step"] = self.global_step
                 t0 = time.perf_counter()
+                window_steps = 0
                 if self.metric_logger:
                     self.metric_logger(self.global_step, last_metrics)
             if (self.checkpoint_every and self.checkpoint_dir
                     and self.global_step % self.checkpoint_every == 0):
-                ckpt.save_checkpoint(self.checkpoint_dir, self.state, self.global_step)
+                self._save(self.global_step)
         if not last_metrics and steps:
             last_metrics = {k: float(v) for k, v in metrics.items()}
+            last_metrics["step"] = self.global_step
         return last_metrics

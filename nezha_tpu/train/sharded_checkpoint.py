@@ -66,7 +66,7 @@ def latest_step(ckpt_dir: str) -> Optional[int]:
     d = Path(ckpt_dir)
     if not d.exists():
         return None
-    steps = []
+    steps, torn = [], []
     for p in d.glob("step_*.sharded"):
         m = re.match(r"step_(\d+)\.sharded$", p.name)
         if not m:
@@ -77,7 +77,33 @@ def latest_step(ckpt_dir: str) -> Optional[int]:
         world = json.loads(metas[0].read_text()).get("world", 1)
         if all((p / f"COMPLETE_p{i}").exists() for i in range(world)):
             steps.append(int(m.group(1)))
-    return max(steps) if steps else None
+        else:
+            torn.append((int(m.group(1)), p))
+    chosen = max(steps) if steps else None
+    # Loud only when it matters: a torn save NEWER than the chosen step
+    # (crash mid-write, or a failure-path rescue whose dead rank never
+    # committed — unusable by construction; we fall back to the last
+    # complete cadence save). Older torn dirs were already reported once.
+    for step, p in torn:
+        if chosen is None or step > chosen:
+            import warnings
+            warnings.warn(f"ignoring torn sharded checkpoint {p} "
+                          f"(missing COMPLETE markers)")
+    return chosen
+
+
+def _byte_view(a: np.ndarray) -> np.ndarray:
+    """np.savez stores extension dtypes (bfloat16 etc., kind 'V') as raw
+    void and load-side casts then fail — store a uint view instead."""
+    if a.dtype.kind == "V":
+        return a.view(np.dtype(f"u{a.dtype.itemsize}"))
+    return a
+
+
+def _unview(a: np.ndarray, dtype: np.dtype) -> np.ndarray:
+    if dtype.kind == "V" and a.dtype.kind != "V":
+        return a.view(dtype)
+    return a
 
 
 class _ShardStore:
@@ -86,6 +112,7 @@ class _ShardStore:
     def __init__(self, step_dir: Path):
         self.leaves: dict = {}
         self._files = []
+        self._cache: dict = {}
         for meta_path in sorted(step_dir.glob("meta_p*.json")):
             proc = re.search(r"meta_p(\d+)\.json$", meta_path.name).group(1)
             z = np.load(step_dir / f"shards_p{proc}.npz")
@@ -99,13 +126,22 @@ class _ShardStore:
                     entry["shards"].append((sh["index"], z, sh["key"]))
 
     def read(self, key: str, index: Tuple[slice, ...]) -> np.ndarray:
-        """Assemble the requested global slice from overlapping shards."""
+        """Assemble the requested global slice from overlapping shards.
+
+        Memoized per (key, slice): make_array_from_callback asks once per
+        device, so a leaf replicated over N devices would otherwise be
+        assembled N times."""
         entry = self.leaves[key]
         gshape = entry["shape"]
-        want = [sl.indices(dim)[:2] for sl, dim in zip(index, gshape)]
+        want = tuple(sl.indices(dim)[:2] for sl, dim in zip(index, gshape))
+        ckey = (key, want)
+        if ckey in self._cache:
+            return self._cache[ckey]
         if not want:  # scalar
             _, z, skey = entry["shards"][0]
-            return z[skey].astype(entry["dtype"])
+            out = _unview(z[skey], entry["dtype"]).astype(entry["dtype"])
+            self._cache[ckey] = out
+            return out
         out_shape = [stop - start for start, stop in want]
         out = np.empty(out_shape, entry["dtype"])
         filled = 0
@@ -122,18 +158,20 @@ class _ShardStore:
                 dst_sl.append(slice(lo - w0, hi - w0))
             if not ok:
                 continue
-            block = z[skey][tuple(src_sl)]
+            block = _unview(z[skey], entry["dtype"])[tuple(src_sl)]
             out[tuple(dst_sl)] = block
             filled += block.size
         if filled < int(np.prod(out_shape)):
             raise ValueError(
                 f"stored shards do not cover requested slice of {key!r} "
                 f"(missing process files?)")
+        self._cache[ckey] = out
         return out
 
     def close(self):
         for z in self._files:
             z.close()
+        self._cache.clear()
 
 
 def restore_sharded(ckpt_dir: str, template: Any,
@@ -263,7 +301,7 @@ def _write_prefetched(ckpt_dir: str, host_state: Any, step: int) -> str:
                                "dtype": str(hs.dtype), "shards": []}
         for i, (idx, data) in enumerate(hs.shards):
             skey = f"{key}::{i}"
-            arrays[skey] = data
+            arrays[skey] = _byte_view(data)
             meta["leaves"][key]["shards"].append(
                 {"key": skey, "index": [list(se) for se in idx]})
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")

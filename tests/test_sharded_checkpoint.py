@@ -140,6 +140,23 @@ def test_async_checkpointer_roundtrip(devices8, tmp_path):
     trees_equal(restored, state)
 
 
+def test_bfloat16_leaves_roundtrip(devices8, tmp_path):
+    # Extension dtypes (kind 'V') are stored as uint views; a straight
+    # np.savez would persist void bytes that fail to cast on restore.
+    mesh = parallel.make_mesh({"dp": 8})
+    state = {
+        "w": parallel.replicate(mesh, jnp.arange(16, dtype=jnp.bfloat16)),
+        "v": jax.device_put(
+            jnp.arange(32, dtype=jnp.bfloat16),
+            jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec("dp"))),
+    }
+    sc.save_sharded(tmp_path, state, step=0)
+    restored, _ = sc.restore_sharded(tmp_path, state)
+    assert restored["w"].dtype == jnp.bfloat16
+    trees_equal(restored, state)
+
+
 def test_missing_leaf_and_shape_mismatch_raise(devices8, tmp_path):
     mesh = parallel.make_mesh({"dp": 8})
     _, _, state = zero1_state(mesh)
