@@ -13,6 +13,8 @@ to specs; unmatched leaves replicate.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import re
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -25,6 +27,25 @@ from nezha_tpu.optim.optimizers import Optimizer, apply_updates
 from nezha_tpu.train.loop import TrainState, merge_state
 
 Rules = List[Tuple[str, P]]
+
+# True while tracing inside make_gspmd_train_step's jit-with-shardings:
+# XLA's SPMD auto-partitioner cannot partition Mosaic (Pallas) custom
+# calls, so models consult this to avoid auto-choosing custom kernels.
+_AUTO_PARTITIONED: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "nezha_gspmd_auto_partitioned", default=False)
+
+
+def under_auto_partitioner() -> bool:
+    return _AUTO_PARTITIONED.get()
+
+
+@contextlib.contextmanager
+def _auto_partitioner_scope():
+    token = _AUTO_PARTITIONED.set(True)
+    try:
+        yield
+    finally:
+        _AUTO_PARTITIONED.reset(token)
 
 # Megatron-style GPT-2 sharding: column-parallel qkv/fc (shard the output
 # features), row-parallel proj (shard the input features), vocab-sharded
@@ -159,9 +180,10 @@ def make_gspmd_train_step(model: Module, optimizer: Optimizer,
         rng, next_rng = jax.random.split(state["rng"])
 
         def compute_loss(params):
-            out, new_state = model.apply(
-                {"params": params, "state": variables["state"]},
-                batch, training=True, rng=rng)
+            with _auto_partitioner_scope():  # trace-time flag, see above
+                out, new_state = model.apply(
+                    {"params": params, "state": variables["state"]},
+                    batch, training=True, rng=rng)
             return jnp.asarray(loss_fn(out, batch), jnp.float32), new_state
 
         (loss, new_state), grads = jax.value_and_grad(

@@ -40,6 +40,39 @@ def test_strict_rules_cover_gpt2_and_bert():
     parallel.param_specs_from_rules(bert, parallel.BERT_TP_RULES, strict=True)
 
 
+def test_auto_partitioner_flag_set_during_gspmd_trace(devices8):
+    """Models consult under_auto_partitioner() to avoid auto-choosing
+    Pallas kernels inside jit-with-shardings (Mosaic custom calls cannot
+    be SPMD-auto-partitioned)."""
+    from nezha_tpu.parallel.gspmd import under_auto_partitioner
+
+    seen = []
+
+    class Probe:
+        def init(self, rng):
+            return {"params": {"w": jnp.ones((4, 4))}, "state": {}}
+
+        def apply(self, variables, batch, training=False, rng=None):
+            seen.append(under_auto_partitioner())
+            return batch["x"] @ variables["params"]["w"], {}
+
+    mesh = parallel.make_mesh({"dp": 2, "tp": 4})
+    model = Probe()
+    opt = optim.sgd(1e-2)
+    state = {"variables": model.init(None), "opt_state": opt.init(
+        model.init(None)["params"]), "rng": jax.random.PRNGKey(0)}
+    specs = {"w": P(None, "tp")}
+    state = parallel.shard_train_state(state, mesh, specs)
+    step = parallel.make_gspmd_train_step(
+        model, opt, lambda out, b: (out ** 2).mean(), mesh, specs,
+        donate=False)
+    assert under_auto_partitioner() is False
+    step(state, parallel.gspmd.shard_batch_gspmd(
+        mesh, {"x": jnp.ones((2, 4))}))
+    assert seen == [True]  # set during trace, only there
+    assert under_auto_partitioner() is False
+
+
 def test_strict_rules_fail_loudly():
     import pytest
     params = tiny_gpt2().init(jax.random.PRNGKey(0))["params"]
