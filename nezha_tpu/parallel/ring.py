@@ -35,20 +35,18 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
     b, h, s_local, d = q.shape
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
 
-    q32 = q.astype(jnp.float32)
     local_pos = jnp.arange(s_local)
     q_pos = idx * s_local + local_pos  # global positions of our queries
 
     perm = [(i, (i + 1) % world) for i in range(world)]
 
-    def body(i, carry):
-        m, l, acc, k_cur, v_cur = carry
-        # After i hops, the block we hold originated at rank (idx - i) mod world.
-        src = (idx - i) % world
+    def attend_block(m, l, acc, k_cur, v_cur, src):
+        """Fold one K/V block into the online-softmax accumulators. Dots
+        take native-dtype inputs (bf16 on TPU: double MXU rate) with fp32
+        accumulation — same recipe as the flash kernel."""
         k_pos = src * s_local + local_pos
-
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q32,
-                            k_cur.astype(jnp.float32)) * scale
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_cur,
+                            preferred_element_type=jnp.float32) * scale
         if causal:
             allowed = k_pos[None, :] <= q_pos[:, None]  # [Sq, Sk] global causal
             scores = jnp.where(allowed[None, None], scores, _NEG_BIG)
@@ -58,11 +56,33 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * corr + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32))
+            "bhqk,bhkd->bhqd", p.astype(v_cur.dtype), v_cur,
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    def body(i, carry):
+        m, l, acc, k_cur, v_cur = carry
+        # After i hops, the block we hold originated at rank (idx - i) mod world.
+        src = (idx - i) % world
+
+        if causal:
+            # A block strictly from the future is fully masked: every score
+            # is _NEG_BIG, so p underflows to exactly 0 and the fold is the
+            # identity — skip the matmuls entirely (a real XLA conditional;
+            # each rank takes its own branch). Saves ~half the ring's FLOPs.
+            # The ppermute stays OUTSIDE the cond: it is a collective and
+            # every rank must participate every hop.
+            m, l, acc = lax.cond(
+                src > idx,
+                lambda ops_: ops_[:3],
+                lambda ops_: attend_block(*ops_),
+                (m, l, acc, k_cur, v_cur, src))
+        else:
+            m, l, acc = attend_block(m, l, acc, k_cur, v_cur, src)
 
         k_next = lax.ppermute(k_cur, axis_name, perm)
         v_next = lax.ppermute(v_cur, axis_name, perm)
-        return m_new, l_new, acc_new, k_next, v_next
+        return m, l, acc, k_next, v_next
 
     m0 = jnp.full((b, h, s_local, 1), _NEG_BIG, jnp.float32)
     l0 = jnp.zeros((b, h, s_local, 1), jnp.float32)

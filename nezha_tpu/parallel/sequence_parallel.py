@@ -37,6 +37,15 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True):
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)  # [B,H/w,S,D]
     s_global = qh.shape[2]
-    mask = causal_mask(s_global, s_global) if causal else None
-    out = dot_product_attention(qh, kh, vh, mask=mask)
+    if jax.default_backend() == "tpu":
+        # Full-sequence attention per rank is exactly the flash kernel's
+        # shape (shard_map hands it per-device blocks, so Mosaic is fine
+        # here, unlike under the GSPMD auto-partitioner); at the long
+        # sequences Ulysses exists for, composed attention's S x S scores
+        # would dominate HBM.
+        from nezha_tpu.ops.pallas import flash_attention
+        out = flash_attention(qh, kh, vh, causal=causal)
+    else:
+        mask = causal_mask(s_global, s_global) if causal else None
+        out = dot_product_attention(qh, kh, vh, mask=mask)
     return heads_to_seq(out)  # back to [B,H,S_loc,D]
