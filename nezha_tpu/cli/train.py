@@ -137,6 +137,16 @@ def _join_world(args):
     return group, coord
 
 
+_IMAGE_CONFIGS = ("resnet50_imagenet", "wrn101_large_batch")
+
+
+def _nzr_count(path) -> int:
+    """Record count from an NZR1 header (magic + int32 n,h,w,c)."""
+    with open(path, "rb") as f:
+        header = f.read(8)
+    return int(np.frombuffer(header[4:8], np.int32)[0])
+
+
 def _data_source(args, cfg, batch_size: int):
     """Training batches: real records via the native C++ loaders when
     ``--data-dir`` holds them (SURVEY.md §2 data loaders), synthetic
@@ -145,7 +155,7 @@ def _data_source(args, cfg, batch_size: int):
 
     if args.data_dir:
         from nezha_tpu.data.native import ImageRecordLoader, TokenLoader
-        if args.config in ("resnet50_imagenet", "wrn101_large_batch"):
+        if args.config in _IMAGE_CONFIGS:
             rec = os.path.join(args.data_dir, "train.nzr")
             if os.path.exists(rec):
                 loader = ImageRecordLoader(rec, batch_size, crop=args.crop,
@@ -173,6 +183,37 @@ def _data_source(args, cfg, batch_size: int):
         print(f"data: no records for {args.config} in {args.data_dir}; "
               f"using synthetic data", file=sys.stderr)
     return cfg.batches(batch_size), None
+
+
+def _eval_source(args, cfg, batch_size: int):
+    """Eval batches: val.nzr records (deterministic center crop) for the
+    CNN configs when present, else the config's built-in eval split.
+    Returns (iterator, closer, stat_fn) — iterator None means no eval."""
+    import os
+
+    from nezha_tpu.train import eval as eval_mod
+
+    if args.data_dir and args.config in _IMAGE_CONFIGS:
+        rec = os.path.join(args.data_dir, "val.nzr")
+        if os.path.exists(rec):
+            from nezha_tpu.data.native import ImageRecordLoader
+            # Largest batch <= requested that divides the record count:
+            # the loader emits only full batches per epoch, so any other
+            # choice silently drops the tail and biases the accuracy (and
+            # a batch > n would be rejected outright).
+            n = _nzr_count(rec)
+            bs = max(d for d in range(1, min(batch_size, n) + 1)
+                     if n % d == 0)
+            if bs != batch_size:
+                print(f"eval: batch {batch_size} -> {bs} to cover all "
+                      f"{n} val records exactly", file=sys.stderr)
+            loader = ImageRecordLoader(rec, bs, crop=args.crop,
+                                       train_augment=False, epochs=1)
+            print(f"eval: {n} val records from {rec}", file=sys.stderr)
+            return iter(loader), loader.close, eval_mod.accuracy
+    if cfg.eval_batches is not None:
+        return cfg.eval_batches(batch_size), None, cfg.eval_stat
+    return None, None, None
 
 
 def run(args) -> Dict[str, float]:
@@ -335,18 +376,23 @@ def run(args) -> Dict[str, float]:
             coord.stop()
     if args.ckpt_dir:
         trainer._save(start_step + args.steps)
-    if args.eval and cfg.eval_batches is not None:
-        from nezha_tpu.train.eval import evaluate
-        # Graph-engine state stores module-layout params without the
-        # variables wrapper; both engines eval through the same model.
-        variables = (trainer.state["variables"] if args.engine != "graph"
-                     else {"params": trainer.state["params"], "state": {}})
-        results = evaluate(model, variables,
-                           cfg.eval_batches(batch_size),
-                           stat_fn=cfg.eval_stat,
-                           max_batches=args.eval_batches)
-        print(json.dumps({"eval": results}), file=sys.stderr)
-        last.update({f"eval_{k}": v for k, v in results.items()})
+    if args.eval:
+        eval_iter, eval_close, stat_fn = _eval_source(args, cfg, batch_size)
+        if eval_iter is not None:
+            from nezha_tpu.train.eval import evaluate
+            # Graph-engine state stores module-layout params without the
+            # variables wrapper; both engines eval through the same model.
+            variables = (trainer.state["variables"] if args.engine != "graph"
+                         else {"params": trainer.state["params"], "state": {}})
+            try:
+                results = evaluate(model, variables, eval_iter,
+                                   stat_fn=stat_fn,
+                                   max_batches=args.eval_batches)
+            finally:
+                if eval_close is not None:
+                    eval_close()
+            print(json.dumps({"eval": results}), file=sys.stderr)
+            last.update({f"eval_{k}": v for k, v in results.items()})
     return last
 
 

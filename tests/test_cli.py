@@ -85,10 +85,19 @@ def test_cli_trains_rn50_from_image_records(devices8, tmp_path):
         tmp_path / "train.nzr",
         rng.randint(0, 256, (64, 40, 40, 3), dtype=np.uint8).astype(np.uint8),
         rng.randint(0, 1000, 64))
+    # 20 val records with batch 8 forces the divisor adjustment (-> 5) and
+    # full coverage; count pins the val.nzr path (synthetic fallback would
+    # differ).
+    write_image_records(
+        tmp_path / "val.nzr",
+        rng.randint(0, 256, (20, 40, 40, 3), dtype=np.uint8),
+        rng.randint(0, 1000, 20))
     metrics = _run(["--config", "resnet50_imagenet", "--steps", "2",
                     "--batch-size", "8", "--log-every", "1",
-                    "--data-dir", str(tmp_path), "--crop", "32"])
+                    "--data-dir", str(tmp_path), "--crop", "32",
+                    "--eval"])
     assert np.isfinite(metrics["loss"])
+    assert metrics["eval_count"] == 20  # every val record, exactly once
 
 
 def test_cli_zero1_sharded_checkpoint_resume(devices8, tmp_path):
