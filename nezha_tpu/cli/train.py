@@ -34,18 +34,32 @@ class Config:
     def __init__(self, build_model: Callable, loss_fn: Callable,
                  batches: Callable[[int], Iterator[dict]],
                  build_optimizer: Callable, default_batch: int,
-                 parallel_mode: str = "dp", default_mesh: str = "dp=-1",
+                 parallel_mode: str = "dp",
                  eval_batches: Optional[Callable] = None,
-                 eval_stat: Optional[Callable] = None):
+                 eval_stat: Optional[Callable] = None,
+                 tiny: Optional[Dict[str, Callable]] = None,
+                 tp_rules=None, pipeline_spec: Optional[Callable] = None,
+                 sp_model: Optional[Callable] = None,
+                 graph_opt: Optional[Dict[str, Any]] = None):
         self.build_model = build_model
         self.loss_fn = loss_fn
         self.batches = batches
         self.build_optimizer = build_optimizer
         self.default_batch = default_batch
-        self.parallel_mode = parallel_mode  # "single" | "dp" | "zero1"
-        self.default_mesh = default_mesh
+        self.parallel_mode = parallel_mode  # default --parallel for the config
         self.eval_batches = eval_batches  # bs -> finite iterator, or None
         self.eval_stat = eval_stat        # stat fn for train.eval.evaluate
+        # --model-preset tiny: field overrides (build_model/batches/...)
+        # producing a seconds-scale variant for CLI mechanics tests.
+        self.tiny = tiny
+        # Advanced parallelism hooks (None = the mode is unsupported here):
+        self.tp_rules = tp_rules            # gspmd Megatron rule table
+        self.pipeline_spec = pipeline_spec  # model -> PipelineSpec
+        self.sp_model = sp_model            # attn_impl -> Module (seq-par)
+        # Graph-engine optimizer pieces ({"schedule": steps -> sched,
+        # "weight_decay": float}) — shared with build_optimizer so the two
+        # engines can't drift apart.
+        self.graph_opt = graph_opt
 
 
 def _configs() -> Dict[str, Config]:
@@ -56,8 +70,33 @@ def _configs() -> Dict[str, Config]:
     from nezha_tpu.tensor import bf16_policy
     from nezha_tpu.train import eval as eval_mod
 
+    from nezha_tpu.parallel import BERT_TP_RULES, GPT2_TP_RULES
+    from nezha_tpu.parallel import pipeline as pp_mod
+
     ce = lambda logits, b: ops.softmax_cross_entropy_with_integer_labels(
         logits, b["label"])
+
+    # Tiny presets run the same code paths at seconds scale (fp32 for the
+    # transformers so mode-vs-mode numerics tests have tight tolerances).
+    def tiny_gpt2(**overrides):
+        return models.GPT2(models.GPT2Config(
+            vocab_size=512, max_positions=96, num_layers=4, num_heads=4,
+            hidden_size=64, **overrides))
+
+    def tiny_bert():
+        return models.Bert(bert_mod.BertConfig(
+            vocab_size=512, max_positions=96, num_layers=2, num_heads=4,
+            hidden_size=64))
+
+    tiny_tokens = lambda bs, **kw: data.synthetic_token_batches(
+        bs, seq_len=64, vocab_size=512, **kw)
+    tiny_images = lambda bs: data.synthetic_image_batches(
+        bs, image_size=32, num_classes=100)
+
+    # One schedule factory for BOTH gpt2 engines (module adamw + graph
+    # AdamW-update programs) — tuning it here tunes them together.
+    gpt2_sched = lambda steps: optim.warmup_cosine_schedule(
+        6e-4, 100, max(steps, 200))
 
     return {
         "mlp_mnist": Config(
@@ -69,7 +108,8 @@ def _configs() -> Dict[str, Config]:
             parallel_mode="single",
             eval_batches=lambda bs: data.mnist_batches(bs, split="test",
                                                        epochs=1),
-            eval_stat=eval_mod.accuracy),
+            eval_stat=eval_mod.accuracy,
+            tiny={}),  # already seconds-scale
         "resnet50_imagenet": Config(
             build_model=lambda: models.resnet50(policy=bf16_policy()),
             loss_fn=ce,
@@ -78,19 +118,32 @@ def _configs() -> Dict[str, Config]:
                 optim.warmup_cosine_schedule(0.4, 5 * 312, max(steps, 10)),
                 beta=0.9, weight_decay=1e-4),
             default_batch=256,
-            parallel_mode="dp"),
+            parallel_mode="dp",
+            tiny={"build_model": lambda: models.ResNet(
+                      (1, 1, 1, 1), num_classes=100, policy=bf16_policy()),
+                  "batches": tiny_images}),
         "gpt2_124m": Config(
-            build_model=lambda: models.gpt2_124m(),
+            # fused_loss_chunk=-1: CE never materializes fp32 [B,S,V]
+            # logits (see GPT2Config) — the training-CLI default.
+            build_model=lambda: models.gpt2_124m(fused_loss_chunk=-1),
             loss_fn=gpt2_mod.lm_loss,
             batches=lambda bs: data.synthetic_token_batches(bs, seq_len=1024),
             build_optimizer=lambda steps: optim.adamw(
-                optim.warmup_cosine_schedule(6e-4, 100, max(steps, 200)),
-                weight_decay=0.1),
+                gpt2_sched(steps), weight_decay=0.1),
             default_batch=8,
             parallel_mode="dp",
             eval_batches=lambda bs: itertools.islice(
                 data.synthetic_token_batches(bs, seq_len=1024, seed=1), 8),
-            eval_stat=eval_mod.lm_token_stats),
+            eval_stat=eval_mod.lm_token_stats,
+            tiny={"build_model": tiny_gpt2,
+                  "batches": tiny_tokens,
+                  "eval_batches": lambda bs: itertools.islice(
+                      tiny_tokens(bs, seed=1), 4),
+                  "sp_model": lambda impl: tiny_gpt2(attn_impl=impl)},
+            tp_rules=GPT2_TP_RULES,
+            pipeline_spec=pp_mod.gpt2_pipeline_spec,
+            sp_model=lambda impl: models.gpt2_124m(attn_impl=impl),
+            graph_opt={"schedule": gpt2_sched, "weight_decay": 0.1}),
         "bert_base_zero1": Config(
             build_model=lambda: models.bert_base(),
             loss_fn=bert_mod.mlm_loss,
@@ -99,7 +152,11 @@ def _configs() -> Dict[str, Config]:
                 optim.warmup_cosine_schedule(1e-4, 100, max(steps, 200)),
                 weight_decay=0.01),
             default_batch=16,
-            parallel_mode="zero1"),
+            parallel_mode="zero1",
+            tiny={"build_model": tiny_bert,
+                  "batches": lambda bs: data.synthetic_mlm_batches(
+                      bs, seq_len=64, vocab_size=512, mask_token=1)},
+            tp_rules=BERT_TP_RULES),
         "wrn101_large_batch": Config(
             build_model=lambda: models.wide_resnet101(policy=bf16_policy()),
             loss_fn=ce,
@@ -108,7 +165,11 @@ def _configs() -> Dict[str, Config]:
                 optim.warmup_cosine_schedule(1.6, 500, max(steps, 1000)),
                 beta=0.9, weight_decay=1e-4),
             default_batch=512,
-            parallel_mode="dp"),
+            parallel_mode="dp",
+            tiny={"build_model": lambda: models.ResNet(
+                      (1, 1, 1, 1), num_classes=100, width_factor=2,
+                      policy=bf16_policy()),
+                  "batches": tiny_images}),
     }
 
 
@@ -231,56 +292,122 @@ def run(args) -> Dict[str, float]:
     from nezha_tpu.train.loop import Trainer, init_train_state, make_train_step
 
     cfg = _configs()[args.config]
+    if args.model_preset == "tiny":
+        for field, value in cfg.tiny.items():
+            setattr(cfg, field, value)
     batch_size = args.batch_size or cfg.default_batch
-    model = cfg.build_model()
-    optimizer = cfg.build_optimizer(args.steps)
-    rng = jax.random.PRNGKey(args.seed)
-
-    mode = cfg.parallel_mode
-    if mode != "single" and len(jax.devices()) == 1:
-        # Degrade, but never silently: a mis-launched multi-host job would
-        # otherwise "succeed" at 1/Nth scale.
-        print(f"WARNING: config {args.config!r} requests parallel mode "
-              f"{mode!r} but only 1 device is visible; running single-device "
-              f"(check your mesh/launch if this is a multi-chip job)",
-              file=sys.stderr)
-        mode = "single"
-    mesh = None
-    if mode != "single":
-        mesh_axes = _parse_mesh(args.mesh) or _parse_mesh(cfg.default_mesh)
-        mesh = parallel.make_mesh(mesh_axes)
 
     # --- graph-IR engine (north star: Graph -> StableHLO -> Executor) -----
+    # Resolved before any parallel-mode/mesh logic: the engine is single-
+    # device by design, so it must neither trip the multi-device degrade
+    # warning nor build a mesh it will never use.
     if args.engine == "graph":
-        if args.config != "mlp_mnist":
-            raise SystemExit("--engine graph currently supports mlp_mnist "
-                             "(benchmark config 1)")
+        if args.config not in ("mlp_mnist", "gpt2_124m"):
+            raise SystemExit("--engine graph supports mlp_mnist and "
+                             "gpt2_124m (benchmark configs 1 and 3)")
+        if args.mesh or args.parallel != "config":
+            raise SystemExit("--engine graph runs single-device; drop "
+                             "--mesh/--parallel (the Graph IR executor does "
+                             "not partition)")
+        import numpy as _np
+
         from nezha_tpu.graph import programs
-        dims = [784, 256, 256, 10]
-        state = programs.init_graph_mlp_state(dims, rng)
+        mode, mesh = "single", None
+        model = cfg.build_model()
+        optimizer = cfg.build_optimizer(args.steps)
+        rng = jax.random.PRNGKey(args.seed)
+        if args.config == "mlp_mnist":
+            dims = [784, 256, 256, 10]
+            state = programs.init_graph_mlp_state(dims, rng)
+            step_fn = programs.make_mlp_graph_train_step(dims, batch_size,
+                                                         lr=0.1)
+            shard = programs.onehot_shard_fn(dims[-1])
+        else:  # gpt2_124m: the transformer authored in the IR
+            state = programs.init_graph_gpt2_state(model, rng)
+            sched = cfg.graph_opt["schedule"](args.steps)
+            step_fn = programs.make_gpt2_graph_train_step(
+                model, lambda t: float(sched(_np.int32(t))),
+                weight_decay=cfg.graph_opt["weight_decay"])
+            shard = programs.lm_shard_fn()
         start_step = 0
         if args.ckpt_dir:
             restored, start_step = ckpt.try_restore(args.ckpt_dir, state)
             if restored is not None:
                 state = restored
                 print(f"resumed from step {start_step}", file=sys.stderr)
-        step_fn = programs.make_mlp_graph_train_step(dims, batch_size, lr=0.1)
-        shard = programs.onehot_shard_fn(dims[-1])
         save_fn = None
-        mode = "single"
     else:
+        mode = cfg.parallel_mode if args.parallel == "config" else args.parallel
+        if mode == "single" and args.mesh:
+            raise SystemExit("--mesh has no effect in single-device mode; "
+                             "drop it or pick a --parallel mode that "
+                             "consumes it")
+        if mode != "single" and len(jax.devices()) == 1:
+            # Degrade, but never silently: a mis-launched multi-host job
+            # would otherwise "succeed" at 1/Nth scale.
+            print(f"WARNING: config {args.config!r} requests parallel mode "
+                  f"{mode!r} but only 1 device is visible; running "
+                  f"single-device (check your mesh/launch if this is a "
+                  f"multi-chip job)", file=sys.stderr)
+            mode = "single"
+
+        # Mesh axes are validated against the chosen mode: an axis the mode
+        # cannot consume is an error, never silently ignored — and every
+        # axis the mode's shard/step functions hardcode must be present
+        # (all modes shard the batch over "dp"; pass dp=1 to opt out of
+        # data parallelism).
+        mode_axes = {"single": (), "dp": ("dp",), "zero1": ("dp",),
+                     "gspmd": ("dp", "tp"), "pp": ("dp", "pp"),
+                     "sp": ("dp", "sp")}
+        mode_default_mesh = {"dp": "dp=-1", "zero1": "dp=-1",
+                             "gspmd": "dp=1,tp=-1", "pp": "dp=1,pp=-1",
+                             "sp": "dp=1,sp=-1"}
+        mesh = None
+        if mode != "single":
+            mesh_axes = (_parse_mesh(args.mesh)
+                         or _parse_mesh(mode_default_mesh[mode]))
+            unusable = [a for a in mesh_axes if a not in mode_axes[mode]]
+            if unusable:
+                raise SystemExit(
+                    f"parallel mode {mode!r} cannot use mesh axis(es) "
+                    f"{unusable} (it consumes {list(mode_axes[mode])}); "
+                    f"pass --parallel to select the mode that uses them")
+            missing = [a for a in mode_axes[mode] if a not in mesh_axes]
+            if missing:
+                raise SystemExit(
+                    f"parallel mode {mode!r} needs mesh axis(es) {missing} "
+                    f"(use size 1 to disable an axis); got "
+                    f"{list(mesh_axes)}")
+            mesh = parallel.make_mesh(mesh_axes)
+
+        if mode == "sp":
+            if cfg.sp_model is None:
+                raise SystemExit(f"config {args.config!r} has no sequence-"
+                                 f"parallel model; --parallel sp supports: "
+                                 f"gpt2_124m")
+            model = cfg.sp_model(args.attn_impl)
+        else:
+            model = cfg.build_model()
+        optimizer = cfg.build_optimizer(args.steps)
+        rng = jax.random.PRNGKey(args.seed)
+
         # --- state + per-mode step/shard/checkpoint format ----------------
-        # ZeRO-1 state is sharded by construction, so it uses the per-shard
-        # checkpoint format (restore needs the sharded template, hence after
-        # layout); the replicated modes restore plain npz before layout.
-        state = init_train_state(model, optimizer, rng)
+        # ZeRO-1/GSPMD/pipeline state is sharded by construction, so those
+        # modes use the per-shard checkpoint format (restore needs the
+        # sharded template, hence after layout); the replicated-state modes
+        # (single/dp/sp) restore plain npz before layout. Pipeline state
+        # never materializes a dense optimizer state at all (its slots are
+        # born sharded over the stage slabs), so it inits from variables
+        # alone below.
         start_step = 0
         save_fn = None
-        if mode != "zero1" and args.ckpt_dir:
-            restored, start_step = ckpt.try_restore(args.ckpt_dir, state)
-            if restored is not None:
-                state = restored
-                print(f"resumed from step {start_step}", file=sys.stderr)
+        if mode != "pp":
+            state = init_train_state(model, optimizer, rng)
+            if mode in ("single", "dp", "sp") and args.ckpt_dir:
+                restored, start_step = ckpt.try_restore(args.ckpt_dir, state)
+                if restored is not None:
+                    state = restored
+                    print(f"resumed from step {start_step}", file=sys.stderr)
 
         if mode == "single":
             step_fn = make_train_step(model, optimizer, cfg.loss_fn)
@@ -290,6 +417,38 @@ def run(args) -> Dict[str, float]:
             step_fn = parallel.make_dp_train_step(model, optimizer,
                                                   cfg.loss_fn, mesh)
             shard = lambda b: parallel.shard_batch(mesh, b)
+        elif mode == "sp":
+            from nezha_tpu.parallel import sequence_parallel as sp_mod
+            state = parallel.replicate(mesh, state)
+            step_fn = sp_mod.make_sp_train_step(model, optimizer, mesh)
+            shard = lambda b: sp_mod.shard_lm_batch(mesh, b)
+        elif mode == "gspmd":
+            if cfg.tp_rules is None:
+                raise SystemExit(
+                    f"config {args.config!r} has no tensor-parallel rule "
+                    f"table; --parallel gspmd supports: gpt2_124m, "
+                    f"bert_base_zero1")
+            specs = parallel.param_specs_from_rules(
+                state["variables"]["params"], cfg.tp_rules, strict=True)
+            state = parallel.shard_train_state(state, mesh, specs)
+            save_fn = sckpt.save_sharded
+            step_fn = parallel.make_gspmd_train_step(
+                model, optimizer, cfg.loss_fn, mesh, specs)
+            from nezha_tpu.parallel.gspmd import shard_batch_gspmd
+            shard = lambda b: shard_batch_gspmd(mesh, b)
+        elif mode == "pp":
+            if cfg.pipeline_spec is None:
+                raise SystemExit(f"config {args.config!r} has no pipeline "
+                                 f"spec; --parallel pp supports: gpt2_124m")
+            from nezha_tpu.parallel import pipeline as pp_mod
+            pspec = cfg.pipeline_spec(model)
+            state = pp_mod.init_pipeline_state(
+                model.init(rng), pspec, optimizer, mesh, rng)
+            save_fn = sckpt.save_sharded
+            step_fn = pp_mod.make_pipeline_train_step(
+                pspec, optimizer, cfg.loss_fn, mesh,
+                num_microbatches=args.microbatches)
+            shard = lambda b: parallel.shard_batch(mesh, b)
         elif mode == "zero1":
             variables = state["variables"]
             state = {
@@ -298,24 +457,36 @@ def run(args) -> Dict[str, float]:
                     optimizer, variables["params"], mesh),
                 "rng": parallel.replicate(mesh, state["rng"]),
             }
-            if args.ckpt_dir:
-                restored, start_step = sckpt.try_restore_sharded(
-                    args.ckpt_dir, state)
-                if restored is None:
-                    # Legacy dense zero1 checkpoints (pre-sharded-format
-                    # CLI) restore into the same laid-out template.
-                    restored, start_step = ckpt.try_restore(args.ckpt_dir,
-                                                            state)
-                if restored is not None:
-                    state = restored
-                    print(f"resumed from step {start_step} (sharded)",
-                          file=sys.stderr)
             save_fn = sckpt.save_sharded
             step_fn = parallel.make_zero1_train_step(model, optimizer,
                                                      cfg.loss_fn, mesh)
             shard = lambda b: parallel.shard_batch(mesh, b)
         else:
             raise ValueError(mode)
+
+        # Sharded-state modes restore AFTER layout: the per-shard format
+        # rebuilds each leaf against the live template sharding (one shared
+        # block — the gspmd/pp/zero1 layouts all restore identically).
+        if save_fn is sckpt.save_sharded and args.ckpt_dir:
+            restored, start_step = sckpt.try_restore_sharded(
+                args.ckpt_dir, state)
+            if restored is None and mode == "zero1":
+                # Legacy dense zero1 checkpoints (pre-sharded-format CLI)
+                # restore into the same laid-out template.
+                restored, start_step = ckpt.try_restore(args.ckpt_dir, state)
+            if restored is not None:
+                state = restored
+                print(f"resumed from step {start_step} (sharded)",
+                      file=sys.stderr)
+
+    # Sharded saves go through the AsyncCheckpointer by default: the step
+    # path pays only the device->host shard copies; file IO runs off-thread
+    # (wait() commits before the failure-path raise and after the final
+    # save).
+    async_ckpt = None
+    if save_fn is sckpt.save_sharded and args.ckpt_dir:
+        async_ckpt = sckpt.AsyncCheckpointer()
+        save_fn = async_ckpt.save
 
     # --- loop (one shared Trainer for every mode, so failure detection /
     # checkpoint-before-raise is live in real CLI runs) --------------------
@@ -341,6 +512,7 @@ def run(args) -> Dict[str, float]:
         step_fn=step_fn,
         shard_fn=shard,
         save_fn=save_fn,
+        save_wait=async_ckpt.wait if async_ckpt is not None else None,
         examples_per_step=batch_size)
     trainer.state = state
     trainer.global_step = start_step
@@ -376,18 +548,41 @@ def run(args) -> Dict[str, float]:
             coord.stop()
     if args.ckpt_dir:
         trainer._save(start_step + args.steps)
+        if async_ckpt is not None:
+            async_ckpt.wait()
     if args.eval:
         eval_iter, eval_close, stat_fn = _eval_source(args, cfg, batch_size)
         if eval_iter is not None:
             from nezha_tpu.train.eval import evaluate
             # Graph-engine state stores module-layout params without the
-            # variables wrapper; both engines eval through the same model.
-            variables = (trainer.state["variables"] if args.engine != "graph"
-                         else {"params": trainer.state["params"], "state": {}})
+            # variables wrapper; pipeline state stores stacked stage slabs
+            # (merged back to the native tree here); sequence-parallel
+            # models only run inside shard_map, so eval uses the plain
+            # single-device model with the same (replicated) params.
+            eval_model = model
+            if args.engine == "graph":
+                variables = {"params": trainer.state["params"], "state": {}}
+            elif mode == "pp":
+                variables = {"params": pp_mod.merge_pipeline_params(
+                    pspec, trainer.state["pparams"]), "state": {}}
+            else:
+                variables = trainer.state["variables"]
+                if mode == "sp":
+                    eval_model = cfg.build_model()
+            import contextlib
+
+            # gspmd/pp leave params sharded; eval traces fresh (outside the
+            # train-step jit), where attn "auto" would otherwise pick the
+            # Mosaic flash kernel XLA can't partition over tp/stage shards.
+            scope = contextlib.nullcontext()
+            if mode in ("gspmd", "pp"):
+                from nezha_tpu.parallel.gspmd import auto_partitioner_scope
+                scope = auto_partitioner_scope()
             try:
-                results = evaluate(model, variables, eval_iter,
-                                   stat_fn=stat_fn,
-                                   max_batches=args.eval_batches)
+                with scope:
+                    results = evaluate(eval_model, variables, eval_iter,
+                                       stat_fn=stat_fn,
+                                       max_batches=args.eval_batches)
             finally:
                 if eval_close is not None:
                     eval_close()
@@ -406,8 +601,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--batch-size", type=int, default=None,
                    help="global batch (default: per-config)")
+    p.add_argument("--model-preset", choices=["full", "tiny"], default="full",
+                   help="tiny = seconds-scale model/data variant of the "
+                        "config (same code paths; for tests and smoke runs)")
     p.add_argument("--mesh", default=None,
-                   help='mesh axes, e.g. "dp=8" or "dp=4,sp=2" (-1 = rest)')
+                   help='mesh axes, e.g. "dp=8" or "dp=2,tp=4" (-1 = rest); '
+                        "axes must match what --parallel consumes")
+    p.add_argument("--parallel", default="config",
+                   choices=["config", "single", "dp", "zero1", "gspmd", "pp",
+                            "sp"],
+                   help="parallelism strategy: config (per-config default), "
+                        "dp (all-reduce), zero1 (sharded optimizer), gspmd "
+                        "(dp x tp tensor parallel), pp (dp x pp GPipe "
+                        "pipeline), sp (dp x sp ring/Ulysses sequence "
+                        "parallel)")
+    p.add_argument("--microbatches", type=int, default=4,
+                   help="pipeline microbatches per step (--parallel pp)")
+    p.add_argument("--attn-impl", default="ring", choices=["ring", "ulysses"],
+                   help="sequence-parallel attention (--parallel sp)")
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. cpu)")
     p.add_argument("--seed", type=int, default=0)

@@ -20,9 +20,10 @@ OP_SET = (
     "add", "sub", "mul", "div", "neg", "pow",
     "matmul", "conv2d",
     "relu", "gelu", "tanh", "exp", "log", "sigmoid",
-    "softmax", "log_softmax", "layernorm",
+    "softmax", "log_softmax", "layernorm", "batchnorm",
+    "max_pool2d", "avg_pool2d",
     "reshape", "transpose", "broadcast_to", "sum", "mean", "max",
-    "cast", "concat", "slice", "take",
+    "cast", "concat", "slice", "take", "take_along",
     "all_reduce", "reduce_scatter", "all_gather",  # collective graph ops
 )
 
@@ -107,6 +108,34 @@ class Graph:
     def layernorm(self, x, scale, bias, eps=1e-5):
         return self._add("layernorm", [x, scale, bias], {"eps": eps})
 
+    def batchnorm(self, x, scale, bias, eps=1e-5):
+        """Training-mode batch norm over N,H,W (NHWC): batch statistics
+        computed in-graph; running-stat tracking is the trainer's concern."""
+        return self._add("batchnorm", [x, scale, bias], {"eps": eps})
+
+    def max_pool2d(self, x, window: int, stride: int, padding="SAME"):
+        return self._add("max_pool2d", [x],
+                         {"window": int(window), "stride": int(stride),
+                          "padding": padding})
+
+    def avg_pool2d(self, x, window: int, stride: int, padding="SAME"):
+        return self._add("avg_pool2d", [x],
+                         {"window": int(window), "stride": int(stride),
+                          "padding": padding})
+
+    def take(self, table, ids, axis=0):
+        return self._add("take", [table, ids], {"axis": axis})
+
+    def take_along(self, x, idx, axis):
+        """Pick one element along ``axis`` per position of ``idx`` (the
+        target-logit gather of a CE loss); output drops ``axis``."""
+        return self._add("take_along", [x, idx], {"axis": axis})
+
+    def slice(self, x, start, limit, strides=None):
+        return self._add("slice", [x], {"start": tuple(start),
+                                        "limit": tuple(limit),
+                                        "strides": strides})
+
     def reshape(self, x, shape):
         return self._add("reshape", [x], {"shape": tuple(shape)})
 
@@ -165,6 +194,9 @@ class Sym:
 
     def __matmul__(self, other):
         return self._bin("matmul", other)
+
+    def __pow__(self, other):
+        return self._bin("pow", other)
 
     def __neg__(self):
         return self.graph._add("neg", [self])

@@ -70,6 +70,21 @@ def _eval_node(node, vals, feeds):
         var = jnp.var(xf, axis=-1, keepdims=True)
         y = (xf - mean) * lax.rsqrt(var + attrs["eps"])
         return (y * x[1] + x[2]).astype(x[0].dtype)
+    if op == "batchnorm":  # training-mode batch stats over N,H,W (NHWC)
+        xf = jnp.asarray(x[0], jnp.float32)
+        axes = tuple(range(xf.ndim - 1))
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.var(xf, axis=axes)
+        y = (xf - mean) * lax.rsqrt(var + attrs["eps"])
+        return (y * x[1] + x[2]).astype(x[0].dtype)
+    if op == "max_pool2d":
+        from nezha_tpu.nn.layers import max_pool
+        return max_pool(x[0], attrs["window"], attrs["stride"],
+                        attrs["padding"])
+    if op == "avg_pool2d":
+        from nezha_tpu.nn.layers import avg_pool
+        return avg_pool(x[0], attrs["window"], attrs["stride"],
+                        attrs["padding"])
     if op == "reshape":
         return jnp.reshape(x[0], attrs["shape"])
     if op == "transpose":
@@ -91,6 +106,10 @@ def _eval_node(node, vals, feeds):
                          attrs.get("strides"))
     if op == "take":
         return jnp.take(x[0], x[1], axis=attrs.get("axis", 0))
+    if op == "take_along":
+        axis = attrs["axis"]
+        return jnp.take_along_axis(
+            x[0], jnp.expand_dims(x[1], axis), axis=axis).squeeze(axis)
     if op == "all_reduce":
         return lax.psum(x[0], attrs["axis_name"])
     if op == "reduce_scatter":

@@ -127,6 +127,173 @@ def make_mlp_graph_train_step(dims: Sequence[int], batch: int, lr: float,
     return step
 
 
+# ---------------------------------------------------------------------------
+# GPT-2 authored in the IR (benchmark config 3 through --engine graph):
+# attention is COMPOSED from IR ops (matmul/softmax/transpose + an additive
+# causal-mask constant), the loss is log_softmax + take_along (no [B,S,V]
+# one-hot), and AdamW is an update graph with bias correction done via the
+# IR's pow op on a step placeholder.
+
+
+def gpt2_loss_graph(cfg, param_template, batch: int, seq: int) -> Graph:
+    """IR graph: (*flat_params, inputs[B,S] i32, targets[B,S] i32) -> loss.
+
+    ``flat_params`` follows ``jax.tree_util.tree_flatten`` order of the
+    module's param tree, so module-initialized params feed straight in.
+    Mirrors ``models.gpt2.GPT2.apply`` (fp32 policy, dropout=0).
+    """
+    if cfg.dropout:
+        raise ValueError("graph GPT-2 has no dropout path; build with "
+                         "dropout=0")
+    g = Graph("gpt2_loss")
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(
+        param_template)
+    syms = [g.placeholder(np.shape(leaf),
+                          str(np.asarray(leaf).dtype),
+                          name=jax.tree_util.keystr(path))
+            for path, leaf in leaves_with_path]
+    p = jax.tree_util.tree_unflatten(treedef, syms)
+    inputs = g.placeholder((batch, seq), "int32", name="inputs")
+    targets = g.placeholder((batch, seq), "int32", name="targets")
+
+    h_dim, nh = cfg.hidden_size, cfg.num_heads
+    hd = h_dim // nh
+    x = g.take(p["wte"]["embedding"], inputs, axis=0)          # [B,S,H]
+    x = x + g.take(p["wpe"]["embedding"],
+                   g.constant(np.arange(seq)), axis=0)          # + [S,H]
+    causal = np.where(np.tri(seq, dtype=bool), 0.0,
+                      -np.inf).astype(np.float32)
+    mask = g.constant(causal)
+
+    def heads(t):  # [B,S,H] -> [B,nh,S,hd]
+        return g.transpose(g.reshape(t, (batch, seq, nh, hd)), (0, 2, 1, 3))
+
+    for i in range(cfg.num_layers):
+        blk = p[f"h{i}"]
+        y = g.layernorm(x, blk["ln_1"]["scale"], blk["ln_1"]["bias"])
+        qkv = (y @ blk["attn"]["qkv"]["w"]) + blk["attn"]["qkv"]["b"]
+        q = heads(g.slice(qkv, (0, 0, 0), (batch, seq, h_dim)))
+        k = heads(g.slice(qkv, (0, 0, h_dim), (batch, seq, 2 * h_dim)))
+        v = heads(g.slice(qkv, (0, 0, 2 * h_dim), (batch, seq, 3 * h_dim)))
+        scores = (q @ g.transpose(k, (0, 1, 3, 2))) * (1.0 / hd ** 0.5)
+        probs = g.softmax(scores + mask, axis=-1)
+        o = g.reshape(g.transpose(probs @ v, (0, 2, 1, 3)),
+                      (batch, seq, h_dim))
+        x = x + (o @ blk["attn"]["proj"]["w"]) + blk["attn"]["proj"]["b"]
+        y = g.layernorm(x, blk["ln_2"]["scale"], blk["ln_2"]["bias"])
+        y = g.gelu((y @ blk["mlp"]["fc"]["w"]) + blk["mlp"]["fc"]["b"])
+        x = x + (y @ blk["mlp"]["proj"]["w"]) + blk["mlp"]["proj"]["b"]
+
+    x = g.layernorm(x, p["ln_f"]["scale"], p["ln_f"]["bias"])
+    logits = x @ g.transpose(p["wte"]["embedding"], (1, 0))  # tied head
+    logp = g.log_softmax(logits, axis=-1)
+    nll = -g.mean(g.take_along(logp, targets, axis=2))
+    g.output(nll)
+    return g
+
+
+def adamw_update_graph(shape: Sequence[int], b1=0.9, b2=0.999, eps=1e-8,
+                       weight_decay=0.1) -> Graph:
+    """IR graph: (param, mu, nu, grad, step_f32, lr) -> (p', mu', nu').
+
+    Matches ``optim.adamw``'s math (bias correction from the
+    post-increment step, decoupled weight decay on every leaf)."""
+    g = Graph("adamw_update")
+    p = g.placeholder(shape, name="param")
+    m = g.placeholder(shape, name="mu")
+    v = g.placeholder(shape, name="nu")
+    grad = g.placeholder(shape, name="grad")
+    t = g.placeholder((), name="step")   # post-increment, fp32
+    lr = g.placeholder((), name="lr")
+    m2 = m * b1 + grad * (1 - b1)
+    v2 = v * b2 + (grad * grad) * (1 - b2)
+    c1 = -(g.constant(np.float32(b1)) ** t) + 1.0
+    c2 = -(g.constant(np.float32(b2)) ** t) + 1.0
+    d = (m2 / c1) / ((v2 / c2) ** 0.5 + eps) + p * weight_decay
+    g.output(p - d * lr, m2, v2)
+    return g
+
+
+def init_graph_gpt2_state(model, rng) -> dict:
+    """Graph-engine GPT-2 state, initialized identically to the module."""
+    params = model.init(rng)["params"]
+    zeros = lambda t: jax.tree_util.tree_map(
+        lambda x: np.zeros(np.shape(x), np.float32), t)
+    return {"params": params, "mu": zeros(params), "nu": zeros(params),
+            "step": np.zeros((), np.int32)}
+
+
+def make_gpt2_graph_train_step(model, lr_schedule, weight_decay: float = 0.1,
+                               executor: Executor = None):
+    """Trainer-compatible step over ``init_graph_gpt2_state`` state; batches
+    are {"inputs": [B,S] i32, "targets": [B,S] i32} (see
+    :func:`lm_shard_fn`). Graphs are built per batch shape on first use."""
+    executor = executor or Executor()
+    cfg = model.cfg
+    _built: Dict[Tuple[int, int], callable] = {}
+
+    def build(params_template, batch, seq):
+        loss_graph = gpt2_loss_graph(cfg, params_template, batch, seq)
+        loss_fn = to_callable(loss_graph)
+        n_params = len(jax.tree_util.tree_leaves(params_template))
+        vg = jax.value_and_grad(loss_fn, argnums=tuple(range(n_params)))
+        shapes = {tuple(np.shape(l))
+                  for l in jax.tree_util.tree_leaves(params_template)}
+        upd = {s: to_callable(adamw_update_graph(
+            s, weight_decay=weight_decay)) for s in shapes}
+
+        def whole_step(*args):
+            flat = args[:3 * n_params]
+            ps, ms, vs = (flat[:n_params], flat[n_params:2 * n_params],
+                          flat[2 * n_params:])
+            t_f32, lr, inputs, targets = args[3 * n_params:]
+            loss, grads = vg(*ps, inputs, targets)
+            new = [upd[tuple(p.shape)](p, m, v, gr, t_f32, lr)
+                   for p, m, v, gr in zip(ps, ms, vs, grads)]
+            new_p, new_m, new_v = zip(*new)
+            return (loss, *new_p, *new_m, *new_v)
+
+        step_obj = {"whole_step": whole_step, "n_params": n_params,
+                    "loss_graph": loss_graph}
+        return step_obj
+
+    def step(state, b):
+        batch, seq = b["inputs"].shape
+        if (batch, seq) not in _built:
+            _built[(batch, seq)] = build(state["params"], batch, seq)
+        so = _built[(batch, seq)]
+        n = so["n_params"]
+        flat_p, treedef = jax.tree_util.tree_flatten(state["params"])
+        flat_m = jax.tree_util.tree_leaves(state["mu"])
+        flat_v = jax.tree_util.tree_leaves(state["nu"])
+        t = int(state["step"])
+        lr = np.float32(lr_schedule(t))       # module: lr from PRE-increment
+        t_f32 = np.float32(t + 1)             # bias correction: post-increment
+        out = executor.run(so["whole_step"], *flat_p, *flat_m, *flat_v,
+                           t_f32, lr, b["inputs"], b["targets"])
+        loss, rest = out[0], out[1:]
+        unf = lambda leaves: jax.tree_util.tree_unflatten(treedef, leaves)
+        return ({"params": unf(rest[:n]), "mu": unf(rest[n:2 * n]),
+                 "nu": unf(rest[2 * n:]),
+                 "step": np.asarray(t + 1, np.int32)},
+                {"loss": loss})
+
+    step.executor = executor
+    step._built = _built  # introspection/tests
+    return step
+
+
+def lm_shard_fn():
+    """Host-side batch transform: {"tokens": [B,S+1]} -> inputs/targets."""
+
+    def shard(b):
+        toks = np.asarray(b["tokens"], np.int32)
+        return {"inputs": toks[:, :-1],
+                "targets": np.ascontiguousarray(toks[:, 1:])}
+
+    return shard
+
+
 def init_graph_mlp_state(dims: Sequence[int], rng) -> dict:
     """Initialize IR-engine state with the SAME values as models.MLP.init
     (so the two engines are numerically comparable)."""

@@ -38,6 +38,15 @@ class GPT2Config:
     # S x S score buffers, so B=32 trains where the xla path OOMs.
     attn_impl: str = "auto"  # "xla" | "flash" | "auto" | "ring" | "ulysses"
     sp_axis: str = "sp"
+    # Fused LM head: apply() returns {"hidden", "wte"} instead of logits and
+    # `lm_loss` computes the CE without materializing fp32 [B,S,V] (1.6 GB
+    # at B=8 S=1024). 0 = off (logits API, decode/HF paths). -1 = dense
+    # compute-dtype logits with the fp32 upcast fused into logsumexp
+    # (fastest on v5e: +3% e2e). >0 = sequence-chunked scan of this many
+    # positions (ops.losses.chunked_lm_cross_entropy) — slower (-10% e2e,
+    # measured) but peak logit memory drops S/chunk-fold in BOTH dtypes;
+    # for very long context / big batch where even bf16 logits blow HBM.
+    fused_loss_chunk: int = 0
 
 
 class Attention(Module):
@@ -195,10 +204,11 @@ class GPT2(Module):
             raise ValueError(
                 f"sequence length {s} exceeds max_positions "
                 f"{self.cfg.max_positions}")
-        if cache is not None:
-            positions = pos + jnp.arange(s)[None, :]
-        else:
-            positions = jnp.arange(s)[None, :]
+        # ``pos`` without a cache = a global position offset: the sequence-
+        # parallel train step passes each shard's offset so position
+        # embeddings (and ring attention's causal mask) see global positions.
+        offset = 0 if pos is None else pos
+        positions = offset + jnp.arange(s)[None, :]
         x = run_child(self.wte, "wte", variables, states, tokens,
                       training=training)
         x = x + run_child(self.wpe, "wpe", variables, states, positions,
@@ -211,6 +221,14 @@ class GPT2(Module):
                           cache=None if cache is None else cache[i], pos=pos)
         x = run_child(self.ln_f, "ln_f", variables, states, x,
                       training=training)
+        if self.cfg.fused_loss_chunk and cache is None:
+            # Defer the LM head to the loss: hand back the final hidden
+            # states + the tied table so chunked_lm_cross_entropy computes
+            # logits blockwise (grads flow to wte through this dict; "chunk"
+            # is a static python int — it never crosses a jit boundary).
+            wte = child_vars(variables, "wte")["params"]["embedding"]
+            return {"hidden": x, "wte": wte,
+                    "chunk": self.cfg.fused_loss_chunk}, states
         logits = self.wte.attend(child_vars(variables, "wte"), x)
         return jnp.asarray(logits, jnp.float32), states
 
@@ -220,7 +238,13 @@ def gpt2_124m(policy: Policy | None = None, **overrides) -> GPT2:
     return GPT2(cfg, policy=policy or bf16_policy())
 
 
-def lm_loss(logits, batch):
-    """Next-token CE over {"tokens": [B, S+1]} batches."""
+def lm_loss(out, batch):
+    """Next-token CE over {"tokens": [B, S+1]} batches.
+
+    ``out`` is either dense logits or the fused-head dict (see
+    ``GPT2Config.fused_loss_chunk``)."""
     targets = batch["tokens"][:, 1:]
-    return ops.softmax_cross_entropy_with_integer_labels(logits, targets)
+    if isinstance(out, dict):
+        from nezha_tpu.ops.losses import lm_ce_from_fused
+        return lm_ce_from_fused(out, targets)
+    return ops.softmax_cross_entropy_with_integer_labels(out, targets)

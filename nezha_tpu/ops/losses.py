@@ -2,7 +2,9 @@
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
+from jax import lax
 
 from nezha_tpu.ops.activations import log_softmax
 
@@ -26,6 +28,95 @@ def softmax_cross_entropy_with_integer_labels(logits, labels, ignore_index: int 
         return -jnp.mean(picked)
     mask = (labels != ignore_index).astype(jnp.float32)
     return -jnp.sum(picked * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def lm_cross_entropy_from_hidden(hidden, emb, targets):
+    """Tied-head LM CE with compute-dtype (bf16) logits and the fp32 upcast
+    fused into the logsumexp reduction — the fp32 [B,S,V] tensor is never
+    written to HBM. Measured on v5e (GPT-2 124M, B=8 S=1024): +3% step
+    throughput over casting the dense logits to fp32 first; equal loss to
+    within bf16 rounding. Use ``chunked_lm_cross_entropy`` instead when
+    even the compute-dtype logits don't fit."""
+    logits = hidden @ emb.astype(hidden.dtype).T  # [B,S,V] compute dtype
+    lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - picked.astype(jnp.float32))
+
+
+def chunked_lm_cross_entropy(hidden, emb, targets, chunk: int = 128,
+                             ignore_index: int | None = None):
+    """Tied-head LM cross-entropy that never materializes [B, S, V] logits.
+
+    The fp32 logit tensor is the GPT-2 HBM bottleneck (124M at B=8 S=1024:
+    1.6 GB live through the loss/backward window — BENCH_NOTES r2). Here the
+    sequence is processed in ``chunk``-position slices inside a ``lax.scan``:
+    each slice computes its [B, chunk, V] logits on the MXU (bf16 inputs,
+    fp32 accumulation — same recipe as the flash kernel), folds them into
+    the CE sum, and frees them; ``jax.checkpoint`` recomputes the slice in
+    the backward pass, so peak logit memory is S/chunk times smaller in both
+    directions.
+
+    ``hidden``: [B, S, H] final activations; ``emb``: [V, H] tied embedding
+    table; ``targets``: [B, S] int labels; positions whose label equals
+    ``ignore_index`` are masked out of the mean (same contract as
+    ``softmax_cross_entropy_with_integer_labels``, in both the chunked path
+    and the ragged-tail fallback). Returns the mean CE (fp32).
+    """
+    b, s, h = hidden.shape
+    emb = emb.astype(hidden.dtype)
+    if s <= chunk:  # one chunk's worth or less: dense is strictly cheaper
+        logits = jnp.einsum("bsh,vh->bsv", hidden, emb,
+                            preferred_element_type=jnp.float32)
+        return softmax_cross_entropy_with_integer_labels(
+            logits, targets, ignore_index=ignore_index)
+    if s % chunk:
+        # Never silently materialize the dense logits the chunked path
+        # exists to avoid — at long context that IS the OOM.
+        raise ValueError(
+            f"sequence length {s} not divisible by loss chunk {chunk}; "
+            f"pick a divisor (or <= {chunk} positions for the dense path)")
+    n = s // chunk
+    h_chunks = hidden.reshape(b, n, chunk, h).transpose(1, 0, 2, 3)
+    t_chunks = targets.reshape(b, n, chunk).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def body(carry, ht):
+        nll_sum, count = carry
+        hc, tc = ht
+        logits = jnp.einsum("bch,vh->bcv", hc, emb,
+                            preferred_element_type=jnp.float32)
+        logp = log_softmax(logits)
+        if ignore_index is None:  # static: no masking, like the dense path
+            picked = jnp.take_along_axis(logp, tc[..., None],
+                                         axis=-1)[..., 0]
+            return (nll_sum - jnp.sum(picked),
+                    count + jnp.float32(picked.size)), None
+        safe = jnp.where(tc == ignore_index, 0, tc)
+        picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        mask = (tc != ignore_index).astype(jnp.float32)
+        return (nll_sum - jnp.sum(picked * mask),
+                count + jnp.sum(mask)), None
+
+    (total, count), _ = lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (h_chunks, t_chunks))
+    return total / jnp.maximum(count, 1.0)
+
+
+def lm_ce_from_fused(out: dict, targets, ignore_index: int | None = None):
+    """CE from a fused-head model output dict ({"hidden", "wte", "chunk"} —
+    see ``GPT2Config.fused_loss_chunk``). The single interpreter of that
+    protocol: chunk == -1 -> dense bf16-logit logsumexp fusion; chunk > 0
+    -> sequence-chunked scan."""
+    if out["chunk"] == -1:
+        if ignore_index is not None:
+            raise NotImplementedError(
+                "ignore_index with the dense fused path")
+        return lm_cross_entropy_from_hidden(out["hidden"], out["wte"],
+                                            targets)
+    return chunked_lm_cross_entropy(out["hidden"], out["wte"], targets,
+                                    chunk=out["chunk"],
+                                    ignore_index=ignore_index)
 
 
 def mse_loss(pred, target):

@@ -49,7 +49,7 @@ def __getattr__(name):
     if name in ("ring_attention", "ring_self_attention"):
         mod = importlib.import_module("nezha_tpu.parallel.ring")
         return getattr(mod, name)
-    if name in ("ulysses_attention",):
+    if name in ("ulysses_attention", "make_sp_train_step", "shard_lm_batch"):
         mod = importlib.import_module("nezha_tpu.parallel.sequence_parallel")
         return getattr(mod, name)
     if name in ("PipelineSpec", "pipeline_blocks", "pipelined_forward",

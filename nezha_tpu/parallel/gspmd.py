@@ -39,6 +39,14 @@ def under_auto_partitioner() -> bool:
     return _AUTO_PARTITIONED.get()
 
 
+def auto_partitioner_scope():
+    """Public scope: trace model code as if under the GSPMD auto-
+    partitioner, so ``attn_impl='auto'`` avoids Mosaic kernels that XLA
+    cannot partition. Needed anywhere sharded params meet a fresh trace —
+    e.g. eval over a gspmd/pipeline-laid-out state."""
+    return _auto_partitioner_scope()
+
+
 @contextlib.contextmanager
 def _auto_partitioner_scope():
     token = _AUTO_PARTITIONED.set(True)

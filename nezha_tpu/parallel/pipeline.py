@@ -227,6 +227,12 @@ def gpt2_pipeline_spec(model) -> PipelineSpec:
     from nezha_tpu.nn.module import child_vars
 
     cfg = model.cfg
+    if cfg.dropout:
+        # block_fn applies blocks with no rng: a dropout>0 config would
+        # silently train without dropout. Refuse instead.
+        raise ValueError(
+            f"gpt2_pipeline_spec requires dropout=0 (got {cfg.dropout}): the "
+            f"pipelined region is deterministic and would silently drop it")
     template = model.h[0]
 
     def embed_fn(outer, batch):

@@ -1,4 +1,5 @@
-"""Ulysses-style sequence parallelism: all-to-all head/sequence exchange.
+"""Sequence parallelism: Ulysses all-to-all attention and the dp x sp
+training step.
 
 Complements ring attention (`nezha_tpu.parallel.ring_attention`): instead of
 rotating K/V blocks, a single ``lax.all_to_all`` re-shards activations from
@@ -6,14 +7,24 @@ sequence-sharded to head-sharded, each rank runs FULL-sequence attention for
 its subset of heads (dense MXU work, no per-hop latency), and a second
 all-to-all restores sequence sharding. Preferred when num_heads %% world == 0
 and the full sequence fits per-chip for 1/world of the heads.
+
+``make_sp_train_step`` is the training path: the WHOLE model (not just
+attention) runs inside shard_map over a (dp, sp) mesh with activations
+sequence-sharded, attention crossing shards via ring/Ulysses collectives,
+and gradients pmean'd over both axes. Per-chip activation memory is
+O(S/sp) — the long-context scaling axis.
 """
 
 from __future__ import annotations
 
 from functools import partial
+from typing import Any, Callable, Dict, Optional
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from nezha_tpu.ops.attention import causal_mask, dot_product_attention
 
@@ -49,3 +60,101 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True):
         mask = causal_mask(s_global, s_global) if causal else None
         out = dot_product_attention(qh, kh, vh, mask=mask)
     return heads_to_seq(out)  # back to [B,H,S_loc,D]
+
+
+# ---------------------------------------------------------------------------
+# The sequence-parallel training step (dp x sp)
+
+
+def shard_lm_batch(mesh: Mesh, batch: Dict[str, Any],
+                   dp_axis: str = "dp", sp_axis: str = "sp") -> Dict[str, Any]:
+    """{"tokens": [B, S+1]} -> {"inputs", "targets"}: both [B, S], batch
+    sharded over ``dp_axis`` and sequence over ``sp_axis``.
+
+    The shift happens host-side because [B, S+1] cannot shard evenly over
+    the sequence axis; inputs/targets [B, S] can.
+    """
+    tokens = np.asarray(batch["tokens"])
+    world = dict(zip(mesh.axis_names, mesh.devices.shape)).get(sp_axis, 1)
+    s = tokens.shape[1] - 1
+    if s % world:
+        raise ValueError(f"sequence length {s} not divisible by "
+                         f"{sp_axis}={world}")
+    sharding = NamedSharding(mesh, P(dp_axis, sp_axis))
+    return {"inputs": jax.device_put(tokens[:, :-1], sharding),
+            "targets": jax.device_put(np.ascontiguousarray(tokens[:, 1:]),
+                                      sharding)}
+
+
+def make_sp_train_step(model, optimizer, mesh: Mesh,
+                       loss_fn: Optional[Callable] = None,
+                       dp_axis: str = "dp", sp_axis: str = "sp",
+                       donate: bool = True):
+    """Sequence-parallel train step: the full model runs inside shard_map
+    over (dp, sp); attention must be built with ``attn_impl='ring'`` or
+    ``'ulysses'`` (its collectives bind to ``sp_axis``). Params/optimizer
+    state replicate; batches come from ``shard_lm_batch``; every shard holds
+    the same number of tokens, so the global mean loss is the pmean of
+    shard means and gradients pmean over both axes.
+    """
+    from nezha_tpu.ops.losses import (
+        softmax_cross_entropy_with_integer_labels)
+    from nezha_tpu.optim.optimizers import apply_updates
+    from nezha_tpu.parallel._compat import shard_map
+    from nezha_tpu.train.loop import merge_state
+
+    if loss_fn is None:
+        loss_fn = softmax_cross_entropy_with_integer_labels
+    axes = (dp_axis, sp_axis)
+
+    def per_shard(state, batch):
+        variables, opt_state = state["variables"], state["opt_state"]
+        rng, next_rng = jax.random.split(state["rng"])
+        shard_id = (lax.axis_index(dp_axis) * lax.axis_size(sp_axis)
+                    + lax.axis_index(sp_axis))
+        step_rng = jax.random.fold_in(rng, shard_id)
+        inputs, targets = batch["inputs"], batch["targets"]
+        # Global position of this shard's first token — the model offsets
+        # its position embeddings by it; ring/Ulysses attention handle the
+        # causal mask in global coordinates themselves.
+        offset = lax.axis_index(sp_axis) * inputs.shape[1]
+
+        def compute_loss(params):
+            out, new_state = model.apply(
+                {"params": params, "state": variables["state"]},
+                inputs, training=True, rng=step_rng, pos=offset)
+            return jnp.asarray(loss_fn(out, targets), jnp.float32), new_state
+
+        (loss, new_state), grads = jax.value_and_grad(
+            compute_loss, has_aux=True)(variables["params"])
+        grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, axes), grads)
+        loss = lax.pmean(loss, axes)
+        new_state = jax.tree_util.tree_map(
+            lambda t: lax.pmean(t, axes), new_state)
+        updates, opt_state = optimizer.update(grads, opt_state,
+                                              variables["params"])
+        params = apply_updates(variables["params"], updates)
+        new_variables = {"params": params,
+                         "state": merge_state(variables["state"], new_state)}
+        return ({"variables": new_variables, "opt_state": opt_state,
+                 "rng": next_rng}, {"loss": loss})
+
+    def build(state_template, batch_template):
+        tmap = jax.tree_util.tree_map
+        state_spec = tmap(lambda _: P(), state_template)
+        batch_spec = tmap(lambda _: P(dp_axis, sp_axis), batch_template)
+        mapped = shard_map(per_shard, mesh=mesh,
+                           in_specs=(state_spec, batch_spec),
+                           out_specs=(state_spec, P()))
+        return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+    _cache = {}
+
+    def step(state, batch):
+        key = tuple((k, tuple(v.shape), str(v.dtype)) for k, v in sorted(
+            batch.items(), key=lambda kv: kv[0]))
+        if key not in _cache:
+            _cache[key] = build(state, batch)
+        return _cache[key](state, batch)
+
+    return step

@@ -24,10 +24,18 @@ def accuracy(logits, batch) -> Dict[str, jax.Array]:
     return {"correct": correct, "count": jnp.asarray(pred.size)}
 
 
-def lm_token_stats(logits, batch) -> Dict[str, jax.Array]:
-    """Next-token NLL sums over {"tokens": [B, S+1]} — yields perplexity."""
+def lm_token_stats(out, batch) -> Dict[str, jax.Array]:
+    """Next-token NLL sums over {"tokens": [B, S+1]} — yields perplexity.
+
+    ``out``: dense logits, or the fused-head {"hidden", "wte"} dict (see
+    ``GPT2Config.fused_loss_chunk``)."""
     targets = batch["tokens"][:, 1:]
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    if isinstance(out, dict):
+        from nezha_tpu.ops.losses import lm_ce_from_fused
+        mean_nll = lm_ce_from_fused(out, targets)
+        return {"nll_sum": mean_nll * targets.size,
+                "count": jnp.asarray(targets.size)}
+    logp = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
     return {"nll_sum": nll.sum(), "count": jnp.asarray(targets.size)}
 

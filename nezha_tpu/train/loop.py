@@ -97,6 +97,7 @@ class Trainer:
                  step_fn=None,
                  shard_fn: Optional[Callable[[dict], dict]] = None,
                  save_fn: Optional[Callable[[str, Any, int], Any]] = None,
+                 save_wait: Optional[Callable[[], None]] = None,
                  examples_per_step: int = 0):
         self.model = model
         self.optimizer = optimizer
@@ -121,6 +122,11 @@ class Trainer:
             model, optimizer, loss_fn)
         self.shard_fn = shard_fn
         self._save_fn = save_fn
+        # For async save_fns (AsyncCheckpointer.save): blocks until the
+        # in-flight write commits. Called before raising on peer failure —
+        # a rescue checkpoint whose files are still being written when the
+        # process dies is a torn save.
+        self._save_wait = save_wait
         self.examples_per_step = examples_per_step
         self.state: Optional[TrainState] = None
         self.global_step = 0
@@ -171,6 +177,8 @@ class Trainer:
                 if failed:
                     if self.checkpoint_dir:  # preserve progress first
                         self._save(self.global_step)
+                        if self._save_wait is not None:
+                            self._save_wait()  # commit before raising
                     if self.on_failure is not None:
                         self.on_failure(failed)
                     else:

@@ -32,19 +32,114 @@ def test_cli_resume(tmp_path):
     assert m["step"] == 15
 
 
-def test_cli_dp_mesh(devices8, tmp_path):
-    # tiny ResNet stand-in is too slow; use mlp in DP mode via gpt2-like path:
-    # mlp_mnist is single-mode by design, so exercise DP through the mesh
-    # parse + resnet tiny steps instead.
-    metrics = _run(["--config", "mlp_mnist", "--steps", "4",
-                    "--batch-size", "64", "--log-every", "2"])
+def test_cli_dp_mesh(devices8, capsys):
+    """ResNet (tiny preset) actually trains data-parallel over the 8-device
+    mesh through the CLI — no degrade warning, finite loss."""
+    metrics = _run(["--config", "resnet50_imagenet", "--model-preset", "tiny",
+                    "--steps", "4", "--batch-size", "16", "--mesh", "dp=8",
+                    "--log-every", "2"])
     assert np.isfinite(metrics["loss"])
+    assert "only 1 device" not in capsys.readouterr().err  # DP really ran
 
 
 def test_mesh_parsing():
     from nezha_tpu.cli.train import _parse_mesh
     assert _parse_mesh("dp=4,sp=2") == {"dp": 4, "sp": 2}
     assert _parse_mesh(None) is None
+
+
+def test_cli_rejects_unusable_mesh_axes(devices8):
+    """A mesh axis the chosen parallel mode cannot consume is an error, not
+    silently ignored (VERDICT r2 missing #1)."""
+    import pytest
+    with pytest.raises(SystemExit, match="cannot use mesh axis"):
+        _run(["--config", "resnet50_imagenet", "--model-preset", "tiny",
+              "--steps", "1", "--batch-size", "8", "--parallel", "dp",
+              "--mesh", "dp=4,tp=2"])
+    with pytest.raises(SystemExit, match=r"needs mesh axis\(es\) \['tp'\]"):
+        _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--steps", "1", "--batch-size", "8", "--parallel", "gspmd",
+              "--mesh", "dp=8"])
+    with pytest.raises(SystemExit, match=r"needs mesh axis\(es\) \['dp'\]"):
+        _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--steps", "1", "--batch-size", "8", "--parallel", "sp",
+              "--mesh", "sp=8"])
+    with pytest.raises(SystemExit, match="no effect in single-device"):
+        _run(["--config", "mlp_mnist", "--steps", "1", "--batch-size", "8",
+              "--parallel", "single", "--mesh", "dp=8"])
+    with pytest.raises(SystemExit, match="no tensor-parallel rule table"):
+        _run(["--config", "resnet50_imagenet", "--model-preset", "tiny",
+              "--steps", "1", "--batch-size", "8", "--parallel", "gspmd",
+              "--mesh", "dp=2,tp=4"])
+
+
+def _final_losses(config, steps, batch, extra):
+    """Per-step losses from a metrics file for mode-vs-mode comparison."""
+    import tempfile, pathlib, os
+    with tempfile.TemporaryDirectory() as d:
+        mf = os.path.join(d, "m.jsonl")
+        _run(["--config", config, "--model-preset", "tiny",
+              "--steps", str(steps), "--batch-size", str(batch),
+              "--log-every", "1", "--metrics-file", mf] + extra)
+        return [json.loads(l)["loss"]
+                for l in pathlib.Path(mf).read_text().strip().splitlines()]
+
+
+def test_cli_gspmd_matches_single(devices8):
+    """--parallel gspmd (dp x tp, Megatron rules) launches from the CLI and
+    matches single-device numerics step-for-step."""
+    ref = _final_losses("gpt2_124m", 3, 8, ["--parallel", "single"])
+    tp = _final_losses("gpt2_124m", 3, 8,
+                       ["--parallel", "gspmd", "--mesh", "dp=2,tp=4"])
+    np.testing.assert_allclose(tp, ref, rtol=1e-3)
+
+
+def test_cli_pp_matches_single(devices8):
+    """--parallel pp (dp x pp GPipe) launches from the CLI and matches
+    single-device numerics step-for-step."""
+    ref = _final_losses("gpt2_124m", 3, 8, ["--parallel", "single"])
+    pp = _final_losses("gpt2_124m", 3, 8,
+                       ["--parallel", "pp", "--mesh", "dp=2,pp=4",
+                        "--microbatches", "2"])
+    np.testing.assert_allclose(pp, ref, rtol=1e-3)
+
+
+def test_cli_sp_matches_single(devices8):
+    """--parallel sp (dp x sp ring attention) launches from the CLI and
+    matches single-device numerics step-for-step."""
+    ref = _final_losses("gpt2_124m", 3, 8, ["--parallel", "single"])
+    sp = _final_losses("gpt2_124m", 3, 8,
+                       ["--parallel", "sp", "--mesh", "dp=2,sp=4",
+                        "--attn-impl", "ring"])
+    np.testing.assert_allclose(sp, ref, rtol=1e-3)
+
+
+def test_cli_gspmd_sharded_checkpoint_resume(devices8, tmp_path):
+    """GSPMD CLI checkpoints in the per-shard format and resumes from it."""
+    ck = str(tmp_path / "ck")
+    base = ["--config", "gpt2_124m", "--model-preset", "tiny",
+            "--batch-size", "8", "--parallel", "gspmd",
+            "--mesh", "dp=2,tp=4", "--ckpt-dir", ck, "--log-every", "1"]
+    _run(base + ["--steps", "2"])
+    import pathlib
+    assert list(pathlib.Path(ck).glob("step_*.sharded"))
+    m = _run(base + ["--steps", "1"])
+    assert m["step"] == 3  # resumed at 2, trained 1 more
+
+
+def test_cli_pp_sharded_checkpoint_resume_and_eval(devices8, tmp_path):
+    """Pipeline CLI checkpoints stacked stage slabs and resumes; eval runs
+    off the merged (native-layout) params."""
+    ck = str(tmp_path / "ck")
+    base = ["--config", "gpt2_124m", "--model-preset", "tiny",
+            "--batch-size", "8", "--parallel", "pp", "--mesh", "dp=2,pp=4",
+            "--microbatches", "2", "--ckpt-dir", ck, "--log-every", "1"]
+    _run(base + ["--steps", "2"])
+    import pathlib
+    assert list(pathlib.Path(ck).glob("step_*.sharded"))
+    m = _run(base + ["--steps", "1", "--eval", "--eval-batches", "2"])
+    assert m["step"] == 3
+    assert any(k.startswith("eval_") for k in m)
 
 
 def test_cli_graph_engine_trains_and_evals(tmp_path):
@@ -58,6 +153,18 @@ def test_cli_graph_engine_trains_and_evals(tmp_path):
              (tmp_path / "m.jsonl").read_text().strip().splitlines()]
     assert lines[-1]["loss"] < lines[0]["loss"]
     assert any(k.startswith("eval_") for k in metrics)
+
+
+def test_cli_graph_engine_gpt2(tmp_path):
+    """Config 3 through the Graph IR engine: the IR-authored transformer +
+    AdamW update graphs train from the CLI and the loss drops."""
+    metrics = _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+                    "--engine", "graph", "--steps", "30",
+                    "--batch-size", "8", "--log-every", "10",
+                    "--metrics-file", str(tmp_path / "m.jsonl")])
+    lines = [json.loads(l) for l in
+             (tmp_path / "m.jsonl").read_text().strip().splitlines()]
+    assert lines[-1]["loss"] < lines[0]["loss"]
 
 
 def test_cli_degrade_warning_is_loud(monkeypatch, capsys):
@@ -103,11 +210,13 @@ def test_cli_trains_rn50_from_image_records(devices8, tmp_path):
 def test_cli_zero1_sharded_checkpoint_resume(devices8, tmp_path):
     """ZeRO-1 CLI runs checkpoint in the per-shard format and resume from it."""
     ck = str(tmp_path / "ck")
-    _run(["--config", "bert_base_zero1", "--steps", "2", "--batch-size", "8",
+    _run(["--config", "bert_base_zero1", "--model-preset", "tiny",
+          "--steps", "2", "--batch-size", "8",
           "--ckpt-dir", ck, "--log-every", "1"])
     import pathlib
     assert list(pathlib.Path(ck).glob("step_*.sharded"))
-    m = _run(["--config", "bert_base_zero1", "--steps", "1",
+    m = _run(["--config", "bert_base_zero1", "--model-preset", "tiny",
+              "--steps", "1",
               "--batch-size", "8", "--ckpt-dir", ck, "--log-every", "1"])
     assert m["step"] == 3  # resumed at 2, trained 1 more
 

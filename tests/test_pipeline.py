@@ -88,6 +88,16 @@ def test_pipeline_train_step_matches_single(devices8):
                                    rtol=5e-4, atol=5e-4, err_msg=str(ka))
 
 
+def test_pipeline_spec_rejects_dropout():
+    """The pipelined region is deterministic: a dropout>0 config must be
+    refused loudly, not silently trained without dropout."""
+    import pytest
+    model = GPT2(GPT2Config(vocab_size=64, max_positions=16, num_layers=2,
+                            num_heads=2, hidden_size=32, dropout=0.1))
+    with pytest.raises(ValueError, match="dropout=0"):
+        pp.gpt2_pipeline_spec(model)
+
+
 def test_pipeline_bubble_independent_of_microbatches(devices8):
     """Loss is identical for any microbatch count (schedule-invariant)."""
     model = _tiny_gpt2(num_layers=2)

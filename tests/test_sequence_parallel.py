@@ -1,0 +1,125 @@
+"""Sequence-parallel TRAINING correctness (VERDICT r2 #2): ring/Ulysses
+attention gradients vs the dense reference, and the full dp x sp train step
+vs single-device training. Forward-only parity lives in test_parallel.py."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from nezha_tpu import data, ops, optim, parallel
+from nezha_tpu.models.gpt2 import GPT2, GPT2Config
+from nezha_tpu.parallel._compat import shard_map
+from nezha_tpu.parallel.ring import ring_attention
+from nezha_tpu.parallel.sequence_parallel import (
+    make_sp_train_step,
+    shard_lm_batch,
+    ulysses_attention,
+)
+from nezha_tpu.train.loop import init_train_state, make_train_step
+
+
+def _qkv(b=2, h=4, s=64, d=16, seed=0):
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return (jax.random.normal(kq, (b, h, s, d)),
+            jax.random.normal(kk, (b, h, s, d)),
+            jax.random.normal(kv, (b, h, s, d)))
+
+
+def _grad_parity(sp_attn_fn, causal, seed=0, h=4):
+    """grad of a weighted-sum loss through the sharded attention must match
+    the dense single-device attention's grad."""
+    mesh = parallel.make_mesh({"sp": 8})
+    q, k, v = _qkv(seed=seed, h=h)
+    w = jax.random.normal(jax.random.PRNGKey(9), q.shape)
+
+    mapped = shard_map(sp_attn_fn, mesh=mesh,
+                       in_specs=(P(None, None, "sp", None),) * 3,
+                       out_specs=P(None, None, "sp", None))
+
+    def sp_loss(q, k, v):
+        return jnp.sum(mapped(q, k, v) * w)
+
+    def ref_loss(q, k, v):
+        mask = ops.causal_mask(q.shape[2], q.shape[2]) if causal else None
+        return jnp.sum(ops.dot_product_attention(q, k, v, mask=mask) * w)
+
+    g_sp = jax.jit(jax.grad(sp_loss, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.jit(jax.grad(ref_loss, argnums=(0, 1, 2)))(q, k, v)
+    for a, b, name in zip(g_sp, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_ring_attention_grad_matches_full(devices8):
+    """Backward through the ring (incl. the causal block-skip lax.cond,
+    whose transpose nothing else exercises) vs dense attention."""
+    for causal in (True, False):
+        _grad_parity(
+            partial(ring_attention, axis_name="sp", causal=causal), causal)
+
+
+def test_ulysses_attention_grad_matches_full(devices8):
+    _grad_parity(
+        partial(ulysses_attention, axis_name="sp", causal=True),
+        causal=True, h=8)
+
+
+def _tiny_gpt2(attn_impl="xla"):
+    return GPT2(GPT2Config(vocab_size=128, max_positions=64, num_layers=2,
+                           num_heads=4, hidden_size=32, attn_impl=attn_impl))
+
+
+def _sp_vs_single(attn_impl, mesh_axes):
+    """Run 3 identical steps single-device and sequence-parallel; params and
+    losses must match."""
+    mesh = parallel.make_mesh(mesh_axes)
+    opt = optim.adamw(1e-3)
+    rng = jax.random.PRNGKey(0)
+
+    ref_model = _tiny_gpt2("xla")
+    ref_state = init_train_state(ref_model, opt, rng)
+    from nezha_tpu.models.gpt2 import lm_loss
+    ref_step = make_train_step(ref_model, opt, lm_loss, donate=False)
+
+    sp_model = _tiny_gpt2(attn_impl)
+    sp_state = parallel.replicate(
+        mesh, jax.tree_util.tree_map(jnp.copy, ref_state))
+    sp_step = make_sp_train_step(sp_model, opt, mesh, donate=False)
+
+    batches = data.synthetic_token_batches(8, seq_len=32, vocab_size=128)
+    for _ in range(3):
+        batch = next(batches)
+        ref_state, ref_m = ref_step(ref_state, batch)
+        sp_state, sp_m = sp_step(sp_state, shard_lm_batch(mesh, batch))
+        np.testing.assert_allclose(float(sp_m["loss"]), float(ref_m["loss"]),
+                                   rtol=1e-4, atol=1e-5)
+
+    for (ka, a), (kb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(
+                ref_state["variables"]["params"]),
+            jax.tree_util.tree_leaves_with_path(
+                sp_state["variables"]["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5,
+                                   err_msg=jax.tree_util.keystr(ka))
+
+
+def test_sp_train_step_ring_matches_single(devices8):
+    """The dp x sp ring-attention training step (gradients and all) tracks
+    single-device training step-for-step."""
+    _sp_vs_single("ring", {"dp": 2, "sp": 4})
+
+
+def test_sp_train_step_ulysses_matches_single(devices8):
+    _sp_vs_single("ulysses", {"dp": 2, "sp": 4})
+
+
+def test_shard_lm_batch_rejects_ragged(devices8):
+    mesh = parallel.make_mesh({"dp": 2, "sp": 4})
+    import pytest
+    with pytest.raises(ValueError, match="not divisible"):
+        shard_lm_batch(mesh, {"tokens": np.zeros((4, 31), np.int32)})
