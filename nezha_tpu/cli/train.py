@@ -79,17 +79,18 @@ def _configs() -> Dict[str, Config]:
     # Tiny presets run the same code paths at seconds scale (fp32 for the
     # transformers so mode-vs-mode numerics tests have tight tolerances).
     def tiny_gpt2(**overrides):
-        return models.GPT2(models.GPT2Config(
-            vocab_size=512, max_positions=96, num_layers=4, num_heads=4,
-            hidden_size=64, **overrides))
+        kw = dict(vocab_size=512, max_positions=96, num_layers=4,
+                  num_heads=4, hidden_size=64)
+        kw.update(overrides)
+        return models.GPT2(models.GPT2Config(**kw))
 
     def tiny_bert():
         return models.Bert(bert_mod.BertConfig(
             vocab_size=512, max_positions=96, num_layers=2, num_heads=4,
             hidden_size=64))
 
-    tiny_tokens = lambda bs, **kw: data.synthetic_token_batches(
-        bs, seq_len=64, vocab_size=512, **kw)
+    tiny_tokens = lambda bs, seq_len=64, **kw: data.synthetic_token_batches(
+        bs, seq_len=seq_len, vocab_size=512, **kw)
     tiny_images = lambda bs: data.synthetic_image_batches(
         bs, image_size=32, num_classes=100)
 
@@ -125,24 +126,29 @@ def _configs() -> Dict[str, Config]:
         "gpt2_124m": Config(
             # fused_loss_chunk=-1: CE never materializes fp32 [B,S,V]
             # logits (see GPT2Config) — the training-CLI default.
-            build_model=lambda: models.gpt2_124m(fused_loss_chunk=-1),
+            build_model=lambda **ov: models.gpt2_124m(fused_loss_chunk=-1,
+                                                      **ov),
             loss_fn=gpt2_mod.lm_loss,
-            batches=lambda bs: data.synthetic_token_batches(bs, seq_len=1024),
+            batches=lambda bs, seq_len=1024: data.synthetic_token_batches(
+                bs, seq_len=seq_len),
             build_optimizer=lambda steps: optim.adamw(
                 gpt2_sched(steps), weight_decay=0.1),
             default_batch=8,
             parallel_mode="dp",
-            eval_batches=lambda bs: itertools.islice(
-                data.synthetic_token_batches(bs, seq_len=1024, seed=1), 8),
+            eval_batches=lambda bs, seq_len=1024: itertools.islice(
+                data.synthetic_token_batches(bs, seq_len=seq_len, seed=1),
+                8),
             eval_stat=eval_mod.lm_token_stats,
             tiny={"build_model": tiny_gpt2,
                   "batches": tiny_tokens,
-                  "eval_batches": lambda bs: itertools.islice(
-                      tiny_tokens(bs, seed=1), 4),
-                  "sp_model": lambda impl: tiny_gpt2(attn_impl=impl)},
+                  "eval_batches": lambda bs, seq_len=64: itertools.islice(
+                      tiny_tokens(bs, seed=1, seq_len=seq_len), 4),
+                  "sp_model": lambda impl, **ov: tiny_gpt2(attn_impl=impl,
+                                                           **ov)},
             tp_rules=GPT2_TP_RULES,
             pipeline_spec=pp_mod.gpt2_pipeline_spec,
-            sp_model=lambda impl: models.gpt2_124m(attn_impl=impl),
+            sp_model=lambda impl, **ov: models.gpt2_124m(attn_impl=impl,
+                                                         **ov),
             graph_opt={"schedule": gpt2_sched, "weight_decay": 0.1}),
         "bert_base_zero1": Config(
             build_model=lambda: models.bert_base(),
@@ -229,7 +235,7 @@ def _data_source(args, cfg, batch_size: int):
                                 ("train.tokens.i32", np.int32)):
                 tok = os.path.join(args.data_dir, name)
                 if os.path.exists(tok):
-                    loader = TokenLoader(tok, seq_len=1024,
+                    loader = TokenLoader(tok, seq_len=args.seq_len or 1024,
                                          batch_size=batch_size, dtype=dtype,
                                          seed=args.seed)
                     print(f"data: {loader.num_tokens} tokens from {tok}",
@@ -296,6 +302,44 @@ def run(args) -> Dict[str, float]:
         for field, value in cfg.tiny.items():
             setattr(cfg, field, value)
     batch_size = args.batch_size or cfg.default_batch
+
+    if args.moe_experts:
+        # Mixture-of-experts GPT-2: every other block's MLP becomes a
+        # top-k routed expert layer; lm_loss adds the load-balance aux.
+        if args.config != "gpt2_124m":
+            raise SystemExit("--moe-experts applies to gpt2_124m")
+        if args.engine == "graph":
+            raise SystemExit("--moe-experts is not expressible in the "
+                             "graph engine's GPT-2 program; drop --engine "
+                             "graph")
+        if args.parallel == "pp":
+            raise SystemExit("--moe-experts cannot pipeline (MoE blocks "
+                             "make the stage slabs heterogeneous); use "
+                             "--parallel dp/zero1/sp, or gspmd with ep "
+                             "rules at the library level")
+        moe_build = cfg.build_model
+        cfg.build_model = lambda **ov: moe_build(
+            moe_experts=args.moe_experts, **ov)
+        if cfg.sp_model is not None:
+            moe_sp = cfg.sp_model
+            cfg.sp_model = lambda impl, **ov: moe_sp(
+                impl, moe_experts=args.moe_experts, **ov)
+
+    if args.seq_len:
+        # Long-context override: resize position table + data together.
+        # With --parallel sp the sequence shards over the sp axis, so
+        # per-chip activation memory stays O(seq_len / sp).
+        if args.config != "gpt2_124m":
+            raise SystemExit("--seq-len applies to gpt2_124m")
+        sl = args.seq_len
+        build0, sp0, batches0 = cfg.build_model, cfg.sp_model, cfg.batches
+        eval0 = cfg.eval_batches
+        cfg.build_model = lambda: build0(max_positions=sl)
+        if sp0 is not None:
+            cfg.sp_model = lambda impl: sp0(impl, max_positions=sl)
+        cfg.batches = lambda bs: batches0(bs, seq_len=sl)
+        if eval0 is not None:
+            cfg.eval_batches = lambda bs: eval0(bs, seq_len=sl)
 
     # --- graph-IR engine (north star: Graph -> StableHLO -> Executor) -----
     # Resolved before any parallel-mode/mesh logic: the engine is single-
@@ -619,6 +663,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pipeline microbatches per step (--parallel pp)")
     p.add_argument("--attn-impl", default="ring", choices=["ring", "ulysses"],
                    help="sequence-parallel attention (--parallel sp)")
+    p.add_argument("--seq-len", type=int, default=None,
+                   help="long-context override for gpt2_124m: sequence "
+                        "length for model + data (shard it with "
+                        "--parallel sp --mesh dp=X,sp=Y)")
+    p.add_argument("--moe-experts", type=int, default=None,
+                   help="gpt2_124m only: swap every other block's MLP for "
+                        "a top-k routed mixture of this many experts")
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. cpu)")
     p.add_argument("--seed", type=int, default=0)

@@ -47,6 +47,14 @@ class GPT2Config:
     # measured) but peak logit memory drops S/chunk-fold in BOTH dtypes;
     # for very long context / big batch where even bf16 logits blow HBM.
     fused_loss_chunk: int = 0
+    # Mixture-of-experts: >0 swaps every `moe_every`-th block's MLP for a
+    # top-k routed expert layer (`parallel.expert.MoE`, dense-dispatch,
+    # EP-shardable over an "ep" mesh axis). apply() then returns a dict
+    # carrying the weighted load-balance aux loss, which `lm_loss` adds.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_every: int = 2  # blocks 1, 3, 5, ... are MoE when moe_every=2
+    moe_aux_weight: float = 0.01
 
 
 class Attention(Module):
@@ -152,12 +160,19 @@ class MLPBlock(Module):
 
 
 class Block(Module):
-    def __init__(self, cfg: GPT2Config, policy: Policy):
+    def __init__(self, cfg: GPT2Config, policy: Policy, use_moe: bool = False):
         h = cfg.hidden_size
         self.ln_1 = nn.LayerNorm(h, policy=policy)
         self.attn = Attention(cfg, policy)
         self.ln_2 = nn.LayerNorm(h, policy=policy)
-        self.mlp = MLPBlock(cfg, policy)
+        if use_moe:
+            from nezha_tpu.parallel.expert import MoE, MoEConfig
+            self.mlp = MoE(MoEConfig(
+                d_model=h, d_ff=h * cfg.mlp_ratio,
+                num_experts=cfg.moe_experts, top_k=cfg.moe_top_k),
+                policy=policy)
+        else:
+            self.mlp = MLPBlock(cfg, policy)
 
     def apply(self, variables: Variables, x, training: bool = False, rng=None,
               cache=None, pos=None):
@@ -188,7 +203,10 @@ class GPT2(Module):
                                 embedding_init=init_lib.normal(0.01),
                                 policy=policy)
         self.drop = nn.Dropout(cfg.dropout)
-        self.h = [Block(cfg, policy) for _ in range(cfg.num_layers)]
+        self.h = [Block(cfg, policy,
+                        use_moe=bool(cfg.moe_experts)
+                        and i % cfg.moe_every == cfg.moe_every - 1)
+                  for i in range(cfg.num_layers)]
         self.ln_f = nn.LayerNorm(cfg.hidden_size, policy=policy)
 
     def apply(self, variables: Variables, batch, training: bool = False,
@@ -221,16 +239,43 @@ class GPT2(Module):
                           cache=None if cache is None else cache[i], pos=pos)
         x = run_child(self.ln_f, "ln_f", variables, states, x,
                       training=training)
+        # MoE blocks report their load-balance losses through child state;
+        # harvest them OUT of the state tree (they're per-forward values,
+        # not running state — leaving them in would change the TrainState
+        # pytree structure between steps) and surface the weighted sum so
+        # lm_loss can add it to the objective.
+        aux = None
+        if self.cfg.moe_experts and cache is None:
+            terms = []
+            for i in range(self.cfg.num_layers):
+                blk = states.get(f"h{i}")
+                if blk and "aux_loss" in blk.get("mlp", {}):
+                    mlp_state = dict(blk["mlp"])
+                    terms.append(mlp_state.pop("aux_loss"))
+                    if mlp_state:
+                        blk["mlp"] = mlp_state
+                    else:
+                        del blk["mlp"]
+                    if not blk:
+                        del states[f"h{i}"]
+            if terms:
+                aux = self.cfg.moe_aux_weight * sum(terms)
         if self.cfg.fused_loss_chunk and cache is None:
             # Defer the LM head to the loss: hand back the final hidden
             # states + the tied table so chunked_lm_cross_entropy computes
             # logits blockwise (grads flow to wte through this dict; "chunk"
             # is a static python int — it never crosses a jit boundary).
             wte = child_vars(variables, "wte")["params"]["embedding"]
-            return {"hidden": x, "wte": wte,
-                    "chunk": self.cfg.fused_loss_chunk}, states
+            out = {"hidden": x, "wte": wte,
+                   "chunk": self.cfg.fused_loss_chunk}
+            if aux is not None:
+                out["aux_loss"] = aux
+            return out, states
         logits = self.wte.attend(child_vars(variables, "wte"), x)
-        return jnp.asarray(logits, jnp.float32), states
+        logits = jnp.asarray(logits, jnp.float32)
+        if aux is not None:
+            return {"logits": logits, "aux_loss": aux}, states
+        return logits, states
 
 
 def gpt2_124m(policy: Policy | None = None, **overrides) -> GPT2:
@@ -244,7 +289,5 @@ def lm_loss(out, batch):
     ``out`` is either dense logits or the fused-head dict (see
     ``GPT2Config.fused_loss_chunk``)."""
     targets = batch["tokens"][:, 1:]
-    if isinstance(out, dict):
-        from nezha_tpu.ops.losses import lm_ce_from_fused
-        return lm_ce_from_fused(out, targets)
-    return ops.softmax_cross_entropy_with_integer_labels(out, targets)
+    from nezha_tpu.ops.losses import lm_objective
+    return lm_objective(out, targets)

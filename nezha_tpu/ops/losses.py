@@ -119,6 +119,23 @@ def lm_ce_from_fused(out: dict, targets, ignore_index: int | None = None):
                                     ignore_index=ignore_index)
 
 
+def lm_objective(out, targets, ignore_index: int | None = None):
+    """Next-token CE for ANY GPT-2 ``apply()`` output shape: dense logits,
+    the MoE {"logits", "aux_loss"} dict, or the fused-head dict (with or
+    without "aux_loss"). Pre-weighted MoE load-balance aux is added when
+    present. The single objective used by ``models.gpt2.lm_loss`` and the
+    sequence-parallel train step's default loss."""
+    if isinstance(out, dict):
+        aux = out.get("aux_loss", 0.0)
+        if "logits" in out:
+            return softmax_cross_entropy_with_integer_labels(
+                out["logits"], targets, ignore_index=ignore_index) + aux
+        return lm_ce_from_fused(out, targets,
+                                ignore_index=ignore_index) + aux
+    return softmax_cross_entropy_with_integer_labels(
+        out, targets, ignore_index=ignore_index)
+
+
 def mse_loss(pred, target):
     pred = jnp.asarray(pred, jnp.float32)
     target = jnp.asarray(target, jnp.float32)

@@ -97,14 +97,14 @@ def make_sp_train_step(model, optimizer, mesh: Mesh,
     the same number of tokens, so the global mean loss is the pmean of
     shard means and gradients pmean over both axes.
     """
-    from nezha_tpu.ops.losses import (
-        softmax_cross_entropy_with_integer_labels)
+    from nezha_tpu.ops.losses import lm_objective
     from nezha_tpu.optim.optimizers import apply_updates
     from nezha_tpu.parallel._compat import shard_map
     from nezha_tpu.train.loop import merge_state
 
     if loss_fn is None:
-        loss_fn = softmax_cross_entropy_with_integer_labels
+        # Handles dense logits AND the fused/MoE dict outputs.
+        loss_fn = lm_objective
     axes = (dp_axis, sp_axis)
 
     def per_shard(state, batch):

@@ -31,10 +31,13 @@ def lm_token_stats(out, batch) -> Dict[str, jax.Array]:
     ``GPT2Config.fused_loss_chunk``)."""
     targets = batch["tokens"][:, 1:]
     if isinstance(out, dict):
-        from nezha_tpu.ops.losses import lm_ce_from_fused
-        mean_nll = lm_ce_from_fused(out, targets)
-        return {"nll_sum": mean_nll * targets.size,
-                "count": jnp.asarray(targets.size)}
+        if "logits" in out:  # MoE logits dict: NLL only, no aux in eval
+            out = out["logits"]
+        else:
+            from nezha_tpu.ops.losses import lm_ce_from_fused
+            mean_nll = lm_ce_from_fused(out, targets)
+            return {"nll_sum": mean_nll * targets.size,
+                    "count": jnp.asarray(targets.size)}
     logp = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
     return {"nll_sum": nll.sum(), "count": jnp.asarray(targets.size)}

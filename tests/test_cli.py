@@ -114,6 +114,25 @@ def test_cli_sp_matches_single(devices8):
     np.testing.assert_allclose(sp, ref, rtol=1e-3)
 
 
+def test_cli_moe_gpt2(devices8):
+    """--moe-experts turns config 3 into a routed-MoE transformer and
+    trains it data-parallel through the CLI."""
+    m = _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--moe-experts", "4", "--parallel", "dp", "--mesh", "dp=8",
+              "--steps", "3", "--batch-size", "16", "--log-every", "1"])
+    assert np.isfinite(m["loss"])
+
+
+def test_cli_sp_long_context(devices8):
+    """--seq-len stretches model + data together; with --parallel sp the
+    sequence shards over sp, the long-context path of the brief."""
+    m = _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--parallel", "sp", "--mesh", "dp=1,sp=8", "--seq-len", "256",
+              "--attn-impl", "ring", "--steps", "2", "--batch-size", "4",
+              "--log-every", "1"])
+    assert np.isfinite(m["loss"])
+
+
 def test_cli_gspmd_sharded_checkpoint_resume(devices8, tmp_path):
     """GSPMD CLI checkpoints in the per-shard format and resumes from it."""
     ck = str(tmp_path / "ck")
