@@ -11,6 +11,10 @@ from nezha_tpu.ops.activations import relu, gelu, silu, softmax, log_softmax
 from nezha_tpu.ops.losses import (
     cross_entropy_with_logits,
     softmax_cross_entropy_with_integer_labels,
+    chunked_lm_cross_entropy,
+    lm_cross_entropy_from_hidden,
+    lm_ce_from_fused,
+    lm_objective,
     mse_loss,
     accuracy,
 )
@@ -23,6 +27,8 @@ from nezha_tpu.ops.attention import (
 __all__ = [
     "relu", "gelu", "silu", "softmax", "log_softmax",
     "cross_entropy_with_logits", "softmax_cross_entropy_with_integer_labels",
+    "chunked_lm_cross_entropy", "lm_cross_entropy_from_hidden",
+    "lm_ce_from_fused", "lm_objective",
     "mse_loss", "accuracy",
     "dot_product_attention", "causal_mask", "make_attention_mask",
 ]

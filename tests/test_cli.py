@@ -123,6 +123,16 @@ def test_cli_moe_gpt2(devices8):
     assert np.isfinite(m["loss"])
 
 
+def test_cli_sp_ulysses(devices8):
+    """--attn-impl ulysses: the all-to-all sequence-parallel path from the
+    CLI (heads 4 divisible by sp=4)."""
+    m = _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--parallel", "sp", "--mesh", "dp=2,sp=4",
+              "--attn-impl", "ulysses", "--steps", "2", "--batch-size", "8",
+              "--log-every", "1"])
+    assert np.isfinite(m["loss"])
+
+
 def test_cli_sp_long_context(devices8):
     """--seq-len stretches model + data together; with --parallel sp the
     sequence shards over sp, the long-context path of the brief."""
