@@ -152,8 +152,9 @@ def test_cli_gspmd_sharded_checkpoint_resume(devices8, tmp_path):
     _run(base + ["--steps", "2"])
     import pathlib
     assert list(pathlib.Path(ck).glob("step_*.sharded"))
-    m = _run(base + ["--steps", "1"])
+    m = _run(base + ["--steps", "1", "--eval", "--eval-batches", "2"])
     assert m["step"] == 3  # resumed at 2, trained 1 more
+    assert any(k.startswith("eval_") for k in m)  # eval over sharded params
 
 
 def test_cli_pp_sharded_checkpoint_resume_and_eval(devices8, tmp_path):
