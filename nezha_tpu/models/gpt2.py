@@ -55,6 +55,8 @@ class GPT2Config:
     moe_top_k: int = 2
     moe_every: int = 2  # blocks 1, 3, 5, ... are MoE when moe_every=2
     moe_aux_weight: float = 0.01
+    # "pallas" opts layer norms into the fused kernel (fwd + bwd) on TPU.
+    ln_impl: str = "xla"
 
 
 class Attention(Module):
@@ -162,9 +164,9 @@ class MLPBlock(Module):
 class Block(Module):
     def __init__(self, cfg: GPT2Config, policy: Policy, use_moe: bool = False):
         h = cfg.hidden_size
-        self.ln_1 = nn.LayerNorm(h, policy=policy)
+        self.ln_1 = nn.LayerNorm(h, policy=policy, impl=cfg.ln_impl)
         self.attn = Attention(cfg, policy)
-        self.ln_2 = nn.LayerNorm(h, policy=policy)
+        self.ln_2 = nn.LayerNorm(h, policy=policy, impl=cfg.ln_impl)
         if use_moe:
             from nezha_tpu.parallel.expert import MoE, MoEConfig
             self.mlp = MoE(MoEConfig(
@@ -207,7 +209,8 @@ class GPT2(Module):
                         use_moe=bool(cfg.moe_experts)
                         and i % cfg.moe_every == cfg.moe_every - 1)
                   for i in range(cfg.num_layers)]
-        self.ln_f = nn.LayerNorm(cfg.hidden_size, policy=policy)
+        self.ln_f = nn.LayerNorm(cfg.hidden_size, policy=policy,
+                          impl=cfg.ln_impl)
 
     def apply(self, variables: Variables, batch, training: bool = False,
               rng=None, cache=None, pos=None):

@@ -160,15 +160,27 @@ class BatchNorm(Module):
 
 
 class LayerNorm(Module):
-    """Layer norm over the last axis; statistics in fp32."""
+    """Layer norm over the last axis; statistics in fp32.
+
+    ``impl="pallas"`` opts into the fused Pallas kernel (fwd + custom-VJP
+    bwd, `ops.pallas.fused_layer_norm`) on TPU backends; requires both
+    scale and bias. Falls back to the XLA composition under the GSPMD
+    auto-partitioner (Mosaic calls cannot be auto-partitioned) and on
+    non-TPU backends."""
 
     def __init__(self, dim: int, eps: float = 1e-5, use_bias: bool = True,
-                 use_scale: bool = True, policy: Policy = DEFAULT_POLICY):
+                 use_scale: bool = True, policy: Policy = DEFAULT_POLICY,
+                 impl: str = "xla"):
         self.dim = dim
         self.eps = eps
         self.use_bias = use_bias
         self.use_scale = use_scale
         self.policy = policy
+        if impl not in ("xla", "pallas"):
+            raise ValueError(f"unknown LayerNorm impl {impl!r}")
+        if impl == "pallas" and not (use_bias and use_scale):
+            raise ValueError("impl='pallas' needs use_scale and use_bias")
+        self.impl = impl
 
     def init(self, rng: jax.Array) -> Variables:
         del rng
@@ -182,6 +194,15 @@ class LayerNorm(Module):
     def apply(self, variables: Variables, x, training: bool = False, rng=None):
         del training, rng
         p = variables["params"]
+        if self.impl == "pallas" and jax.default_backend() == "tpu":
+            from nezha_tpu.parallel.gspmd import under_auto_partitioner
+            if not under_auto_partitioner():
+                from nezha_tpu.ops.pallas import fused_layer_norm
+                y = fused_layer_norm(
+                    self.policy.cast_to_compute(x),
+                    jnp.asarray(p["scale"], jnp.float32),
+                    jnp.asarray(p["bias"], jnp.float32), eps=self.eps)
+                return self.policy.cast_output(y), {}
         xf = jnp.asarray(x, jnp.float32)
         mean = jnp.mean(xf, axis=-1, keepdims=True)
         var = jnp.var(xf, axis=-1, keepdims=True)

@@ -137,3 +137,47 @@ def test_flash_bwd_bf16_grads_match_reference():
         np.testing.assert_allclose(np.asarray(a, np.float32),
                                    np.asarray(b, np.float32),
                                    atol=0.15, rtol=0.1)  # bf16 grain
+
+
+def test_fused_layer_norm_grads_match_xla():
+    """The fused backward kernel's dx/dscale/dbias vs autodiff through the
+    composed XLA layer norm."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(6, 128, 64), jnp.float32)
+    scale = jnp.asarray(rng.rand(64) + 0.5, jnp.float32)
+    bias = jnp.asarray(rng.randn(64), jnp.float32)
+    w = jnp.asarray(rng.randn(*x.shape), jnp.float32)
+
+    def ref_ln(x, scale, bias):
+        mu = x.mean(-1, keepdims=True)
+        var = ((x - mu) ** 2).mean(-1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + 1e-5) * scale + bias
+
+    def loss(fn):
+        return lambda x, s, b: jnp.sum(fn(x, s, b) * w)
+
+    g_fused = jax.grad(loss(lambda x, s, b: fused_layer_norm(x, s, b)),
+                       argnums=(0, 1, 2))(x, scale, bias)
+    g_ref = jax.grad(loss(ref_ln), argnums=(0, 1, 2))(x, scale, bias)
+    for a, b, name in zip(g_fused, g_ref, ("dx", "dscale", "dbias")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_fused_layer_norm_bf16_grads_finite():
+    x = jnp.asarray(np.random.RandomState(1).randn(4, 64, 32), jnp.bfloat16)
+    scale = jnp.ones((32,), jnp.float32)
+    bias = jnp.zeros((32,), jnp.float32)
+    g = jax.grad(lambda x: jnp.sum(
+        fused_layer_norm(x, scale, bias).astype(jnp.float32) ** 2))(x)
+    assert np.isfinite(np.asarray(g, np.float32)).all()
+
+
+def test_fused_layer_norm_mixed_param_dtypes_grad():
+    """scale f32 + bias bf16: cotangent dtypes must match each primal."""
+    x = jnp.asarray(np.random.RandomState(2).randn(4, 32, 16), jnp.float32)
+    scale = jnp.ones((16,), jnp.float32)
+    bias = jnp.zeros((16,), jnp.bfloat16)
+    g = jax.grad(lambda s, b: jnp.sum(fused_layer_norm(x, s, b)),
+                 argnums=(0, 1))(scale, bias)
+    assert g[0].dtype == jnp.float32 and g[1].dtype == jnp.bfloat16
