@@ -346,9 +346,11 @@ def run(args) -> Dict[str, float]:
     # device by design, so it must neither trip the multi-device degrade
     # warning nor build a mesh it will never use.
     if args.engine == "graph":
-        if args.config not in ("mlp_mnist", "gpt2_124m"):
-            raise SystemExit("--engine graph supports mlp_mnist and "
-                             "gpt2_124m (benchmark configs 1 and 3)")
+        if args.config not in ("mlp_mnist", "gpt2_124m",
+                               "resnet50_imagenet"):
+            raise SystemExit("--engine graph supports mlp_mnist, "
+                             "resnet50_imagenet, and gpt2_124m "
+                             "(benchmark configs 1-3)")
         if args.mesh or args.parallel != "config":
             raise SystemExit("--engine graph runs single-device; drop "
                              "--mesh/--parallel (the Graph IR executor does "
@@ -366,6 +368,14 @@ def run(args) -> Dict[str, float]:
             step_fn = programs.make_mlp_graph_train_step(dims, batch_size,
                                                          lr=0.1)
             shard = programs.onehot_shard_fn(dims[-1])
+        elif args.config == "resnet50_imagenet":
+            if args.eval:
+                raise SystemExit("graph-engine ResNet runs training-mode "
+                                 "batch stats only (no running BN stats); "
+                                 "drop --eval")
+            state = programs.init_graph_resnet_state(model, rng)
+            step_fn = programs.make_resnet_graph_train_step(model, lr=0.1)
+            shard = programs.image_shard_fn()
         else:  # gpt2_124m: the transformer authored in the IR
             state = programs.init_graph_gpt2_state(model, rng)
             sched = cfg.graph_opt["schedule"](args.steps)

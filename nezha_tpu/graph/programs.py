@@ -294,6 +294,138 @@ def lm_shard_fn():
     return shard
 
 
+# ---------------------------------------------------------------------------
+# ResNet authored in the IR (benchmark config 2 through --engine graph):
+# conv2d/batchnorm/max_pool2d/relu/mean IR ops compose the bottleneck
+# topology of models.resnet.ResNet; training-mode batch statistics only
+# (running stats for eval are the module engine's concern).
+
+
+def resnet_loss_graph(stage_sizes: Sequence[int], param_template,
+                      batch: int, size: int) -> Graph:
+    """IR graph: (*flat_params, image[B,H,W,3], labels[B] i32) -> loss.
+
+    Mirrors ``models.resnet.ResNet.apply`` in training mode (batch-stat
+    batchnorm). ``flat_params`` follows tree_flatten order of the module's
+    param tree.
+    """
+    g = Graph("resnet_loss")
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(
+        param_template)
+    syms = [g.placeholder(np.shape(leaf), str(np.asarray(leaf).dtype),
+                          name=jax.tree_util.keystr(path))
+            for path, leaf in leaves_with_path]
+    p = jax.tree_util.tree_unflatten(treedef, syms)
+    image = g.placeholder((batch, size, size, 3), name="image")
+    labels = g.placeholder((batch,), "int32", name="labels")
+
+    def conv(prm, x, stride):
+        return g.conv2d(x, prm["w"], stride=(stride, stride), padding="SAME")
+
+    def bn(prm, x):
+        return g.batchnorm(x, prm["scale"], prm["bias"])
+
+    x = g.relu(bn(p["stem_bn"], conv(p["stem_conv"], image, 2)))
+    x = g.max_pool2d(x, 3, 2, "SAME")
+
+    # Same block/channel bookkeeping as ResNet.__init__.
+    in_ch, idx = 64, 0
+    for stage, n_blocks in enumerate(stage_sizes):
+        base = 64 * (2 ** stage)
+        out_ch = base * 4
+        for b in range(n_blocks):
+            stride = 2 if (stage > 0 and b == 0) else 1
+            blk = p[f"blocks{idx}"]
+            y = g.relu(bn(blk["bn1"], conv(blk["conv1"], x, 1)))
+            y = g.relu(bn(blk["bn2"], conv(blk["conv2"], y, stride)))
+            y = bn(blk["bn3"], conv(blk["conv3"], y, 1))
+            if (in_ch != out_ch) or (stride != 1):
+                sc = bn(blk["proj_bn"], conv(blk["proj"], x, stride))
+            else:
+                sc = x
+            x = g.relu(y + sc)
+            in_ch = out_ch
+            idx += 1
+
+    x = g.mean(x, axis=(1, 2))                       # global average pool
+    logits = (x @ p["head"]["w"]) + p["head"]["b"]
+    logp = g.log_softmax(logits, axis=-1)
+    nll = -g.mean(g.take_along(logp, labels, axis=1))
+    g.output(nll)
+    return g
+
+
+def init_graph_resnet_state(model, rng) -> dict:
+    """Graph-engine ResNet state, initialized identically to the module
+    (including the zero-init of each block's last BN scale)."""
+    params = model.init(rng)["params"]
+    vel = jax.tree_util.tree_map(
+        lambda x: np.zeros(np.shape(x), np.asarray(x).dtype), params)
+    return {"params": params, "vel": vel}
+
+
+def make_resnet_graph_train_step(model, lr: float, beta: float = 0.9,
+                                 executor: Executor = None):
+    """Trainer-compatible step over ``init_graph_resnet_state`` state;
+    batches are {"image": [B,H,W,3] f32, "labels": [B] i32} (see
+    :func:`image_shard_fn`). SGD-momentum update graphs, one per shape."""
+    executor = executor or Executor()
+    _built: Dict[Tuple[int, int], dict] = {}
+
+    def build(params_template, batch, size):
+        loss_graph = resnet_loss_graph(model.stage_sizes, params_template,
+                                       batch, size)
+        loss_fn = to_callable(loss_graph)
+        n_params = len(jax.tree_util.tree_leaves(params_template))
+        vg = jax.value_and_grad(loss_fn, argnums=tuple(range(n_params)))
+        shapes = {tuple(np.shape(l))
+                  for l in jax.tree_util.tree_leaves(params_template)}
+        upd = {s: to_callable(momentum_update_graph(s, lr, beta))
+               for s in shapes}
+
+        def whole_step(*args):
+            flat = args[:2 * n_params]
+            ps, vs = flat[:n_params], flat[n_params:]
+            image, labels = args[2 * n_params:]
+            loss, grads = vg(*ps, image, labels)
+            new = [upd[tuple(x.shape)](x, v, gr)
+                   for x, v, gr in zip(ps, vs, grads)]
+            new_p, new_v = zip(*new)
+            return (loss, *new_p, *new_v)
+
+        return {"whole_step": whole_step, "n_params": n_params,
+                "loss_graph": loss_graph}
+
+    def step(state, b):
+        batch, size = b["image"].shape[0], b["image"].shape[1]
+        if (batch, size) not in _built:
+            _built[(batch, size)] = build(state["params"], batch, size)
+        so = _built[(batch, size)]
+        n = so["n_params"]
+        flat_p, treedef = jax.tree_util.tree_flatten(state["params"])
+        flat_v = jax.tree_util.tree_leaves(state["vel"])
+        out = executor.run(so["whole_step"], *flat_p, *flat_v,
+                           b["image"], b["labels"])
+        loss, rest = out[0], out[1:]
+        unf = lambda leaves: jax.tree_util.tree_unflatten(treedef, leaves)
+        return ({"params": unf(rest[:n]), "vel": unf(rest[n:])},
+                {"loss": loss})
+
+    step.executor = executor
+    step._built = _built
+    return step
+
+
+def image_shard_fn():
+    """Host-side batch transform for the graph ResNet step."""
+
+    def shard(b):
+        return {"image": np.asarray(b["image"], np.float32),
+                "labels": np.asarray(b["label"], np.int32)}
+
+    return shard
+
+
 def init_graph_mlp_state(dims: Sequence[int], rng) -> dict:
     """Initialize IR-engine state with the SAME values as models.MLP.init
     (so the two engines are numerically comparable)."""

@@ -185,6 +185,27 @@ def test_cli_graph_engine_trains_and_evals(tmp_path):
     assert any(k.startswith("eval_") for k in metrics)
 
 
+def test_cli_graph_engine_resnet(tmp_path):
+    """Config 2 through the Graph IR engine (tiny preset): runs from the
+    CLI with finite loss (descent is asserted on a fixed batch in
+    test_graph.py); --eval is rejected (no running BN stats)."""
+    import pytest
+    _run(["--config", "resnet50_imagenet", "--model-preset",
+                    "tiny", "--engine", "graph", "--steps", "6",
+                    "--batch-size", "8", "--log-every", "2",
+                    "--metrics-file", str(tmp_path / "m.jsonl")])
+    # Rotating random-label batches at fixed lr don't descend this fast;
+    # descent is asserted on a fixed batch in test_graph.py. Here: the IR
+    # program runs through the CLI and stays finite.
+    lines = [json.loads(l) for l in
+             (tmp_path / "m.jsonl").read_text().strip().splitlines()]
+    assert all(np.isfinite(l["loss"]) for l in lines)
+    with pytest.raises(SystemExit, match="running BN stats"):
+        _run(["--config", "resnet50_imagenet", "--model-preset", "tiny",
+              "--engine", "graph", "--steps", "1", "--batch-size", "8",
+              "--eval"])
+
+
 def test_cli_graph_engine_gpt2(tmp_path):
     """Config 3 through the Graph IR engine: the IR-authored transformer +
     AdamW update graphs train from the CLI and the loss drops."""

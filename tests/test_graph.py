@@ -253,3 +253,50 @@ def test_graph_gpt2_trains_and_matches_module_adamw():
         np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
                                    rtol=1e-4, atol=1e-4,
                                    err_msg=jax.tree_util.keystr(ka))
+
+
+def test_graph_resnet_forward_matches_module():
+    """The IR-composed bottleneck ResNet reproduces the module's training-
+    mode loss (configs 2/5 expressible in the IR, VERDICT r2 missing #6)."""
+    import jax as _jax
+
+    from nezha_tpu.models.resnet import ResNet
+    from nezha_tpu.ops import softmax_cross_entropy_with_integer_labels
+
+    model = ResNet((1, 1), num_classes=10)
+    variables = model.init(_jax.random.PRNGKey(0))
+    rng = np.random.RandomState(1)
+    image = rng.rand(2, 32, 32, 3).astype(np.float32)
+    labels = rng.randint(0, 10, 2).astype(np.int32)
+
+    logits, _ = model.apply(variables, {"image": jnp.asarray(image)},
+                            training=True)
+    ref = float(softmax_cross_entropy_with_integer_labels(
+        logits, jnp.asarray(labels)))
+
+    g = programs.resnet_loss_graph((1, 1), variables["params"],
+                                   batch=2, size=32)
+    flat = _jax.tree_util.tree_leaves(variables["params"])
+    got = float(to_callable(g)(*flat, image, labels))
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_graph_resnet_trains():
+    """Full IR train step (IR forward + momentum update graphs): loss
+    descends on a fixed batch."""
+    import jax as _jax
+
+    from nezha_tpu.models.resnet import ResNet
+
+    model = ResNet((1, 1), num_classes=10)
+    state = programs.init_graph_resnet_state(model, _jax.random.PRNGKey(0))
+    step = programs.make_resnet_graph_train_step(model, lr=0.05)
+    shard = programs.image_shard_fn()
+    rng = np.random.RandomState(2)
+    b = shard({"image": rng.rand(8, 32, 32, 3).astype(np.float32),
+               "label": rng.randint(0, 10, 8)})
+    losses = []
+    for _ in range(8):
+        state, m = step(state, b)
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0] * 0.9
