@@ -347,10 +347,11 @@ def run(args) -> Dict[str, float]:
     # warning nor build a mesh it will never use.
     if args.engine == "graph":
         if args.config not in ("mlp_mnist", "gpt2_124m",
-                               "resnet50_imagenet"):
+                               "resnet50_imagenet", "wrn101_large_batch"):
             raise SystemExit("--engine graph supports mlp_mnist, "
-                             "resnet50_imagenet, and gpt2_124m "
-                             "(benchmark configs 1-3)")
+                             "resnet50_imagenet, wrn101_large_batch, and "
+                             "gpt2_124m (benchmark configs 1-3 and 5; "
+                             "BERT-ZeRO-1 is module-engine only)")
         if args.mesh or args.parallel != "config":
             raise SystemExit("--engine graph runs single-device; drop "
                              "--mesh/--parallel (the Graph IR executor does "
@@ -368,7 +369,7 @@ def run(args) -> Dict[str, float]:
             step_fn = programs.make_mlp_graph_train_step(dims, batch_size,
                                                          lr=0.1)
             shard = programs.onehot_shard_fn(dims[-1])
-        elif args.config == "resnet50_imagenet":
+        elif args.config in ("resnet50_imagenet", "wrn101_large_batch"):
             if args.eval:
                 raise SystemExit("graph-engine ResNet runs training-mode "
                                  "batch stats only (no running BN stats); "
