@@ -94,10 +94,12 @@ def _configs() -> Dict[str, Config]:
     tiny_images = lambda bs: data.synthetic_image_batches(
         bs, image_size=32, num_classes=100)
 
-    # One schedule factory for BOTH gpt2 engines (module adamw + graph
-    # AdamW-update programs) — tuning it here tunes them together.
+    # One schedule factory per config for BOTH engines (module adamw +
+    # graph AdamW-update programs) — tuning it here tunes them together.
     gpt2_sched = lambda steps: optim.warmup_cosine_schedule(
         6e-4, 100, max(steps, 200))
+    bert_sched = lambda steps: optim.warmup_cosine_schedule(
+        1e-4, 100, max(steps, 200))
 
     return {
         "mlp_mnist": Config(
@@ -155,14 +157,14 @@ def _configs() -> Dict[str, Config]:
             loss_fn=bert_mod.mlm_loss,
             batches=lambda bs: data.synthetic_mlm_batches(bs, seq_len=512),
             build_optimizer=lambda steps: optim.adamw(
-                optim.warmup_cosine_schedule(1e-4, 100, max(steps, 200)),
-                weight_decay=0.01),
+                bert_sched(steps), weight_decay=0.01),
             default_batch=16,
             parallel_mode="zero1",
             tiny={"build_model": tiny_bert,
                   "batches": lambda bs: data.synthetic_mlm_batches(
                       bs, seq_len=64, vocab_size=512, mask_token=1)},
-            tp_rules=BERT_TP_RULES),
+            tp_rules=BERT_TP_RULES,
+            graph_opt={"schedule": bert_sched, "weight_decay": 0.01}),
         "wrn101_large_batch": Config(
             build_model=lambda: models.wide_resnet101(policy=bf16_policy()),
             loss_fn=ce,
@@ -346,12 +348,6 @@ def run(args) -> Dict[str, float]:
     # device by design, so it must neither trip the multi-device degrade
     # warning nor build a mesh it will never use.
     if args.engine == "graph":
-        if args.config not in ("mlp_mnist", "gpt2_124m",
-                               "resnet50_imagenet", "wrn101_large_batch"):
-            raise SystemExit("--engine graph supports mlp_mnist, "
-                             "resnet50_imagenet, wrn101_large_batch, and "
-                             "gpt2_124m (benchmark configs 1-3 and 5; "
-                             "BERT-ZeRO-1 is module-engine only)")
         if args.mesh or args.parallel != "config":
             raise SystemExit("--engine graph runs single-device; drop "
                              "--mesh/--parallel (the Graph IR executor does "
@@ -377,6 +373,13 @@ def run(args) -> Dict[str, float]:
             state = programs.init_graph_resnet_state(model, rng)
             step_fn = programs.make_resnet_graph_train_step(model, lr=0.1)
             shard = programs.image_shard_fn()
+        elif args.config == "bert_base_zero1":
+            state = programs.init_graph_bert_state(model, rng)
+            sched = cfg.graph_opt["schedule"](args.steps)
+            step_fn = programs.make_bert_graph_train_step(
+                model, lambda t: float(sched(_np.int32(t))),
+                weight_decay=cfg.graph_opt["weight_decay"])
+            shard = programs.bert_shard_fn()
         else:  # gpt2_124m: the transformer authored in the IR
             state = programs.init_graph_gpt2_state(model, rng)
             sched = cfg.graph_opt["schedule"](args.steps)

@@ -96,8 +96,8 @@ class Graph:
     def relu(self, x):
         return self._add("relu", [x])
 
-    def gelu(self, x):
-        return self._add("gelu", [x])
+    def gelu(self, x, approximate: bool = True):
+        return self._add("gelu", [x], {"approximate": approximate})
 
     def softmax(self, x, axis=-1):
         return self._add("softmax", [x], {"axis": axis})

@@ -51,7 +51,8 @@ def _eval_node(node, vals, feeds):
     if op == "relu":
         return jnp.maximum(x[0], 0)
     if op == "gelu":
-        return activations.gelu(x[0])
+        return activations.gelu(x[0], approximate=attrs.get("approximate",
+                                                            True))
     if op == "tanh":
         return jnp.tanh(x[0])
     if op == "exp":

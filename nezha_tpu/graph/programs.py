@@ -223,17 +223,19 @@ def init_graph_gpt2_state(model, rng) -> dict:
             "step": np.zeros((), np.int32)}
 
 
-def make_gpt2_graph_train_step(model, lr_schedule, weight_decay: float = 0.1,
-                               executor: Executor = None):
-    """Trainer-compatible step over ``init_graph_gpt2_state`` state; batches
-    are {"inputs": [B,S] i32, "targets": [B,S] i32} (see
-    :func:`lm_shard_fn`). Graphs are built per batch shape on first use."""
+def _make_adamw_ir_step(build_loss_graph, feed_keys: Tuple[str, ...],
+                        shape_key: str, lr_schedule,
+                        weight_decay: float, executor: Executor = None):
+    """Shared IR-engine AdamW trainer: ``build_loss_graph(template, batch,
+    seq) -> Graph`` whose placeholders are (*flat_params, *feed_keys
+    tensors); state = {"params", "mu", "nu", "step"}; graphs built per
+    (batch, seq) of ``b[shape_key]`` on first use. One implementation so
+    the per-model engines (GPT-2, BERT) cannot drift apart."""
     executor = executor or Executor()
-    cfg = model.cfg
-    _built: Dict[Tuple[int, int], callable] = {}
+    _built: Dict[Tuple[int, int], dict] = {}
 
     def build(params_template, batch, seq):
-        loss_graph = gpt2_loss_graph(cfg, params_template, batch, seq)
+        loss_graph = build_loss_graph(params_template, batch, seq)
         loss_fn = to_callable(loss_graph)
         n_params = len(jax.tree_util.tree_leaves(params_template))
         vg = jax.value_and_grad(loss_fn, argnums=tuple(range(n_params)))
@@ -246,19 +248,19 @@ def make_gpt2_graph_train_step(model, lr_schedule, weight_decay: float = 0.1,
             flat = args[:3 * n_params]
             ps, ms, vs = (flat[:n_params], flat[n_params:2 * n_params],
                           flat[2 * n_params:])
-            t_f32, lr, inputs, targets = args[3 * n_params:]
-            loss, grads = vg(*ps, inputs, targets)
-            new = [upd[tuple(p.shape)](p, m, v, gr, t_f32, lr)
-                   for p, m, v, gr in zip(ps, ms, vs, grads)]
+            t_f32, lr = args[3 * n_params:3 * n_params + 2]
+            feeds = args[3 * n_params + 2:]
+            loss, grads = vg(*ps, *feeds)
+            new = [upd[tuple(x.shape)](x, m, v, gr, t_f32, lr)
+                   for x, m, v, gr in zip(ps, ms, vs, grads)]
             new_p, new_m, new_v = zip(*new)
             return (loss, *new_p, *new_m, *new_v)
 
-        step_obj = {"whole_step": whole_step, "n_params": n_params,
-                    "loss_graph": loss_graph}
-        return step_obj
+        return {"whole_step": whole_step, "n_params": n_params,
+                "loss_graph": loss_graph}
 
     def step(state, b):
-        batch, seq = b["inputs"].shape
+        batch, seq = b[shape_key].shape
         if (batch, seq) not in _built:
             _built[(batch, seq)] = build(state["params"], batch, seq)
         so = _built[(batch, seq)]
@@ -270,7 +272,7 @@ def make_gpt2_graph_train_step(model, lr_schedule, weight_decay: float = 0.1,
         lr = np.float32(lr_schedule(t))       # module: lr from PRE-increment
         t_f32 = np.float32(t + 1)             # bias correction: post-increment
         out = executor.run(so["whole_step"], *flat_p, *flat_m, *flat_v,
-                           t_f32, lr, b["inputs"], b["targets"])
+                           t_f32, lr, *[b[k] for k in feed_keys])
         loss, rest = out[0], out[1:]
         unf = lambda leaves: jax.tree_util.tree_unflatten(treedef, leaves)
         return ({"params": unf(rest[:n]), "mu": unf(rest[n:2 * n]),
@@ -283,6 +285,19 @@ def make_gpt2_graph_train_step(model, lr_schedule, weight_decay: float = 0.1,
     return step
 
 
+def make_gpt2_graph_train_step(model, lr_schedule, weight_decay: float = 0.1,
+                               executor: Executor = None):
+    """Trainer-compatible step over ``init_graph_gpt2_state`` state; batches
+    are {"inputs": [B,S] i32, "targets": [B,S] i32} (see
+    :func:`lm_shard_fn`). Graphs are built per batch shape on first use."""
+    cfg = model.cfg
+    return _make_adamw_ir_step(
+        lambda tmpl, batch, seq: gpt2_loss_graph(cfg, tmpl, batch, seq),
+        feed_keys=("inputs", "targets"), shape_key="inputs",
+        lr_schedule=lr_schedule, weight_decay=weight_decay,
+        executor=executor)
+
+
 def lm_shard_fn():
     """Host-side batch transform: {"tokens": [B,S+1]} -> inputs/targets."""
 
@@ -292,6 +307,127 @@ def lm_shard_fn():
                 "targets": np.ascontiguousarray(toks[:, 1:])}
 
     return shard
+
+
+# ---------------------------------------------------------------------------
+# BERT authored in the IR (benchmark config 4's model through --engine
+# graph, single-device): post-LN encoder, erf GELU, additive padding mask
+# fed as a placeholder, MLM loss masked via host-prepared safe-labels +
+# mask (the IR needs no comparison ops that way).
+
+
+def bert_loss_graph(cfg, param_template, batch: int, seq: int) -> Graph:
+    """IR graph: (*flat_params, tokens[B,S] i32, segment_ids[B,S] i32,
+    attn_mask[B,1,1,S] f32 additive, safe_labels[B,S] i32,
+    label_mask[B,S] f32) -> masked-mean MLM loss.
+
+    Mirrors ``models.bert.Bert.apply`` + ``mlm_loss`` (ignore_index=-100
+    becomes the host-side safe_labels/label_mask pair)."""
+    if cfg.dropout:
+        raise ValueError("graph BERT has no dropout path; build with "
+                         "dropout=0")
+    g = Graph("bert_mlm_loss")
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(
+        param_template)
+    syms = [g.placeholder(np.shape(leaf), str(np.asarray(leaf).dtype),
+                          name=jax.tree_util.keystr(path))
+            for path, leaf in leaves_with_path]
+    p = jax.tree_util.tree_unflatten(treedef, syms)
+    tokens = g.placeholder((batch, seq), "int32", name="tokens")
+    segment_ids = g.placeholder((batch, seq), "int32", name="segment_ids")
+    attn_mask = g.placeholder((batch, 1, 1, seq), name="attn_mask")
+    safe_labels = g.placeholder((batch, seq), "int32", name="safe_labels")
+    label_mask = g.placeholder((batch, seq), name="label_mask")
+
+    h_dim, nh = cfg.hidden_size, cfg.num_heads
+    hd = h_dim // nh
+    eps = cfg.ln_eps
+
+    def ln(prm, x):
+        return g.layernorm(x, prm["scale"], prm["bias"], eps=eps)
+
+    x = g.take(p["tok_emb"]["embedding"], tokens, axis=0)
+    x = x + g.take(p["pos_emb"]["embedding"], g.constant(np.arange(seq)),
+                   axis=0)
+    x = x + g.take(p["type_emb"]["embedding"], segment_ids, axis=0)
+    x = ln(p["emb_ln"], x)
+
+    def heads(t):
+        return g.transpose(g.reshape(t, (batch, seq, nh, hd)), (0, 2, 1, 3))
+
+    for i in range(cfg.num_layers):
+        lyr = p[f"layers{i}"]
+        qkv = (x @ lyr["qkv"]["w"]) + lyr["qkv"]["b"]
+        q = heads(g.slice(qkv, (0, 0, 0), (batch, seq, h_dim)))
+        k = heads(g.slice(qkv, (0, 0, h_dim), (batch, seq, 2 * h_dim)))
+        v = heads(g.slice(qkv, (0, 0, 2 * h_dim), (batch, seq, 3 * h_dim)))
+        scores = (q @ g.transpose(k, (0, 1, 3, 2))) * (1.0 / hd ** 0.5)
+        probs = g.softmax(scores + attn_mask, axis=-1)
+        att = g.reshape(g.transpose(probs @ v, (0, 2, 1, 3)),
+                        (batch, seq, h_dim))
+        att = (att @ lyr["attn_out"]["w"]) + lyr["attn_out"]["b"]
+        x = ln(lyr["attn_ln"], x + att)               # post-LN topology
+        y = g.gelu((x @ lyr["fc"]["w"]) + lyr["fc"]["b"], approximate=False)
+        y = (y @ lyr["fc_out"]["w"]) + lyr["fc_out"]["b"]
+        x = ln(lyr["out_ln"], x + y)
+
+    y = g.gelu((x @ p["mlm_dense"]["w"]) + p["mlm_dense"]["b"],
+               approximate=False)
+    y = ln(p["mlm_ln"], y)
+    logits = (y @ g.transpose(p["tok_emb"]["embedding"], (1, 0))
+              ) + p["mlm_bias"]
+    logp = g.log_softmax(logits, axis=-1)
+    picked = g.take_along(logp, safe_labels, axis=2)
+    # masked mean; max(count, 1) = relu(count - 1) + 1 for count >= 0.
+    count = g.sum(label_mask)
+    nll = -(g.sum(picked * label_mask) / (g.relu(count + (-1.0)) + 1.0))
+    g.output(nll)
+    return g
+
+
+def bert_shard_fn():
+    """Host-side transform of BERT MLM batches into the graph's feeds.
+
+    ``segment_ids`` is required (the IR program always adds type
+    embeddings, matching the module path WITH segments — defaulting them
+    to zeros would silently diverge from a module run without segments).
+    ``padding_mask`` may be absent: all-attendable == additive zeros."""
+
+    def shard(b):
+        tokens = np.asarray(b["tokens"], np.int32)
+        labels = np.asarray(b["labels"], np.int32)
+        pad = np.asarray(b.get("padding_mask",
+                               np.ones_like(tokens, bool)), bool)
+        attn = np.where(pad, 0.0, -1e30).astype(np.float32)
+        return {
+            "tokens": tokens,
+            "segment_ids": np.asarray(b["segment_ids"], np.int32),
+            "attn_mask": attn[:, None, None, :],
+            "safe_labels": np.where(labels == -100, 0, labels).astype(
+                np.int32),
+            "label_mask": (labels != -100).astype(np.float32),
+        }
+
+    return shard
+
+
+def init_graph_bert_state(model, rng) -> dict:
+    """Graph-engine BERT state (AdamW slots), module-identical init."""
+    return init_graph_gpt2_state(model, rng)
+
+
+def make_bert_graph_train_step(model, lr_schedule,
+                               weight_decay: float = 0.01,
+                               executor: Executor = None):
+    """Trainer-compatible step over ``init_graph_bert_state`` state;
+    batches from :func:`bert_shard_fn`."""
+    cfg = model.cfg
+    return _make_adamw_ir_step(
+        lambda tmpl, batch, seq: bert_loss_graph(cfg, tmpl, batch, seq),
+        feed_keys=("tokens", "segment_ids", "attn_mask", "safe_labels",
+                   "label_mask"),
+        shape_key="tokens", lr_schedule=lr_schedule,
+        weight_decay=weight_decay, executor=executor)
 
 
 # ---------------------------------------------------------------------------

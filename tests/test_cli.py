@@ -206,6 +206,18 @@ def test_cli_graph_engine_resnet(tmp_path):
               "--eval"])
 
 
+def test_cli_graph_engine_bert(tmp_path):
+    """Config 4's model through the Graph IR engine: the IR-authored BERT
+    encoder + AdamW graphs train from the CLI and the loss drops."""
+    metrics = _run(["--config", "bert_base_zero1", "--model-preset", "tiny",
+                    "--engine", "graph", "--steps", "20",
+                    "--batch-size", "8", "--log-every", "5",
+                    "--metrics-file", str(tmp_path / "m.jsonl")])
+    lines = [json.loads(l) for l in
+             (tmp_path / "m.jsonl").read_text().strip().splitlines()]
+    assert lines[-1]["loss"] < lines[0]["loss"]
+
+
 def test_cli_graph_engine_gpt2(tmp_path):
     """Config 3 through the Graph IR engine: the IR-authored transformer +
     AdamW update graphs train from the CLI and the loss drops."""
