@@ -148,7 +148,10 @@ def bench_resnet50(on_tpu: bool, peak):
     batch, size = (128, 224) if on_tpu else (4, 64)
     steps_target = 10 if on_tpu else 2
 
-    model = resnet50(policy=bf16_policy())
+    # s2d stem: same arithmetic as the 7x7/s2 conv, relaid out for the MXU
+    # (test_s2d_stem_matches_conv7 proves equivalence).
+    model = resnet50(stem="s2d" if on_tpu else "conv7",
+                     policy=bf16_policy())
     opt = optim.momentum(0.1, beta=0.9, weight_decay=1e-4)
     state = init_train_state(model, opt, jax.random.PRNGKey(0))
     ce = lambda logits, b_: ops.softmax_cross_entropy_with_integer_labels(
@@ -226,7 +229,7 @@ def bench_wrn101(on_tpu: bool, peak):
     batch, size = (64, 224) if on_tpu else (2, 64)
     steps_target = 5 if on_tpu else 2
 
-    model = (wide_resnet101(policy=bf16_policy()) if on_tpu
+    model = (wide_resnet101(stem="s2d", policy=bf16_policy()) if on_tpu
              else ResNet((1, 1, 1, 1), width_factor=2, policy=bf16_policy()))
     opt = optim.momentum(0.1, beta=0.9, weight_decay=1e-4)
     state = init_train_state(model, opt, jax.random.PRNGKey(0))

@@ -1,0 +1,65 @@
+"""RN50 perf probe: where does the step time go on the real chip?"""
+import time, json, sys
+import jax, jax.numpy as jnp, numpy as np
+sys.path.insert(0, "/root/repo")
+from nezha_tpu import ops, optim
+from nezha_tpu.models.resnet import resnet50
+from nezha_tpu.tensor import bf16_policy
+from nezha_tpu.train.loop import init_train_state, make_train_step
+
+B, SZ = 128, 224
+model = resnet50(policy=bf16_policy())
+opt = optim.momentum(0.1, beta=0.9, weight_decay=1e-4)
+state = init_train_state(model, opt, jax.random.PRNGKey(0))
+ce = lambda logits, b_: ops.softmax_cross_entropy_with_integer_labels(
+    logits, b_["label"]).mean()
+step = make_train_step(model, opt, ce)
+rng = np.random.RandomState(0)
+b = {"image": jnp.asarray(rng.rand(B, SZ, SZ, 3).astype(np.float32)),
+     "label": jnp.asarray(rng.randint(0, 1000, B), jnp.int32)}
+
+def timeit(fn, *args, n=10, fetch=None):
+    out = fn(*args)
+    if fetch: fetch(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    if fetch: fetch(out)
+    return (time.perf_counter() - t0) / n, out
+
+compiled = jax.jit(step, donate_argnums=(0,)).lower(state, b).compile()
+cost = compiled.cost_analysis()
+if isinstance(cost, (list, tuple)): cost = cost[0]
+print("XLA flops/step:", cost.get("flops"), " bytes:", cost.get("bytes accessed"))
+# donation means we must rebuild state each call — time without donation instead
+step_nd = jax.jit(step).lower(state, b).compile()
+dt, out = timeit(lambda: step_nd(state, b), n=10, fetch=lambda o: float(o[1]["loss"]))
+print(f"full step: {dt*1e3:.2f} ms  -> {B/dt:.0f} img/s  MFU(XLA)={cost.get('flops',0)/dt/197e12:.3f}")
+
+# forward only (train mode, incl BN stats)
+fwd = jax.jit(lambda v, bb: model.apply(v, bb, training=True)[0].sum()).lower(state["variables"], b).compile()
+dt_f, _ = timeit(lambda: fwd(state["variables"], b), n=10, fetch=lambda o: float(o))
+print(f"fwd only: {dt_f*1e3:.2f} ms")
+
+# fwd+bwd (no optimizer)
+def loss_fn(params, variables, bb):
+    v = dict(variables); v["params"] = params
+    logits, _ = model.apply(v, bb, training=True)
+    return ce(logits, bb)
+g = jax.jit(jax.grad(loss_fn)).lower(state["variables"]["params"], state["variables"], b).compile()
+dt_g, _ = timeit(lambda: g(state["variables"]["params"], state["variables"], b), n=10,
+                 fetch=lambda o: float(jax.tree_util.tree_leaves(o)[0].sum()))
+print(f"fwd+bwd: {dt_g*1e3:.2f} ms  (optimizer+rest: {(dt-dt_g)*1e3:.2f} ms)")
+
+# stem alone (7x7s2 conv fwd+bwd) at step scale
+from nezha_tpu import nn
+stem = nn.Conv2d(3, 64, 7, stride=2, use_bias=False, policy=bf16_policy())
+sv = stem.init(jax.random.PRNGKey(1))
+def stem_loss(p, x):
+    v = dict(sv); v["params"] = p
+    y, _ = stem.apply(v, x)
+    return jnp.sum(jnp.asarray(y, jnp.float32))
+gs = jax.jit(jax.grad(stem_loss)).lower(sv["params"], b["image"]).compile()
+dt_s, _ = timeit(lambda: gs(sv["params"], b["image"]), n=20,
+                 fetch=lambda o: float(jax.tree_util.tree_leaves(o)[0].sum()))
+print(f"stem conv fwd+bwd: {dt_s*1e3:.2f} ms")

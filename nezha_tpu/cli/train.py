@@ -114,7 +114,8 @@ def _configs() -> Dict[str, Config]:
             eval_stat=eval_mod.accuracy,
             tiny={}),  # already seconds-scale
         "resnet50_imagenet": Config(
-            build_model=lambda: models.resnet50(policy=bf16_policy()),
+            build_model=lambda: models.resnet50(stem="s2d",
+                                                policy=bf16_policy()),
             loss_fn=ce,
             batches=lambda bs: data.synthetic_image_batches(bs),
             build_optimizer=lambda steps: optim.momentum(
@@ -166,7 +167,8 @@ def _configs() -> Dict[str, Config]:
             tp_rules=BERT_TP_RULES,
             graph_opt={"schedule": bert_sched, "weight_decay": 0.01}),
         "wrn101_large_batch": Config(
-            build_model=lambda: models.wide_resnet101(policy=bf16_policy()),
+            build_model=lambda: models.wide_resnet101(stem="s2d",
+                                                      policy=bf16_policy()),
             loss_fn=ce,
             batches=lambda bs: data.synthetic_image_batches(bs),
             build_optimizer=lambda steps: optim.momentum(

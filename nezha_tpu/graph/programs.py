@@ -24,6 +24,14 @@ from nezha_tpu.runtime.executor import Executor
 # — matches models.MLP's {"fc0": {"w","b"}, ..., "head": {"w","b"}} layout.
 
 
+def _leaf_dtype(leaf) -> str:
+    """Dtype string of a param leaf without np.asarray's device-to-host
+    copy (a full-model transfer at graph-build time when leaves live on
+    device)."""
+    return str(leaf.dtype) if hasattr(leaf, "dtype") else str(
+        np.asarray(leaf).dtype)
+
+
 def mlp_param_names(n_layers: int) -> Sequence[str]:
     names = [f"fc{i}" for i in range(n_layers - 1)] + ["head"]
     return names
@@ -145,11 +153,16 @@ def gpt2_loss_graph(cfg, param_template, batch: int, seq: int) -> Graph:
     if cfg.dropout:
         raise ValueError("graph GPT-2 has no dropout path; build with "
                          "dropout=0")
+    if seq > cfg.max_positions:
+        # Same loud failure as GPT2.apply: the position-embedding gather
+        # below would silently clamp past the table's last row.
+        raise ValueError(f"sequence length {seq} exceeds max_positions "
+                         f"{cfg.max_positions}")
     g = Graph("gpt2_loss")
     leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(
         param_template)
     syms = [g.placeholder(np.shape(leaf),
-                          str(np.asarray(leaf).dtype),
+                          _leaf_dtype(leaf),
                           name=jax.tree_util.keystr(path))
             for path, leaf in leaves_with_path]
     p = jax.tree_util.tree_unflatten(treedef, syms)
@@ -326,10 +339,15 @@ def bert_loss_graph(cfg, param_template, batch: int, seq: int) -> Graph:
     if cfg.dropout:
         raise ValueError("graph BERT has no dropout path; build with "
                          "dropout=0")
+    if seq > cfg.max_positions:
+        # Same loud failure as Bert.apply (models/bert.py:116-120): the
+        # position-embedding gather below would silently clamp.
+        raise ValueError(f"sequence length {seq} exceeds max_positions "
+                         f"{cfg.max_positions}")
     g = Graph("bert_mlm_loss")
     leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(
         param_template)
-    syms = [g.placeholder(np.shape(leaf), str(np.asarray(leaf).dtype),
+    syms = [g.placeholder(np.shape(leaf), _leaf_dtype(leaf),
                           name=jax.tree_util.keystr(path))
             for path, leaf in leaves_with_path]
     p = jax.tree_util.tree_unflatten(treedef, syms)
@@ -448,7 +466,7 @@ def resnet_loss_graph(stage_sizes: Sequence[int], param_template,
     g = Graph("resnet_loss")
     leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(
         param_template)
-    syms = [g.placeholder(np.shape(leaf), str(np.asarray(leaf).dtype),
+    syms = [g.placeholder(np.shape(leaf), _leaf_dtype(leaf),
                           name=jax.tree_util.keystr(path))
             for path, leaf in leaves_with_path]
     p = jax.tree_util.tree_unflatten(treedef, syms)

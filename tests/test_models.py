@@ -38,6 +38,59 @@ def test_resnet_forward_shapes():
     assert "stem_bn" in states and "blocks0" in states
 
 
+def test_s2d_stem_matches_conv7():
+    """stem="s2d" is the same arithmetic as the 7x7/s2 conv, relaid out for
+    the MXU (models/resnet.py:_space_to_depth_stem) — outputs must agree to
+    fp32 summation-order tolerance, and gradients must flow to the SAME
+    [7,7,3,64]-shaped parameter."""
+    m7 = tiny_resnet(stem="conv7")
+    ms = tiny_resnet(stem="s2d")
+    v = m7.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+    y7, _ = m7.apply(v, x, training=False)
+    ys, _ = ms.apply(v, x, training=False)
+    np.testing.assert_allclose(np.asarray(y7), np.asarray(ys),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss(params, model):
+        vv = {**v, "params": params}
+        out, _ = model.apply(vv, x, training=False)
+        return (out.astype(jnp.float32) ** 2).sum()
+
+    g7 = jax.grad(loss)(v["params"], m7)["stem_conv"]["w"]
+    gs = jax.grad(loss)(v["params"], ms)["stem_conv"]["w"]
+    assert gs.shape == (7, 7, 3, 64)
+    np.testing.assert_allclose(np.asarray(g7), np.asarray(gs),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_s2d_stem_odd_input_falls_back():
+    ms = tiny_resnet(stem="s2d")
+    v = ms.init(jax.random.PRNGKey(0))
+    logits, _ = ms.apply(v, jnp.ones((1, 31, 31, 3)), training=False)
+    assert logits.shape == (1, 10)
+
+
+def test_batchnorm_keeps_stats_fp32_normalizes_in_compute_dtype():
+    """Stats are fp32 even under bf16 (SURVEY §0 config 5 mixed precision);
+    the normalized output stays in the compute dtype with no fp32
+    intermediate saved for backward (nn/layers.py BatchNorm)."""
+    from nezha_tpu import nn
+    from nezha_tpu.tensor import bf16_policy
+    bn = nn.BatchNorm(8, policy=bf16_policy())
+    v = bn.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 4, 4, 8), jnp.bfloat16)
+    y, new_state = bn.apply(v, x, training=True)
+    assert y.dtype == jnp.bfloat16
+    assert new_state["mean"].dtype == jnp.float32
+    assert new_state["var"].dtype == jnp.float32
+    # Normalization is still correct: batch-normed output has ~0 mean/unit
+    # var per channel (bf16 tolerance).
+    yf = np.asarray(y, np.float32).reshape(-1, 8)
+    assert np.abs(yf.mean(axis=0)).max() < 0.1
+    assert np.abs(yf.std(axis=0) - 1.0).max() < 0.15
+
+
 def test_resnet50_structure():
     model = resnet50()
     # 3+4+6+3 bottlenecks, ImageNet head.
