@@ -25,7 +25,14 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 import time
+
+# Runnable as `python benchmarks/collectives.py` from anywhere: the repo
+# root (one level up) must be importable for nezha_tpu.
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
 
 # jax imports live inside functions: forcing a virtual CPU mesh
@@ -55,6 +62,7 @@ def _collectives(mesh):
     from jax.sharding import PartitionSpec as P
 
     from nezha_tpu.parallel._compat import shard_map
+    from nezha_tpu.parallel.quantized import _qar_mean
 
     n = mesh.devices.size
     spec = P("x")
@@ -77,6 +85,12 @@ def _collectives(mesh):
         "ppermute": (wrap(lambda x: jax.lax.ppermute(
             x, "x", [(i, (i + 1) % n) for i in range(n)])),
                      lambda b: b),
+        # int8-wire all-reduce (parallel/quantized.py). busBW is reported
+        # for the fp32-equivalent payload — "effective" bandwidth, i.e. how
+        # fast exact fp32 all-reduce would have to run to move the same
+        # gradient; the wire itself carries ~4x less.
+        "all_reduce_int8": (wrap(lambda x: _qar_mean(x, "x", 512)),
+                            lambda b: b * 2 * (n - 1) / n),
     }
 
 

@@ -1,7 +1,7 @@
 """RN50 perf probe: where does the step time go on the real chip?"""
-import time, json, sys
+import os, time, json, sys
 import jax, jax.numpy as jnp, numpy as np
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from nezha_tpu import ops, optim
 from nezha_tpu.models.resnet import resnet50
 from nezha_tpu.tensor import bf16_policy

@@ -354,6 +354,9 @@ def run(args) -> Dict[str, float]:
             raise SystemExit("--engine graph runs single-device; drop "
                              "--mesh/--parallel (the Graph IR executor does "
                              "not partition)")
+        if args.grad_allreduce != "fp32":
+            raise SystemExit("--grad-allreduce applies to --parallel dp; "
+                             "the graph engine runs single-device")
         import numpy as _np
 
         from nezha_tpu.graph import programs
@@ -410,6 +413,13 @@ def run(args) -> Dict[str, float]:
                   f"single-device (check your mesh/launch if this is a "
                   f"multi-chip job)", file=sys.stderr)
             mode = "single"
+        # After the degrade: a mode that will not run the dp wire cannot
+        # consume the int8 request — reject, don't ignore (the degrade
+        # would otherwise silently swap exact fp32 semantics back in).
+        if args.grad_allreduce != "fp32" and mode != "dp":
+            raise SystemExit("--grad-allreduce int8 is the dp gradient "
+                             f"wire format; mode {mode!r} does not consume "
+                             "it (reject, don't ignore)")
 
         # Mesh axes are validated against the chosen mode: an axis the mode
         # cannot consume is an error, never silently ignored — and every
@@ -474,8 +484,9 @@ def run(args) -> Dict[str, float]:
             shard = None
         elif mode == "dp":
             state = parallel.replicate(mesh, state)
-            step_fn = parallel.make_dp_train_step(model, optimizer,
-                                                  cfg.loss_fn, mesh)
+            step_fn = parallel.make_dp_train_step(
+                model, optimizer, cfg.loss_fn, mesh,
+                grad_reduce=args.grad_allreduce)
             shard = lambda b: parallel.shard_batch(mesh, b)
         elif mode == "sp":
             from nezha_tpu.parallel import sequence_parallel as sp_mod
@@ -686,6 +697,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--moe-experts", type=int, default=None,
                    help="gpt2_124m only: swap every other block's MLP for "
                         "a top-k routed mixture of this many experts")
+    p.add_argument("--grad-allreduce", default="fp32",
+                   choices=["fp32", "int8"],
+                   help="--parallel dp gradient wire format: exact fp32 "
+                        "pmean or EQuARX-style block-scaled int8 (~4x less "
+                        "ICI traffic)")
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. cpu)")
     p.add_argument("--seed", type=int, default=0)

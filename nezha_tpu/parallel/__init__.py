@@ -62,4 +62,8 @@ def __getattr__(name):
                 "dryrun_moe_step"):
         mod = importlib.import_module("nezha_tpu.parallel.expert")
         return getattr(mod, name)
+    if name in ("quantized_all_reduce_mean", "quantize_roundtrip",
+                "quantized_wire_bytes"):
+        mod = importlib.import_module("nezha_tpu.parallel.quantized")
+        return getattr(mod, name)
     raise AttributeError(name)

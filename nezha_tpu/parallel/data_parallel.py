@@ -37,9 +37,18 @@ def replicate(mesh: Mesh, tree: Any) -> Any:
 
 def make_dp_train_step(model: Module, optimizer: Optimizer,
                        loss_fn: Callable[[Any, dict], Any],
-                       mesh: Mesh, axis: str = "dp", donate: bool = True):
+                       mesh: Mesh, axis: str = "dp", donate: bool = True,
+                       grad_reduce: str = "fp32"):
     """Build ``step(state, batch) -> (state, metrics)`` with the batch
-    sharded over ``axis`` and params/optimizer state replicated."""
+    sharded over ``axis`` and params/optimizer state replicated.
+
+    ``grad_reduce="int8"`` swaps the gradient pmean for the EQuARX-style
+    block-scaled int8 wire collective (parallel/quantized.py) — ~4x less
+    ICI traffic per step at gradient-compression accuracy; loss/BN-stat
+    reductions stay exact either way.
+    """
+    if grad_reduce not in ("fp32", "int8"):
+        raise ValueError(f"grad_reduce must be fp32|int8, got {grad_reduce!r}")
 
     def per_replica(state: TrainState, batch: dict):
         variables, opt_state = state["variables"], state["opt_state"]
@@ -58,7 +67,11 @@ def make_dp_train_step(model: Module, optimizer: Optimizer,
 
         # The DP collective: mean over the dp axis (reference: NCCL ring
         # all-reduce). XLA overlaps this with the tail of backward.
-        grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, axis), grads)
+        if grad_reduce == "int8":
+            from nezha_tpu.parallel.quantized import quantized_all_reduce_mean
+            grads = quantized_all_reduce_mean(grads, axis)
+        else:
+            grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, axis), grads)
         loss = lax.pmean(loss, axis)
         new_state = jax.tree_util.tree_map(lambda s: lax.pmean(s, axis), new_state)
 

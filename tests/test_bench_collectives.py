@@ -14,7 +14,7 @@ def test_collectives_bench_runs():
     recs = collectives.run(sizes_mb=[0.25], iters=2)
     names = {r["collective"] for r in recs}
     assert names == {"all_reduce", "all_gather", "reduce_scatter",
-                     "ppermute"}
+                     "ppermute", "all_reduce_int8"}
     for r in recs:
         assert r["devices"] == 8
         assert r["time_ms"] > 0

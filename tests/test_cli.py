@@ -42,6 +42,21 @@ def test_cli_dp_mesh(devices8, capsys):
     assert "only 1 device" not in capsys.readouterr().err  # DP really ran
 
 
+def test_cli_dp_int8_allreduce(devices8, capsys):
+    """--grad-allreduce int8 trains DP with the quantized wire collective;
+    non-dp modes reject the flag instead of ignoring it."""
+    import pytest
+    metrics = _run(["--config", "resnet50_imagenet", "--model-preset", "tiny",
+                    "--steps", "4", "--batch-size", "16", "--mesh", "dp=8",
+                    "--grad-allreduce", "int8", "--log-every", "2"])
+    assert np.isfinite(metrics["loss"])
+    assert "only 1 device" not in capsys.readouterr().err
+    with pytest.raises(SystemExit, match="grad-allreduce"):
+        _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--steps", "1", "--batch-size", "8", "--parallel", "sp",
+              "--mesh", "dp=4,sp=2", "--grad-allreduce", "int8"])
+
+
 def test_mesh_parsing():
     from nezha_tpu.cli.train import _parse_mesh
     assert _parse_mesh("dp=4,sp=2") == {"dp": 4, "sp": 2}
