@@ -355,8 +355,8 @@ def run(args) -> Dict[str, float]:
                              "--mesh/--parallel (the Graph IR executor does "
                              "not partition)")
         if args.grad_allreduce != "fp32":
-            raise SystemExit("--grad-allreduce applies to --parallel dp; "
-                             "the graph engine runs single-device")
+            raise SystemExit("--grad-allreduce applies to --parallel "
+                             "dp/zero1; the graph engine runs single-device")
         import numpy as _np
 
         from nezha_tpu.graph import programs
@@ -413,13 +413,13 @@ def run(args) -> Dict[str, float]:
                   f"single-device (check your mesh/launch if this is a "
                   f"multi-chip job)", file=sys.stderr)
             mode = "single"
-        # After the degrade: a mode that will not run the dp wire cannot
-        # consume the int8 request — reject, don't ignore (the degrade
-        # would otherwise silently swap exact fp32 semantics back in).
-        if args.grad_allreduce != "fp32" and mode != "dp":
-            raise SystemExit("--grad-allreduce int8 is the dp gradient "
-                             f"wire format; mode {mode!r} does not consume "
-                             "it (reject, don't ignore)")
+        # After the degrade: a mode that will not run the dp/zero1 wire
+        # cannot consume the int8 request — reject, don't ignore (the
+        # degrade would otherwise silently swap exact fp32 semantics in).
+        if args.grad_allreduce != "fp32" and mode not in ("dp", "zero1"):
+            raise SystemExit("--grad-allreduce int8 is the dp/zero1 "
+                             f"gradient wire format; mode {mode!r} does "
+                             "not consume it (reject, don't ignore)")
 
         # Mesh axes are validated against the chosen mode: an axis the mode
         # cannot consume is an error, never silently ignored — and every
@@ -529,8 +529,9 @@ def run(args) -> Dict[str, float]:
                 "rng": parallel.replicate(mesh, state["rng"]),
             }
             save_fn = sckpt.save_sharded
-            step_fn = parallel.make_zero1_train_step(model, optimizer,
-                                                     cfg.loss_fn, mesh)
+            step_fn = parallel.make_zero1_train_step(
+                model, optimizer, cfg.loss_fn, mesh,
+                grad_reduce=args.grad_allreduce)
             shard = lambda b: parallel.shard_batch(mesh, b)
         else:
             raise ValueError(mode)
@@ -699,9 +700,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "a top-k routed mixture of this many experts")
     p.add_argument("--grad-allreduce", default="fp32",
                    choices=["fp32", "int8"],
-                   help="--parallel dp gradient wire format: exact fp32 "
-                        "pmean or EQuARX-style block-scaled int8 (~4x less "
-                        "ICI traffic)")
+                   help="dp/zero1 gradient wire format: exact fp32 or "
+                        "EQuARX/ZeRO++-style block-scaled int8 (~4x less "
+                        "ICI traffic; dp all-reduce, zero1 reduce-scatter "
+                        "+ update all-gather)")
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. cpu)")
     p.add_argument("--seed", type=int, default=0)

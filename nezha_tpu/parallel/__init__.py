@@ -63,7 +63,8 @@ def __getattr__(name):
         mod = importlib.import_module("nezha_tpu.parallel.expert")
         return getattr(mod, name)
     if name in ("quantized_all_reduce_mean", "quantize_roundtrip",
-                "quantized_wire_bytes"):
+                "quantized_wire_bytes", "quantized_reduce_scatter_mean",
+                "quantized_all_gather"):
         mod = importlib.import_module("nezha_tpu.parallel.quantized")
         return getattr(mod, name)
     raise AttributeError(name)

@@ -64,26 +64,24 @@ def quantize_roundtrip(x: jax.Array, block: int = 512) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def should_quantize(leaf: jax.Array, min_numel: int) -> bool:
+    """The size/dtype cutoff policy, shared by the dp and zero1 paths:
+    quantize float leaves of at least ``min_numel`` elements; everything
+    else rides the exact collective."""
+    return bool(jnp.issubdtype(leaf.dtype, jnp.floating)
+                and leaf.size >= min_numel)
+
+
 def _qar_mean(x: jax.Array, axis_name: str, block: int) -> jax.Array:
-    """int8-wire all-reduce-mean of one array (inside shard_map)."""
+    """int8-wire all-reduce-mean of one array (inside shard_map): the ring
+    decomposition reduce_scatter + all_gather, each phase quantized."""
     n = lax.axis_size(axis_name)
-    orig_shape, orig_dtype = x.shape, x.dtype
     flat = jnp.asarray(x, jnp.float32).reshape(-1)
     per = -(-flat.size // (n * block)) * block  # chunk per rank, block-aligned
     flat = jnp.pad(flat, (0, n * per - flat.size))
-    q, s = _quantize_blocks(flat.reshape(n, per), block)  # [n, per/b, b]
-
-    # Reduce phase: chunk j of every rank lands on rank j (int8 wire).
-    qt = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0, tiled=True)
-    st = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0, tiled=True)
-    owned = jnp.sum(_dequantize(qt, st), axis=0) / n  # fp32 [per/b, b]
-
-    # Broadcast phase: requantize the owned shard, gather all shards.
-    q2, s2 = _quantize_blocks(owned.reshape(1, per), block)
-    qg = lax.all_gather(q2, axis_name, axis=0, tiled=True)
-    sg = lax.all_gather(s2, axis_name, axis=0, tiled=True)
-    out = _dequantize(qg, sg).reshape(-1)[:x.size]
-    return out.reshape(orig_shape).astype(orig_dtype)
+    owned = quantized_reduce_scatter_mean(flat, axis_name, block)
+    out = quantized_all_gather(owned, axis_name, block)[:x.size]
+    return out.reshape(x.shape).astype(x.dtype)
 
 
 def quantized_all_reduce_mean(tree: Any, axis_name: str, block: int = 512,
@@ -92,11 +90,42 @@ def quantized_all_reduce_mean(tree: Any, axis_name: str, block: int = 512,
     every float leaf of at least ``min_numel`` elements; small or integer
     leaves take the exact ``pmean`` path."""
     def one(g):
-        if (not jnp.issubdtype(g.dtype, jnp.floating)) or g.size < min_numel:
+        if not should_quantize(g, min_numel):
             return lax.pmean(g, axis_name)
         return _qar_mean(g, axis_name, block)
 
     return jax.tree_util.tree_map(one, tree)
+
+
+def quantized_reduce_scatter_mean(flat: jax.Array, axis_name: str,
+                                  block: int = 512) -> jax.Array:
+    """int8-wire mean reduce-scatter: ``flat`` [world*chunk] fp32 -> this
+    rank's mean chunk [chunk] (the ZeRO-1 gradient phase; ZeRO++'s qgZ in
+    XLA-collective form). Row padding to the block size happens internally,
+    so callers keep the exact-path layout (chunk = size/world)."""
+    n = lax.axis_size(axis_name)
+    rows = jnp.asarray(flat, jnp.float32).reshape(n, -1)
+    chunk = rows.shape[1]
+    rows = jnp.pad(rows, ((0, 0), (0, (-chunk) % block)))
+    q, s = _quantize_blocks(rows, block)
+    qt = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    st = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    owned = jnp.sum(_dequantize(qt, st), axis=0) / n
+    return owned.reshape(-1)[:chunk]
+
+
+def quantized_all_gather(chunk_arr: jax.Array, axis_name: str,
+                         block: int = 512) -> jax.Array:
+    """int8-wire tiled all-gather of a per-rank [chunk] array ->
+    [world*chunk] fp32 (the ZeRO-1 weight/update broadcast phase)."""
+    n = lax.axis_size(axis_name)
+    chunk = chunk_arr.size
+    x = jnp.pad(jnp.asarray(chunk_arr, jnp.float32).reshape(-1),
+                (0, (-chunk) % block))
+    q, s = _quantize_blocks(x.reshape(1, -1), block)
+    qg = lax.all_gather(q, axis_name, axis=0, tiled=True)
+    sg = lax.all_gather(s, axis_name, axis=0, tiled=True)
+    return _dequantize(qg, sg).reshape(n, -1)[:, :chunk].reshape(-1)
 
 
 def quantized_wire_bytes(numel: int, block: int = 512, world: int = 8) -> int:

@@ -73,9 +73,20 @@ def _opt_state_specs(opt_state: Any, axis: str) -> Any:
 
 def make_zero1_train_step(model: Module, optimizer: Optimizer,
                           loss_fn: Callable[[Any, dict], Any],
-                          mesh: Mesh, axis: str = "dp", donate: bool = True):
+                          mesh: Mesh, axis: str = "dp", donate: bool = True,
+                          grad_reduce: str = "fp32",
+                          quant_min_numel: int = 4096):
     """Build the ZeRO-1 train step. ``state["opt_state"]`` must come from
-    ``zero1_init_opt_state``. Params stay replicated; batch sharded."""
+    ``zero1_init_opt_state``. Params stay replicated; batch sharded.
+
+    ``grad_reduce="int8"`` puts block-scaled int8 on the wire for BOTH
+    collectives of leaves >= ``quant_min_numel`` — the gradient
+    reduce-scatter and the update all-gather (parallel/quantized.py;
+    ZeRO++-style). Optimizer math stays fp32 on the exact-summed shard;
+    small leaves ride the exact path.
+    """
+    if grad_reduce not in ("fp32", "int8"):
+        raise ValueError(f"grad_reduce must be fp32|int8, got {grad_reduce!r}")
     world = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
 
     def per_replica(state: TrainState, batch: dict):
@@ -96,8 +107,17 @@ def make_zero1_train_step(model: Module, optimizer: Optimizer,
         new_state = jax.tree_util.tree_map(lambda s: lax.pmean(s, axis), new_state)
 
         # (2) grad reduce-scatter: each rank ends with its mean slice.
+        # Both wire phases gate on the same shared predicate over the SAME
+        # leaf size (params and their grads are shaped alike), so a leaf is
+        # either quantized in both phases or neither.
+        from nezha_tpu.parallel.quantized import should_quantize
+
         def to_chunk(g):
             flat = _flat_pad(g.astype(jnp.float32), world)
+            if grad_reduce == "int8" and should_quantize(g, quant_min_numel):
+                from nezha_tpu.parallel.quantized import (
+                    quantized_reduce_scatter_mean)
+                return quantized_reduce_scatter_mean(flat, axis)
             return lax.psum_scatter(flat, axis, scatter_dimension=0,
                                     tiled=True) / world
 
@@ -117,7 +137,11 @@ def make_zero1_train_step(model: Module, optimizer: Optimizer,
 
         # (4) weight all-gather of the updates, then apply to full params.
         def to_full(u, p):
-            full = lax.all_gather(u, axis, axis=0, tiled=True)
+            if grad_reduce == "int8" and should_quantize(p, quant_min_numel):
+                from nezha_tpu.parallel.quantized import quantized_all_gather
+                full = quantized_all_gather(u, axis)
+            else:
+                full = lax.all_gather(u, axis, axis=0, tiled=True)
             return full[:p.size].reshape(p.shape)
 
         updates = jax.tree_util.tree_map(to_full, update_chunks,

@@ -51,6 +51,11 @@ def test_cli_dp_int8_allreduce(devices8, capsys):
                     "--grad-allreduce", "int8", "--log-every", "2"])
     assert np.isfinite(metrics["loss"])
     assert "only 1 device" not in capsys.readouterr().err
+    # ZeRO-1 consumes it too (both wire phases quantized).
+    metrics = _run(["--config", "bert_base_zero1", "--model-preset", "tiny",
+                    "--steps", "2", "--batch-size", "16", "--mesh", "dp=8",
+                    "--grad-allreduce", "int8", "--log-every", "2"])
+    assert np.isfinite(metrics["loss"])
     with pytest.raises(SystemExit, match="grad-allreduce"):
         _run(["--config", "gpt2_124m", "--model-preset", "tiny",
               "--steps", "1", "--batch-size", "8", "--parallel", "sp",
