@@ -1,0 +1,91 @@
+"""GPT-2 trunk tuning matrix (run on the real chip).
+
+Round-2/3 established: loss path fused (+3%), flash attention tuned (+17%),
+and the remaining gap to 50% MFU lives in the trunk (BENCH_NOTES.md r3:
+head-free ceiling 128k tok/s). This script A/Bs the remaining trunk knobs
+and prints one JSON line per variant:
+
+  - ln:    xla composed layer norm vs the fused Pallas kernel (25 norms/step)
+  - attn:  flash (default) sanity point vs xla composed
+  - donate: buffer donation on/off (should be ~free, catches regressions)
+
+Usage: python experiments/gpt2_tune.py [--steps 20] [--batch 8] [--seq 1024]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure(variant: dict, batch: int, seq: int, steps: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nezha_tpu import optim
+    from nezha_tpu.models.gpt2 import GPT2, GPT2Config, lm_loss
+    from nezha_tpu.tensor import bf16_policy
+    from nezha_tpu.train.loop import init_train_state, make_train_step
+
+    cfg = GPT2Config(fused_loss_chunk=-1, **variant.get("cfg", {}))
+    model = GPT2(cfg, policy=bf16_policy())
+    opt = optim.adamw(6e-4, weight_decay=0.1)
+    state = init_train_state(model, opt, jax.random.PRNGKey(0))
+    step = make_train_step(model, opt, lm_loss,
+                           donate=variant.get("donate", True))
+
+    tokens = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32)
+    b = {"tokens": jnp.asarray(tokens)}
+
+    # Warm (compile), then 3 timing windows; median.
+    for _ in range(2):
+        state, m = step(state, b)
+    float(m["loss"])
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = step(state, b)
+        float(m["loss"])
+        rates.append(steps / (time.perf_counter() - t0))
+    rates.sort()
+    tps = batch * seq * rates[1]
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(
+        state["variables"]["params"]))
+    flops = (6 * n_params + 6 * cfg.num_layers * cfg.hidden_size * seq) \
+        * batch * seq
+    return {"variant": variant["name"], "tokens_per_sec": round(tps, 1),
+            "mfu": round(flops * rates[1] / 197e12, 4),
+            "spread": round((rates[-1] - rates[0]) / rates[1], 4)}
+
+
+VARIANTS = [
+    {"name": "baseline"},
+    {"name": "ln_pallas", "cfg": {"ln_impl": "pallas"}},
+    {"name": "attn_xla", "cfg": {"attn_impl": "xla"}},
+    {"name": "no_donate", "donate": False},
+]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--variants", nargs="+", default=None)
+    args = ap.parse_args()
+    for v in VARIANTS:
+        if args.variants and v["name"] not in args.variants:
+            continue
+        print(json.dumps(measure(v, args.batch, args.seq, args.steps)),
+              flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -124,7 +124,7 @@ def _configs() -> Dict[str, Config]:
             default_batch=256,
             parallel_mode="dp",
             tiny={"build_model": lambda: models.ResNet(
-                      (1, 1, 1, 1), num_classes=100, policy=bf16_policy()),
+                      (1, 1), num_classes=100, policy=bf16_policy()),
                   "batches": tiny_images}),
         "gpt2_124m": Config(
             # fused_loss_chunk=-1: CE never materializes fp32 [B,S,V]
@@ -177,7 +177,7 @@ def _configs() -> Dict[str, Config]:
             default_batch=512,
             parallel_mode="dp",
             tiny={"build_model": lambda: models.ResNet(
-                      (1, 1, 1, 1), num_classes=100, width_factor=2,
+                      (1, 1), num_classes=100, width_factor=2,
                       policy=bf16_policy()),
                   "batches": tiny_images}),
     }
@@ -319,8 +319,8 @@ def run(args) -> Dict[str, float]:
         if args.parallel == "pp":
             raise SystemExit("--moe-experts cannot pipeline (MoE blocks "
                              "make the stage slabs heterogeneous); use "
-                             "--parallel dp/zero1/sp, or gspmd with ep "
-                             "rules at the library level")
+                             "--parallel dp/zero1/sp, or gspmd with an ep "
+                             "mesh axis (--mesh dp=X,tp=Y,ep=Z)")
         moe_build = cfg.build_model
         cfg.build_model = lambda **ov: moe_build(
             moe_experts=args.moe_experts, **ov)
@@ -432,6 +432,11 @@ def run(args) -> Dict[str, float]:
         mode_default_mesh = {"dp": "dp=-1", "zero1": "dp=-1",
                              "gspmd": "dp=1,tp=-1", "pp": "dp=1,pp=-1",
                              "sp": "dp=1,sp=-1"}
+        if args.moe_experts and mode == "gspmd":
+            # MoE under GSPMD adds the expert axis: dp x tp x ep (tp=1 to
+            # disable tensor parallelism; experts shard over ep).
+            mode_axes["gspmd"] = ("dp", "tp", "ep")
+            mode_default_mesh["gspmd"] = "dp=1,tp=1,ep=-1"
         mesh = None
         if mode != "single":
             mesh_axes = (_parse_mesh(args.mesh)
@@ -449,6 +454,13 @@ def run(args) -> Dict[str, float]:
                     f"(use size 1 to disable an axis); got "
                     f"{list(mesh_axes)}")
             mesh = parallel.make_mesh(mesh_axes)
+            ep_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("ep")
+            if ep_size and args.moe_experts % ep_size:
+                raise SystemExit(
+                    f"--moe-experts {args.moe_experts} is not divisible by "
+                    f"mesh axis ep={ep_size}; expert stacks shard over ep "
+                    f"(pass --mesh dp=X,tp=Y,ep=Z with Z dividing the "
+                    f"expert count)")
 
         if mode == "sp":
             if cfg.sp_model is None:
@@ -499,8 +511,12 @@ def run(args) -> Dict[str, float]:
                     f"config {args.config!r} has no tensor-parallel rule "
                     f"table; --parallel gspmd supports: gpt2_124m, "
                     f"bert_base_zero1")
+            rules = cfg.tp_rules
+            if args.moe_experts:
+                from nezha_tpu.parallel.expert import gpt2_moe_gspmd_rules
+                rules = gpt2_moe_gspmd_rules(cfg.tp_rules)
             specs = parallel.param_specs_from_rules(
-                state["variables"]["params"], cfg.tp_rules, strict=True)
+                state["variables"]["params"], rules, strict=True)
             state = parallel.shard_train_state(state, mesh, specs)
             save_fn = sckpt.save_sharded
             step_fn = parallel.make_gspmd_train_step(

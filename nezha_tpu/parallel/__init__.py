@@ -59,7 +59,7 @@ def __getattr__(name):
         mod = importlib.import_module("nezha_tpu.parallel.pipeline")
         return getattr(mod, name)
     if name in ("MoE", "MoEConfig", "MOE_EP_RULES", "shard_moe_params",
-                "dryrun_moe_step"):
+                "dryrun_moe_step", "gpt2_moe_gspmd_rules"):
         mod = importlib.import_module("nezha_tpu.parallel.expert")
         return getattr(mod, name)
     if name in ("quantized_all_reduce_mean", "quantize_roundtrip",

@@ -153,6 +153,19 @@ def moe_ep_rules(ep_axis: str = "ep"):
 MOE_EP_RULES = moe_ep_rules()
 
 
+def gpt2_moe_gspmd_rules(tp_rules=None, ep_axis: str = "ep"):
+    """First-match GSPMD rule table for the MoE GPT-2 param tree: stacked
+    expert weights shard over ``ep_axis``, the router replicates, and the
+    dense remainder (attention, dense-block MLPs, embeddings, norms)
+    follows ``tp_rules`` — pass ``parallel.GPT2_TP_RULES`` for a
+    dp x tp x ep launch (tp=1 degrades gracefully to dp x ep). Strict-mode
+    compatible: every MoE-specific leaf is matched here, every dense leaf
+    by the appended table."""
+    return (moe_ep_rules(ep_axis)  # single source of truth for expert specs
+            + [(r".*/mlp/router/w$", P())]
+            + list(tp_rules or []))
+
+
 def shard_moe_params(params: Any, mesh: Mesh, ep_axis: str = "ep") -> Any:
     """Place a MoE param tree per ``moe_ep_rules`` (single source of truth
     with the exported rule table)."""
