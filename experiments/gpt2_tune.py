@@ -77,7 +77,8 @@ def main() -> int:
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--seq", type=int, default=1024)
-    ap.add_argument("--variants", nargs="+", default=None)
+    ap.add_argument("--variants", nargs="+", default=None,
+                    choices=[v["name"] for v in VARIANTS])
     args = ap.parse_args()
     for v in VARIANTS:
         if args.variants and v["name"] not in args.variants:

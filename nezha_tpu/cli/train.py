@@ -454,7 +454,7 @@ def run(args) -> Dict[str, float]:
                     f"(use size 1 to disable an axis); got "
                     f"{list(mesh_axes)}")
             mesh = parallel.make_mesh(mesh_axes)
-            ep_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("ep")
+            ep_size = mesh.shape.get("ep")
             if ep_size and args.moe_experts % ep_size:
                 raise SystemExit(
                     f"--moe-experts {args.moe_experts} is not divisible by "
