@@ -315,3 +315,90 @@ def test_rejoined_rank_resumes_collective_rounds():
         assert got == b"addr-v2", "replacement read a stale round"
         g1b.leave()
         g0.leave()
+
+
+def test_initialize_jax_distributed_two_processes(tmp_path):
+    """The full multi-host bootstrap: two real OS processes rendezvous
+    through the native coordinator, rank 0 advertises the jax.distributed
+    address via the KV store, both enter jax.distributed.initialize, and
+    each sees the GLOBAL runtime (process_count 2, 2 CPU devices, disjoint
+    local devices). This is the exact path `nezha-train --coordinator`
+    takes on a pod (dist/launch.py)."""
+    import json
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    # Free-port probe for the jax coordination service. (Small TOCTOU
+    # window before rank 0 re-binds it; the suite runs single-process, and
+    # the finally below reaps workers if a bind conflict ever hangs them.)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        jax_port = s.getsockname()[1]
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(f"""
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")  # before device init (conftest rule)
+from nezha_tpu import dist
+from nezha_tpu.dist.launch import initialize_jax_distributed
+
+group = dist.join("127.0.0.1", int(sys.argv[1]))
+initialize_jax_distributed(group, coord_port={jax_port}, timeout_s=60)
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Cross-process data path: a psum over the 2-device global mesh (one
+# device per process) — the XLA collective rides the distributed runtime.
+mesh = Mesh(np.array(jax.devices()), ("dp",))
+shard = jax.device_put(jnp.array([float(group.rank + 1)]),
+                       jax.local_devices()[0])
+arr = jax.make_array_from_single_device_arrays(
+    (2,), NamedSharding(mesh, P("dp")), [shard])
+total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)
+psum_val = float(total.addressable_shards[0].data)
+
+print(json.dumps({{
+    "rank": group.rank,
+    "process_count": jax.process_count(),
+    "process_index": jax.process_index(),
+    "global_devices": len(jax.devices()),
+    "local_devices": len(jax.local_devices()),
+    "psum": psum_val,
+}}))
+group.leave()
+""")
+    with dist.Coordinator(world_size=2) as coord:
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        # The suite forces an 8-device virtual mesh via XLA_FLAGS; the
+        # workers model one-device hosts, so scrub that flag (keep others).
+        env["XLA_FLAGS"] = " ".join(
+            f for f in env.get("XLA_FLAGS", "").split()
+            if not f.startswith("--xla_force_host_platform_device_count"))
+        procs = [subprocess.Popen(
+            [sys.executable, str(worker), str(coord.port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+            for _ in range(2)]
+        try:
+            outs = [p.communicate(timeout=120) for p in procs]
+        finally:  # never leak a wedged worker (hung initialize, etc.)
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, err[-2000:]
+    recs = [json.loads(out.strip().splitlines()[-1]) for out, _ in outs]
+    assert {r["rank"] for r in recs} == {0, 1}
+    for r in recs:
+        assert r["process_count"] == 2
+        assert r["global_devices"] == 2  # both hosts' devices visible
+        assert r["local_devices"] == 1   # but only its own are local
+        assert r["process_index"] == r["rank"]  # coordinator rank == jax id
+        assert r["psum"] == 3.0  # 1 + 2 summed ACROSS processes
