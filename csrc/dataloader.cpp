@@ -248,9 +248,18 @@ class MnistLoader : public Loader {
 class TokenLoader : public Loader {
  public:
   // dtype_code: 2 = uint16, 4 = int32.
+  // shard_index decorrelates the random-window streams across hosts (the
+  // stream is infinite/sampled, so sharding is a seed split, not a
+  // partition).
   TokenLoader(const char* path, int dtype_code, int seq, int batch,
-              uint64_t seed, int workers, size_t depth)
-      : Loader(batch, depth), seq_(seq), seed_(seed) {
+              uint64_t seed, int workers, size_t depth, int shard_index,
+              int shard_count)
+      : Loader(batch, depth), seq_(seq),
+        seed_(seed + 0xd1342543de82ef95ULL * static_cast<uint64_t>(shard_index)) {
+    if (shard_count < 1 || shard_index < 0 || shard_index >= shard_count) {
+      error_ = "need 0 <= shard_index < shard_count";
+      return;
+    }
     std::vector<unsigned char> raw;
     if (!read_file(path, &raw)) {
       error_ = "cannot read token file";
@@ -318,11 +327,20 @@ class TokenLoader : public Loader {
 // shuffle, random crop, horizontal flip, normalize — on worker threads.
 class ImageRecordLoader : public Loader {
  public:
+  // shard_index/shard_count: multi-host data sharding. Every shard derives
+  // the SAME per-epoch permutation (seed-keyed) and takes batches
+  // b ≡ shard_index (mod shard_count), so across the world each record is
+  // consumed exactly once per epoch with zero coordination traffic.
   ImageRecordLoader(const char* path, int batch, int crop_h, int crop_w,
                     uint64_t seed, int workers, size_t depth, int epochs,
-                    bool train_augment)
+                    bool train_augment, int shard_index, int shard_count)
       : Loader(batch, depth), crop_h_(crop_h), crop_w_(crop_w),
-        seed_(seed), epochs_(epochs), augment_(train_augment) {
+        seed_(seed), epochs_(epochs), augment_(train_augment),
+        shard_index_(shard_index), shard_count_(shard_count) {
+    if (shard_count_ < 1 || shard_index_ < 0 || shard_index_ >= shard_count_) {
+      error_ = "need 0 <= shard_index < shard_count";
+      return;
+    }
     if (!read_file(path, &raw_)) {
       error_ = "cannot read record file";
       return;
@@ -351,6 +369,11 @@ class ImageRecordLoader : public Loader {
       error_ = "batch size exceeds number of records";
       return;
     }
+    if (size_t(n_) / batch < static_cast<size_t>(shard_count_)) {
+      // A shard with zero batches would silently starve its host.
+      error_ = "shard_count exceeds batches per epoch";
+      return;
+    }
     if (crop_h_ <= 0) crop_h_ = h_;
     if (crop_w_ <= 0) crop_w_ = w_;
     if (crop_h_ > h_ || crop_w_ > w_) {
@@ -374,7 +397,15 @@ class ImageRecordLoader : public Loader {
  protected:
   void WorkerLoop(int worker_id) override {
     const size_t out_px = size_t(crop_h_) * crop_w_ * c_;
-    if (static_cast<size_t>(worker_id) >= size_t(n_) / batch_) {
+    const size_t shard0 = static_cast<size_t>(shard_index_);
+    const size_t sstride = static_cast<size_t>(shard_count_);
+    // Every shard serves exactly floor(nbatch / shard_count) batches per
+    // epoch (the ragged tail is dropped): lockstep multi-host consumers
+    // would otherwise deadlock when a short shard exhausts first.
+    const size_t nbatch_shard = (size_t(n_) / batch_) / sstride;
+    // s enumerates this shard's batch series; this worker takes every
+    // num_workers-th element of it. Global batch index b = shard0 + s*stride.
+    if (static_cast<size_t>(worker_id) >= nbatch_shard) {
       WorkerDone();  // can never produce a batch; see MnistLoader note
       return;
     }
@@ -384,9 +415,9 @@ class ImageRecordLoader : public Loader {
       for (int i = 0; i < n_; ++i) perm[i] = static_cast<uint32_t>(i);
       std::mt19937_64 perm_rng(seed_ + static_cast<uint64_t>(epoch));
       std::shuffle(perm.begin(), perm.end(), perm_rng);
-      const size_t nbatch = size_t(n_) / batch_;
-      for (size_t b = static_cast<size_t>(worker_id); b < nbatch;
-           b += static_cast<size_t>(num_workers_)) {
+      for (size_t s = static_cast<size_t>(worker_id); s < nbatch_shard;
+           s += static_cast<size_t>(num_workers_)) {
+        const size_t b = shard0 + s * sstride;
         if (stopping_) return;
         // Augmentation rng keyed by (seed, epoch, batch index): identical
         // batches regardless of which worker drew them.
@@ -447,6 +478,7 @@ class ImageRecordLoader : public Loader {
   const uint64_t seed_;
   const int epochs_;
   const bool augment_;
+  const int shard_index_, shard_count_;
 };
 
 }  // namespace
@@ -472,9 +504,11 @@ void* nz_mnist_open(const char* images_path, const char* labels_path,
 }
 
 void* nz_tokens_open(const char* path, int dtype_code, int seq, int batch,
-                     uint64_t seed, int workers, int depth, long* n_tokens) {
+                     uint64_t seed, int workers, int depth, int shard_index,
+                     int shard_count, long* n_tokens) {
   auto* l = new TokenLoader(path, dtype_code, seq, batch, seed, workers,
-                            static_cast<size_t>(depth));
+                            static_cast<size_t>(depth), shard_index,
+                            shard_count);
   if (!l->ok()) {
     set_loader_error(l->error());
     delete l;
@@ -486,11 +520,12 @@ void* nz_tokens_open(const char* path, int dtype_code, int seq, int batch,
 
 void* nz_records_open(const char* path, int batch, int crop_h, int crop_w,
                       uint64_t seed, int workers, int depth, int epochs,
-                      int train_augment, int* n_out, int* h_out, int* w_out,
-                      int* c_out) {
+                      int train_augment, int shard_index, int shard_count,
+                      int* n_out, int* h_out, int* w_out, int* c_out) {
   auto* l = new ImageRecordLoader(path, batch, crop_h, crop_w, seed, workers,
                                   static_cast<size_t>(depth), epochs,
-                                  train_augment != 0);
+                                  train_augment != 0, shard_index,
+                                  shard_count);
   if (!l->ok()) {
     set_loader_error(l->error());
     delete l;

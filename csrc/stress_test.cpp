@@ -35,7 +35,8 @@ void nz_client_close(void* c);
 
 const char* nz_loader_error();
 void* nz_tokens_open(const char* path, int dtype_code, int seq, int batch,
-                     uint64_t seed, int workers, int depth, long* n_tokens);
+                     uint64_t seed, int workers, int depth, int shard_index,
+                     int shard_count, long* n_tokens);
 int nz_loader_next(void* l, float* f32_out, int32_t* i32_out);
 void nz_loader_close(void* l);
 }
@@ -113,7 +114,8 @@ static void loader_stress(const char* tmpdir) {
     std::fclose(f);
   }
   long n_tokens = 0;
-  void* l = nz_tokens_open(path.c_str(), 2, 128, 32, 7, 4, 8, &n_tokens);
+  void* l = nz_tokens_open(path.c_str(), 2, 128, 32, 7, 4, 8, 0, 1,
+                           &n_tokens);
   CHECK(l != nullptr, "tokens open");
   if (!l) return;
   // Two consumer threads racing the 4 producer workers.

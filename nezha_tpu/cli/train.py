@@ -218,20 +218,42 @@ def _nzr_count(path) -> int:
     return int(np.frombuffer(header[4:8], np.int32)[0])
 
 
-def _data_source(args, cfg, batch_size: int):
+def _slice_rows(it: Iterator[dict], rank: int, local: int) -> Iterator[dict]:
+    """Rows [rank*local, (rank+1)*local) of each globally-identical batch —
+    turns a same-seed synthetic stream into per-host-distinct local rows."""
+    for b in it:
+        yield {k: v[rank * local:(rank + 1) * local] for k, v in b.items()}
+
+
+def _data_source(args, cfg, batch_size: int, group=None):
     """Training batches: real records via the native C++ loaders when
     ``--data-dir`` holds them (SURVEY.md §2 data loaders), synthetic
-    fallback otherwise. Returns (iterator, closer)."""
+    fallback otherwise. Returns (iterator, closer).
+
+    With ``group`` set (multi-process dp/zero1), ``batch_size`` is the
+    GLOBAL batch and each host yields only its batch_size/world local rows:
+    record loaders read a disjoint shard of each epoch (same-seed shuffle,
+    batches ``b % world == rank``, zero coordination traffic), token
+    loaders draw a decorrelated window stream, and synthetic streams are
+    row-sliced out of the same-seed global batch. The per-mode ``shard``
+    fn then assembles the global array from process-local rows
+    (``parallel.shard_batch_process_local``)."""
     import os
 
+    world = group.world_size if group is not None else 1
+    rank = group.rank if group is not None else 0
+    local = batch_size // world
+    shard = {"shard_index": rank, "shard_count": world} if world > 1 else {}
     if args.data_dir:
         from nezha_tpu.data.native import ImageRecordLoader, TokenLoader
         if args.config in _IMAGE_CONFIGS:
             rec = os.path.join(args.data_dir, "train.nzr")
             if os.path.exists(rec):
-                loader = ImageRecordLoader(rec, batch_size, crop=args.crop,
-                                           seed=args.seed, train_augment=True)
-                print(f"data: {loader.num_examples} image records from {rec}",
+                loader = ImageRecordLoader(rec, local, crop=args.crop,
+                                           seed=args.seed, train_augment=True,
+                                           **shard)
+                print(f"data: {loader.num_examples} image records from {rec}"
+                      + (f" (shard {rank}/{world})" if shard else ""),
                       file=sys.stderr)
                 return iter(loader), loader.close
         elif args.config == "gpt2_124m":
@@ -240,9 +262,10 @@ def _data_source(args, cfg, batch_size: int):
                 tok = os.path.join(args.data_dir, name)
                 if os.path.exists(tok):
                     loader = TokenLoader(tok, seq_len=args.seq_len or 1024,
-                                         batch_size=batch_size, dtype=dtype,
-                                         seed=args.seed)
-                    print(f"data: {loader.num_tokens} tokens from {tok}",
+                                         batch_size=local, dtype=dtype,
+                                         seed=args.seed, **shard)
+                    print(f"data: {loader.num_tokens} tokens from {tok}"
+                          + (f" (shard {rank}/{world})" if shard else ""),
                           file=sys.stderr)
                     return iter(loader), loader.close
         elif args.config == "mlp_mnist":
@@ -250,10 +273,13 @@ def _data_source(args, cfg, batch_size: int):
             if os.path.isdir(os.path.join(args.data_dir, "mnist")):
                 print(f"data: MNIST IDX files from {args.data_dir}/mnist",
                       file=sys.stderr)
-                return cfg.batches(batch_size), None
+                it = cfg.batches(batch_size)
+                return (_slice_rows(it, rank, local) if world > 1 else it,
+                        None)
         print(f"data: no records for {args.config} in {args.data_dir}; "
               f"using synthetic data", file=sys.stderr)
-    return cfg.batches(batch_size), None
+    it = cfg.batches(batch_size)
+    return (_slice_rows(it, rank, local) if world > 1 else it), None
 
 
 def _eval_source(args, cfg, batch_size: int):
@@ -285,6 +311,18 @@ def _eval_source(args, cfg, batch_size: int):
     if cfg.eval_batches is not None:
         return cfg.eval_batches(batch_size), None, cfg.eval_stat
     return None, None, None
+
+
+def _make_batch_sharder(mesh, group):
+    """dp/zero1 batch placement: single-process hosts hold the whole global
+    batch (device_put row-split); multi-process hosts hold only their local
+    shard rows, assembled into the global array with zero inter-host
+    transfer (pairs with _data_source's per-rank sharded loading)."""
+    from nezha_tpu import parallel
+
+    if group is not None and group.world_size > 1:
+        return lambda b: parallel.shard_batch_process_local(mesh, b)
+    return lambda b: parallel.shard_batch(mesh, b)
 
 
 def run(args) -> Dict[str, float]:
@@ -499,7 +537,7 @@ def run(args) -> Dict[str, float]:
             step_fn = parallel.make_dp_train_step(
                 model, optimizer, cfg.loss_fn, mesh,
                 grad_reduce=args.grad_allreduce)
-            shard = lambda b: parallel.shard_batch(mesh, b)
+            shard = _make_batch_sharder(mesh, group)
         elif mode == "sp":
             from nezha_tpu.parallel import sequence_parallel as sp_mod
             state = parallel.replicate(mesh, state)
@@ -548,7 +586,7 @@ def run(args) -> Dict[str, float]:
             step_fn = parallel.make_zero1_train_step(
                 model, optimizer, cfg.loss_fn, mesh,
                 grad_reduce=args.grad_allreduce)
-            shard = lambda b: parallel.shard_batch(mesh, b)
+            shard = _make_batch_sharder(mesh, group)
         else:
             raise ValueError(mode)
 
@@ -578,7 +616,18 @@ def run(args) -> Dict[str, float]:
 
     # --- loop (one shared Trainer for every mode, so failure detection /
     # checkpoint-before-raise is live in real CLI runs) --------------------
-    source, close_source = _data_source(args, cfg, batch_size)
+    # Multi-process data sharding pairs with process-local batch assembly,
+    # which only the dp/zero1 sharders do; other modes keep the documented
+    # identical-stream semantics of shard_batch.
+    data_group = (group if group is not None and group.world_size > 1
+                  and mode in ("dp", "zero1") else None)
+    if data_group is not None and batch_size % data_group.world_size:
+        raise SystemExit(
+            f"--batch-size {batch_size} must be divisible by the process "
+            f"world size {data_group.world_size} (it is the GLOBAL batch; "
+            f"each host loads batch/world local rows)")
+    source, close_source = _data_source(args, cfg, batch_size,
+                                        group=data_group)
     prefetch = Prefetcher(source, depth=args.prefetch)
     from nezha_tpu.utils import MetricsLogger
     metrics_log = MetricsLogger(args.metrics_file) if args.metrics_file else None

@@ -85,11 +85,18 @@ class ImageRecordLoader(_Closable):
     crop at eval). Yields ``{"image": float32 [B, ch, cw, C] in [0,1],
     "label": int32 [B]}``. Write record files with
     :func:`write_image_records`. ``epochs <= 0`` streams forever.
+
+    ``shard_index``/``shard_count`` (multi-host): every shard derives the
+    same per-epoch shuffle and takes batches ``b % shard_count ==
+    shard_index`` — each record is consumed exactly once per epoch across
+    the world, with zero coordination traffic (pass the coordinator's
+    rank/world_size).
     """
 
     def __init__(self, path: str, batch_size: int, crop: int = 0,
                  seed: int = 0, num_workers: int = 2, queue_depth: int = 4,
-                 epochs: int = 0, train_augment: bool = True):
+                 epochs: int = 0, train_augment: bool = True,
+                 shard_index: int = 0, shard_count: int = 1):
         self._lib = load_library()
         n = ctypes.c_int()
         h = ctypes.c_int()
@@ -98,7 +105,7 @@ class ImageRecordLoader(_Closable):
         self._h = self._lib.nz_records_open(
             str(path).encode(), int(batch_size), int(crop), int(crop),
             int(seed), int(num_workers), int(queue_depth), int(epochs),
-            1 if train_augment else 0,
+            1 if train_augment else 0, int(shard_index), int(shard_count),
             ctypes.byref(n), ctypes.byref(h), ctypes.byref(w),
             ctypes.byref(c))
         if not self._h:
@@ -150,15 +157,19 @@ class TokenLoader(_Closable):
 
     def __init__(self, path: str, seq_len: int, batch_size: int,
                  dtype=np.uint16, seed: int = 0, num_workers: int = 2,
-                 queue_depth: int = 4):
+                 queue_depth: int = 4, shard_index: int = 0,
+                 shard_count: int = 1):
         self._lib = load_library()
         code = self._DTYPES.get(np.dtype(dtype))
         if code is None:
             raise ValueError("dtype must be uint16 or int32")
         n = ctypes.c_long()
+        # The stream is sampled (random windows), so sharding is a seed
+        # split: each host draws a decorrelated window stream.
         self._h = self._lib.nz_tokens_open(
             str(path).encode(), code, int(seq_len), int(batch_size),
-            int(seed), int(num_workers), int(queue_depth), ctypes.byref(n))
+            int(seed), int(num_workers), int(queue_depth), int(shard_index),
+            int(shard_count), ctypes.byref(n))
         if not self._h:
             raise NativeLoaderError(self._lib.nz_loader_error().decode())
         self.num_tokens = n.value

@@ -20,6 +20,7 @@ from nezha_tpu.parallel.collectives import (
 from nezha_tpu.parallel.data_parallel import (
     make_dp_train_step,
     shard_batch,
+    shard_batch_process_local,
     replicate,
     sync_batch_stats,
 )
@@ -36,7 +37,8 @@ __all__ = [
     "make_mesh", "make_cpu_mesh", "local_mesh_axes",
     "all_reduce_mean", "all_reduce_sum", "all_gather", "reduce_scatter",
     "ring_permute", "barrier",
-    "make_dp_train_step", "shard_batch", "replicate", "sync_batch_stats",
+    "make_dp_train_step", "shard_batch", "shard_batch_process_local",
+    "replicate", "sync_batch_stats",
     "make_zero1_train_step", "zero1_init_opt_state",
     "GPT2_TP_RULES", "BERT_TP_RULES", "param_specs_from_rules",
     "shard_train_state", "make_gspmd_train_step",

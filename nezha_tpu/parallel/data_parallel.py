@@ -25,9 +25,30 @@ from nezha_tpu.train.loop import TrainState, merge_state
 
 def shard_batch(mesh: Mesh, batch: Any, axis: str = "dp") -> Any:
     """Place a host batch with its leading dim sharded over ``axis`` —
-    arrays land already distributed, so no resharding inside the step."""
+    arrays land already distributed, so no resharding inside the step.
+
+    Multi-process note: ``device_put`` treats ``batch`` as the GLOBAL batch
+    and every process must pass the same logical value (each keeps its
+    addressable row-slice). For per-host-distinct data use
+    :func:`shard_batch_process_local` instead.
+    """
     sharding = NamedSharding(mesh, P(axis))
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def shard_batch_process_local(mesh: Mesh, local_batch: Any,
+                              axis: str = "dp") -> Any:
+    """Assemble a global batch from per-process LOCAL rows: each host
+    contributes ``local_batch`` (global_rows / process_count of them) as its
+    own shard — the multi-host data path (each host's loader reads a
+    disjoint shard; nothing is transferred between hosts)."""
+    import numpy as np
+
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(
+            sharding, np.asarray(x)),
+        local_batch)
 
 
 def replicate(mesh: Mesh, tree: Any) -> Any:

@@ -75,14 +75,14 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
                                   c.POINTER(c.c_int), c.POINTER(c.c_int)]
     lib.nz_tokens_open.restype = c.c_void_p
     lib.nz_tokens_open.argtypes = [c.c_char_p, c.c_int, c.c_int, c.c_int,
-                                   c.c_uint64, c.c_int, c.c_int,
-                                   c.POINTER(c.c_long)]
+                                   c.c_uint64, c.c_int, c.c_int, c.c_int,
+                                   c.c_int, c.POINTER(c.c_long)]
     lib.nz_records_open.restype = c.c_void_p
     lib.nz_records_open.argtypes = [c.c_char_p, c.c_int, c.c_int, c.c_int,
                                     c.c_uint64, c.c_int, c.c_int, c.c_int,
-                                    c.c_int, c.POINTER(c.c_int),
+                                    c.c_int, c.c_int, c.c_int,
                                     c.POINTER(c.c_int), c.POINTER(c.c_int),
-                                    c.POINTER(c.c_int)]
+                                    c.POINTER(c.c_int), c.POINTER(c.c_int)]
     lib.nz_loader_next.restype = c.c_int
     lib.nz_loader_next.argtypes = [c.c_void_p, c.POINTER(c.c_float),
                                    c.POINTER(c.c_int32)]

@@ -373,3 +373,50 @@ def test_cli_with_coordinator(tmp_path):
         ])
         last = run(args)
     assert "loss" in last
+
+
+def test_cli_two_process_dp_sharded_data(devices8, tmp_path):
+    """The pod launch path end-to-end on one box: two OS processes
+    rendezvous via --coordinator, enter jax.distributed, shard the record
+    file by rank (disjoint halves of each epoch), assemble global batches
+    from process-local rows, and train DP over the 2-device global mesh —
+    replicated metrics must agree bit-for-bit across ranks."""
+    import socket
+    import sys
+
+    from conftest import run_worker_processes
+    from nezha_tpu.data.native import write_image_records
+    from nezha_tpu.runtime.native import native_available
+    if not native_available():
+        import pytest
+        pytest.skip("native runtime not available")
+
+    rng = np.random.RandomState(0)
+    write_image_records(
+        tmp_path / "train.nzr",
+        rng.randint(0, 256, (32, 36, 36, 3), dtype=np.uint8),
+        rng.randint(0, 100, 32))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    base = [sys.executable, "-m", "nezha_tpu.cli.train",
+            "--config", "resnet50_imagenet", "--model-preset", "tiny",
+            "--steps", "2", "--batch-size", "8", "--mesh", "dp=2",
+            "--crop", "32", "--data-dir", str(tmp_path),
+            "--platform", "cpu", "--log-every", "1",
+            "--coordinator", f"127.0.0.1:{port}"]
+    results = run_worker_processes([
+        base + (["--serve-coordinator", "--world-size", "2"] if i == 0
+                else [])
+        for i in range(2)])
+    for rc, _, err in results:
+        assert rc == 0, err[-3000:]
+    shards = {s for _, _, err in results
+              for s in ("(shard 0/2)", "(shard 1/2)") if s in err}
+    assert shards == {"(shard 0/2)", "(shard 1/2)"}, \
+        [e[-500:] for _, _, e in results]
+    finals = [json.loads(out.strip().splitlines()[-1])["final"]["loss"]
+              for _, out, _ in results]
+    assert np.isfinite(finals[0])
+    assert finals[0] == finals[1]  # replicated metrics agree across ranks

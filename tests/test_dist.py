@@ -325,10 +325,10 @@ def test_initialize_jax_distributed_two_processes(tmp_path):
     local devices). This is the exact path `nezha-train --coordinator`
     takes on a pod (dist/launch.py)."""
     import json
-    import os
     import socket
-    import subprocess
     import sys
+
+    from conftest import run_worker_processes
 
     # Free-port probe for the jax coordination service. (Small TOCTOU
     # window before rank 0 re-binds it; the suite runs single-process, and
@@ -372,29 +372,12 @@ print(json.dumps({{
 group.leave()
 """)
     with dist.Coordinator(world_size=2) as coord:
-        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        env = dict(os.environ)
-        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-        # The suite forces an 8-device virtual mesh via XLA_FLAGS; the
-        # workers model one-device hosts, so scrub that flag (keep others).
-        env["XLA_FLAGS"] = " ".join(
-            f for f in env.get("XLA_FLAGS", "").split()
-            if not f.startswith("--xla_force_host_platform_device_count"))
-        procs = [subprocess.Popen(
-            [sys.executable, str(worker), str(coord.port)],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env=env)
-            for _ in range(2)]
-        try:
-            outs = [p.communicate(timeout=120) for p in procs]
-        finally:  # never leak a wedged worker (hung initialize, etc.)
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
-                    p.wait()
-    for p, (out, err) in zip(procs, outs):
-        assert p.returncode == 0, err[-2000:]
-    recs = [json.loads(out.strip().splitlines()[-1]) for out, _ in outs]
+        results = run_worker_processes(
+            [[sys.executable, str(worker), str(coord.port)]
+             for _ in range(2)], timeout=120)
+    for rc, _, err in results:
+        assert rc == 0, err[-2000:]
+    recs = [json.loads(out.strip().splitlines()[-1]) for _, out, _ in results]
     assert {r["rank"] for r in recs} == {0, 1}
     for r in recs:
         assert r["process_count"] == 2

@@ -251,3 +251,73 @@ def test_records_batch_larger_than_dataset_rejected(tmp_path):
     p, _, _ = _write_records(tmp_path, n=8)
     with pytest.raises(NativeLoaderError, match="batch"):
         ImageRecordLoader(p, batch_size=64)
+
+
+def test_records_sharding_partitions_each_epoch(tmp_path):
+    """Multi-host sharding: two shards with the same seed consume disjoint
+    halves of the epoch whose union is every record exactly once."""
+    from nezha_tpu.data.native import ImageRecordLoader, write_image_records
+    rng = np.random.RandomState(0)
+    n = 32
+    p = str(tmp_path / "r.nzr")
+    write_image_records(p, rng.randint(0, 256, (n, 6, 6, 3), dtype=np.uint8),
+                        np.arange(n))  # unique labels identify records
+    served = {}
+    for idx in range(2):
+        with ImageRecordLoader(p, batch_size=4, epochs=1, num_workers=2,
+                               train_augment=False, seed=7,
+                               shard_index=idx, shard_count=2) as ld:
+            served[idx] = np.concatenate([b["label"] for b in ld])
+    assert len(served[0]) == len(served[1]) == n // 2
+    assert not set(served[0]) & set(served[1])  # disjoint
+    assert sorted(np.concatenate([served[0], served[1]])) == list(range(n))
+
+
+def test_records_sharding_rejects_starved_shard(tmp_path):
+    from nezha_tpu.data.native import (ImageRecordLoader, NativeLoaderError,
+                                       write_image_records)
+    rng = np.random.RandomState(0)
+    p = str(tmp_path / "r.nzr")
+    write_image_records(p, rng.randint(0, 256, (8, 4, 4, 3), dtype=np.uint8),
+                        np.arange(8))
+    # 2 batches per epoch cannot feed 4 shards.
+    with pytest.raises(NativeLoaderError, match="shard_count"):
+        ImageRecordLoader(p, batch_size=4, shard_index=0, shard_count=4)
+    with pytest.raises(NativeLoaderError, match="shard_index"):
+        ImageRecordLoader(p, batch_size=4, shard_index=2, shard_count=2)
+
+
+def test_tokens_sharding_decorrelates_streams(tmp_path):
+    from nezha_tpu.data.native import TokenLoader
+    toks = np.arange(4096, dtype=np.uint16)
+    p = str(tmp_path / "t.bin")
+    toks.tofile(p)
+    outs = []
+    for idx in range(2):
+        with TokenLoader(p, seq_len=16, batch_size=4, seed=3,
+                         num_workers=1, shard_index=idx,
+                         shard_count=2) as ld:
+            outs.append(next(iter(ld))["tokens"].copy())
+    assert not np.array_equal(outs[0], outs[1])  # different window streams
+
+
+def test_records_uneven_shards_serve_equal_counts(tmp_path):
+    """nbatch not divisible by shard_count: every shard serves exactly
+    floor(nbatch/shard_count) batches per epoch (ragged tail dropped), so
+    lockstep multi-host consumers can never deadlock on a short shard."""
+    from nezha_tpu.data.native import ImageRecordLoader, write_image_records
+    rng = np.random.RandomState(0)
+    n, batch, shards = 40, 4, 3  # 10 batches -> 3 per shard, 1 dropped
+    p = str(tmp_path / "r.nzr")
+    write_image_records(p, rng.randint(0, 256, (n, 5, 5, 3), dtype=np.uint8),
+                        np.arange(n))
+    counts, seen = [], []
+    for idx in range(shards):
+        with ImageRecordLoader(p, batch_size=batch, epochs=1, num_workers=2,
+                               train_augment=False, seed=5,
+                               shard_index=idx, shard_count=shards) as ld:
+            labels = [b["label"] for b in ld]
+        counts.append(len(labels))
+        seen.extend(np.concatenate(labels).tolist())
+    assert counts == [3, 3, 3]  # floor(10/3) each, no ragged shard
+    assert len(seen) == len(set(seen)) == 36  # disjoint, 4 records dropped
