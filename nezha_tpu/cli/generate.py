@@ -1,0 +1,143 @@
+"""`nezha-generate` — KV-cache text generation from a trained checkpoint.
+
+The inference-side counterpart of `nezha-train` (SURVEY.md §1 CLI row):
+restore a GPT-2 checkpoint the trainer wrote (or Hugging Face weights via
+models/convert.py) and decode with the cached single-position path
+(models/generate.py: jit-compiled prefill + lax.scan decode — no Python
+loop over positions, TPU-friendly static shapes).
+
+Prompts are token id lists (`--prompt-tokens 15496,995`) or a binary token
+file (`--prompt-file`, uint16/int32) — tokenization itself is a dataset
+-prep concern (the training data path is pre-tokenized too, data/native.py).
+
+    nezha-generate --ckpt-dir runs/gpt2 --prompt-tokens 1,2,3 \
+        --max-new-tokens 32 --temperature 0.8 --top-k 40
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="nezha-generate", description=__doc__)
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--ckpt-dir",
+                     help="checkpoint dir written by nezha-train "
+                          "(--config gpt2_124m)")
+    src.add_argument("--hf-dir",
+                     help="Hugging Face GPT2LMHeadModel directory "
+                          "(offline; needs the `transformers` package)")
+    src.add_argument("--random-init", action="store_true",
+                     help="fresh random weights (smoke/benchmark runs)")
+    p.add_argument("--model-preset", choices=["full", "tiny"], default="full",
+                   help="must match the preset the checkpoint was trained "
+                        "with (mirrors nezha-train)")
+    p.add_argument("--prompt-tokens", default=None,
+                   help="comma-separated token ids, e.g. 15496,995")
+    p.add_argument("--prompt-file", default=None,
+                   help="binary token file (uint16 unless --prompt-i32)")
+    p.add_argument("--prompt-i32", action="store_true")
+    p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--temperature", type=float, default=1.0,
+                   help="0 = greedy argmax")
+    p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--platform", default=None,
+                   help="force a jax platform (e.g. cpu)")
+    return p
+
+
+def _prompt_ids(args) -> np.ndarray:
+    if (args.prompt_tokens is None) == (args.prompt_file is None):
+        raise SystemExit("pass exactly one of --prompt-tokens/--prompt-file")
+    if args.prompt_tokens is not None:
+        try:
+            ids = [int(t) for t in args.prompt_tokens.split(",") if t.strip()]
+        except ValueError:
+            raise SystemExit(f"--prompt-tokens must be comma-separated ids, "
+                             f"got {args.prompt_tokens!r}")
+        if not ids:
+            raise SystemExit("--prompt-tokens is empty")
+        return np.asarray([ids], np.int32)
+    dtype = np.int32 if args.prompt_i32 else np.uint16
+    ids = np.fromfile(args.prompt_file, dtype=dtype).astype(np.int32)
+    if ids.size == 0:
+        raise SystemExit(f"{args.prompt_file} holds no tokens")
+    return ids[None, :]
+
+
+def run(args) -> dict:
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from nezha_tpu.models.generate import generate
+    from nezha_tpu.models.gpt2 import GPT2, GPT2Config
+    from nezha_tpu.tensor import bf16_policy
+
+    if args.hf_dir:
+        import transformers
+
+        hf = transformers.GPT2LMHeadModel.from_pretrained(args.hf_dir)
+        from nezha_tpu.models.convert import gpt2_from_hf
+        model, variables = gpt2_from_hf(hf)
+    else:
+        # Policies mirror nezha-train's presets exactly: full trains bf16,
+        # tiny trains fp32 (DEFAULT_POLICY) — greedy decode must run the
+        # same compute numerics as the checkpoint's training run.
+        if args.model_preset == "full":
+            model = GPT2(GPT2Config(), policy=bf16_policy())
+        else:
+            from nezha_tpu.cli.train import TINY_GPT2_KW
+            model = GPT2(GPT2Config(**TINY_GPT2_KW))
+        variables = model.init(jax.random.PRNGKey(args.seed))
+        if args.ckpt_dir:
+            from nezha_tpu.train.checkpoint import try_restore
+
+            # nezha-train checkpoints hold the full train state; generation
+            # needs the variables leaf only (optimizer state is ignored).
+            from nezha_tpu import optim
+            from nezha_tpu.train.loop import init_train_state
+            template = init_train_state(model, optim.sgd(0.1),
+                                        jax.random.PRNGKey(0))
+            restored, step = try_restore(args.ckpt_dir, template)
+            if restored is None:
+                raise SystemExit(f"no checkpoint found in {args.ckpt_dir}")
+            variables = restored["variables"]
+            print(f"restored step {step} from {args.ckpt_dir}",
+                  file=sys.stderr)
+
+    prompt = _prompt_ids(args)
+    vocab = model.cfg.vocab_size
+    if prompt.max() >= vocab or prompt.min() < 0:
+        raise SystemExit(f"prompt ids must be in [0, {vocab}); "
+                         f"got max {int(prompt.max())}")
+    limit = model.cfg.max_positions - prompt.shape[1]
+    if args.max_new_tokens > limit:
+        raise SystemExit(f"prompt ({prompt.shape[1]} tokens) + "
+                         f"--max-new-tokens {args.max_new_tokens} exceeds "
+                         f"max_positions {model.cfg.max_positions}")
+
+    out = generate(model, variables, prompt,
+                   max_new_tokens=args.max_new_tokens,
+                   temperature=args.temperature, top_k=args.top_k,
+                   rng=jax.random.PRNGKey(args.seed))
+    new_tokens = np.asarray(out)[0, prompt.shape[1]:].tolist()
+    result = {"prompt_len": int(prompt.shape[1]), "tokens": new_tokens}
+    print(json.dumps(result))
+    return result
+
+
+def main(argv=None) -> int:
+    run(build_parser().parse_args(argv))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

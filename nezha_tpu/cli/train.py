@@ -62,6 +62,13 @@ class Config:
         self.graph_opt = graph_opt
 
 
+# The tiny GPT-2 preset's hyperparameters — one definition shared by the
+# train and generate CLIs so a `--model-preset tiny` checkpoint always
+# round-trips (fp32 DEFAULT_POLICY, for tight mode-vs-mode tolerances).
+TINY_GPT2_KW = dict(vocab_size=512, max_positions=96, num_layers=4,
+                    num_heads=4, hidden_size=64)
+
+
 def _configs() -> Dict[str, Config]:
     # Imports deferred so `--help` stays instant.
     from nezha_tpu import data, models, ops, optim
@@ -79,8 +86,7 @@ def _configs() -> Dict[str, Config]:
     # Tiny presets run the same code paths at seconds scale (fp32 for the
     # transformers so mode-vs-mode numerics tests have tight tolerances).
     def tiny_gpt2(**overrides):
-        kw = dict(vocab_size=512, max_positions=96, num_layers=4,
-                  num_heads=4, hidden_size=64)
+        kw = dict(TINY_GPT2_KW)
         kw.update(overrides)
         return models.GPT2(models.GPT2Config(**kw))
 
