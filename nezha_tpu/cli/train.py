@@ -373,6 +373,24 @@ def run(args) -> Dict[str, float]:
             cfg.sp_model = lambda impl, **ov: moe_sp(
                 impl, moe_experts=args.moe_experts, **ov)
 
+    if args.remat:
+        # Block rematerialization: the long-context/big-batch memory knob
+        # (jax.checkpoint per transformer block; see GPT2Config.remat).
+        if args.config != "gpt2_124m":
+            raise SystemExit("--remat applies to gpt2_124m")
+        if args.engine == "graph":
+            raise SystemExit("--remat is a jax.checkpoint knob; the graph "
+                             "engine does not rematerialize")
+        if args.parallel == "pp":
+            raise SystemExit("--remat does not reach the pipeline's stage "
+                             "slabs (they apply blocks directly); "
+                             "--microbatches is the pp memory knob")
+        rm_build = cfg.build_model
+        cfg.build_model = lambda **ov: rm_build(remat=True, **ov)
+        if cfg.sp_model is not None:
+            rm_sp = cfg.sp_model
+            cfg.sp_model = lambda impl, **ov: rm_sp(impl, remat=True, **ov)
+
     if args.seq_len:
         # Long-context override: resize position table + data together.
         # With --parallel sp the sequence shards over the sp axis, so
@@ -769,6 +787,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--moe-experts", type=int, default=None,
                    help="gpt2_124m only: swap every other block's MLP for "
                         "a top-k routed mixture of this many experts")
+    p.add_argument("--remat", action="store_true",
+                   help="gpt2_124m only: rematerialize each block in "
+                        "backward (jax.checkpoint) — O(1) activation "
+                        "residuals per block for ~1/3 extra FLOPs; the "
+                        "long-context memory knob (pairs with --seq-len "
+                        "and --parallel sp)")
     p.add_argument("--grad-allreduce", default="fp32",
                    choices=["fp32", "int8"],
                    help="dp/zero1 gradient wire format: exact fp32 or "

@@ -57,6 +57,12 @@ class GPT2Config:
     moe_aux_weight: float = 0.01
     # "pallas" opts layer norms into the fused kernel (fwd + bwd) on TPU.
     ln_impl: str = "xla"
+    # Rematerialize each transformer block in backward (jax.checkpoint):
+    # activation memory drops from O(layers) residuals to O(1) per block at
+    # ~1/3 extra FLOPs — the long-context / big-batch memory knob (pairs
+    # with fused_loss_chunk>0 and --parallel sp). Training-only; the
+    # KV-cache decode path never remats.
+    remat: bool = False
 
 
 class Attention(Module):
@@ -236,10 +242,27 @@ class GPT2(Module):
                           training=training)
         x = run_child(self.drop, "drop", variables, states, x,
                       training=training, rng=rng)
+        remat = self.cfg.remat and training and cache is None
         for i, block in enumerate(self.h):
-            x = run_child(block, f"h{i}", variables, states, x,
-                          training=training, rng=rng,
-                          cache=None if cache is None else cache[i], pos=pos)
+            if remat:
+                # Save only each block's input; recompute its internals in
+                # backward. rng/pos ride through as traced args so dropout
+                # keys replay identically in the recompute.
+                name = f"h{i}"
+
+                def block_fn(bvars, xx, block=block):
+                    return block.apply(bvars, xx, training=True,
+                                       rng=child_rng(rng, name), pos=pos)
+
+                x, st = jax.checkpoint(block_fn)(
+                    child_vars(variables, name), x)
+                if st:
+                    states[name] = st
+            else:
+                x = run_child(block, f"h{i}", variables, states, x,
+                              training=training, rng=rng,
+                              cache=None if cache is None else cache[i],
+                              pos=pos)
         x = run_child(self.ln_f, "ln_f", variables, states, x,
                       training=training)
         # MoE blocks report their load-balance losses through child state;

@@ -173,12 +173,31 @@ def test_cli_sp_ulysses(devices8):
 
 def test_cli_sp_long_context(devices8):
     """--seq-len stretches model + data together; with --parallel sp the
-    sequence shards over sp, the long-context path of the brief."""
+    sequence shards over sp, the long-context path of the brief — composed
+    here with --remat (jax.checkpoint per block), the other long-context
+    memory knob."""
     m = _run(["--config", "gpt2_124m", "--model-preset", "tiny",
               "--parallel", "sp", "--mesh", "dp=1,sp=8", "--seq-len", "256",
-              "--attn-impl", "ring", "--steps", "2", "--batch-size", "4",
-              "--log-every", "1"])
+              "--attn-impl", "ring", "--remat", "--steps", "2",
+              "--batch-size", "4", "--log-every", "1"])
     assert np.isfinite(m["loss"])
+
+
+def test_cli_remat_matches_and_rejects(devices8):
+    """--remat must not change training numerics, and configs/engines that
+    cannot honor it reject instead of silently ignoring."""
+    import pytest
+    ref = _final_losses("gpt2_124m", 2, 8, ["--parallel", "single"])
+    rm = _final_losses("gpt2_124m", 2, 8, ["--parallel", "single",
+                                           "--remat"])
+    np.testing.assert_allclose(rm, ref, rtol=1e-5)
+    with pytest.raises(SystemExit, match="applies to gpt2_124m"):
+        _run(["--config", "mlp_mnist", "--steps", "1", "--batch-size", "8",
+              "--remat"])
+    with pytest.raises(SystemExit, match="pp memory knob"):
+        _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--steps", "1", "--batch-size", "8", "--parallel", "pp",
+              "--mesh", "dp=2,pp=4", "--remat"])
 
 
 def test_cli_gspmd_sharded_checkpoint_resume(devices8, tmp_path):
