@@ -6,9 +6,11 @@ models/convert.py) and decode with the cached single-position path
 (models/generate.py: jit-compiled prefill + lax.scan decode — no Python
 loop over positions, TPU-friendly static shapes).
 
-Prompts are token id lists (`--prompt-tokens 15496,995`) or a binary token
-file (`--prompt-file`, uint16/int32) — tokenization itself is a dataset
--prep concern (the training data path is pre-tokenized too, data/native.py).
+Prompts: token id lists (`--prompt-tokens 15496,995`), a binary token file
+(`--prompt-file`, uint16/int32), or raw text (`--prompt`, byte-level —
+the vocab-256 encoding `data/pack.py` trains with; output decodes back to
+text). Subword tokenization stays a dataset-prep concern, same as the
+pre-tokenized training path (data/native.py).
 
     nezha-generate --ckpt-dir runs/gpt2 --prompt-tokens 1,2,3 \
         --max-new-tokens 32 --temperature 0.8 --top-k 40
@@ -39,6 +41,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "with (mirrors nezha-train)")
     p.add_argument("--prompt-tokens", default=None,
                    help="comma-separated token ids, e.g. 15496,995")
+    p.add_argument("--prompt", default=None,
+                   help="raw text, byte-level tokenized (vocab 256 — the "
+                        "encoding data/pack.py trains with); output decodes "
+                        "back to text")
     p.add_argument("--prompt-file", default=None,
                    help="binary token file (uint16 unless --prompt-i32)")
     p.add_argument("--prompt-i32", action="store_true")
@@ -53,8 +59,16 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def _prompt_ids(args) -> np.ndarray:
-    if (args.prompt_tokens is None) == (args.prompt_file is None):
-        raise SystemExit("pass exactly one of --prompt-tokens/--prompt-file")
+    given = [x is not None
+             for x in (args.prompt_tokens, args.prompt, args.prompt_file)]
+    if sum(given) != 1:
+        raise SystemExit("pass exactly one of "
+                         "--prompt-tokens/--prompt/--prompt-file")
+    if args.prompt is not None:
+        if not args.prompt:
+            raise SystemExit("--prompt is empty")
+        ids = np.frombuffer(args.prompt.encode("utf-8"), np.uint8)
+        return ids.astype(np.int32)[None, :]
     if args.prompt_tokens is not None:
         try:
             ids = [int(t) for t in args.prompt_tokens.split(",") if t.strip()]
@@ -130,6 +144,19 @@ def run(args) -> dict:
                    rng=jax.random.PRNGKey(args.seed))
     new_tokens = np.asarray(out)[0, prompt.shape[1]:].tolist()
     result = {"prompt_len": int(prompt.shape[1]), "tokens": new_tokens}
+    if args.prompt is not None:
+        # Byte-level round trip (the encoding pack_text_files trains with).
+        # A non-byte-trained checkpoint (e.g. BPE HF weights) emits ids
+        # >= 256 — count them loudly rather than silently shrinking "text".
+        dropped = sum(t >= 256 for t in new_tokens)
+        result["text"] = bytes(t for t in new_tokens if t < 256).decode(
+            "utf-8", errors="replace")
+        if dropped:
+            result["non_byte_tokens"] = dropped
+            print(f"warning: {dropped}/{len(new_tokens)} generated ids are "
+                  f">= 256 — this checkpoint is not byte-level-trained; "
+                  f"\"text\" is partial (use --prompt-tokens with the "
+                  f"model's real tokenizer)", file=sys.stderr)
     print(json.dumps(result))
     return result
 

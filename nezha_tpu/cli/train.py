@@ -319,6 +319,17 @@ def _eval_source(args, cfg, batch_size: int):
     return None, None, None
 
 
+def _wrap_model_overrides(cfg, **overrides) -> None:
+    """Rebind cfg.build_model (and sp_model) with extra model-config kwargs
+    — the shared core of the gpt2 knobs (--moe-experts, --remat). Wraps
+    compose; a duplicated kwarg fails loudly at build time."""
+    build0 = cfg.build_model
+    cfg.build_model = lambda **ov: build0(**overrides, **ov)
+    if cfg.sp_model is not None:
+        sp0 = cfg.sp_model
+        cfg.sp_model = lambda impl, **ov: sp0(impl, **overrides, **ov)
+
+
 def _make_batch_sharder(mesh, group):
     """dp/zero1 batch placement: single-process hosts hold the whole global
     batch (device_put row-split); multi-process hosts hold only their local
@@ -365,13 +376,7 @@ def run(args) -> Dict[str, float]:
                              "make the stage slabs heterogeneous); use "
                              "--parallel dp/zero1/sp, or gspmd with an ep "
                              "mesh axis (--mesh dp=X,tp=Y,ep=Z)")
-        moe_build = cfg.build_model
-        cfg.build_model = lambda **ov: moe_build(
-            moe_experts=args.moe_experts, **ov)
-        if cfg.sp_model is not None:
-            moe_sp = cfg.sp_model
-            cfg.sp_model = lambda impl, **ov: moe_sp(
-                impl, moe_experts=args.moe_experts, **ov)
+        _wrap_model_overrides(cfg, moe_experts=args.moe_experts)
 
     if args.remat:
         # Block rematerialization: the long-context/big-batch memory knob
@@ -385,11 +390,7 @@ def run(args) -> Dict[str, float]:
             raise SystemExit("--remat does not reach the pipeline's stage "
                              "slabs (they apply blocks directly); "
                              "--microbatches is the pp memory knob")
-        rm_build = cfg.build_model
-        cfg.build_model = lambda **ov: rm_build(remat=True, **ov)
-        if cfg.sp_model is not None:
-            rm_sp = cfg.sp_model
-            cfg.sp_model = lambda impl, **ov: rm_sp(impl, remat=True, **ov)
+        _wrap_model_overrides(cfg, remat=True)
 
     if args.seq_len:
         # Long-context override: resize position table + data together.
@@ -657,6 +658,10 @@ def run(args) -> Dict[str, float]:
     metrics_log = MetricsLogger(args.metrics_file) if args.metrics_file else None
 
     def log_metrics(step_no: int, metrics: Dict[str, float]) -> None:
+        if args.log_memory:
+            # Live/peak HBM per step (empty off-TPU: CPU exposes no stats).
+            from nezha_tpu.tensor import memory_metrics
+            metrics = {**metrics, **memory_metrics()}
         print(json.dumps(metrics), file=sys.stderr)
         if metrics_log:
             metrics_log.log(step_no, metrics)
@@ -817,6 +822,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--failure-check-every", type=int, default=10,
                    help="poll the coordinator for dead peers every N steps "
                         "(multi-process runs)")
+    p.add_argument("--log-memory", action="store_true",
+                   help="add live/peak HBM bytes to every metrics line "
+                        "(TPU backends; no-op where the backend exposes "
+                        "no memory stats)")
     p.add_argument("--profile-dir", default=None,
                    help="capture an XLA/TPU profiler trace here")
     p.add_argument("--coordinator", default=None, metavar="HOST:PORT",

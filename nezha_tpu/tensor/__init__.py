@@ -13,6 +13,7 @@ from nezha_tpu.tensor.memory import (
     to_device,
     to_host,
     device_memory_stats,
+    memory_metrics,
     tree_bytes,
 )
 
@@ -24,5 +25,6 @@ __all__ = [
     "to_device",
     "to_host",
     "device_memory_stats",
+    "memory_metrics",
     "tree_bytes",
 ]

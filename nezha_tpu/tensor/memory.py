@@ -41,10 +41,26 @@ def tree_bytes(tree: Any) -> int:
 
 
 def device_memory_stats(device: Optional[jax.Device] = None) -> dict:
-    """Per-device memory stats when the backend exposes them (TPU does)."""
-    dev = device or jax.devices()[0]
+    """Per-device memory stats when the backend exposes them (TPU does).
+
+    Defaults to the first LOCAL device: in a multi-process job,
+    ``jax.devices()[0]`` is host 0's device, whose stats a non-primary host
+    can't read — each host reports its own HBM."""
+    dev = device or jax.local_devices()[0]
     try:
         stats = dev.memory_stats()
     except Exception:  # CPU backend has none
         stats = None
     return stats or {}
+
+
+def memory_metrics(device: Optional[jax.Device] = None) -> dict:
+    """The two live/peak HBM numbers worth logging every step, with stable
+    metric names (empty off-TPU — the CPU backend exposes no stats)."""
+    stats = device_memory_stats(device)
+    out = {}
+    if "bytes_in_use" in stats:
+        out["hbm_bytes_in_use"] = int(stats["bytes_in_use"])
+    if "peak_bytes_in_use" in stats:
+        out["hbm_peak_bytes"] = int(stats["peak_bytes_in_use"])
+    return out

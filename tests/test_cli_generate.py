@@ -71,3 +71,18 @@ def test_generate_from_hf_weights(tmp_path):
                 "--temperature", "0"])
     assert len(out["tokens"]) == 6
     assert all(0 <= t < 128 for t in out["tokens"])
+
+
+def test_generate_text_prompt_byte_level(tmp_path):
+    """--prompt encodes bytes (the data/pack.py training encoding) and the
+    output decodes back to text."""
+    out = _gen(["--random-init", "--model-preset", "tiny",
+                "--prompt", "hi", "--max-new-tokens", "5",
+                "--temperature", "0"])
+    assert out["prompt_len"] == 2
+    assert isinstance(out["text"], str)
+    with pytest.raises(SystemExit, match="exactly one of"):
+        _gen(["--random-init", "--model-preset", "tiny",
+              "--prompt", "hi", "--prompt-tokens", "1"])
+    with pytest.raises(SystemExit, match="empty"):
+        _gen(["--random-init", "--model-preset", "tiny", "--prompt", ""])

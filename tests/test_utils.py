@@ -189,3 +189,32 @@ def test_tracer_rebases_window_on_resumed_steps(tmp_path):
     for root, _, files in os.walk(tmp_path / "rt"):
         produced += files
     assert produced
+
+
+def test_memory_metrics_names_and_cpu_noop(monkeypatch):
+    """memory_metrics maps backend stats to stable metric names, and is an
+    empty dict where the backend exposes none (CPU)."""
+    import jax
+
+    from nezha_tpu.tensor import memory_metrics
+    assert memory_metrics() == {}  # CPU backend: no stats, no crash
+
+    class FakeDev:
+        def memory_stats(self):
+            return {"bytes_in_use": 123, "peak_bytes_in_use": 456,
+                    "largest_free_block_bytes": 9}
+
+    out = memory_metrics(FakeDev())
+    assert out == {"hbm_bytes_in_use": 123, "hbm_peak_bytes": 456}
+
+
+def test_cli_log_memory_flag_is_safe_off_tpu(tmp_path):
+    import json as _json
+
+    from nezha_tpu.cli.train import build_parser, run
+    mf = tmp_path / "m.jsonl"
+    run(build_parser().parse_args(
+        ["--config", "mlp_mnist", "--steps", "4", "--batch-size", "16",
+         "--log-every", "2", "--log-memory", "--metrics-file", str(mf)]))
+    recs = [_json.loads(l) for l in mf.read_text().strip().splitlines()]
+    assert recs and all("loss" in r for r in recs)  # flag adds nothing on CPU
