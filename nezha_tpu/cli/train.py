@@ -343,6 +343,9 @@ def _make_batch_sharder(mesh, group):
 
 
 def run(args) -> Dict[str, float]:
+    if args.ckpt_keep is not None and args.ckpt_keep <= 0:
+        raise SystemExit(f"--ckpt-keep must be >= 1 (got {args.ckpt_keep}); "
+                         f"omit it to keep all checkpoints")
     group, coord = _join_world(args)
 
     import jax
@@ -638,6 +641,11 @@ def run(args) -> Dict[str, float]:
     if save_fn is sckpt.save_sharded and args.ckpt_dir:
         async_ckpt = sckpt.AsyncCheckpointer()
         save_fn = async_ckpt.save
+    if save_fn is not None and args.ckpt_keep:
+        # Retention rides the save: prune to the N newest after each write
+        # (sharded pruning counts only fully-complete checkpoints).
+        save0 = save_fn
+        save_fn = lambda d, s, st: save0(d, s, st, keep_last=args.ckpt_keep)
 
     # --- loop (one shared Trainer for every mode, so failure detection /
     # checkpoint-before-raise is live in real CLI runs) --------------------
@@ -679,6 +687,7 @@ def run(args) -> Dict[str, float]:
         shard_fn=shard,
         save_fn=save_fn,
         save_wait=async_ckpt.wait if async_ckpt is not None else None,
+        checkpoint_keep=args.ckpt_keep,
         examples_per_step=batch_size)
     trainer.state = state
     trainer.global_step = start_step
@@ -811,6 +820,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prefetch", type=int, default=2)
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--ckpt-every", type=int, default=0)
+    p.add_argument("--ckpt-keep", type=int, default=None,
+                   help="keep only the N newest checkpoints (sharded "
+                        "retention counts fully-complete saves only); "
+                        "default keeps all")
     p.add_argument("--metrics-file", default=None,
                    help="append JSONL metrics here")
     p.add_argument("--data-dir", default=None,

@@ -49,8 +49,13 @@ def _unflatten(template: Any, flat: dict) -> Any:
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
 
-def save_checkpoint(ckpt_dir: str, state: Any, step: int) -> str:
-    """Atomically write ``step_<N>.npz``; returns the path."""
+def save_checkpoint(ckpt_dir: str, state: Any, step: int,
+                    keep_last: Optional[int] = None) -> str:
+    """Atomically write ``step_<N>.npz``; returns the path.
+
+    ``keep_last=N`` prunes all but the N newest checkpoints AFTER the new
+    one is durably in place (a failed save never costs an old checkpoint).
+    """
     d = Path(ckpt_dir)
     d.mkdir(parents=True, exist_ok=True)
     flat = _flatten(jax.device_get(state))
@@ -63,7 +68,24 @@ def save_checkpoint(ckpt_dir: str, state: Any, step: int) -> str:
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
+    if keep_last is not None and keep_last > 0:
+        prune_old_checkpoints(ckpt_dir, keep_last)
     return str(final)
+
+
+def prune_old_checkpoints(ckpt_dir: str, keep_last: int) -> None:
+    """Delete all but the ``keep_last`` newest ``step_*.npz`` files.
+    Concurrent pruners (multi-host) race benignly: a loser's missing path
+    is ignored. (Sharded checkpoints have their own pruner with
+    completeness checks — sharded_checkpoint.prune_old_sharded.)"""
+    d = Path(ckpt_dir)
+    entries = sorted(p for p in d.glob("step_*.npz")
+                     if re.match(r"step_\d+\.npz$", p.name))
+    for p in entries[:-keep_last]:
+        try:
+            p.unlink()
+        except FileNotFoundError:
+            pass
 
 
 def latest_step(ckpt_dir: str) -> Optional[int]:

@@ -98,6 +98,7 @@ class Trainer:
                  shard_fn: Optional[Callable[[dict], dict]] = None,
                  save_fn: Optional[Callable[[str, Any, int], Any]] = None,
                  save_wait: Optional[Callable[[], None]] = None,
+                 checkpoint_keep: Optional[int] = None,
                  examples_per_step: int = 0):
         self.model = model
         self.optimizer = optimizer
@@ -127,6 +128,9 @@ class Trainer:
         # a rescue checkpoint whose files are still being written when the
         # process dies is a torn save.
         self._save_wait = save_wait
+        # Retention: keep only the N newest checkpoints (None = keep all).
+        # Custom save_fns handle their own pruning (the CLI wraps them).
+        self.checkpoint_keep = checkpoint_keep
         self.examples_per_step = examples_per_step
         self.state: Optional[TrainState] = None
         self.global_step = 0
@@ -136,7 +140,8 @@ class Trainer:
             self._save_fn(self.checkpoint_dir, self.state, step)
         else:
             from nezha_tpu.train import checkpoint as ckpt
-            ckpt.save_checkpoint(self.checkpoint_dir, self.state, step)
+            ckpt.save_checkpoint(self.checkpoint_dir, self.state, step,
+                                 keep_last=self.checkpoint_keep)
 
     def initialize(self, resume: bool = True):
         from nezha_tpu.train import checkpoint as ckpt

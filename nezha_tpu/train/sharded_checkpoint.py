@@ -33,6 +33,7 @@ import os
 import re
 import tempfile
 import threading
+import warnings
 from pathlib import Path
 from typing import Any, Callable, List, Optional, Tuple
 
@@ -56,10 +57,51 @@ def _norm_index(index, shape) -> List[List[int]]:
     return out
 
 
-def save_sharded(ckpt_dir: str, state: Any, step: int) -> str:
-    """Write this process's shards of ``state`` under ``step_<N>.sharded``."""
+def save_sharded(ckpt_dir: str, state: Any, step: int,
+                 keep_last: Optional[int] = None) -> str:
+    """Write this process's shards of ``state`` under ``step_<N>.sharded``.
+
+    ``keep_last=N`` prunes all but the N newest FULLY-COMPLETE checkpoints
+    afterwards (torn dirs and the one just written are never counted or
+    touched by the count — a crash mid-save can't cost the fallback)."""
     host_state = jax.tree_util.tree_map(_host_shards, state)
-    return _write_prefetched(ckpt_dir, host_state, step)
+    out = _write_prefetched(ckpt_dir, host_state, step)
+    if keep_last is not None and keep_last > 0:
+        prune_old_sharded(ckpt_dir, keep_last)
+    return out
+
+
+def _is_complete(d: Path) -> bool:
+    try:
+        metas = list(d.glob("meta_p*.json"))
+        if not metas:
+            return False
+        world = json.loads(metas[0].read_text()).get("world", 1)
+        return all((d / f"COMPLETE_p{i}").exists() for i in range(world))
+    except (OSError, ValueError):
+        # A concurrent pruner may delete the dir between glob and read —
+        # treat vanished/torn as not-complete, never raise from cleanup.
+        return False
+
+
+def prune_old_sharded(ckpt_dir: str, keep_last: int) -> None:
+    """Delete all but the ``keep_last`` newest fully-complete sharded
+    checkpoints. Best-effort cleanup: concurrent pruners (every rank after
+    its own save) race benignly, and NO failure here may escape — a
+    durably-written checkpoint must never be reported failed over a
+    cleanup hiccup."""
+    import shutil
+
+    try:
+        d = Path(ckpt_dir)
+        complete = sorted(p for p in d.glob("step_*.sharded")
+                          if re.match(r"step_\d+\.sharded$", p.name)
+                          and _is_complete(p))
+        for p in complete[:-keep_last]:
+            shutil.rmtree(p, ignore_errors=True)
+    except OSError as e:  # pragma: no cover - depends on races/filesystems
+        warnings.warn(f"checkpoint retention pruning failed (save itself "
+                      f"succeeded): {e}")
 
 
 def latest_step(ckpt_dir: str) -> Optional[int]:
@@ -235,7 +277,8 @@ class AsyncCheckpointer:
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
 
-    def save(self, ckpt_dir: str, state: Any, step: int) -> None:
+    def save(self, ckpt_dir: str, state: Any, step: int,
+             keep_last: Optional[int] = None) -> None:
         self.wait()
         # Snapshot device shards to host NOW (so the caller may donate/mutate
         # state immediately), write files in the background.
@@ -244,6 +287,8 @@ class AsyncCheckpointer:
         def work():
             try:
                 _write_prefetched(ckpt_dir, host_state, step)
+                if keep_last is not None and keep_last > 0:
+                    prune_old_sharded(ckpt_dir, keep_last)
             except BaseException as e:  # surfaced on next wait()/save()
                 self._error = e
 

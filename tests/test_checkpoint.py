@@ -51,3 +51,16 @@ def test_trainer_resume(tmp_path):
     for a, b in zip(jax.tree_util.tree_leaves(saved_params),
                     jax.tree_util.tree_leaves(t2.state["variables"]["params"])):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_keep_last_prunes_oldest(tmp_path):
+    from nezha_tpu.train.checkpoint import (latest_step, restore_checkpoint,
+                                            save_checkpoint)
+    state = {"w": np.arange(4.0)}
+    for step in range(1, 6):
+        save_checkpoint(tmp_path, {"w": state["w"] + step}, step, keep_last=2)
+    left = sorted(p.name for p in tmp_path.glob("step_*.npz"))
+    assert left == ["step_00000004.npz", "step_00000005.npz"]
+    restored, step = restore_checkpoint(tmp_path, state)
+    assert step == 5 == latest_step(tmp_path)
+    np.testing.assert_array_equal(restored["w"], state["w"] + 5)

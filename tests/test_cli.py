@@ -343,6 +343,24 @@ def test_cli_zero1_sharded_checkpoint_resume(devices8, tmp_path):
     assert m["step"] == 3  # resumed at 2, trained 1 more
 
 
+def test_cli_ckpt_keep_retention(devices8, tmp_path):
+    """--ckpt-keep N prunes old checkpoints in both formats (npz via the
+    Trainer default path, per-shard via the wrapped async save_fn)."""
+    import pathlib
+    ck = str(tmp_path / "npz")
+    _run(["--config", "mlp_mnist", "--steps", "6", "--batch-size", "16",
+          "--ckpt-dir", ck, "--ckpt-every", "2", "--ckpt-keep", "1"])
+    names = sorted(p.name for p in pathlib.Path(ck).glob("step_*.npz"))
+    assert names == ["step_00000006.npz"]  # 2 and 4 pruned, final kept
+
+    ck = str(tmp_path / "sharded")
+    _run(["--config", "bert_base_zero1", "--model-preset", "tiny",
+          "--steps", "4", "--batch-size", "16", "--mesh", "dp=8",
+          "--ckpt-dir", ck, "--ckpt-every", "1", "--ckpt-keep", "2"])
+    names = sorted(p.name for p in pathlib.Path(ck).glob("step_*.sharded"))
+    assert names == ["step_00000003.sharded", "step_00000004.sharded"]
+
+
 def test_cli_failure_detection_checkpoints_then_raises(tmp_path):
     """Kill a peer rank mid-run: the CLI loop (via Trainer) must detect the
     failure, checkpoint, and raise — the elastic machinery live from the
@@ -439,3 +457,10 @@ def test_cli_two_process_dp_sharded_data(devices8, tmp_path):
               for _, out, _ in results]
     assert np.isfinite(finals[0])
     assert finals[0] == finals[1]  # replicated metrics agree across ranks
+
+
+def test_cli_ckpt_keep_rejects_nonpositive():
+    import pytest
+    with pytest.raises(SystemExit, match="ckpt-keep must be >= 1"):
+        _run(["--config", "mlp_mnist", "--steps", "1", "--batch-size", "8",
+              "--ckpt-keep", "0"])

@@ -232,3 +232,28 @@ def test_missing_leaf_and_shape_mismatch_raise(devices8, tmp_path):
     template["rng"] = jnp.zeros((7,), jnp.uint32)
     with pytest.raises(ValueError, match="shape mismatch"):
         sc.restore_sharded(tmp_path, template)
+
+
+def test_sharded_keep_last_counts_only_complete(devices8, tmp_path):
+    """Retention prunes to the N newest FULLY-COMPLETE sharded checkpoints;
+    torn dirs are neither counted nor trusted as fallbacks."""
+    import pathlib
+
+    from nezha_tpu import parallel
+    from nezha_tpu.train import sharded_checkpoint as sckpt
+
+    mesh = parallel.make_mesh({"dp": 8})
+    state = {"w": parallel.replicate(mesh, jnp.arange(8.0))}
+    for step in (1, 2):
+        sckpt.save_sharded(tmp_path, state, step, keep_last=2)
+    # A torn dir (no COMPLETE markers) between complete saves.
+    torn = pathlib.Path(tmp_path) / "step_00000003.sharded"
+    torn.mkdir()
+    (torn / "meta_p0.json").write_text('{"leaves": {}, "world": 1}')
+    sckpt.save_sharded(tmp_path, state, 4, keep_last=2)
+    names = sorted(p.name for p in pathlib.Path(tmp_path).glob("*.sharded"))
+    # keep_last=2 complete saves (2, 4); torn 3 untouched; 1 pruned.
+    assert names == ["step_00000002.sharded", "step_00000003.sharded",
+                     "step_00000004.sharded"]
+    restored, step = sckpt.try_restore_sharded(tmp_path, state)
+    assert step == 4
