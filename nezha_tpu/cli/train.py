@@ -381,6 +381,17 @@ def run(args) -> Dict[str, float]:
                              "mesh axis (--mesh dp=X,tp=Y,ep=Z)")
         _wrap_model_overrides(cfg, moe_experts=args.moe_experts)
 
+    if args.dropout is not None:
+        if args.config != "gpt2_124m":
+            raise SystemExit("--dropout applies to gpt2_124m")
+        if args.engine == "graph":
+            raise SystemExit("the graph engine's GPT-2 program has no "
+                             "dropout path; drop --engine graph")
+        if not 0.0 <= args.dropout < 1.0:
+            raise SystemExit(f"--dropout must be in [0, 1), got "
+                             f"{args.dropout}")
+        _wrap_model_overrides(cfg, dropout=args.dropout)
+
     if args.remat:
         # Block rematerialization: the long-context/big-batch memory knob
         # (jax.checkpoint per transformer block; see GPT2Config.remat).
@@ -600,7 +611,8 @@ def run(args) -> Dict[str, float]:
             save_fn = sckpt.save_sharded
             step_fn = pp_mod.make_pipeline_train_step(
                 pspec, optimizer, cfg.loss_fn, mesh,
-                num_microbatches=args.microbatches)
+                num_microbatches=args.microbatches,
+                dropout_rng=bool(getattr(model.cfg, "dropout", 0.0)))
             shard = lambda b: parallel.shard_batch(mesh, b)
         elif mode == "zero1":
             variables = state["variables"]
@@ -641,11 +653,8 @@ def run(args) -> Dict[str, float]:
     if save_fn is sckpt.save_sharded and args.ckpt_dir:
         async_ckpt = sckpt.AsyncCheckpointer()
         save_fn = async_ckpt.save
-    if save_fn is not None and args.ckpt_keep:
-        # Retention rides the save: prune to the N newest after each write
-        # (sharded pruning counts only fully-complete checkpoints).
-        save0 = save_fn
-        save_fn = lambda d, s, st: save0(d, s, st, keep_last=args.ckpt_keep)
+    # Retention (--ckpt-keep) flows through Trainer.checkpoint_keep for
+    # every save path — the Trainer forwards keep_last to the save_fn.
 
     # --- loop (one shared Trainer for every mode, so failure detection /
     # checkpoint-before-raise is live in real CLI runs) --------------------
@@ -801,6 +810,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--moe-experts", type=int, default=None,
                    help="gpt2_124m only: swap every other block's MLP for "
                         "a top-k routed mixture of this many experts")
+    p.add_argument("--dropout", type=float, default=None,
+                   help="gpt2_124m only: dropout rate override (works in "
+                        "every parallel mode incl. pp, where per-(layer, "
+                        "microbatch) keys thread through the schedule)")
     p.add_argument("--remat", action="store_true",
                    help="gpt2_124m only: rematerialize each block in "
                         "backward (jax.checkpoint) — O(1) activation "

@@ -43,15 +43,21 @@ PyTree = Any
 class PipelineSpec(NamedTuple):
     """How to pipeline a model of shape embed -> N identical blocks -> head.
 
-    - ``embed_fn(outer_params, batch) -> x``: pre-pipeline compute (token +
-      position embedding), replicated over pp, GSPMD-sharded over dp.
-    - ``block_fn(block_params, x) -> x``: apply ONE block; scanned over each
-      stage's layer slab inside the pipeline.
+    - ``embed_fn(outer_params, batch, rng=None) -> x``: pre-pipeline compute
+      (token + position embedding [+ embed dropout when rng is given]),
+      replicated over pp, GSPMD-sharded over dp.
+    - ``block_fn(block_params, x, rng=None) -> x``: apply ONE block; scanned
+      over each stage's layer slab inside the pipeline. ``rng`` (when the
+      step passes one) is already unique per (layer, microbatch, dp-rank).
     - ``head_fn(outer_params, x) -> out``: post-pipeline compute (final norm
       + LM head).
     - ``split(params) -> (outer, [block_params, ...])`` and
       ``merge(outer, [block_params, ...]) -> params`` convert between the
       model's native param tree and the pipelined layout.
+
+    The rng parameters are only exercised by steps built with
+    ``make_pipeline_train_step(..., dropout_rng=True)`` — deterministic
+    specs may ignore them.
     """
 
     embed_fn: Callable[[PyTree, Any], jax.Array]
@@ -59,6 +65,9 @@ class PipelineSpec(NamedTuple):
     head_fn: Callable[[PyTree, jax.Array], jax.Array]
     split: Callable[[PyTree], Tuple[PyTree, List[PyTree]]]
     merge: Callable[[PyTree, List[PyTree]], PyTree]
+    # The model's dropout rate: lets the step factory refuse a dropout>0
+    # spec without dropout_rng=True (which would silently train dropless).
+    dropout: float = 0.0
 
 
 def stack_block_params(blocks: List[PyTree]) -> PyTree:
@@ -71,14 +80,21 @@ def unstack_block_params(stacked: PyTree) -> List[PyTree]:
     return [jax.tree_util.tree_map(lambda a: a[i], stacked) for i in range(n)]
 
 
-def pipeline_blocks(stage_params: PyTree, x: jax.Array,
-                    block_fn: Callable[[PyTree, jax.Array], jax.Array],
-                    num_microbatches: int, axis_name: str = "pp") -> jax.Array:
+def pipeline_blocks(stage_params: PyTree, x: jax.Array, rng=None, *,
+                    block_fn: Callable[..., jax.Array],
+                    num_microbatches: int, axis_name: str = "pp",
+                    dp_axis: str = None) -> jax.Array:
     """The SPMD pipeline body. Call inside shard_map over ``axis_name``.
 
     ``stage_params``: this rank's slab of stacked layer params [L_stage, ...].
     ``x``: the local batch of activations [B_local, ...]; split into
     ``num_microbatches`` microbatches internally. Returns [B_local, ...].
+
+    ``rng`` (optional): dropout key. Each block application receives a key
+    folded with (global layer index, microbatch index, dp rank) so masks
+    are independent across layers, microbatches, steps, and data-parallel
+    shards — bubble-tick applications draw keys too but their outputs are
+    masked away, so they cost nothing and corrupt nothing.
     """
     world = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
@@ -88,11 +104,21 @@ def pipeline_blocks(stage_params: PyTree, x: jax.Array,
         raise ValueError(f"local batch {b_local} not divisible by "
                          f"num_microbatches {m}")
     xs = x.reshape(m, b_local // m, *x.shape[1:])
+    if rng is not None and dp_axis is not None:
+        rng = jax.random.fold_in(rng, lax.axis_index(dp_axis))
 
-    def stage_fn(params_slab, h):
-        def body(h, layer_params):
-            return block_fn(layer_params, h), None
-        h, _ = lax.scan(body, h, params_slab)
+    n_layers_stage = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+
+    def stage_fn(params_slab, h, mb_idx):
+        def body(h, scanned):
+            layer_params, li = scanned
+            if rng is None:
+                return block_fn(layer_params, h), None
+            key = jax.random.fold_in(
+                jax.random.fold_in(rng, stage * n_layers_stage + li), mb_idx)
+            return block_fn(layer_params, h, key), None
+
+        h, _ = lax.scan(body, h, (params_slab, jnp.arange(n_layers_stage)))
         return h
 
     perm = [(i, (i + 1) % world) for i in range(world)]
@@ -103,7 +129,7 @@ def pipeline_blocks(stage_params: PyTree, x: jax.Array,
         x_in = lax.dynamic_index_in_dim(xs, jnp.clip(t, 0, m - 1), 0,
                                         keepdims=False)
         inp = jnp.where(stage == 0, x_in, state)
-        out = stage_fn(stage_params, inp)
+        out = stage_fn(stage_params, inp, jnp.clip(t - stage, 0, m - 1))
         out_idx = jnp.clip(t - (world - 1), 0, m - 1)
         valid = jnp.logical_and(stage == world - 1, t >= world - 1)
         cur = lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
@@ -126,19 +152,30 @@ def pipeline_blocks(stage_params: PyTree, x: jax.Array,
 
 def pipelined_forward(spec: PipelineSpec, pparams: Dict[str, PyTree],
                       batch_inputs: Any, mesh: Mesh, num_microbatches: int,
-                      pp_axis: str = "pp", dp_axis: str = "dp") -> jax.Array:
+                      pp_axis: str = "pp", dp_axis: str = "dp",
+                      rng=None) -> jax.Array:
     """Full forward: embed (GSPMD) -> pipelined blocks (shard_map) -> head.
 
     ``pparams``: {"outer": outer_params, "blocks": stacked [L, ...] tree}.
+    ``rng``: dropout key threaded to embed_fn and (per layer/microbatch)
+    into the pipelined region; None = deterministic forward.
     """
-    x = spec.embed_fn(pparams["outer"], batch_inputs)
     dp_in_mesh = dp_axis in mesh.axis_names
     xspec = P(dp_axis) if dp_in_mesh else P()
-    run = shard_map(
-        partial(pipeline_blocks, block_fn=spec.block_fn,
-                num_microbatches=num_microbatches, axis_name=pp_axis),
-        mesh=mesh, in_specs=(P(pp_axis), xspec), out_specs=xspec)
-    y = run(pparams["blocks"], x)
+    body = partial(pipeline_blocks, block_fn=spec.block_fn,
+                   num_microbatches=num_microbatches, axis_name=pp_axis,
+                   dp_axis=dp_axis if dp_in_mesh else None)
+    if rng is None:
+        x = spec.embed_fn(pparams["outer"], batch_inputs)
+        run = shard_map(body, mesh=mesh, in_specs=(P(pp_axis), xspec),
+                        out_specs=xspec)
+        y = run(pparams["blocks"], x)
+    else:
+        embed_rng, block_rng = jax.random.split(rng)
+        x = spec.embed_fn(pparams["outer"], batch_inputs, embed_rng)
+        run = shard_map(body, mesh=mesh,
+                        in_specs=(P(pp_axis), xspec, P()), out_specs=xspec)
+        y = run(pparams["blocks"], x, block_rng)
     return spec.head_fn(pparams["outer"], y)
 
 
@@ -183,23 +220,35 @@ def make_pipeline_train_step(spec: PipelineSpec, optimizer: Optimizer,
                              loss_fn: Callable[[jax.Array, dict], jax.Array],
                              mesh: Mesh, num_microbatches: int,
                              pp_axis: str = "pp", dp_axis: str = "dp",
-                             donate: bool = True):
+                             donate: bool = True, dropout_rng: bool = False):
     """jit'd train step over {"pparams", "opt_state", "rng"} state.
 
     Batch dicts shard over ``dp_axis`` (when present in the mesh); grads of
     stage slabs stay pp-local, grads of outer params are psum'd by the SPMD
-    partitioner. Returns ``step(state, batch) -> (state, metrics)``.
+    partitioner. ``dropout_rng=True`` threads a per-step key through the
+    spec's embed/block fns (which must then accept one) so dropout>0 models
+    pipeline correctly. Returns ``step(state, batch) -> (state, metrics)``.
     """
+    if spec.dropout and not dropout_rng:
+        # Without keys the blocks run deterministically — a dropout>0 model
+        # would silently train with dropout off. Refuse loudly.
+        raise ValueError(
+            f"spec carries dropout={spec.dropout} but dropout_rng=False; "
+            f"pass make_pipeline_train_step(..., dropout_rng=True)")
 
     def step(state, batch):
-        # Pipelined forward is deterministic — no rng path through
-        # pipelined_forward (stage fns take no dropout key); the state rng
-        # advances so interleaving with stochastic steps stays reproducible.
-        next_rng = jax.random.fold_in(state["rng"], 0)
+        if dropout_rng:
+            step_rng, next_rng = jax.random.split(state["rng"])
+        else:
+            # Deterministic forward; the state rng still advances so
+            # interleaving with stochastic steps stays reproducible.
+            step_rng = None
+            next_rng = jax.random.fold_in(state["rng"], 0)
 
         def compute_loss(pparams):
             out = pipelined_forward(spec, pparams, batch, mesh,
-                                    num_microbatches, pp_axis, dp_axis)
+                                    num_microbatches, pp_axis, dp_axis,
+                                    rng=step_rng)
             return jnp.asarray(loss_fn(out, batch), jnp.float32)
 
         loss, grads = jax.value_and_grad(compute_loss)(state["pparams"])
@@ -222,29 +271,33 @@ def merge_pipeline_params(spec: PipelineSpec, pparams: Dict[str, PyTree]) -> PyT
 
 
 def gpt2_pipeline_spec(model) -> PipelineSpec:
-    """PipelineSpec for ``nezha_tpu.models.gpt2.GPT2`` (stateless path:
-    dropout off inside the pipelined region)."""
+    """PipelineSpec for ``nezha_tpu.models.gpt2.GPT2``. dropout>0 configs
+    need a step built with ``dropout_rng=True`` (the CLI does this
+    automatically) so the per-(layer, microbatch) keys reach the blocks;
+    without a key the blocks run deterministically."""
     from nezha_tpu.nn.module import child_vars
 
     cfg = model.cfg
-    if cfg.dropout:
-        # block_fn applies blocks with no rng: a dropout>0 config would
-        # silently train without dropout. Refuse instead.
-        raise ValueError(
-            f"gpt2_pipeline_spec requires dropout=0 (got {cfg.dropout}): the "
-            f"pipelined region is deterministic and would silently drop it")
+    if cfg.moe_experts:
+        raise ValueError("gpt2_pipeline_spec cannot pipeline MoE blocks "
+                         "(heterogeneous stage slabs)")
     template = model.h[0]
 
-    def embed_fn(outer, batch):
+    def embed_fn(outer, batch, rng=None):
         tokens = batch["tokens"][:, :-1] if isinstance(batch, dict) else batch
         variables = {"params": outer, "state": {}}
         pos = jnp.arange(tokens.shape[1])[None, :]
         x, _ = model.wte.apply(child_vars(variables, "wte"), tokens)
         pe, _ = model.wpe.apply(child_vars(variables, "wpe"), pos)
-        return x + pe
+        x = x + pe
+        if rng is not None:
+            x, _ = model.drop.apply({"params": {}, "state": {}}, x,
+                                    training=True, rng=rng)
+        return x
 
-    def block_fn(block_params, x):
-        out, _ = template.apply({"params": block_params, "state": {}}, x)
+    def block_fn(block_params, x, rng=None):
+        out, _ = template.apply({"params": block_params, "state": {}}, x,
+                                training=rng is not None, rng=rng)
         return out
 
     def head_fn(outer, x):
@@ -265,4 +318,5 @@ def gpt2_pipeline_spec(model) -> PipelineSpec:
             p[f"h{i}"] = b
         return p
 
-    return PipelineSpec(embed_fn, block_fn, head_fn, split, merge)
+    return PipelineSpec(embed_fn, block_fn, head_fn, split, merge,
+                        dropout=cfg.dropout)

@@ -137,7 +137,14 @@ class Trainer:
 
     def _save(self, step: int) -> None:
         if self._save_fn is not None:
-            self._save_fn(self.checkpoint_dir, self.state, step)
+            if self.checkpoint_keep:
+                # Every built-in save_fn (save_checkpoint, save_sharded,
+                # AsyncCheckpointer.save) takes keep_last; only pass it when
+                # retention is on so bare custom save_fns keep working.
+                self._save_fn(self.checkpoint_dir, self.state, step,
+                              keep_last=self.checkpoint_keep)
+            else:
+                self._save_fn(self.checkpoint_dir, self.state, step)
         else:
             from nezha_tpu.train import checkpoint as ckpt
             ckpt.save_checkpoint(self.checkpoint_dir, self.state, step,

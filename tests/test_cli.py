@@ -459,6 +459,27 @@ def test_cli_two_process_dp_sharded_data(devices8, tmp_path):
     assert finals[0] == finals[1]  # replicated metrics agree across ranks
 
 
+def test_cli_dropout_pipelines(devices8):
+    """--dropout works in pp mode (per-layer/microbatch keys through the
+    GPipe schedule) and is rejected where it cannot apply."""
+    import pytest
+    m = _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--parallel", "pp", "--mesh", "dp=2,pp=4",
+              "--microbatches", "2", "--dropout", "0.2", "--steps", "2",
+              "--batch-size", "8", "--log-every", "1"])
+    assert np.isfinite(m["loss"])
+    with pytest.raises(SystemExit, match="applies to gpt2_124m"):
+        _run(["--config", "mlp_mnist", "--steps", "1", "--batch-size", "8",
+              "--dropout", "0.1"])
+    with pytest.raises(SystemExit, match="no.*dropout path|dropout path"):
+        _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--engine", "graph", "--steps", "1", "--batch-size", "8",
+              "--dropout", "0.1"])
+    with pytest.raises(SystemExit, match=r"in \[0, 1\)"):
+        _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--steps", "1", "--batch-size", "8", "--dropout", "1.5"])
+
+
 def test_cli_ckpt_keep_rejects_nonpositive():
     import pytest
     with pytest.raises(SystemExit, match="ckpt-keep must be >= 1"):

@@ -88,13 +88,69 @@ def test_pipeline_train_step_matches_single(devices8):
                                    rtol=5e-4, atol=5e-4, err_msg=str(ka))
 
 
-def test_pipeline_spec_rejects_dropout():
-    """The pipelined region is deterministic: a dropout>0 config must be
-    refused loudly, not silently trained without dropout."""
+def test_pipeline_dropout_rng_plumbing_is_identity_at_rate_zero(devices8):
+    """dropout_rng=True threads keys through embed + every (layer,
+    microbatch) application; with rate 0 the masks are identity, so the
+    loss must match the deterministic path exactly — proving the rng
+    plumbing itself corrupts nothing."""
+    model = _tiny_gpt2(num_layers=4)  # dropout=0.0
+    opt = optim.adamw(1e-3)
+    rng = jax.random.PRNGKey(0)
+    mesh = parallel.make_mesh({"dp": 2, "pp": 4})
+    spec = pp.gpt2_pipeline_spec(model)
+    variables = model.init(rng)
+    batch = _batch()
+
+    det = pp.make_pipeline_train_step(spec, opt, lm_loss, mesh,
+                                      num_microbatches=4, donate=False)
+    sto = pp.make_pipeline_train_step(spec, opt, lm_loss, mesh,
+                                      num_microbatches=4, donate=False,
+                                      dropout_rng=True)
+    s0 = pp.init_pipeline_state(variables, spec, opt, mesh, rng)
+    s1 = pp.init_pipeline_state(variables, spec, opt, mesh, rng)
+    _, m0 = det(s0, batch)
+    _, m1 = sto(s1, batch)
+    np.testing.assert_allclose(float(m0["loss"]), float(m1["loss"]),
+                               rtol=1e-6)
+
+
+def test_pipeline_trains_with_dropout(devices8):
+    """A dropout>0 GPT-2 pipelines with real (per-layer, per-microbatch)
+    masks: the stochastic loss differs from the deterministic forward of
+    the same params, changes between steps (fresh keys), and training
+    stays finite."""
+    model = GPT2(GPT2Config(vocab_size=64, max_positions=16, num_layers=4,
+                            num_heads=2, hidden_size=32, dropout=0.5))
+    opt = optim.adamw(1e-3)
+    rng = jax.random.PRNGKey(0)
+    mesh = parallel.make_mesh({"dp": 2, "pp": 4})
+    spec = pp.gpt2_pipeline_spec(model)
+    variables = model.init(rng)
+    pstate = pp.init_pipeline_state(variables, spec, opt, mesh, rng)
+    pstep = pp.make_pipeline_train_step(spec, opt, lm_loss, mesh,
+                                        num_microbatches=4, donate=False,
+                                        dropout_rng=True)
+    batch = _batch()
+    # Deterministic loss of the same initial params for contrast.
+    det_logits, _ = model.apply(variables, batch)  # training=False: no drop
+    det_loss = float(lm_loss(det_logits, batch))
+
+    losses = []
+    for _ in range(3):
+        pstate, pm = pstep(pstate, batch)
+        losses.append(float(pm["loss"]))
+    assert np.isfinite(losses).all()
+    # Dropout at 0.5 moves the loss well off the deterministic value and
+    # draws fresh masks each step.
+    assert abs(losses[0] - det_loss) > 1e-3
+    assert losses[0] != losses[1]
+
+
+def test_pipeline_spec_rejects_moe():
     import pytest
     model = GPT2(GPT2Config(vocab_size=64, max_positions=16, num_layers=2,
-                            num_heads=2, hidden_size=32, dropout=0.1))
-    with pytest.raises(ValueError, match="dropout=0"):
+                            num_heads=2, hidden_size=32, moe_experts=4))
+    with pytest.raises(ValueError, match="MoE"):
         pp.gpt2_pipeline_spec(model)
 
 
