@@ -381,6 +381,24 @@ def run(args) -> Dict[str, float]:
                              "mesh axis (--mesh dp=X,tp=Y,ep=Z)")
         _wrap_model_overrides(cfg, moe_experts=args.moe_experts)
 
+    if args.grad_accum is not None:
+        if args.grad_accum < 1:
+            raise SystemExit(f"--grad-accum must be >= 1, got "
+                             f"{args.grad_accum}")
+        if args.engine == "graph" and args.grad_accum > 1:
+            raise SystemExit("--grad-accum is an optimizer wrapper the "
+                             "graph engine's IR-authored update does not "
+                             "express; drop --engine graph")
+        if args.grad_accum > 1:
+            from nezha_tpu import optim
+            acc_build = cfg.build_optimizer
+            # The inner optimizer (and its LR schedule) steps once per
+            # FLUSH, not per micro-step — size the schedule horizon to the
+            # number of real updates or the cosine never finishes.
+            cfg.build_optimizer = lambda steps: optim.accumulate_gradients(
+                acc_build(max(1, steps // args.grad_accum)),
+                args.grad_accum)
+
     if args.dropout is not None:
         if args.config != "gpt2_124m":
             raise SystemExit("--dropout applies to gpt2_124m")
@@ -612,7 +630,7 @@ def run(args) -> Dict[str, float]:
             step_fn = pp_mod.make_pipeline_train_step(
                 pspec, optimizer, cfg.loss_fn, mesh,
                 num_microbatches=args.microbatches,
-                dropout_rng=bool(getattr(model.cfg, "dropout", 0.0)))
+                dropout_rng=bool(pspec.dropout))
             shard = lambda b: parallel.shard_batch(mesh, b)
         elif mode == "zero1":
             variables = state["variables"]
@@ -810,6 +828,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--moe-experts", type=int, default=None,
                    help="gpt2_124m only: swap every other block's MLP for "
                         "a top-k routed mixture of this many experts")
+    p.add_argument("--grad-accum", type=int, default=None,
+                   help="accumulate gradients over N micro-steps before "
+                        "each optimizer update (any config/parallel mode; "
+                        "effective batch = batch-size x N)")
     p.add_argument("--dropout", type=float, default=None,
                    help="gpt2_124m only: dropout rate override (works in "
                         "every parallel mode incl. pp, where per-(layer, "

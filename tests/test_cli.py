@@ -480,6 +480,28 @@ def test_cli_dropout_pipelines(devices8):
               "--steps", "1", "--batch-size", "8", "--dropout", "1.5"])
 
 
+def test_cli_grad_accum(devices8):
+    """--grad-accum N holds updates for N micro-steps: params change only
+    every Nth step, and the graph engine rejects the wrapper."""
+    import pytest
+    losses = _final_losses("gpt2_124m", 4, 8,
+                           ["--parallel", "single", "--grad-accum", "2"])
+    # Steps 1 and 2 see the same params (update flushes at step 2's end):
+    # identical batch stream per step is not guaranteed, so instead pin the
+    # mechanism by comparing against no-accum: first-step losses match
+    # (same init params), later steps diverge.
+    plain = _final_losses("gpt2_124m", 4, 8, ["--parallel", "single"])
+    np.testing.assert_allclose(losses[0], plain[0], rtol=1e-6)
+    assert not np.allclose(losses[-1], plain[-1], rtol=1e-6)
+    with pytest.raises(SystemExit, match="graph engine"):
+        _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--engine", "graph", "--steps", "1", "--batch-size", "8",
+              "--grad-accum", "2"])
+    with pytest.raises(SystemExit, match="grad-accum must be"):
+        _run(["--config", "mlp_mnist", "--steps", "1", "--batch-size", "8",
+              "--grad-accum", "0"])
+
+
 def test_cli_ckpt_keep_rejects_nonpositive():
     import pytest
     with pytest.raises(SystemExit, match="ckpt-keep must be >= 1"):

@@ -218,3 +218,36 @@ def test_gpt2_moe_sequence_parallel_trains(devices8):
         state, m = step(state, batch)
         losses.append(float(m["loss"]))
     assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_gpt2_moe_remat_matches_exact():
+    """remat wraps MoE blocks too: the aux-loss state must flow through
+    jax.checkpoint unchanged, and gradients must match the non-remat
+    model."""
+    from nezha_tpu.models.gpt2 import GPT2, GPT2Config, lm_loss
+
+    def build(remat):
+        return GPT2(GPT2Config(vocab_size=128, max_positions=32,
+                               num_layers=2, num_heads=2, hidden_size=32,
+                               moe_experts=4, remat=remat))
+
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 128, (2, 17)), jnp.int32)
+
+    def loss_grads(model):
+        v = model.init(jax.random.PRNGKey(0))
+
+        def loss(params):
+            out, _ = model.apply({"params": params, "state": v["state"]},
+                                 {"tokens": tokens}, training=True)
+            return lm_loss(out, {"tokens": tokens})  # includes moe aux
+
+        return jax.value_and_grad(loss)(v["params"])
+
+    l0, g0 = loss_grads(build(False))
+    l1, g1 = loss_grads(build(True))
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)

@@ -172,3 +172,17 @@ def test_pipeline_bubble_independent_of_microbatches(devices8):
     for o in outs[1:]:
         np.testing.assert_allclose(np.asarray(o), np.asarray(outs[0]),
                                    rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_step_refuses_dropout_without_rng():
+    """A dropout>0 spec with dropout_rng=False would silently train
+    dropless — make_pipeline_train_step must refuse (the guard that
+    replaced the old spec-level rejection)."""
+    import pytest
+    model = GPT2(GPT2Config(vocab_size=64, max_positions=16, num_layers=4,
+                            num_heads=2, hidden_size=32, dropout=0.1))
+    spec = pp.gpt2_pipeline_spec(model)
+    mesh = parallel.make_mesh({"dp": 2, "pp": 4})
+    with pytest.raises(ValueError, match="dropout_rng=True"):
+        pp.make_pipeline_train_step(spec, optim.adamw(1e-3), lm_loss, mesh,
+                                    num_microbatches=2)
