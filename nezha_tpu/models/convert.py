@@ -217,3 +217,50 @@ def bert_from_hf(hf_model) -> tuple:
     params = bert_params_from_hf(hf_model.state_dict(), cfg.num_layers)
     params = jtu.tree_map(lambda x: jnp.asarray(x, jnp.float32), params)
     return model, {"params": params, "state": {}}
+
+
+def bert_params_to_hf(params, num_layers, hidden_size):
+    """Export back to the HF ``BertForMaskedLM`` key layout (numpy) — the
+    inverse of :func:`bert_params_from_hf` (fused qkv splits into separate
+    q/k/v; [in,out] Linears transpose back to torch's [out,in])."""
+    out = {
+        "bert.embeddings.word_embeddings.weight":
+            _np(params["tok_emb"]["embedding"]),
+        "bert.embeddings.position_embeddings.weight":
+            _np(params["pos_emb"]["embedding"]),
+        "bert.embeddings.token_type_embeddings.weight":
+            _np(params["type_emb"]["embedding"]),
+        "cls.predictions.bias": _np(params["mlm_bias"]),
+        # Tied decoder: HF materializes the word embedding (and the shared
+        # prediction bias) again under the decoder's own keys.
+        "cls.predictions.decoder.weight":
+            _np(params["tok_emb"]["embedding"]),
+        "cls.predictions.decoder.bias": _np(params["mlm_bias"]),
+    }
+
+    def put_lin(key, p):
+        out[f"{key}.weight"] = _np(p["w"]).T
+        out[f"{key}.bias"] = _np(p["b"])
+
+    def put_ln(key, p):
+        out[f"{key}.weight"] = _np(p["scale"])
+        out[f"{key}.bias"] = _np(p["bias"])
+
+    put_ln("bert.embeddings.LayerNorm", params["emb_ln"])
+    put_lin("cls.predictions.transform.dense", params["mlm_dense"])
+    put_ln("cls.predictions.transform.LayerNorm", params["mlm_ln"])
+    h = hidden_size
+    for i in range(num_layers):
+        blk = params[f"layers{i}"]
+        L = f"bert.encoder.layer.{i}"
+        w, b = _np(blk["qkv"]["w"]), _np(blk["qkv"]["b"])
+        for j, name in enumerate(("query", "key", "value")):
+            out[f"{L}.attention.self.{name}.weight"] = \
+                w[:, j * h:(j + 1) * h].T
+            out[f"{L}.attention.self.{name}.bias"] = b[j * h:(j + 1) * h]
+        put_lin(f"{L}.attention.output.dense", blk["attn_out"])
+        put_ln(f"{L}.attention.output.LayerNorm", blk["attn_ln"])
+        put_lin(f"{L}.intermediate.dense", blk["fc"])
+        put_lin(f"{L}.output.dense", blk["fc_out"])
+        put_ln(f"{L}.output.LayerNorm", blk["out_ln"])
+    return out

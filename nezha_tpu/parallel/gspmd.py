@@ -143,6 +143,11 @@ def param_specs_from_rules(params: Any, rules: Rules,
 def opt_state_specs(opt_state: Any, param_specs: Any) -> Any:
     """Optimizer stats inherit their parameter's spec; scalars replicate.
 
+    Recurses into nested dicts whose structure does not match the param
+    tree directly — optimizer WRAPPERS (e.g. accumulate_gradients) nest
+    the inner optimizer's state under a key, and its mu/nu must stay
+    sharded like their parameters, not silently replicate.
+
     Shared by the GSPMD and pipeline state-placement paths."""
     out = {}
     for key, sub in opt_state.items():
@@ -151,6 +156,8 @@ def opt_state_specs(opt_state: Any, param_specs: Any) -> Any:
         elif isinstance(sub, dict) and jax.tree_util.tree_structure(
                 sub) == jax.tree_util.tree_structure(param_specs):
             out[key] = param_specs
+        elif isinstance(sub, dict):
+            out[key] = opt_state_specs(sub, param_specs)
         else:
             out[key] = jax.tree_util.tree_map(lambda _: P(), sub)
     return out

@@ -105,3 +105,29 @@ def test_bert_logits_match_torch_reference(hf_bert):
     # but they attend differently and are never used.
     np.testing.assert_allclose(np.asarray(ours)[pad], ref[pad],
                                atol=3e-4, rtol=3e-4)
+
+
+def test_bert_roundtrip_export(hf_bert):
+    from nezha_tpu.models.convert import (
+        bert_from_hf, bert_params_from_hf, bert_params_to_hf)
+
+    model, variables = bert_from_hf(hf_bert)
+    exported = bert_params_to_hf(variables["params"], model.cfg.num_layers,
+                                 model.cfg.hidden_size)
+    re_imported = bert_params_from_hf(exported, model.cfg.num_layers)
+    orig = bert_params_from_hf(hf_bert.state_dict(), model.cfg.num_layers)
+
+    import jax.tree_util as jtu
+    leaves1 = jtu.tree_leaves(re_imported)
+    leaves2 = jtu.tree_leaves(orig)
+    assert len(leaves1) == len(leaves2)
+    for a, b in zip(leaves1, leaves2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # And HF itself accepts the exported dict (shape/key compatibility).
+    import torch as _torch
+    missing, unexpected = hf_bert.load_state_dict(
+        {k: _torch.tensor(v) for k, v in exported.items()}, strict=False)
+    assert not unexpected, unexpected
+    # Nothing may be missing beyond torch-internal buffers.
+    assert all("position_ids" in k for k in missing), missing

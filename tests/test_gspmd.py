@@ -132,3 +132,23 @@ def test_gspmd_params_are_physically_sharded(devices8):
     # Optimizer stats follow the param layout (mu of qkv/w also sharded).
     mu = sharded["opt_state"]["mu"]["h0"]["attn"]["qkv"]["w"]
     assert {s.data.shape for s in mu.addressable_shards} == {(32, 24)}
+
+
+def test_opt_state_specs_recurse_into_wrapped_optimizers(devices8):
+    """accumulate_gradients nests the inner optimizer's state under
+    "inner"; its mu/nu must inherit the param specs (sharded), not fall to
+    a replicate-everything branch (found via --grad-accum x pp review)."""
+    from jax.sharding import PartitionSpec as P
+
+    from nezha_tpu import optim
+    from nezha_tpu.parallel.gspmd import opt_state_specs
+
+    params = {"w": jnp.zeros((8, 4)), "b": jnp.zeros((4,))}
+    param_specs = {"w": P("dp", None), "b": P()}
+    opt = optim.accumulate_gradients(optim.adamw(1e-3), 4)
+    specs = opt_state_specs(opt.init(params), param_specs)
+    assert specs["acc"] == param_specs
+    assert specs["count"] == P()
+    assert specs["inner"]["mu"] == param_specs  # sharded, not replicated
+    assert specs["inner"]["nu"] == param_specs
+    assert specs["inner"]["step"] == P()
