@@ -418,10 +418,6 @@ def run(args) -> Dict[str, float]:
         if args.engine == "graph":
             raise SystemExit("--remat is a jax.checkpoint knob; the graph "
                              "engine does not rematerialize")
-        if args.parallel == "pp":
-            raise SystemExit("--remat does not reach the pipeline's stage "
-                             "slabs (they apply blocks directly); "
-                             "--microbatches is the pp memory knob")
         _wrap_model_overrides(cfg, remat=True)
 
     if args.seq_len:
@@ -627,6 +623,8 @@ def run(args) -> Dict[str, float]:
             state = pp_mod.init_pipeline_state(
                 model.init(rng), pspec, optimizer, mesh, rng)
             save_fn = sckpt.save_sharded
+            # dropout_rng/remat resolve from the spec's own fields (set
+            # from the model config by gpt2_pipeline_spec).
             step_fn = pp_mod.make_pipeline_train_step(
                 pspec, optimizer, cfg.loss_fn, mesh,
                 num_microbatches=args.microbatches,

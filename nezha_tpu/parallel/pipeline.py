@@ -68,6 +68,10 @@ class PipelineSpec(NamedTuple):
     # The model's dropout rate: lets the step factory refuse a dropout>0
     # spec without dropout_rng=True (which would silently train dropless).
     dropout: float = 0.0
+    # The model's remat request: the step factory maps it onto per-tick
+    # stage checkpointing (GPT2Config.remat wraps blocks outside pp; inside
+    # pp the schedule owns rematerialization).
+    remat: bool = False
 
 
 def stack_block_params(blocks: List[PyTree]) -> PyTree:
@@ -83,7 +87,7 @@ def unstack_block_params(stacked: PyTree) -> List[PyTree]:
 def pipeline_blocks(stage_params: PyTree, x: jax.Array, rng=None, *,
                     block_fn: Callable[..., jax.Array],
                     num_microbatches: int, axis_name: str = "pp",
-                    dp_axis: str = None) -> jax.Array:
+                    dp_axis: str = None, remat: bool = False) -> jax.Array:
     """The SPMD pipeline body. Call inside shard_map over ``axis_name``.
 
     ``stage_params``: this rank's slab of stacked layer params [L_stage, ...].
@@ -121,6 +125,14 @@ def pipeline_blocks(stage_params: PyTree, x: jax.Array, rng=None, *,
         h, _ = lax.scan(body, h, (params_slab, jnp.arange(n_layers_stage)))
         return h
 
+    if remat:
+        # GPipe's memory cliff is the M microbatch activations saved per
+        # tick; checkpointing the stage application keeps only each tick's
+        # input and recomputes the stage in backward (~1/3 extra FLOPs for
+        # O(M)->O(1) per-tick residuals). rng replays through the
+        # recompute, so dropout masks are identical.
+        stage_fn = jax.checkpoint(stage_fn)
+
     perm = [(i, (i + 1) % world) for i in range(world)]
     ticks = m + world - 1
 
@@ -153,7 +165,7 @@ def pipeline_blocks(stage_params: PyTree, x: jax.Array, rng=None, *,
 def pipelined_forward(spec: PipelineSpec, pparams: Dict[str, PyTree],
                       batch_inputs: Any, mesh: Mesh, num_microbatches: int,
                       pp_axis: str = "pp", dp_axis: str = "dp",
-                      rng=None) -> jax.Array:
+                      rng=None, remat: bool = False) -> jax.Array:
     """Full forward: embed (GSPMD) -> pipelined blocks (shard_map) -> head.
 
     ``pparams``: {"outer": outer_params, "blocks": stacked [L, ...] tree}.
@@ -164,7 +176,7 @@ def pipelined_forward(spec: PipelineSpec, pparams: Dict[str, PyTree],
     xspec = P(dp_axis) if dp_in_mesh else P()
     body = partial(pipeline_blocks, block_fn=spec.block_fn,
                    num_microbatches=num_microbatches, axis_name=pp_axis,
-                   dp_axis=dp_axis if dp_in_mesh else None)
+                   dp_axis=dp_axis if dp_in_mesh else None, remat=remat)
     if rng is None:
         x = spec.embed_fn(pparams["outer"], batch_inputs)
         run = shard_map(body, mesh=mesh, in_specs=(P(pp_axis), xspec),
@@ -220,7 +232,8 @@ def make_pipeline_train_step(spec: PipelineSpec, optimizer: Optimizer,
                              loss_fn: Callable[[jax.Array, dict], jax.Array],
                              mesh: Mesh, num_microbatches: int,
                              pp_axis: str = "pp", dp_axis: str = "dp",
-                             donate: bool = True, dropout_rng: bool = False):
+                             donate: bool = True, dropout_rng: bool = False,
+                             remat: bool = None):
     """jit'd train step over {"pparams", "opt_state", "rng"} state.
 
     Batch dicts shard over ``dp_axis`` (when present in the mesh); grads of
@@ -235,6 +248,10 @@ def make_pipeline_train_step(spec: PipelineSpec, optimizer: Optimizer,
         raise ValueError(
             f"spec carries dropout={spec.dropout} but dropout_rng=False; "
             f"pass make_pipeline_train_step(..., dropout_rng=True)")
+    # remat defaults to the spec's own request (cfg.remat), so a model
+    # built for rematerialization can't silently hit the GPipe memory
+    # cliff; pass remat=False explicitly to override.
+    remat = spec.remat if remat is None else remat
 
     def step(state, batch):
         if dropout_rng:
@@ -248,7 +265,7 @@ def make_pipeline_train_step(spec: PipelineSpec, optimizer: Optimizer,
         def compute_loss(pparams):
             out = pipelined_forward(spec, pparams, batch, mesh,
                                     num_microbatches, pp_axis, dp_axis,
-                                    rng=step_rng)
+                                    rng=step_rng, remat=remat)
             return jnp.asarray(loss_fn(out, batch), jnp.float32)
 
         loss, grads = jax.value_and_grad(compute_loss)(state["pparams"])
@@ -319,4 +336,4 @@ def gpt2_pipeline_spec(model) -> PipelineSpec:
         return p
 
     return PipelineSpec(embed_fn, block_fn, head_fn, split, merge,
-                        dropout=cfg.dropout)
+                        dropout=cfg.dropout, remat=cfg.remat)

@@ -194,10 +194,15 @@ def test_cli_remat_matches_and_rejects(devices8):
     with pytest.raises(SystemExit, match="applies to gpt2_124m"):
         _run(["--config", "mlp_mnist", "--steps", "1", "--batch-size", "8",
               "--remat"])
-    with pytest.raises(SystemExit, match="pp memory knob"):
-        _run(["--config", "gpt2_124m", "--model-preset", "tiny",
-              "--steps", "1", "--batch-size", "8", "--parallel", "pp",
-              "--mesh", "dp=2,pp=4", "--remat"])
+    # pp honors --remat too (per-tick stage checkpointing): numerics match
+    # the plain pp run exactly.
+    pp_ref = _final_losses("gpt2_124m", 2, 8,
+                           ["--parallel", "pp", "--mesh", "dp=2,pp=4",
+                            "--microbatches", "2"])
+    pp_rm = _final_losses("gpt2_124m", 2, 8,
+                          ["--parallel", "pp", "--mesh", "dp=2,pp=4",
+                           "--microbatches", "2", "--remat"])
+    np.testing.assert_allclose(pp_rm, pp_ref, rtol=1e-5)
 
 
 def test_cli_gspmd_sharded_checkpoint_resume(devices8, tmp_path):

@@ -186,3 +186,30 @@ def test_pipeline_step_refuses_dropout_without_rng():
     with pytest.raises(ValueError, match="dropout_rng=True"):
         pp.make_pipeline_train_step(spec, optim.adamw(1e-3), lm_loss, mesh,
                                     num_microbatches=2)
+
+
+def test_pipeline_remat_matches_exact(devices8):
+    """Per-tick stage checkpointing changes memory scheduling, not math —
+    including with dropout keys, which must replay identically through the
+    recompute."""
+    model = GPT2(GPT2Config(vocab_size=64, max_positions=16, num_layers=4,
+                            num_heads=2, hidden_size=32, dropout=0.3))
+    opt = optim.adamw(1e-3)
+    rng = jax.random.PRNGKey(0)
+    mesh = parallel.make_mesh({"dp": 2, "pp": 4})
+    spec = pp.gpt2_pipeline_spec(model)
+    variables = model.init(rng)
+    batch = _batch()
+
+    losses = {}
+    for remat in (False, True):
+        state = pp.init_pipeline_state(variables, spec, opt, mesh, rng)
+        step = pp.make_pipeline_train_step(spec, opt, lm_loss, mesh,
+                                           num_microbatches=4, donate=False,
+                                           dropout_rng=True, remat=remat)
+        ls = []
+        for _ in range(2):
+            state, m = step(state, batch)
+            ls.append(float(m["loss"]))
+        losses[remat] = ls
+    np.testing.assert_allclose(losses[True], losses[False], rtol=1e-6)
