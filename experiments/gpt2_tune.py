@@ -7,6 +7,7 @@ and prints one JSON line per variant:
 
   - ln:    xla composed layer norm vs the fused Pallas kernel (25 norms/step)
   - attn:  flash (default) sanity point vs xla composed
+  - remat: per-block jax.checkpoint (the memory knob's throughput cost)
   - donate: buffer donation on/off (should be ~free, catches regressions)
 
 Usage: python experiments/gpt2_tune.py [--steps 20] [--batch 8] [--seq 1024]
@@ -68,6 +69,7 @@ VARIANTS = [
     {"name": "baseline"},
     {"name": "ln_pallas", "cfg": {"ln_impl": "pallas"}},
     {"name": "attn_xla", "cfg": {"attn_impl": "xla"}},
+    {"name": "remat", "cfg": {"remat": True}},  # cost of the memory knob
     {"name": "no_donate", "donate": False},
 ]
 
