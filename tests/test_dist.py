@@ -361,6 +361,21 @@ arr = jax.make_array_from_single_device_arrays(
 total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)
 psum_val = float(total.addressable_shards[0].data)
 
+# The int8 wire collective (all_to_all + all_gather composition) must also
+# ride the cross-process runtime — the multi-host path of
+# --grad-allreduce int8.
+from nezha_tpu.parallel._compat import shard_map
+from nezha_tpu.parallel.quantized import _qar_mean
+
+vec = jax.make_array_from_single_device_arrays(
+    (2, 256), NamedSharding(mesh, P("dp")),
+    [jax.device_put(jnp.full((1, 256), float(group.rank + 1)),
+                    jax.local_devices()[0])])
+q8 = jax.jit(shard_map(lambda v: _qar_mean(v[0], "dp", 128)[None],
+                       mesh=mesh, in_specs=(P("dp"),),
+                       out_specs=P("dp")))(vec)
+q8_val = float(np.asarray(q8.addressable_shards[0].data).mean())
+
 print(json.dumps({{
     "rank": group.rank,
     "process_count": jax.process_count(),
@@ -368,6 +383,7 @@ print(json.dumps({{
     "global_devices": len(jax.devices()),
     "local_devices": len(jax.local_devices()),
     "psum": psum_val,
+    "int8_mean": q8_val,
 }}))
 group.leave()
 """)
@@ -385,3 +401,5 @@ group.leave()
         assert r["local_devices"] == 1   # but only its own are local
         assert r["process_index"] == r["rank"]  # coordinator rank == jax id
         assert r["psum"] == 3.0  # 1 + 2 summed ACROSS processes
+        # int8-wire mean of (1, 2) across processes, exact at these values.
+        assert abs(r["int8_mean"] - 1.5) < 0.02, r["int8_mean"]

@@ -257,3 +257,39 @@ def test_sharded_keep_last_counts_only_complete(devices8, tmp_path):
                      "step_00000004.sharded"]
     restored, step = sckpt.try_restore_sharded(tmp_path, state)
     assert step == 4
+
+
+def test_ep_sharded_expert_leaves_restore_bit_exact(devices8, tmp_path):
+    """Reshard-on-restore of the MoE layout: [E,.,.] expert leaves split
+    over ep must come back VALUE-exact (a shard-to-rank permutation would
+    keep shapes and finiteness — only a leafwise compare catches it)."""
+    import jax
+
+    from nezha_tpu import optim, parallel
+    from nezha_tpu.models.gpt2 import GPT2, GPT2Config
+    from nezha_tpu.parallel.expert import gpt2_moe_gspmd_rules
+    from nezha_tpu.train import sharded_checkpoint as sckpt
+    from nezha_tpu.train.loop import init_train_state
+
+    cfg = GPT2Config(vocab_size=128, max_positions=32, num_layers=2,
+                     num_heads=2, hidden_size=32, moe_experts=4)
+    model = GPT2(cfg)
+    opt = optim.adamw(1e-3)
+    mesh = parallel.make_mesh({"dp": 2, "tp": 2, "ep": 2})
+    rules = gpt2_moe_gspmd_rules(parallel.GPT2_TP_RULES)
+    state = init_train_state(model, opt, jax.random.PRNGKey(0))
+    specs = parallel.param_specs_from_rules(
+        state["variables"]["params"], rules, strict=True)
+    state = parallel.shard_train_state(state, mesh, specs)
+    want = jax.tree_util.tree_map(np.asarray, jax.device_get(state))
+
+    sckpt.save_sharded(tmp_path, state, 7)
+    template = parallel.shard_train_state(
+        init_train_state(model, opt, jax.random.PRNGKey(1)), mesh, specs)
+    restored, step = sckpt.try_restore_sharded(tmp_path, template)
+    assert step == 7
+    got = jax.tree_util.tree_map(np.asarray, jax.device_get(restored))
+    jax.tree_util.tree_map(np.testing.assert_array_equal, want, got)
+    # The expert stacks really are ep-split in the restored layout.
+    w_in = restored["variables"]["params"]["h1"]["mlp"]["w_in"]
+    assert {s.data.shape[0] for s in w_in.addressable_shards} == {2}  # 4/ep=2
