@@ -14,6 +14,7 @@ from __future__ import annotations
 import argparse
 import itertools
 import json
+import re
 import sys
 from typing import Callable, Dict, Iterator, Optional
 
@@ -342,10 +343,25 @@ def _make_batch_sharder(mesh, group):
     return lambda b: parallel.shard_batch(mesh, b)
 
 
+def _parse_profile_steps(spec: str):
+    """Validate START:COUNT (pure argv parsing — called before any setup so
+    a typo can't strand multi-host peers past the rendezvous)."""
+    m = re.match(r"^(\d+):(\d+)$", spec)
+    if not m or int(m.group(2)) < 1:
+        raise SystemExit(f"--profile-steps takes START:COUNT with COUNT >= "
+                         f"1 (e.g. 10:3), got {spec!r}")
+    return int(m.group(1)), int(m.group(2))
+
+
 def run(args) -> Dict[str, float]:
     if args.ckpt_keep is not None and args.ckpt_keep <= 0:
         raise SystemExit(f"--ckpt-keep must be >= 1 (got {args.ckpt_keep}); "
                          f"omit it to keep all checkpoints")
+    if args.profile_steps:
+        if not args.profile_dir:
+            raise SystemExit("--profile-steps needs --profile-dir for the "
+                             "trace output")
+        _parse_profile_steps(args.profile_steps)
     group, coord = _join_world(args)
 
     import jax
@@ -699,12 +715,20 @@ def run(args) -> Dict[str, float]:
         if metrics_log:
             metrics_log.log(step_no, metrics)
 
+    tracer = None
+    if args.profile_steps:
+        # Validated at the top of run(); the Tracer itself is cheap.
+        start, count = _parse_profile_steps(args.profile_steps)
+        from nezha_tpu.utils import Tracer
+        tracer = Tracer(args.profile_dir, start_step=start, num_steps=count)
+
     trainer = Trainer(
         model, optimizer, cfg.loss_fn,
         checkpoint_dir=args.ckpt_dir,
         checkpoint_every=args.ckpt_every,
         log_every=args.log_every,
         metric_logger=log_metrics,
+        tracer=tracer,
         process_group=group,
         failure_check_every=args.failure_check_every if group is not None
         else 0,
@@ -717,7 +741,8 @@ def run(args) -> Dict[str, float]:
     trainer.state = state
     trainer.global_step = start_step
 
-    if args.profile_dir:
+    whole_run_trace = args.profile_dir and tracer is None
+    if whole_run_trace:
         import os as _os
         _os.makedirs(args.profile_dir, exist_ok=True)
         jax.profiler.start_trace(args.profile_dir)
@@ -729,8 +754,10 @@ def run(args) -> Dict[str, float]:
         prefetch.close()
         if close_source is not None:
             close_source()
-        if args.profile_dir:
+        if whole_run_trace:
             jax.profiler.stop_trace()
+        elif tracer is not None:
+            tracer.stop()  # window may still be open on early exit
         if metrics_log:
             metrics_log.close()
         if group is not None:
@@ -873,7 +900,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "(TPU backends; no-op where the backend exposes "
                         "no memory stats)")
     p.add_argument("--profile-dir", default=None,
-                   help="capture an XLA/TPU profiler trace here")
+                   help="capture an XLA/TPU profiler trace here (whole run "
+                        "unless --profile-steps bounds it)")
+    p.add_argument("--profile-steps", default=None, metavar="START:COUNT",
+                   help="bounded trace into --profile-dir: capture begins "
+                        "once step START has completed and covers the next "
+                        "COUNT steps (e.g. 10:3 traces steps 11-13 — the "
+                        "standard steady-state window)")
     p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
                    help="rendezvous address for multi-process launch")
     p.add_argument("--serve-coordinator", action="store_true",

@@ -218,3 +218,31 @@ def test_cli_log_memory_flag_is_safe_off_tpu(tmp_path):
          "--log-every", "2", "--log-memory", "--metrics-file", str(mf)]))
     recs = [_json.loads(l) for l in mf.read_text().strip().splitlines()]
     assert recs and all("loss" in r for r in recs)  # flag adds nothing on CPU
+
+
+def test_cli_profile_steps_window(tmp_path):
+    """--profile-steps START:COUNT captures a bounded trace window into
+    --profile-dir (and validates its inputs)."""
+    import pytest
+
+    from nezha_tpu.cli.train import build_parser, run
+    pd = tmp_path / "prof"
+    run(build_parser().parse_args(
+        ["--config", "mlp_mnist", "--steps", "8", "--batch-size", "16",
+         "--profile-dir", str(pd), "--profile-steps", "3:2",
+         "--log-every", "4"]))
+    # jax writes trace artifacts under plugins/profile/<ts>/.
+    assert any(pd.rglob("*.pb")) or any(pd.rglob("*.json.gz")), \
+        list(pd.rglob("*"))
+    with pytest.raises(SystemExit, match="START:COUNT"):
+        run(build_parser().parse_args(
+            ["--config", "mlp_mnist", "--steps", "1", "--batch-size", "8",
+             "--profile-dir", str(pd), "--profile-steps", "banana"]))
+    with pytest.raises(SystemExit, match="COUNT >= 1"):
+        run(build_parser().parse_args(
+            ["--config", "mlp_mnist", "--steps", "1", "--batch-size", "8",
+             "--profile-dir", str(pd), "--profile-steps", "10:0"]))
+    with pytest.raises(SystemExit, match="needs --profile-dir"):
+        run(build_parser().parse_args(
+            ["--config", "mlp_mnist", "--steps", "1", "--batch-size", "8",
+             "--profile-steps", "1:1"]))
