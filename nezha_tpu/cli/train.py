@@ -347,9 +347,11 @@ def _parse_profile_steps(spec: str):
     """Validate START:COUNT (pure argv parsing — called before any setup so
     a typo can't strand multi-host peers past the rendezvous)."""
     m = re.match(r"^(\d+):(\d+)$", spec)
-    if not m or int(m.group(2)) < 1:
-        raise SystemExit(f"--profile-steps takes START:COUNT with COUNT >= "
-                         f"1 (e.g. 10:3), got {spec!r}")
+    if not m or int(m.group(1)) < 1 or int(m.group(2)) < 1:
+        # START >= 1: the window opens after step START completes, so 0
+        # cannot capture step 1 and would silently shift the window.
+        raise SystemExit(f"--profile-steps takes START:COUNT with START "
+                         f">= 1 and COUNT >= 1 (e.g. 10:3), got {spec!r}")
     return int(m.group(1)), int(m.group(2))
 
 

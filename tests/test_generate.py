@@ -21,12 +21,17 @@ def model_and_vars():
 
 
 def _naive_greedy(model, variables, prompt, n):
-    """Reference decode: full forward over the whole prefix each step."""
-    toks = jnp.asarray(prompt, jnp.int32)
-    for _ in range(n):
-        logits, _ = model.apply(variables, toks, training=False)
-        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
-        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    """Reference decode: full forward each step, at a FIXED padded length
+    so jit compiles once instead of once per prefix length (causality
+    makes the tail padding invisible to the positions we read)."""
+    b, p = prompt.shape
+    toks = jnp.zeros((b, p + n), jnp.int32).at[:, :p].set(
+        jnp.asarray(prompt, jnp.int32))
+    fwd = jax.jit(lambda v, t: model.apply(v, t, training=False)[0])
+    for i in range(n):
+        logits = fwd(variables, toks)
+        nxt = jnp.argmax(logits[:, p + i - 1, :], axis=-1).astype(jnp.int32)
+        toks = toks.at[:, p + i].set(nxt)
     return toks
 
 

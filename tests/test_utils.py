@@ -242,6 +242,10 @@ def test_cli_profile_steps_window(tmp_path):
         run(build_parser().parse_args(
             ["--config", "mlp_mnist", "--steps", "1", "--batch-size", "8",
              "--profile-dir", str(pd), "--profile-steps", "10:0"]))
+    with pytest.raises(SystemExit, match="START >= 1"):
+        run(build_parser().parse_args(
+            ["--config", "mlp_mnist", "--steps", "1", "--batch-size", "8",
+             "--profile-dir", str(pd), "--profile-steps", "0:3"]))
     with pytest.raises(SystemExit, match="needs --profile-dir"):
         run(build_parser().parse_args(
             ["--config", "mlp_mnist", "--steps", "1", "--batch-size", "8",
