@@ -364,6 +364,17 @@ def run(args) -> Dict[str, float]:
             raise SystemExit("--profile-steps needs --profile-dir for the "
                              "trace output")
         _parse_profile_steps(args.profile_steps)
+    if args.clip_norm is not None:
+        # Pure-argv validation BEFORE the rendezvous (a post-join
+        # SystemExit would strand multi-host peers in their next
+        # collective); the wrap itself happens after the parallel mode is
+        # known, since ZeRO-1 needs the cross-rank norm.
+        if not args.clip_norm > 0:  # also catches NaN (every compare False)
+            raise SystemExit(f"--clip-norm must be > 0, got {args.clip_norm}")
+        if args.engine == "graph":
+            raise SystemExit("--clip-norm is an optimizer wrapper the "
+                             "graph engine's IR-authored update does not "
+                             "express; drop --engine graph")
     group, coord = _join_world(args)
 
     import jax
@@ -407,15 +418,7 @@ def run(args) -> Dict[str, float]:
             raise SystemExit("--grad-accum is an optimizer wrapper the "
                              "graph engine's IR-authored update does not "
                              "express; drop --engine graph")
-        if args.grad_accum > 1:
-            from nezha_tpu import optim
-            acc_build = cfg.build_optimizer
-            # The inner optimizer (and its LR schedule) steps once per
-            # FLUSH, not per micro-step — size the schedule horizon to the
-            # number of real updates or the cosine never finishes.
-            cfg.build_optimizer = lambda steps: optim.accumulate_gradients(
-                acc_build(max(1, steps // args.grad_accum)),
-                args.grad_accum)
+        # (The wrap itself happens late, composed outside --clip-norm.)
 
     if args.dropout is not None:
         if args.config != "gpt2_124m":
@@ -579,6 +582,25 @@ def run(args) -> Dict[str, float]:
             model = cfg.sp_model(args.attn_impl)
         else:
             model = cfg.build_model()
+        if args.clip_norm is not None:
+            # ZeRO-1's optimizer sees per-rank gradient SHARDS, so the
+            # clip's norm must psum over dp; every other mode's optimizer
+            # sees full gradients.
+            from nezha_tpu import optim as optim_mod
+            clip_build = cfg.build_optimizer
+            clip_axis = "dp" if mode == "zero1" else None
+            cfg.build_optimizer = lambda steps: optim_mod.with_grad_clipping(
+                clip_build(steps), args.clip_norm, axis_name=clip_axis)
+        if args.grad_accum is not None and args.grad_accum > 1:
+            # Outside the clip: accumulate RAW micro-grads, clip the
+            # flushed mean. The inner optimizer (and its LR schedule)
+            # steps once per FLUSH — size the horizon to real updates or
+            # the cosine never finishes.
+            from nezha_tpu import optim as optim_mod
+            acc_build = cfg.build_optimizer
+            cfg.build_optimizer = lambda steps: optim_mod.accumulate_gradients(
+                acc_build(max(1, steps // args.grad_accum)),
+                args.grad_accum)
         optimizer = cfg.build_optimizer(args.steps)
         rng = jax.random.PRNGKey(args.seed)
 
@@ -855,6 +877,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--moe-experts", type=int, default=None,
                    help="gpt2_124m only: swap every other block's MLP for "
                         "a top-k routed mixture of this many experts")
+    p.add_argument("--clip-norm", type=float, default=None,
+                   help="clip gradients to this global L2 norm before the "
+                        "optimizer update (any config/parallel mode)")
     p.add_argument("--grad-accum", type=int, default=None,
                    help="accumulate gradients over N micro-steps before "
                         "each optimizer update (any config/parallel mode; "

@@ -37,13 +37,24 @@ def apply_updates(params, updates):
         params, updates)
 
 
-def global_norm(tree) -> jnp.ndarray:
-    leaves = jax.tree_util.tree_leaves(tree)
-    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves))
+def global_norm(tree, axis_name=None) -> jnp.ndarray:
+    """Global L2 norm of a pytree. ``axis_name``: psum the squared sum over
+    that mapped axis first — for trees holding only this rank's SHARD of
+    each leaf (ZeRO-1's post-reduce-scatter chunks)."""
+    sq = sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+             for x in jax.tree_util.tree_leaves(tree))
+    if axis_name is not None:
+        from jax import lax
+        sq = lax.psum(sq, axis_name)
+    return jnp.sqrt(sq)
 
 
-def clip_by_global_norm(tree, max_norm: float):
-    norm = global_norm(tree)
+def clip_by_global_norm(tree, max_norm: float, axis_name=None):
+    """Scale ``tree`` so its global L2 norm is at most ``max_norm``.
+
+    ``axis_name``: see :func:`global_norm` — without it, sharded-gradient
+    callers would clip against a ~sqrt(world)x-too-small per-rank norm."""
+    norm = global_norm(tree, axis_name=axis_name)
     scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
     return jax.tree_util.tree_map(lambda x: x * scale, tree), norm
 
@@ -306,11 +317,14 @@ def adafactor(lr, decay: float = 0.8, eps: float = 1e-30,
     return Optimizer(init, update)
 
 
-def with_grad_clipping(opt: Optimizer, max_norm: float) -> Optimizer:
-    """Wrap an optimizer with global-norm gradient clipping."""
+def with_grad_clipping(opt: Optimizer, max_norm: float,
+                       axis_name=None) -> Optimizer:
+    """Wrap an optimizer with global-norm gradient clipping. Pass
+    ``axis_name`` when the optimizer runs on per-rank gradient SHARDS
+    (ZeRO-1) so the norm is global, not shard-local."""
 
     def update(grads, state, params):
-        grads, _ = clip_by_global_norm(grads, max_norm)
+        grads, _ = clip_by_global_norm(grads, max_norm, axis_name=axis_name)
         return opt.update(grads, state, params)
 
     return Optimizer(opt.init, update)

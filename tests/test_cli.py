@@ -522,6 +522,29 @@ def test_cli_grad_accum(devices8):
               "--grad-accum", "0"])
 
 
+def test_cli_clip_norm(devices8):
+    """--clip-norm bounds the update: a near-zero clip freezes training
+    (losses stay ~constant) where the unclipped run moves; invalid values
+    reject."""
+    import pytest
+    # mlp_mnist trains with momentum SGD, whose update scales with the
+    # gradient (AdamW's does not — it normalizes scale away), so a
+    # near-zero clip visibly freezes it.
+    clipped = _final_losses("mlp_mnist", 8, 64,
+                            ["--parallel", "single", "--clip-norm", "1e-9"])
+    plain = _final_losses("mlp_mnist", 8, 64, ["--parallel", "single"])
+    # Frozen params still see per-batch loss noise (~0.05); the real run's
+    # drop must dwarf the clipped run's drift.
+    assert plain[0] - plain[-1] > 5 * abs(clipped[0] - clipped[-1]), \
+        (plain, clipped)
+    with pytest.raises(SystemExit, match="clip-norm must be"):
+        _run(["--config", "mlp_mnist", "--steps", "1", "--batch-size", "8",
+              "--clip-norm", "-1"])
+    with pytest.raises(SystemExit, match="graph engine"):
+        _run(["--config", "mlp_mnist", "--steps", "1", "--batch-size", "8",
+              "--engine", "graph", "--clip-norm", "1.0"])
+
+
 def test_cli_ckpt_keep_rejects_nonpositive():
     import pytest
     with pytest.raises(SystemExit, match="ckpt-keep must be >= 1"):
