@@ -375,6 +375,19 @@ def run(args) -> Dict[str, float]:
             raise SystemExit("--clip-norm is an optimizer wrapper the "
                              "graph engine's IR-authored update does not "
                              "express; drop --engine graph")
+    if args.lr is not None and not args.optimizer:
+        raise SystemExit("--lr only applies with --optimizer (each config's "
+                         "default optimizer bakes its own tuned schedule)")
+    if args.optimizer:
+        if args.engine == "graph":
+            raise SystemExit("the graph engine authors its optimizer update "
+                             "in the IR (momentum/adamw programs); "
+                             "--optimizer cannot swap it")
+        if args.lr is None:
+            raise SystemExit("--optimizer needs --lr (peak learning rate "
+                             "for the warmup+cosine schedule)")
+        if not args.lr > 0:  # also catches NaN
+            raise SystemExit(f"--lr must be > 0, got {args.lr}")
     group, coord = _join_world(args)
 
     import jax
@@ -409,6 +422,24 @@ def run(args) -> Dict[str, float]:
                              "--parallel dp/zero1/sp, or gspmd with an ep "
                              "mesh axis (--mesh dp=X,tp=Y,ep=Z)")
         _wrap_model_overrides(cfg, moe_experts=args.moe_experts)
+
+    if args.optimizer:
+        # (Pairing/value/engine checks ran pre-rendezvous; the lars/lamb x
+        # zero1 guard runs post-degrade below, where the real mode is known.)
+        from nezha_tpu import optim as optim_mod
+        factories = {
+            "sgd": optim_mod.sgd,
+            "momentum": lambda lr: optim_mod.momentum(
+                lr, beta=0.9, weight_decay=1e-4),
+            "adamw": lambda lr: optim_mod.adamw(lr, weight_decay=0.1),
+            "lars": lambda lr: optim_mod.lars(lr, weight_decay=1e-4),
+            "lamb": lambda lr: optim_mod.lamb(lr, weight_decay=0.01),
+            "adafactor": optim_mod.adafactor,
+        }
+        factory = factories[args.optimizer]
+        cfg.build_optimizer = lambda steps: factory(
+            optim_mod.warmup_cosine_schedule(
+                args.lr, min(100, max(1, steps // 10)), max(steps, 200)))
 
     if args.grad_accum is not None:
         if args.grad_accum < 1:
@@ -532,6 +563,11 @@ def run(args) -> Dict[str, float]:
             raise SystemExit("--grad-allreduce int8 is the dp/zero1 "
                              f"gradient wire format; mode {mode!r} does "
                              "not consume it (reject, don't ignore)")
+        if args.optimizer in ("lars", "lamb") and mode == "zero1":
+            raise SystemExit(f"--optimizer {args.optimizer} computes "
+                             f"layerwise trust ratios, which ZeRO-1's flat "
+                             f"per-rank chunks cannot preserve; use "
+                             f"--parallel dp (or adamw/momentum with zero1)")
 
         # Mesh axes are validated against the chosen mode: an axis the mode
         # cannot consume is an error, never silently ignored — and every
@@ -877,6 +913,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--moe-experts", type=int, default=None,
                    help="gpt2_124m only: swap every other block's MLP for "
                         "a top-k routed mixture of this many experts")
+    p.add_argument("--optimizer", default=None,
+                   choices=["sgd", "momentum", "adamw", "lars", "lamb",
+                            "adafactor"],
+                   help="swap the config's optimizer (requires --lr; gets "
+                        "a warmup+cosine schedule over --steps). The "
+                        "config defaults stay the tuned choice.")
+    p.add_argument("--lr", type=float, default=None,
+                   help="peak learning rate for --optimizer's schedule")
     p.add_argument("--clip-norm", type=float, default=None,
                    help="clip gradients to this global L2 norm before the "
                         "optimizer update (any config/parallel mode)")

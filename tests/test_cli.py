@@ -550,3 +550,37 @@ def test_cli_ckpt_keep_rejects_nonpositive():
     with pytest.raises(SystemExit, match="ckpt-keep must be >= 1"):
         _run(["--config", "mlp_mnist", "--steps", "1", "--batch-size", "8",
               "--ckpt-keep", "0"])
+
+
+def test_cli_optimizer_override(devices8):
+    """--optimizer swaps the config's optimizer (with --lr + warmup/cosine);
+    invalid combinations reject loudly."""
+    import pytest
+    m = _run(["--config", "resnet50_imagenet", "--model-preset", "tiny",
+              "--steps", "3", "--batch-size", "16", "--mesh", "dp=8",
+              "--optimizer", "lars", "--lr", "0.5", "--log-every", "1"])
+    assert np.isfinite(m["loss"])
+    m = _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--steps", "2", "--batch-size", "8", "--parallel", "single",
+              "--optimizer", "adafactor", "--lr", "1e-2"])
+    assert np.isfinite(m["loss"])
+    with pytest.raises(SystemExit, match="needs --lr"):
+        _run(["--config", "mlp_mnist", "--steps", "1", "--batch-size", "8",
+              "--optimizer", "adamw"])
+    with pytest.raises(SystemExit, match="only applies with --optimizer"):
+        _run(["--config", "mlp_mnist", "--steps", "1", "--batch-size", "8",
+              "--lr", "0.1"])
+    with pytest.raises(SystemExit, match="layerwise trust ratios"):
+        _run(["--config", "bert_base_zero1", "--model-preset", "tiny",
+              "--steps", "1", "--batch-size", "8", "--optimizer", "lamb",
+              "--lr", "1e-3"])
+    with pytest.raises(SystemExit, match="graph engine"):
+        _run(["--config", "mlp_mnist", "--steps", "1", "--batch-size", "8",
+              "--engine", "graph", "--optimizer", "adamw", "--lr", "1e-3"])
+
+
+def test_cli_lr_rejects_nonpositive():
+    import pytest
+    with pytest.raises(SystemExit, match="lr must be"):
+        _run(["--config", "mlp_mnist", "--steps", "1", "--batch-size", "8",
+              "--optimizer", "sgd", "--lr", "nan"])
