@@ -1,0 +1,130 @@
+"""`nezha-export` — convert a `nezha-train` checkpoint to Hugging Face
+weights.
+
+Closes the interchange loop (models/convert.py maps both directions for
+GPT-2 and BERT): train here, export to the HF key layout, load in torch.
+Output formats:
+
+- ``--format npz`` (default): one .npz of HF-keyed numpy arrays — no torch
+  needed to write or read (`np.load`; torch users: `torch.tensor(z[k])`).
+- ``--format torch``: a ``pytorch_model.bin`` state dict via torch.save,
+  directly loadable by ``GPT2LMHeadModel``/``BertForMaskedLM``
+  ``load_state_dict`` (requires the baked-in cpu torch).
+
+    nezha-export --config gpt2_124m --ckpt-dir runs/gpt2 \
+        --out gpt2_hf.npz
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="nezha-export", description=__doc__)
+    p.add_argument("--config", required=True,
+                   choices=["gpt2_124m", "bert_base_zero1"],
+                   help="which trained architecture the checkpoint holds "
+                        "(GPT-2 -> GPT2LMHeadModel keys, BERT -> "
+                        "BertForMaskedLM keys)")
+    p.add_argument("--ckpt-dir", required=True,
+                   help="checkpoint dir written by nezha-train (npz or "
+                        "per-shard format — restore handles either)")
+    p.add_argument("--model-preset", choices=["full", "tiny"],
+                   default="full",
+                   help="must match the preset the checkpoint was trained "
+                        "with (mirrors nezha-train)")
+    p.add_argument("--out", required=True, help="output file path")
+    p.add_argument("--format", choices=["npz", "torch"], default="npz")
+    p.add_argument("--platform", default=None,
+                   help="force a jax platform (e.g. cpu)")
+    return p
+
+
+def _restore_params(args, model, optimizer):
+    """Variables from either checkpoint format (dense npz preferred,
+    per-shard fallback — the zero1/gspmd CLI paths write sharded). The
+    sgd template works for any training optimizer: restore walks TEMPLATE
+    leaves only, and sgd's opt state ({"step"}) is a subset of every
+    saved optimizer's."""
+    import jax
+
+    from nezha_tpu.train import checkpoint as ckpt
+    from nezha_tpu.train import sharded_checkpoint as sckpt
+    from nezha_tpu.train.loop import init_train_state
+
+    template = init_train_state(model, optimizer, jax.random.PRNGKey(0))
+    restored, step = ckpt.try_restore(args.ckpt_dir, template)
+    if restored is None:
+        restored, step = sckpt.try_restore_sharded(args.ckpt_dir, template)
+    if restored is None:
+        raise SystemExit(f"no checkpoint (npz or sharded) in "
+                         f"{args.ckpt_dir}")
+    print(f"restored step {step} from {args.ckpt_dir}", file=sys.stderr)
+    return restored["variables"]["params"]
+
+
+def run(args) -> dict:
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from nezha_tpu import optim
+    from nezha_tpu.models import convert
+    from nezha_tpu.models.bert import Bert, BertConfig
+    from nezha_tpu.models.gpt2 import GPT2, GPT2Config
+
+    # Restore templates need the param SHAPES only (master params are fp32
+    # under every policy), so default-policy models suffice.
+    if args.config == "gpt2_124m":
+        if args.model_preset == "full":
+            model = GPT2(GPT2Config())
+        else:
+            from nezha_tpu.cli.train import TINY_GPT2_KW
+            model = GPT2(GPT2Config(**TINY_GPT2_KW))
+        params = _restore_params(args, model, optim.sgd(0.1))
+        state_dict = convert.gpt2_params_to_hf(
+            jax.device_get(params), model.cfg.num_layers)
+    else:
+        if args.model_preset == "full":
+            cfg = BertConfig()
+        else:
+            from nezha_tpu.cli.train import TINY_BERT_KW
+            cfg = BertConfig(**TINY_BERT_KW)
+        model = Bert(cfg)
+        params = _restore_params(args, model, optim.sgd(0.1))
+        state_dict = convert.bert_params_to_hf(
+            jax.device_get(params), cfg.num_layers, cfg.hidden_size)
+
+    state_dict = {k: np.asarray(v, np.float32)
+                  for k, v in state_dict.items()}
+    out_path = args.out
+    if args.format == "npz":
+        # np.savez silently appends .npz — normalize FIRST so the reported
+        # path is the real one.
+        if not out_path.endswith(".npz"):
+            out_path += ".npz"
+        np.savez(out_path, **state_dict)
+    else:
+        import torch
+
+        torch.save({k: torch.tensor(v) for k, v in state_dict.items()},
+                   out_path)
+    result = {"keys": len(state_dict), "format": args.format,
+              "out": out_path}
+    print(json.dumps(result))
+    return result
+
+
+def main(argv=None) -> int:
+    run(build_parser().parse_args(argv))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -68,6 +68,8 @@ class Config:
 # round-trips (fp32 DEFAULT_POLICY, for tight mode-vs-mode tolerances).
 TINY_GPT2_KW = dict(vocab_size=512, max_positions=96, num_layers=4,
                     num_heads=4, hidden_size=64)
+TINY_BERT_KW = dict(vocab_size=512, max_positions=96, num_layers=2,
+                    num_heads=4, hidden_size=64)
 
 
 def _configs() -> Dict[str, Config]:
@@ -92,9 +94,7 @@ def _configs() -> Dict[str, Config]:
         return models.GPT2(models.GPT2Config(**kw))
 
     def tiny_bert():
-        return models.Bert(bert_mod.BertConfig(
-            vocab_size=512, max_positions=96, num_layers=2, num_heads=4,
-            hidden_size=64))
+        return models.Bert(bert_mod.BertConfig(**TINY_BERT_KW))
 
     tiny_tokens = lambda bs, seq_len=64, **kw: data.synthetic_token_batches(
         bs, seq_len=seq_len, vocab_size=512, **kw)

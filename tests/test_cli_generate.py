@@ -86,3 +86,67 @@ def test_generate_text_prompt_byte_level(tmp_path):
               "--prompt", "hi", "--prompt-tokens", "1"])
     with pytest.raises(SystemExit, match="empty"):
         _gen(["--random-init", "--model-preset", "tiny", "--prompt", ""])
+
+
+def test_export_gpt2_npz_and_torch(tmp_path, devices8):
+    """nezha-export converts a trained checkpoint to HF-keyed weights; the
+    torch format loads straight into GPT2LMHeadModel."""
+    from nezha_tpu.cli.export import build_parser as export_parser
+    from nezha_tpu.cli.export import run as export_run
+
+    ck = str(tmp_path / "ck")
+    train_run(train_parser().parse_args(
+        ["--config", "gpt2_124m", "--model-preset", "tiny", "--steps", "2",
+         "--batch-size", "8", "--ckpt-dir", ck]))
+
+    out = str(tmp_path / "w.npz")
+    res = export_run(export_parser().parse_args(
+        ["--config", "gpt2_124m", "--ckpt-dir", ck, "--model-preset",
+         "tiny", "--out", out]))
+    z = np.load(out)
+    assert res["keys"] == len(z.files)
+    np.testing.assert_array_equal(z["lm_head.weight"],
+                                  z["transformer.wte.weight"])  # tied
+
+    transformers = pytest.importorskip("transformers")
+    import torch
+    outb = str(tmp_path / "w.bin")
+    export_run(export_parser().parse_args(
+        ["--config", "gpt2_124m", "--ckpt-dir", ck, "--model-preset",
+         "tiny", "--out", outb, "--format", "torch"]))
+    hf = transformers.GPT2LMHeadModel(transformers.GPT2Config(
+        vocab_size=512, n_positions=96, n_embd=64, n_layer=4, n_head=4))
+    missing, unexpected = hf.load_state_dict(torch.load(outb),
+                                             strict=False)
+    assert not unexpected, unexpected
+    assert all(".attn.bias" in k or ".attn.masked_bias" in k
+               for k in missing), missing  # torch-internal causal buffers
+
+
+def test_export_bert_from_sharded_zero1_checkpoint(tmp_path, devices8):
+    """The per-shard zero1 checkpoint exports too (sharded restore with an
+    sgd template, then the BERT HF mapping)."""
+    from nezha_tpu.cli.export import build_parser as export_parser
+    from nezha_tpu.cli.export import run as export_run
+
+    ck = str(tmp_path / "ck")
+    train_run(train_parser().parse_args(
+        ["--config", "bert_base_zero1", "--model-preset", "tiny",
+         "--steps", "2", "--batch-size", "16", "--mesh", "dp=8",
+         "--ckpt-dir", ck]))
+    out = str(tmp_path / "b.npz")
+    res = export_run(export_parser().parse_args(
+        ["--config", "bert_base_zero1", "--ckpt-dir", ck,
+         "--model-preset", "tiny", "--out", out]))
+    z = np.load(out)
+    assert res["keys"] == len(z.files) > 20
+    assert "bert.encoder.layer.1.attention.self.query.weight" in z.files
+
+
+def test_export_rejects_missing_checkpoint(tmp_path):
+    from nezha_tpu.cli.export import build_parser as export_parser
+    from nezha_tpu.cli.export import run as export_run
+    with pytest.raises(SystemExit, match="no checkpoint"):
+        export_run(export_parser().parse_args(
+            ["--config", "gpt2_124m", "--ckpt-dir", str(tmp_path / "none"),
+             "--model-preset", "tiny", "--out", str(tmp_path / "x.npz")]))
