@@ -16,7 +16,7 @@ import itertools
 import json
 import re
 import sys
-from typing import Callable, Dict, Iterator, Optional
+from typing import Any, Callable, Dict, Iterator, Optional
 
 import numpy as np
 
@@ -375,6 +375,13 @@ def run(args) -> Dict[str, float]:
             raise SystemExit("--clip-norm is an optimizer wrapper the "
                              "graph engine's IR-authored update does not "
                              "express; drop --engine graph")
+    if args.eval_every is not None and args.eval_every < 1:
+        raise SystemExit(f"--eval-every must be >= 1, got {args.eval_every}")
+    if args.eval_batches is not None and args.eval_batches < 1:
+        # An empty eval pass would raise MID-training under --eval-every,
+        # after real progress — reject it before anything starts.
+        raise SystemExit(f"--eval-batches must be >= 1, got "
+                         f"{args.eval_batches}")
     if args.lr is not None and not args.optimizer:
         raise SystemExit("--lr only applies with --optimizer (each config's "
                          "default optimizer bakes its own tuned schedule)")
@@ -514,10 +521,10 @@ def run(args) -> Dict[str, float]:
                                                          lr=0.1)
             shard = programs.onehot_shard_fn(dims[-1])
         elif args.config in ("resnet50_imagenet", "wrn101_large_batch"):
-            if args.eval:
+            if args.eval or args.eval_every:
                 raise SystemExit("graph-engine ResNet runs training-mode "
                                  "batch stats only (no running BN stats); "
-                                 "drop --eval")
+                                 "drop --eval/--eval-every")
             state = programs.init_graph_resnet_state(model, rng)
             step_fn = programs.make_resnet_graph_train_step(model, lr=0.1)
             shard = programs.image_shard_fn()
@@ -801,6 +808,7 @@ def run(args) -> Dict[str, float]:
     trainer.state = state
     trainer.global_step = start_step
 
+    eval_cache: Dict[str, Any] = {}  # jitted eval step reused across passes
     whole_run_trace = args.profile_dir and tracer is None
     if whole_run_trace:
         import os as _os
@@ -809,7 +817,31 @@ def run(args) -> Dict[str, float]:
 
     last: Dict[str, float] = {}
     try:
-        last = trainer.fit(prefetch, args.steps)
+        if args.eval_every:
+            # Periodic eval: train in chunks aligned to GLOBAL-step
+            # multiples of --eval-every (same cadence convention as
+            # --ckpt-every/--log-every, so a resumed run's eval points
+            # line up with the pre-restart stream), full eval pass between
+            # chunks. The final pass happens at the tail with the
+            # end-of-run --eval handling.
+            done = 0
+            while done < args.steps:
+                to_boundary = (args.eval_every
+                               - trainer.global_step % args.eval_every)
+                n = min(to_boundary, args.steps - done)
+                last = trainer.fit(prefetch, n)
+                done += n
+                if done < args.steps:
+                    results = _run_eval(args, cfg, batch_size, mode, model,
+                                        trainer,
+                                        pspec if mode == "pp" else None,
+                                        cache=eval_cache)
+                    if results is not None:
+                        log_metrics(trainer.global_step, {
+                            "step": trainer.global_step,
+                            **{f"eval_{k}": v for k, v in results.items()}})
+        else:
+            last = trainer.fit(prefetch, args.steps)
     finally:
         prefetch.close()
         if close_source is not None:
@@ -837,45 +869,66 @@ def run(args) -> Dict[str, float]:
         trainer._save(start_step + args.steps)
         if async_ckpt is not None:
             async_ckpt.wait()
-    if args.eval:
-        eval_iter, eval_close, stat_fn = _eval_source(args, cfg, batch_size)
-        if eval_iter is not None:
-            from nezha_tpu.train.eval import evaluate
-            # Graph-engine state stores module-layout params without the
-            # variables wrapper; pipeline state stores stacked stage slabs
-            # (merged back to the native tree here); sequence-parallel
-            # models only run inside shard_map, so eval uses the plain
-            # single-device model with the same (replicated) params.
-            eval_model = model
-            if args.engine == "graph":
-                variables = {"params": trainer.state["params"], "state": {}}
-            elif mode == "pp":
-                variables = {"params": pp_mod.merge_pipeline_params(
-                    pspec, trainer.state["pparams"]), "state": {}}
-            else:
-                variables = trainer.state["variables"]
-                if mode == "sp":
-                    eval_model = cfg.build_model()
-            import contextlib
-
-            # gspmd/pp leave params sharded; eval traces fresh (outside the
-            # train-step jit), where attn "auto" would otherwise pick the
-            # Mosaic flash kernel XLA can't partition over tp/stage shards.
-            scope = contextlib.nullcontext()
-            if mode in ("gspmd", "pp"):
-                from nezha_tpu.parallel.gspmd import auto_partitioner_scope
-                scope = auto_partitioner_scope()
-            try:
-                with scope:
-                    results = evaluate(eval_model, variables, eval_iter,
-                                       stat_fn=stat_fn,
-                                       max_batches=args.eval_batches)
-            finally:
-                if eval_close is not None:
-                    eval_close()
+    if args.eval or args.eval_every:
+        results = _run_eval(args, cfg, batch_size, mode, model, trainer,
+                            pspec if mode == "pp" else None,
+                            cache=eval_cache)
+        if results is not None:
             print(json.dumps({"eval": results}), file=sys.stderr)
             last.update({f"eval_{k}": v for k, v in results.items()})
     return last
+
+
+def _run_eval(args, cfg, batch_size, mode, model, trainer, pspec,
+              cache=None):
+    """One full pass over the eval split against the CURRENT train state.
+    Returns the results dict, or None when the config has no eval split.
+    Safe to call repeatedly (--eval-every): the eval SOURCE re-opens each
+    time, while the jitted eval step (and the sp eval model) live in
+    ``cache`` so repeated passes hit jit's cache instead of retracing."""
+    eval_iter, eval_close, stat_fn = _eval_source(args, cfg, batch_size)
+    if eval_iter is None:
+        return None
+    from nezha_tpu.train.eval import evaluate, make_eval_step
+
+    # Graph-engine state stores module-layout params without the
+    # variables wrapper; pipeline state stores stacked stage slabs
+    # (merged back to the native tree here); sequence-parallel
+    # models only run inside shard_map, so eval uses the plain
+    # single-device model with the same (replicated) params.
+    cache = cache if cache is not None else {}
+    eval_model = model
+    if args.engine == "graph":
+        variables = {"params": trainer.state["params"], "state": {}}
+    elif mode == "pp":
+        from nezha_tpu.parallel import pipeline as pp_mod
+        variables = {"params": pp_mod.merge_pipeline_params(
+            pspec, trainer.state["pparams"]), "state": {}}
+    else:
+        variables = trainer.state["variables"]
+        if mode == "sp":
+            if "sp_model" not in cache:
+                cache["sp_model"] = cfg.build_model()
+            eval_model = cache["sp_model"]
+    import contextlib
+
+    # gspmd/pp leave params sharded; eval traces fresh (outside the
+    # train-step jit), where attn "auto" would otherwise pick the
+    # Mosaic flash kernel XLA can't partition over tp/stage shards.
+    scope = contextlib.nullcontext()
+    if mode in ("gspmd", "pp"):
+        from nezha_tpu.parallel.gspmd import auto_partitioner_scope
+        scope = auto_partitioner_scope()
+    if "step" not in cache:
+        cache["step"] = make_eval_step(eval_model, stat_fn)
+    try:
+        with scope:
+            return evaluate(eval_model, variables, eval_iter,
+                            stat_fn=stat_fn, max_batches=args.eval_batches,
+                            step=cache["step"])
+    finally:
+        if eval_close is not None:
+            eval_close()
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -994,6 +1047,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "Graph IR -> StableHLO -> Executor path")
     p.add_argument("--eval", action="store_true",
                    help="run the config's eval split after training")
+    p.add_argument("--eval-every", type=int, default=None,
+                   help="also run the eval split every N training steps "
+                        "(results logged to the metrics stream; implies a "
+                        "final --eval pass)")
     p.add_argument("--eval-batches", type=int, default=None,
                    help="cap eval to N batches")
     return p

@@ -64,13 +64,18 @@ def make_eval_step(model: Module, stat_fn: Callable):
 
 def evaluate(model: Module, variables: dict, batches: Iterator[dict],
              stat_fn: Callable = accuracy,
-             max_batches: Optional[int] = None) -> Dict[str, float]:
+             max_batches: Optional[int] = None,
+             step: Optional[Callable] = None) -> Dict[str, float]:
     """Run the model over ``batches`` and reduce the accumulated stats.
 
     Returns the raw sums plus derived metrics: ``accuracy`` when the
     stat_fn produced correct/count, ``perplexity`` for nll_sum/count.
+    ``step``: a prebuilt ``make_eval_step`` — pass it when evaluating
+    repeatedly (periodic eval) so jit's cache is hit instead of retracing
+    a fresh closure every pass.
     """
-    step = make_eval_step(model, stat_fn)
+    if step is None:
+        step = make_eval_step(model, stat_fn)
     acc = None
     n = 0
     for batch in batches:

@@ -584,3 +584,22 @@ def test_cli_lr_rejects_nonpositive():
     with pytest.raises(SystemExit, match="lr must be"):
         _run(["--config", "mlp_mnist", "--steps", "1", "--batch-size", "8",
               "--optimizer", "sgd", "--lr", "nan"])
+
+
+def test_cli_eval_every(devices8, tmp_path):
+    """--eval-every N interleaves full eval passes with training: the
+    metrics stream carries eval_* entries at each boundary plus the final
+    pass, and eval accuracy reflects the current (training) params."""
+    import pytest
+    mf = tmp_path / "m.jsonl"
+    m = _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--parallel", "single", "--steps", "4", "--batch-size", "8",
+              "--eval-every", "2", "--eval-batches", "2",
+              "--log-every", "4", "--metrics-file", str(mf)])
+    assert any(k.startswith("eval_") for k in m)  # final pass in result
+    recs = [json.loads(l) for l in mf.read_text().strip().splitlines()]
+    evals = [r for r in recs if any(k.startswith("eval_") for k in r)]
+    assert len(evals) == 1 and evals[0]["step"] == 2  # midpoint pass logged
+    with pytest.raises(SystemExit, match="eval-every must be"):
+        _run(["--config", "mlp_mnist", "--steps", "1", "--batch-size", "8",
+              "--eval-every", "0"])
