@@ -1,0 +1,28 @@
+"""Shared CLI helpers (nezha-generate / nezha-export)."""
+
+from __future__ import annotations
+
+import sys
+
+
+def restore_variables_any(ckpt_dir: str, model, optimizer):
+    """Model variables from EITHER checkpoint format a `nezha-train` run
+    may have written: dense npz (single/dp/sp) or per-shard
+    (zero1/gspmd/pp). The sgd-or-whatever template trick: restore walks
+    TEMPLATE leaves only, and every optimizer's state carries ``step`` at
+    the same path, so a minimal-optimizer template reads any checkpoint.
+    Raises SystemExit when neither format is present."""
+    import jax
+
+    from nezha_tpu.train import checkpoint as ckpt
+    from nezha_tpu.train import sharded_checkpoint as sckpt
+    from nezha_tpu.train.loop import init_train_state
+
+    template = init_train_state(model, optimizer, jax.random.PRNGKey(0))
+    restored, step = ckpt.try_restore(ckpt_dir, template)
+    if restored is None:
+        restored, step = sckpt.try_restore_sharded(ckpt_dir, template)
+    if restored is None:
+        raise SystemExit(f"no checkpoint (npz or sharded) in {ckpt_dir}")
+    print(f"restored step {step} from {ckpt_dir}", file=sys.stderr)
+    return restored["variables"]

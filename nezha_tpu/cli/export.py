@@ -46,26 +46,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def _restore_params(args, model, optimizer):
-    """Variables from either checkpoint format (dense npz preferred,
-    per-shard fallback — the zero1/gspmd CLI paths write sharded). The
-    sgd template works for any training optimizer: restore walks TEMPLATE
-    leaves only, and sgd's opt state ({"step"}) is a subset of every
-    saved optimizer's."""
-    import jax
+    from nezha_tpu.cli.common import restore_variables_any
 
-    from nezha_tpu.train import checkpoint as ckpt
-    from nezha_tpu.train import sharded_checkpoint as sckpt
-    from nezha_tpu.train.loop import init_train_state
-
-    template = init_train_state(model, optimizer, jax.random.PRNGKey(0))
-    restored, step = ckpt.try_restore(args.ckpt_dir, template)
-    if restored is None:
-        restored, step = sckpt.try_restore_sharded(args.ckpt_dir, template)
-    if restored is None:
-        raise SystemExit(f"no checkpoint (npz or sharded) in "
-                         f"{args.ckpt_dir}")
-    print(f"restored step {step} from {args.ckpt_dir}", file=sys.stderr)
-    return restored["variables"]["params"]
+    return restore_variables_any(args.ckpt_dir, model, optimizer)["params"]
 
 
 def run(args) -> dict:

@@ -110,22 +110,17 @@ def run(args) -> dict:
         else:
             from nezha_tpu.cli.train import TINY_GPT2_KW
             model = GPT2(GPT2Config(**TINY_GPT2_KW))
-        variables = model.init(jax.random.PRNGKey(args.seed))
         if args.ckpt_dir:
-            from nezha_tpu.train.checkpoint import try_restore
-
-            # nezha-train checkpoints hold the full train state; generation
-            # needs the variables leaf only (optimizer state is ignored).
+            # Either checkpoint format: dense npz OR the per-shard layout
+            # that zero1/gspmd/pp training writes. Generation needs the
+            # variables leaf only (optimizer state is ignored); no point
+            # materializing a random init just to overwrite it.
             from nezha_tpu import optim
-            from nezha_tpu.train.loop import init_train_state
-            template = init_train_state(model, optim.sgd(0.1),
-                                        jax.random.PRNGKey(0))
-            restored, step = try_restore(args.ckpt_dir, template)
-            if restored is None:
-                raise SystemExit(f"no checkpoint found in {args.ckpt_dir}")
-            variables = restored["variables"]
-            print(f"restored step {step} from {args.ckpt_dir}",
-                  file=sys.stderr)
+            from nezha_tpu.cli.common import restore_variables_any
+            variables = restore_variables_any(args.ckpt_dir, model,
+                                              optim.sgd(0.1))
+        else:
+            variables = model.init(jax.random.PRNGKey(args.seed))
 
     prompt = _prompt_ids(args)
     vocab = model.cfg.vocab_size

@@ -55,7 +55,7 @@ def test_generate_rejects_bad_inputs(tmp_path):
     with pytest.raises(SystemExit, match="exceeds max_positions"):
         _gen(["--random-init", "--model-preset", "tiny",
               "--prompt-tokens", "1,2", "--max-new-tokens", "200"])
-    with pytest.raises(SystemExit, match="no checkpoint found"):
+    with pytest.raises(SystemExit, match="no checkpoint"):
         _gen(["--ckpt-dir", str(tmp_path / "none"), "--model-preset", "tiny",
               "--prompt-tokens", "1"])
 
@@ -150,3 +150,17 @@ def test_export_rejects_missing_checkpoint(tmp_path):
         export_run(export_parser().parse_args(
             ["--config", "gpt2_124m", "--ckpt-dir", str(tmp_path / "none"),
              "--model-preset", "tiny", "--out", str(tmp_path / "x.npz")]))
+
+
+def test_generate_from_sharded_gspmd_checkpoint(tmp_path, devices8):
+    """nezha-generate restores the per-shard checkpoint format too (a
+    gspmd-trained GPT-2 decodes without an export step)."""
+    ck = str(tmp_path / "ck")
+    train_run(train_parser().parse_args(
+        ["--config", "gpt2_124m", "--model-preset", "tiny", "--steps", "2",
+         "--batch-size", "8", "--parallel", "gspmd",
+         "--mesh", "dp=2,tp=4", "--ckpt-dir", ck]))
+    out = _gen(["--ckpt-dir", ck, "--model-preset", "tiny",
+                "--prompt-tokens", "5,17,3", "--max-new-tokens", "6",
+                "--temperature", "0"])
+    assert len(out["tokens"]) == 6
