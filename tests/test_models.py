@@ -278,3 +278,18 @@ def test_gpt2_remat_decode_unaffected():
     a = generate(m0, v, prompt, max_new_tokens=6, temperature=0.0)
     b = generate(m1, v, prompt, max_new_tokens=6, temperature=0.0)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_s2d_stem_matches_conv7_under_bf16_policy():
+    """The bench/CLI full-size path runs s2d under the bf16 policy — the
+    relayout must stay equivalent at bf16 tolerances too."""
+    from nezha_tpu.tensor import bf16_policy
+    m7 = tiny_resnet(stem="conv7", policy=bf16_policy())
+    ms = tiny_resnet(stem="s2d", policy=bf16_policy())
+    v = m7.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+    y7, _ = m7.apply(v, x, training=False)
+    ys, _ = ms.apply(v, x, training=False)
+    np.testing.assert_allclose(np.asarray(y7, np.float32),
+                               np.asarray(ys, np.float32),
+                               rtol=5e-2, atol=5e-2)
