@@ -603,3 +603,20 @@ def test_cli_eval_every(devices8, tmp_path):
     with pytest.raises(SystemExit, match="eval-every must be"):
         _run(["--config", "mlp_mnist", "--steps", "1", "--batch-size", "8",
               "--eval-every", "0"])
+
+
+def test_cli_knob_composition(devices8, tmp_path):
+    """The whole knob stack composes in one run: gspmd (dp x tp) + remat +
+    dropout + global clip + grad accumulation + periodic eval + retention,
+    end to end with finite losses."""
+    m = _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--parallel", "gspmd", "--mesh", "dp=2,tp=4",
+              "--remat", "--dropout", "0.1", "--clip-norm", "1.0",
+              "--grad-accum", "2", "--eval-every", "2",
+              "--eval-batches", "2", "--steps", "4", "--batch-size", "8",
+              "--ckpt-dir", str(tmp_path), "--ckpt-every", "2",
+              "--ckpt-keep", "1", "--log-every", "2"])
+    assert np.isfinite(m["loss"])
+    assert any(k.startswith("eval_") for k in m)
+    kept = list(tmp_path.glob("step_*.sharded"))
+    assert len(kept) == 1  # retention pruned to the newest
