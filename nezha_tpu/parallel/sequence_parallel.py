@@ -29,9 +29,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from nezha_tpu.ops.attention import causal_mask, dot_product_attention
 
 
-def ulysses_attention(q, k, v, axis_name: str, causal: bool = True):
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
+                      use_flash: Optional[bool] = None):
     """q,k,v local: [B, H, S_local, D] sequence-sharded. Must run inside
-    shard_map. Requires H % world == 0."""
+    shard_map. Requires H % world == 0.
+
+    ``use_flash=None`` auto-selects: the Pallas flash kernel on TPU backends,
+    composed XLA attention elsewhere. Pass ``use_flash=True`` on CPU to force
+    the flash path (the kernel runs in interpret mode there) — this is how CI
+    executes the TPU branch's plumbing without a chip.
+    """
     world = lax.axis_size(axis_name)
     b, h, s_local, d = q.shape
     if h % world:
@@ -48,7 +55,9 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True):
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)  # [B,H/w,S,D]
     s_global = qh.shape[2]
-    if jax.default_backend() == "tpu":
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu"
+    if use_flash:
         # Full-sequence attention per rank is exactly the flash kernel's
         # shape (shard_map hands it per-device blocks, so Mosaic is fine
         # here, unlike under the GSPMD auto-partitioner); at the long
