@@ -128,6 +128,58 @@ class ImageRecordLoader(_Closable):
             yield {"image": images, "label": labels}
 
 
+class ImageRecordWriter:
+    """Streaming NZR1 writer: append one decoded image at a time, so packing
+    a dataset never holds more than one image in memory (the prep-side
+    counterpart of :class:`ImageRecordLoader`; `nezha-pack-images` uses it).
+
+    The record count is backpatched into the header on ``close`` — a writer
+    that is never closed leaves an invalid count of 0, which the loader
+    rejects, so a crashed prep run cannot masquerade as a complete file.
+    """
+
+    def __init__(self, path: str, h: int, w: int, c: int = 3):
+        self.shape = (int(h), int(w), int(c))
+        self._n = 0
+        self._f = open(path, "wb")
+        self._f.write(b"NZR1")
+        self._f.write(np.asarray([0, *self.shape], np.int32).tobytes())
+
+    def append(self, image: np.ndarray, label: int) -> None:
+        image = np.ascontiguousarray(image, np.uint8)
+        if image.shape != self.shape:
+            raise ValueError(f"image shape {image.shape} != record shape "
+                             f"{self.shape}")
+        self._f.write(np.int32(label).tobytes())
+        self._f.write(image.tobytes())
+        self._n += 1
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.seek(4)
+            self._f.write(np.int32(self._n).tobytes())
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            # Unwinding an exception: close WITHOUT backpatching, leaving
+            # the header count at 0 — which the loader rejects — so the
+            # crashed pack cannot masquerade as a complete file.
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+        else:
+            self.close()
+
+
 def write_image_records(path: str, images: np.ndarray,
                         labels: np.ndarray) -> None:
     """Write an NZR1 record file: ``images`` uint8 [N,H,W,C] (pre-decoded,
@@ -138,12 +190,9 @@ def write_image_records(path: str, images: np.ndarray,
     if images.ndim != 4 or labels.shape[0] != images.shape[0]:
         raise ValueError("images must be [N,H,W,C] with matching labels")
     n, h, w, c = images.shape
-    with open(path, "wb") as f:
-        f.write(b"NZR1")
-        f.write(np.asarray([n, h, w, c], np.int32).tobytes())
+    with ImageRecordWriter(path, h, w, c) as wr:
         for i in range(n):
-            f.write(labels[i].tobytes())
-            f.write(images[i].tobytes())
+            wr.append(images[i], int(labels[i]))
 
 
 class TokenLoader(_Closable):
