@@ -395,6 +395,25 @@ def run(args) -> Dict[str, float]:
                              "for the warmup+cosine schedule)")
         if not args.lr > 0:  # also catches NaN
             raise SystemExit(f"--lr must be > 0, got {args.lr}")
+    if args.on_failure == "rejoin":
+        # All argv-level: reject before the rendezvous can strand peers.
+        if not args.rejoin_timeout > 0:  # also catches NaN
+            raise SystemExit(f"--rejoin-timeout must be > 0, got "
+                             f"{args.rejoin_timeout}")
+        if not args.coordinator:
+            raise SystemExit("--on-failure rejoin needs --coordinator "
+                             "(failure detection is the coordinator's "
+                             "heartbeat)")
+        if not args.ckpt_dir:
+            raise SystemExit("--on-failure rejoin needs --ckpt-dir: "
+                             "recovery reloads the rescue checkpoint")
+        if not args.no_jax_distributed:
+            raise SystemExit("--on-failure rejoin requires "
+                             "--no-jax-distributed: XLA's distributed "
+                             "runtime cannot absorb a restarted process "
+                             "mid-run — with jax.distributed, use "
+                             "--on-failure stop and a supervisor relaunch "
+                             "(training resumes from --ckpt-dir)")
     group, coord = _join_world(args)
 
     import jax
@@ -825,6 +844,17 @@ def run(args) -> Dict[str, float]:
         from nezha_tpu.utils import Tracer
         tracer = Tracer(args.profile_dir, start_step=start, num_steps=count)
 
+    if args.on_failure == "rejoin" and (mode not in ("single", "dp", "sp")
+                                        or args.engine == "graph"):
+        # The recovery reload goes through Trainer.initialize's plain-npz
+        # restore, which pairs with the replicated-state module-engine
+        # modes; sharded-state modes (zero1/gspmd/pp) and the graph
+        # engine's own state layouts recover via supervisor restart.
+        raise SystemExit(f"--on-failure rejoin supports the "
+                         f"replicated-state module-engine modes "
+                         f"(single/dp/sp); got mode {mode!r}, engine "
+                         f"{args.engine!r} — use --on-failure stop with a "
+                         f"supervisor relaunch")
     trainer = Trainer(
         model, optimizer, cfg.loss_fn,
         checkpoint_dir=args.ckpt_dir,
@@ -835,6 +865,8 @@ def run(args) -> Dict[str, float]:
         process_group=group,
         failure_check_every=args.failure_check_every if group is not None
         else 0,
+        failure_mode=args.on_failure,
+        rejoin_timeout_s=args.rejoin_timeout,
         step_fn=step_fn,
         shard_fn=shard,
         save_fn=save_fn,
@@ -1055,6 +1087,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--failure-check-every", type=int, default=10,
                    help="poll the coordinator for dead peers every N steps "
                         "(multi-process runs)")
+    p.add_argument("--on-failure", choices=["stop", "rejoin"],
+                   default="stop",
+                   help="dead-peer response: 'stop' checkpoints then raises "
+                        "(supervisor restarts the world and training "
+                        "resumes from --ckpt-dir); 'rejoin' additionally "
+                        "waits for the crashed rank to be relaunched "
+                        "(--rank-hint), reloads the rescue checkpoint, and "
+                        "continues in-process")
+    p.add_argument("--rejoin-timeout", type=float, default=300.0,
+                   help="seconds --on-failure rejoin waits for the "
+                        "replacement rank before giving up (then raises, "
+                        "checkpoint already committed)")
     p.add_argument("--log-memory", action="store_true",
                    help="add live/peak HBM bytes to every metrics line "
                         "(TPU backends; no-op where the backend exposes "

@@ -94,6 +94,9 @@ class Trainer:
                  process_group=None,
                  failure_check_every: int = 0,
                  on_failure: Optional[Callable[[list], None]] = None,
+                 failure_mode: str = "stop",
+                 rejoin_timeout_s: float = 300.0,
+                 recover_fn: Optional[Callable[[], None]] = None,
                  step_fn=None,
                  shard_fn: Optional[Callable[[dict], dict]] = None,
                  save_fn: Optional[Callable[[str, Any, int], Any]] = None,
@@ -116,6 +119,32 @@ class Trainer:
         self.process_group = process_group
         self.failure_check_every = failure_check_every
         self.on_failure = on_failure
+        # Elastic recovery (SURVEY.md §5): "stop" checkpoints then raises
+        # (supervisor restarts the world); "rejoin" additionally waits for
+        # the dead rank's replacement to re-rendezvous (the coordinator
+        # frees crashed rank slots, csrc/coordinator.cpp rejoin), reloads
+        # the rescue checkpoint, and CONTINUES in-process. recover_fn, when
+        # set, replaces the default reload (initialize) for states that
+        # need mode-specific re-layout after restore.
+        if failure_mode not in ("stop", "rejoin"):
+            raise ValueError(f"failure_mode must be stop|rejoin, got "
+                             f"{failure_mode!r}")
+        if failure_mode == "rejoin":
+            # Reject the combos whose semantics would otherwise silently
+            # degrade: recovery NEEDS a checkpoint to reload, and an
+            # on_failure callback would never fire on the heal path.
+            if not checkpoint_dir:
+                raise ValueError("failure_mode='rejoin' needs a "
+                                 "checkpoint_dir: recovery reloads the "
+                                 "rescue checkpoint")
+            if on_failure is not None:
+                raise ValueError("failure_mode='rejoin' and on_failure are "
+                                 "mutually exclusive (rejoin continues "
+                                 "in-process; the callback would never "
+                                 "fire)")
+        self.failure_mode = failure_mode
+        self.rejoin_timeout_s = rejoin_timeout_s
+        self.recover_fn = recover_fn
         # Injection points so one loop serves every parallelism mode: a
         # prebuilt sharded step (DP/ZeRO-1/GSPMD), a host-side batch-placement
         # fn, and a checkpoint writer (e.g. sharded_checkpoint.save_sharded).
@@ -149,6 +178,36 @@ class Trainer:
             from nezha_tpu.train import checkpoint as ckpt
             ckpt.save_checkpoint(self.checkpoint_dir, self.state, step,
                                  keep_last=self.checkpoint_keep)
+
+    def _rejoin_and_reload(self, failed: list) -> None:
+        """The healthy-rank half of elastic recovery: the rescue checkpoint
+        is already committed (fit saves before calling this); poll until the
+        coordinator reports no failed ranks (the replacement's HELLO clears
+        the mark), then reload the rescue checkpoint so survivor and
+        replacement resume from the same step with identical state. Raises
+        if no replacement rejoins within ``rejoin_timeout_s``."""
+        import sys
+
+        print(f"peer rank(s) {failed} failed at step {self.global_step}; "
+              f"checkpoint committed; waiting for rejoin "
+              f"(timeout {self.rejoin_timeout_s:.0f}s)", file=sys.stderr)
+        deadline = time.monotonic() + self.rejoin_timeout_s
+        while True:
+            still = self.process_group.failed_ranks()
+            if not still:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"peer rank(s) {still} failed at step "
+                    f"{self.global_step}; no replacement rejoined within "
+                    f"{self.rejoin_timeout_s:.0f}s")
+            time.sleep(0.2)
+        if self.recover_fn is not None:
+            self.recover_fn()
+        else:
+            self.initialize(resume=True)
+        print(f"world healed; resumed from step {self.global_step}",
+              file=sys.stderr)
 
     def initialize(self, resume: bool = True):
         from nezha_tpu.train import checkpoint as ckpt
@@ -191,6 +250,12 @@ class Trainer:
                         self._save(self.global_step)
                         if self._save_wait is not None:
                             self._save_wait()  # commit before raising
+                    if self.failure_mode == "rejoin":  # ckpt_dir guaranteed
+                        self._rejoin_and_reload(failed)
+                        # Rate windows must not count the heal wait.
+                        t0 = time.perf_counter()
+                        window_steps = 0
+                        continue
                     if self.on_failure is not None:
                         self.on_failure(failed)
                     else:

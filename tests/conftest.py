@@ -29,20 +29,26 @@ def devices8():
     return devs
 
 
-def run_worker_processes(argv_per_rank, timeout=300):
-    """Launch one OS process per argv list (modelling one-device hosts) and
-    return [(returncode, stdout, stderr)]. Shared harness for the
-    multi-process launch tests: repo root on PYTHONPATH (extended, never
-    replaced), the suite's forced 8-device flag scrubbed so each worker
-    sees its own single CPU device, and workers always reaped on timeout."""
-    import subprocess
-
+def worker_env():
+    """Environment for worker OS processes (one-device hosts): repo root on
+    PYTHONPATH (extended, never replaced), the suite's forced 8-device flag
+    scrubbed so each worker sees its own single CPU device."""
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     env["XLA_FLAGS"] = " ".join(
         f for f in env.get("XLA_FLAGS", "").split()
         if not f.startswith("--xla_force_host_platform_device_count"))
+    return env
+
+
+def run_worker_processes(argv_per_rank, timeout=300):
+    """Launch one OS process per argv list (modelling one-device hosts) and
+    return [(returncode, stdout, stderr)]. Shared harness for the
+    multi-process launch tests; workers always reaped on timeout."""
+    import subprocess
+
+    env = worker_env()
     procs = [subprocess.Popen(argv, stdout=subprocess.PIPE,
                               stderr=subprocess.PIPE, text=True, env=env)
              for argv in argv_per_rank]
