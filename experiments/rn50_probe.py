@@ -1,65 +1,209 @@
-"""RN50 perf probe: where does the step time go on the real chip?"""
-import os, time, json, sys
-import jax, jax.numpy as jnp, numpy as np
+"""RN50 perf probe + tuning matrix (run on the real chip).
+
+Round-3 landed two structural fixes proven equivalent by test but never
+measured on hardware (the tunnel died): the space-to-depth stem and
+compute-dtype BatchNorm. Round 4 adds the next levers from the r3 roofline
+(BENCH_NOTES.md: 51 GB/step HLO bytes-accessed — bandwidth-heavy): buffer
+donation on the train state and batch 256. This script measures them all.
+
+Default mode prints one JSON line per variant (median-of-3 windows):
+
+  baseline   conv7 stem, B=128, donated state (the r2 bench geometry)
+  s2d        space-to-depth stem (r3 fix #1; expected ~3.5 ms of the 5 ms
+             stem per the r3 utilization probe)
+  no_donate  donation off (costs a full param+opt-state copy per step if
+             XLA can't reuse; quantifies what donation buys)
+  b256       s2d + batch 256 (amortizes fixed costs; bigger MXU tiles)
+
+``--probe`` runs the r3 breakdown instead (fwd / fwd+bwd / stem-alone /
+XLA cost analysis) for roofline arithmetic.
+
+Usage: python experiments/rn50_probe.py [--steps 10] [--variants s2d ...]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-from nezha_tpu import ops, optim
-from nezha_tpu.models.resnet import resnet50
-from nezha_tpu.tensor import bf16_policy
-from nezha_tpu.train.loop import init_train_state, make_train_step
 
-B, SZ = 128, 224
-model = resnet50(policy=bf16_policy())
-opt = optim.momentum(0.1, beta=0.9, weight_decay=1e-4)
-state = init_train_state(model, opt, jax.random.PRNGKey(0))
-ce = lambda logits, b_: ops.softmax_cross_entropy_with_integer_labels(
-    logits, b_["label"]).mean()
-step = make_train_step(model, opt, ce)
-rng = np.random.RandomState(0)
-b = {"image": jnp.asarray(rng.rand(B, SZ, SZ, 3).astype(np.float32)),
-     "label": jnp.asarray(rng.randint(0, 1000, B), jnp.int32)}
+PEAK_FLOPS = 197e12  # v5e bf16
 
-def timeit(fn, *args, n=10, fetch=None):
-    out = fn(*args)
-    if fetch: fetch(out)
-    t0 = time.perf_counter()
-    for _ in range(n):
+
+IMAGE_SIZE = 224  # overridable via --image-size for CPU smoke runs
+
+
+def _build(stem: str, batch: int, donate: bool):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nezha_tpu import ops, optim
+    from nezha_tpu.models.resnet import resnet50
+    from nezha_tpu.tensor import bf16_policy
+    from nezha_tpu.train.loop import init_train_state, make_train_step
+
+    model = resnet50(stem=stem, policy=bf16_policy())
+    opt = optim.momentum(0.1, beta=0.9, weight_decay=1e-4)
+    state = init_train_state(model, opt, jax.random.PRNGKey(0))
+    ce = lambda logits, b_: ops.softmax_cross_entropy_with_integer_labels(
+        logits, b_["label"]).mean()
+    step = make_train_step(model, opt, ce, donate=donate)
+    rng = np.random.RandomState(0)
+    sz = IMAGE_SIZE
+    b = {"image": jnp.asarray(rng.rand(batch, sz, sz, 3).astype(np.float32)),
+         "label": jnp.asarray(rng.randint(0, 1000, batch), jnp.int32)}
+    return step, state, b
+
+
+def measure(variant: dict, steps: int) -> dict:
+    batch = variant.get("batch", 128)
+    step, state, b = _build(variant.get("stem", "conv7"), batch,
+                            variant.get("donate", True))
+    # ONE AOT compile serves both the timing loop and the cost analysis
+    # (a second compile per geometry would double chip time and hold a
+    # duplicate state in HBM alongside the donated one — b256 could OOM).
+    compiled = step.lower(state, b).compile()
+    flops = None
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = cost.get("flops") or None
+    except Exception:
+        pass
+    # Threading state through the loop keeps donation legal (each step
+    # consumes the previous step's output buffers).
+    state, m = compiled(state, b)
+    state, m = compiled(state, b)
+    float(m["loss"])
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = compiled(state, b)
+        float(m["loss"])
+        rates.append(steps / (time.perf_counter() - t0))
+    rates.sort()
+    return {"variant": variant["name"], "batch": batch,
+            "images_per_sec": round(batch * rates[1], 1),
+            "mfu": round(flops * rates[1] / PEAK_FLOPS, 4)
+            if flops else None,
+            "spread": round((rates[-1] - rates[0]) / rates[1], 4)}
+
+
+VARIANTS = [
+    {"name": "baseline", "stem": "conv7"},
+    {"name": "s2d", "stem": "s2d"},
+    {"name": "no_donate", "stem": "s2d", "donate": False},
+    {"name": "b256", "stem": "s2d", "batch": 256},
+]
+
+
+def probe() -> None:
+    """The r3 breakdown: where does the step go? (roofline inputs)"""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nezha_tpu import nn, ops
+    from nezha_tpu.models.resnet import resnet50
+    from nezha_tpu.tensor import bf16_policy
+
+    B = 128
+    step, state, b = _build("conv7", B, donate=False)
+    model = resnet50(policy=bf16_policy())
+    ce = lambda logits, b_: ops.softmax_cross_entropy_with_integer_labels(
+        logits, b_["label"]).mean()
+
+    def timeit(fn, *args, n=10, fetch=None):
         out = fn(*args)
-    if fetch: fetch(out)
-    return (time.perf_counter() - t0) / n, out
+        if fetch:
+            fetch(out)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(*args)
+        if fetch:
+            fetch(out)
+        return (time.perf_counter() - t0) / n, out
 
-compiled = jax.jit(step, donate_argnums=(0,)).lower(state, b).compile()
-cost = compiled.cost_analysis()
-if isinstance(cost, (list, tuple)): cost = cost[0]
-print("XLA flops/step:", cost.get("flops"), " bytes:", cost.get("bytes accessed"))
-# donation means we must rebuild state each call — time without donation instead
-step_nd = jax.jit(step).lower(state, b).compile()
-dt, out = timeit(lambda: step_nd(state, b), n=10, fetch=lambda o: float(o[1]["loss"]))
-print(f"full step: {dt*1e3:.2f} ms  -> {B/dt:.0f} img/s  MFU(XLA)={cost.get('flops',0)/dt/197e12:.3f}")
+    compiled = jax.jit(step).lower(state, b).compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0]
+    print("XLA flops/step:", cost.get("flops"),
+          " bytes:", cost.get("bytes accessed"))
+    dt, _ = timeit(lambda: compiled(state, b), n=10,
+                   fetch=lambda o: float(o[1]["loss"]))
+    print(f"full step: {dt*1e3:.2f} ms -> {B/dt:.0f} img/s "
+          f"MFU(XLA)={cost.get('flops', 0)/dt/PEAK_FLOPS:.3f}")
 
-# forward only (train mode, incl BN stats)
-fwd = jax.jit(lambda v, bb: model.apply(v, bb, training=True)[0].sum()).lower(state["variables"], b).compile()
-dt_f, _ = timeit(lambda: fwd(state["variables"], b), n=10, fetch=lambda o: float(o))
-print(f"fwd only: {dt_f*1e3:.2f} ms")
+    fwd = jax.jit(lambda v, bb: model.apply(v, bb, training=True)[0].sum()
+                  ).lower(state["variables"], b).compile()
+    dt_f, _ = timeit(lambda: fwd(state["variables"], b), n=10,
+                     fetch=float)
+    print(f"fwd only: {dt_f*1e3:.2f} ms")
 
-# fwd+bwd (no optimizer)
-def loss_fn(params, variables, bb):
-    v = dict(variables); v["params"] = params
-    logits, _ = model.apply(v, bb, training=True)
-    return ce(logits, bb)
-g = jax.jit(jax.grad(loss_fn)).lower(state["variables"]["params"], state["variables"], b).compile()
-dt_g, _ = timeit(lambda: g(state["variables"]["params"], state["variables"], b), n=10,
-                 fetch=lambda o: float(jax.tree_util.tree_leaves(o)[0].sum()))
-print(f"fwd+bwd: {dt_g*1e3:.2f} ms  (optimizer+rest: {(dt-dt_g)*1e3:.2f} ms)")
+    def loss_fn(params, variables, bb):
+        v = dict(variables)
+        v["params"] = params
+        logits, _ = model.apply(v, bb, training=True)
+        return ce(logits, bb)
 
-# stem alone (7x7s2 conv fwd+bwd) at step scale
-from nezha_tpu import nn
-stem = nn.Conv2d(3, 64, 7, stride=2, use_bias=False, policy=bf16_policy())
-sv = stem.init(jax.random.PRNGKey(1))
-def stem_loss(p, x):
-    v = dict(sv); v["params"] = p
-    y, _ = stem.apply(v, x)
-    return jnp.sum(jnp.asarray(y, jnp.float32))
-gs = jax.jit(jax.grad(stem_loss)).lower(sv["params"], b["image"]).compile()
-dt_s, _ = timeit(lambda: gs(sv["params"], b["image"]), n=20,
-                 fetch=lambda o: float(jax.tree_util.tree_leaves(o)[0].sum()))
-print(f"stem conv fwd+bwd: {dt_s*1e3:.2f} ms")
+    g = jax.jit(jax.grad(loss_fn)).lower(
+        state["variables"]["params"], state["variables"], b).compile()
+    dt_g, _ = timeit(
+        lambda: g(state["variables"]["params"], state["variables"], b),
+        n=10, fetch=lambda o: float(jax.tree_util.tree_leaves(o)[0].sum()))
+    print(f"fwd+bwd: {dt_g*1e3:.2f} ms (optimizer+rest: "
+          f"{(dt - dt_g)*1e3:.2f} ms)")
+
+    stem = nn.Conv2d(3, 64, 7, stride=2, use_bias=False,
+                     policy=bf16_policy())
+    sv = stem.init(jax.random.PRNGKey(1))
+
+    def stem_loss(p, x):
+        v = dict(sv)
+        v["params"] = p
+        y, _ = stem.apply(v, x)
+        return jnp.sum(jnp.asarray(y, jnp.float32))
+
+    gs = jax.jit(jax.grad(stem_loss)).lower(sv["params"], b["image"]
+                                            ).compile()
+    dt_s, _ = timeit(
+        lambda: gs(sv["params"], b["image"]), n=20,
+        fetch=lambda o: float(jax.tree_util.tree_leaves(o)[0].sum()))
+    print(f"stem conv fwd+bwd: {dt_s*1e3:.2f} ms")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--probe", action="store_true",
+                    help="run the step-breakdown probe instead of the "
+                         "variant matrix")
+    ap.add_argument("--variants", nargs="+", default=None,
+                    choices=[v["name"] for v in VARIANTS])
+    ap.add_argument("--image-size", type=int, default=224,
+                    help="input size (shrink for CPU smoke runs)")
+    ap.add_argument("--base-batch", type=int, default=None,
+                    help="override every variant's batch (CPU smoke)")
+    args = ap.parse_args()
+    global IMAGE_SIZE
+    IMAGE_SIZE = args.image_size
+    if args.base_batch:
+        for v in VARIANTS:
+            v["batch"] = args.base_batch
+    if args.probe:
+        probe()
+        return 0
+    for v in VARIANTS:
+        if args.variants and v["name"] not in args.variants:
+            continue
+        print(json.dumps(measure(v, args.steps)), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

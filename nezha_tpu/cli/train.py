@@ -371,10 +371,12 @@ def run(args) -> Dict[str, float]:
         # known, since ZeRO-1 needs the cross-rank norm.
         if not args.clip_norm > 0:  # also catches NaN (every compare False)
             raise SystemExit(f"--clip-norm must be > 0, got {args.clip_norm}")
-        if args.engine == "graph":
-            raise SystemExit("--clip-norm is an optimizer wrapper the "
-                             "graph engine's IR-authored update does not "
-                             "express; drop --engine graph")
+        if args.engine == "graph" and args.parallel == "dp":
+            raise SystemExit("--clip-norm with the graph engine's dp mode "
+                             "is unsupported: the clip must see the "
+                             "REDUCED gradients, but graph-dp's all_reduce "
+                             "lives inside the per-shape update graphs; "
+                             "use single-device graph or module-engine dp")
     if args.eval_every is not None and args.eval_every < 1:
         raise SystemExit(f"--eval-every must be >= 1, got {args.eval_every}")
     if args.eval_batches is not None and args.eval_batches < 1:
@@ -571,7 +573,7 @@ def run(args) -> Dict[str, float]:
                 shard = lambda b: place(onehot(b))
             else:
                 step_fn = programs.make_mlp_graph_train_step(
-                    dims, batch_size, lr=0.1)
+                    dims, batch_size, lr=0.1, clip_norm=args.clip_norm)
                 shard = programs.onehot_shard_fn(dims[-1])
         elif args.config in ("resnet50_imagenet", "wrn101_large_batch"):
             if args.eval or args.eval_every:
@@ -579,21 +581,24 @@ def run(args) -> Dict[str, float]:
                                  "batch stats only (no running BN stats); "
                                  "drop --eval/--eval-every")
             state = programs.init_graph_resnet_state(model, rng)
-            step_fn = programs.make_resnet_graph_train_step(model, lr=0.1)
+            step_fn = programs.make_resnet_graph_train_step(
+                model, lr=0.1, clip_norm=args.clip_norm)
             shard = programs.image_shard_fn()
         elif args.config == "bert_base_zero1":
             state = programs.init_graph_bert_state(model, rng)
             sched = cfg.graph_opt["schedule"](args.steps)
             step_fn = programs.make_bert_graph_train_step(
                 model, lambda t: float(sched(_np.int32(t))),
-                weight_decay=cfg.graph_opt["weight_decay"])
+                weight_decay=cfg.graph_opt["weight_decay"],
+                clip_norm=args.clip_norm)
             shard = programs.bert_shard_fn()
         else:  # gpt2_124m: the transformer authored in the IR
             state = programs.init_graph_gpt2_state(model, rng)
             sched = cfg.graph_opt["schedule"](args.steps)
             step_fn = programs.make_gpt2_graph_train_step(
                 model, lambda t: float(sched(_np.int32(t))),
-                weight_decay=cfg.graph_opt["weight_decay"])
+                weight_decay=cfg.graph_opt["weight_decay"],
+                clip_norm=args.clip_norm)
             shard = programs.lm_shard_fn()
         start_step = 0
         if args.ckpt_dir:

@@ -93,6 +93,58 @@ def momentum_update_graph(shape: Sequence[int], lr: float,
     return g
 
 
+def clip_scale_graph(shapes: Sequence[Tuple[int, ...]],
+                     clip_norm: float) -> Graph:
+    """IR graph: (*flat_grads) -> clip scale = min(1, C / (||g|| + 1e-6)).
+
+    ``optim.clip_by_global_norm``'s exact math (same eps) authored as IR
+    nodes so `--clip-norm --engine graph` stays inside the op graph. The IR
+    has no min op; min(1, r) = 1 - relu(1 - r), which is exact for ANY
+    fp32 r (the algebraically-equal r - relu(r - 1) collapses to 0 once
+    r > 2^24: r-1 rounds to r and the subtraction cancels — a huge
+    clip_norm would silently zero every gradient)."""
+    g = Graph("clip_scale")
+    total = None
+    for i, s in enumerate(shapes):
+        gr = g.placeholder(s, name=f"g{i}")
+        sq = g.sum(gr * gr)
+        total = sq if total is None else total + sq
+    norm = total ** 0.5
+    r = g.constant(np.float32(clip_norm)) / (norm + 1e-6)
+    g.output(-g.relu(-r + 1.0) + 1.0)
+    return g
+
+
+def scale_grad_graph(shape: Sequence[int]) -> Graph:
+    """IR graph: (grad, scale) -> grad * scale (scalar broadcast)."""
+    g = Graph("scale_grad")
+    gr = g.placeholder(shape, name="grad")
+    sc = g.placeholder((), name="scale")
+    g.output(gr * sc)
+    return g
+
+
+def _make_clip(ordered_shapes, clip_norm):
+    """(clip_fn, per-shape scale_fns); both None when clipping is off.
+    ``ordered_shapes`` must match the flat-gradient order the step passes
+    to clip_fn. Shared by every IR step builder so the clip math cannot
+    drift between configs."""
+    if clip_norm is None:
+        return None, None
+    ordered_shapes = [tuple(s) for s in ordered_shapes]
+    clip_fn = to_callable(clip_scale_graph(ordered_shapes, clip_norm))
+    scale_fns = {s: to_callable(scale_grad_graph(s))
+                 for s in set(ordered_shapes)}
+    return clip_fn, scale_fns
+
+
+def _apply_clip(clip_fn, scale_fns, grads):
+    if clip_fn is None:
+        return grads
+    sc = clip_fn(*grads)
+    return [scale_fns[tuple(np.shape(g_))](g_, sc) for g_ in grads]
+
+
 def dp_momentum_update_graph(shape: Sequence[int], lr: float, beta: float,
                              axis_name: str, world: int) -> Graph:
     """IR graph: (param, velocity, LOCAL grad) -> (new_param, new_velocity)
@@ -188,13 +240,15 @@ def make_mlp_graph_dp_train_step(dims: Sequence[int], global_batch: int,
 
 def make_mlp_graph_train_step(dims: Sequence[int], batch: int, lr: float,
                               beta: float = 0.9,
+                              clip_norm: float = None,
                               executor: Executor = None):
     """Trainer-compatible ``step(state, batch) -> (state, metrics)`` whose
     forward/loss/update are Graph IR programs.
 
     ``state`` = {"params": {fcN/head: {"w","b"}}, "vel": same-shaped}.
     ``batch`` = {"image": [B, in], "onehot": [B, classes]} (see
-    :func:`onehot_shard_fn`).
+    :func:`onehot_shard_fn`). ``clip_norm``: IR-authored global-norm
+    gradient clipping (:func:`clip_scale_graph`).
     """
     executor = executor or Executor()
     loss_graph = mlp_loss_graph(dims, batch)
@@ -208,12 +262,17 @@ def make_mlp_graph_train_step(dims: Sequence[int], batch: int, lr: float,
     upd_fns: Dict[Tuple[int, ...], callable] = {}
     for s in {tuple(s) for s in shapes}:
         upd_fns[s] = to_callable(momentum_update_graph(s, lr, beta))
+    # Gradient order is w0,b0,w1,b1,... (flatten order), not `shapes` order.
+    grad_shapes = [s for din, dout in zip(dims[:-1], dims[1:])
+                   for s in ((din, dout), (dout,))]
+    clip_fn, scale_fns = _make_clip(grad_shapes, clip_norm)
 
     def whole_step(*flat_and_batch):
         flat = flat_and_batch[:2 * n_params]
         params, vels = flat[:n_params], flat[n_params:]
         image, onehot = flat_and_batch[-2:]
         loss, grads = vg(*params, image, onehot)
+        grads = _apply_clip(clip_fn, scale_fns, grads)
         new_p, new_v = [], []
         for p, v, gr in zip(params, vels, grads):
             pn, vn = upd_fns[tuple(p.shape)](p, v, gr)
@@ -339,24 +398,28 @@ def init_graph_gpt2_state(model, rng) -> dict:
 
 def _make_adamw_ir_step(build_loss_graph, feed_keys: Tuple[str, ...],
                         shape_key: str, lr_schedule,
-                        weight_decay: float, executor: Executor = None):
+                        weight_decay: float, clip_norm: float = None,
+                        executor: Executor = None):
     """Shared IR-engine AdamW trainer: ``build_loss_graph(template, batch,
     seq) -> Graph`` whose placeholders are (*flat_params, *feed_keys
     tensors); state = {"params", "mu", "nu", "step"}; graphs built per
     (batch, seq) of ``b[shape_key]`` on first use. One implementation so
-    the per-model engines (GPT-2, BERT) cannot drift apart."""
+    the per-model engines (GPT-2, BERT) cannot drift apart. ``clip_norm``:
+    IR-authored global-norm clipping before the update graphs."""
     executor = executor or Executor()
     _built: Dict[Tuple[int, int], dict] = {}
 
     def build(params_template, batch, seq):
         loss_graph = build_loss_graph(params_template, batch, seq)
         loss_fn = to_callable(loss_graph)
-        n_params = len(jax.tree_util.tree_leaves(params_template))
+        leaves = jax.tree_util.tree_leaves(params_template)
+        n_params = len(leaves)
         vg = jax.value_and_grad(loss_fn, argnums=tuple(range(n_params)))
-        shapes = {tuple(np.shape(l))
-                  for l in jax.tree_util.tree_leaves(params_template)}
+        shapes = {tuple(np.shape(l)) for l in leaves}
         upd = {s: to_callable(adamw_update_graph(
             s, weight_decay=weight_decay)) for s in shapes}
+        clip_fn, scale_fns = _make_clip(
+            [np.shape(l) for l in leaves], clip_norm)
 
         def whole_step(*args):
             flat = args[:3 * n_params]
@@ -365,6 +428,7 @@ def _make_adamw_ir_step(build_loss_graph, feed_keys: Tuple[str, ...],
             t_f32, lr = args[3 * n_params:3 * n_params + 2]
             feeds = args[3 * n_params + 2:]
             loss, grads = vg(*ps, *feeds)
+            grads = _apply_clip(clip_fn, scale_fns, grads)
             new = [upd[tuple(x.shape)](x, m, v, gr, t_f32, lr)
                    for x, m, v, gr in zip(ps, ms, vs, grads)]
             new_p, new_m, new_v = zip(*new)
@@ -400,6 +464,7 @@ def _make_adamw_ir_step(build_loss_graph, feed_keys: Tuple[str, ...],
 
 
 def make_gpt2_graph_train_step(model, lr_schedule, weight_decay: float = 0.1,
+                               clip_norm: float = None,
                                executor: Executor = None):
     """Trainer-compatible step over ``init_graph_gpt2_state`` state; batches
     are {"inputs": [B,S] i32, "targets": [B,S] i32} (see
@@ -409,7 +474,7 @@ def make_gpt2_graph_train_step(model, lr_schedule, weight_decay: float = 0.1,
         lambda tmpl, batch, seq: gpt2_loss_graph(cfg, tmpl, batch, seq),
         feed_keys=("inputs", "targets"), shape_key="inputs",
         lr_schedule=lr_schedule, weight_decay=weight_decay,
-        executor=executor)
+        clip_norm=clip_norm, executor=executor)
 
 
 def lm_shard_fn():
@@ -537,6 +602,7 @@ def init_graph_bert_state(model, rng) -> dict:
 
 def make_bert_graph_train_step(model, lr_schedule,
                                weight_decay: float = 0.01,
+                               clip_norm: float = None,
                                executor: Executor = None):
     """Trainer-compatible step over ``init_graph_bert_state`` state;
     batches from :func:`bert_shard_fn`."""
@@ -546,7 +612,7 @@ def make_bert_graph_train_step(model, lr_schedule,
         feed_keys=("tokens", "segment_ids", "attn_mask", "safe_labels",
                    "label_mask"),
         shape_key="tokens", lr_schedule=lr_schedule,
-        weight_decay=weight_decay, executor=executor)
+        weight_decay=weight_decay, clip_norm=clip_norm, executor=executor)
 
 
 # ---------------------------------------------------------------------------
@@ -620,6 +686,7 @@ def init_graph_resnet_state(model, rng) -> dict:
 
 
 def make_resnet_graph_train_step(model, lr: float, beta: float = 0.9,
+                                 clip_norm: float = None,
                                  executor: Executor = None):
     """Trainer-compatible step over ``init_graph_resnet_state`` state;
     batches are {"image": [B,H,W,3] f32, "labels": [B] i32} (see
@@ -631,18 +698,21 @@ def make_resnet_graph_train_step(model, lr: float, beta: float = 0.9,
         loss_graph = resnet_loss_graph(model.stage_sizes, params_template,
                                        batch, size)
         loss_fn = to_callable(loss_graph)
-        n_params = len(jax.tree_util.tree_leaves(params_template))
+        leaves = jax.tree_util.tree_leaves(params_template)
+        n_params = len(leaves)
         vg = jax.value_and_grad(loss_fn, argnums=tuple(range(n_params)))
-        shapes = {tuple(np.shape(l))
-                  for l in jax.tree_util.tree_leaves(params_template)}
+        shapes = {tuple(np.shape(l)) for l in leaves}
         upd = {s: to_callable(momentum_update_graph(s, lr, beta))
                for s in shapes}
+        clip_fn, scale_fns = _make_clip(
+            [np.shape(l) for l in leaves], clip_norm)
 
         def whole_step(*args):
             flat = args[:2 * n_params]
             ps, vs = flat[:n_params], flat[n_params:]
             image, labels = args[2 * n_params:]
             loss, grads = vg(*ps, image, labels)
+            grads = _apply_clip(clip_fn, scale_fns, grads)
             new = [upd[tuple(x.shape)](x, v, gr)
                    for x, v, gr in zip(ps, vs, grads)]
             new_p, new_v = zip(*new)

@@ -15,9 +15,21 @@ if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Persistent XLA compilation cache: the suite is compile-bound on the
+# 1-core build box (~40 CLI tests each jitting multi-second programs), and
+# identical programs recur both across runs and across the worker processes
+# the multi-process tests spawn. Same-machine reuse only (the cache is
+# host-feature-specific); override the location with JAX_COMPILATION_CACHE_DIR.
+_jax_cache = os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                 ".jax_cache"))
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", _jax_cache)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pytest  # noqa: E402
 
@@ -32,7 +44,9 @@ def devices8():
 def worker_env():
     """Environment for worker OS processes (one-device hosts): repo root on
     PYTHONPATH (extended, never replaced), the suite's forced 8-device flag
-    scrubbed so each worker sees its own single CPU device."""
+    scrubbed so each worker sees its own single CPU device. Workers inherit
+    JAX_COMPILATION_CACHE_DIR (set above), so repeated launches of the same
+    tiny-preset programs deserialize instead of recompiling."""
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")

@@ -678,9 +678,17 @@ def test_cli_clip_norm(devices8):
     with pytest.raises(SystemExit, match="clip-norm must be"):
         _run(["--config", "mlp_mnist", "--steps", "1", "--batch-size", "8",
               "--clip-norm", "-1"])
-    with pytest.raises(SystemExit, match="graph engine"):
+    # The graph engine authors the clip in the IR (clip_scale_graph): a
+    # near-zero clip freezes it exactly like the module engine.
+    gclip = _final_losses("mlp_mnist", 8, 64,
+                          ["--engine", "graph", "--clip-norm", "1e-9"])
+    gplain = _final_losses("mlp_mnist", 8, 64, ["--engine", "graph"])
+    assert gplain[0] - gplain[-1] > 5 * abs(gclip[0] - gclip[-1]), \
+        (gplain, gclip)
+    # Graph-dp cannot clip (the all_reduce lives inside the update graphs).
+    with pytest.raises(SystemExit, match="REDUCED gradients"):
         _run(["--config", "mlp_mnist", "--steps", "1", "--batch-size", "8",
-              "--engine", "graph", "--clip-norm", "1.0"])
+              "--engine", "graph", "--parallel", "dp", "--clip-norm", "1.0"])
 
 
 def test_cli_ckpt_keep_rejects_nonpositive():

@@ -158,6 +158,68 @@ def test_graph_dp_step_matches_single_graph(devices8):
     assert "all_reduce" in hlo  # the IR collective survives lowering
 
 
+def test_graph_clip_matches_module():
+    """The IR-authored global-norm clip (clip_scale_graph: min(1, C/(n+eps))
+    via relu) tracks the module engine's with_grad_clipping step-for-step
+    at a clip tight enough to actively bind."""
+    from nezha_tpu import ops, optim
+    from nezha_tpu.models.mlp import MLP
+    from nezha_tpu.train.loop import init_train_state, make_train_step
+
+    dims, batch, clip = [16, 32, 10], 16, 0.05
+    model = MLP(dims[0], (dims[1],), dims[2])
+    opt = optim.with_grad_clipping(optim.momentum(0.1, beta=0.9), clip)
+    mstate = init_train_state(model, opt, jax.random.PRNGKey(0))
+    mstep = make_train_step(
+        model, opt,
+        lambda logits, b: ops.softmax_cross_entropy_with_integer_labels(
+            logits, b["label"]).mean(),
+        donate=False)
+
+    params0 = jax.tree_util.tree_map(
+        jnp.copy, mstate["variables"]["params"])
+    zeros = lambda: jax.tree_util.tree_map(np.zeros_like, params0)
+    gstate = {"params": params0, "vel": zeros()}
+    pstate = {"params": jax.tree_util.tree_map(jnp.copy, params0),
+              "vel": zeros()}
+    gstep = programs.make_mlp_graph_train_step(dims, batch, lr=0.1,
+                                               clip_norm=clip)
+    plain = programs.make_mlp_graph_train_step(dims, batch, lr=0.1)
+
+    rng = np.random.RandomState(3)
+    shard = programs.onehot_shard_fn(dims[-1])
+    for _ in range(3):
+        img = rng.rand(batch, dims[0]).astype(np.float32)
+        labels = rng.randint(0, dims[-1], batch)
+        mstate, _ = mstep(mstate, {"image": img, "label": labels})
+        b = shard({"image": img, "label": labels})
+        gstate, _ = gstep(gstate, b)
+        pstate, _ = plain(pstate, b)
+
+    for (ka, a), (_, b_) in zip(
+            jax.tree_util.tree_leaves_with_path(
+                mstate["variables"]["params"]),
+            jax.tree_util.tree_leaves_with_path(gstate["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=jax.tree_util.keystr(ka))
+    # The clip actually bound (else the parity above is vacuous).
+    diffs = [float(np.abs(np.asarray(a) - np.asarray(b_)).max())
+             for (_, a), (_, b_) in zip(
+                 jax.tree_util.tree_leaves_with_path(gstate["params"]),
+                 jax.tree_util.tree_leaves_with_path(pstate["params"]))]
+    assert max(diffs) > 1e-4, "clip never engaged; parity proves nothing"
+
+    # Regression (r4 review): a huge clip_norm must be a no-op (scale
+    # exactly 1.0) — the naive min(1,r) = r - relu(r-1) form collapses to
+    # 0 in fp32 once r > 2^24, silently zeroing every gradient.
+    g5 = np.full(4, 5.0, np.float32)  # norm 10
+    fn = to_callable(programs.clip_scale_graph([(4,)], 1e9))
+    assert float(fn(g5)) == 1.0
+    fn_tight = to_callable(programs.clip_scale_graph([(4,)], 0.1))
+    np.testing.assert_allclose(float(fn_tight(g5)), 0.01, rtol=1e-4)
+
+
 def test_graph_dp_rejects_ragged_batch(devices8):
     from nezha_tpu import parallel
     import pytest
