@@ -252,6 +252,22 @@ def bench_wrn101(on_tpu: bool, peak):
     return images_per_sec, mfu, spread
 
 
+def bench_mlp(on_tpu: bool):
+    """Config 1 through the REAL CLI entry (the reference's CPU-path
+    benchmark config): examples/sec from the trainer's own metrics.
+
+    Two logging windows; the returned metrics are the LAST one, whose t0
+    resets after the first window — so the reported rate excludes the
+    first-step compile (the Trainer's window timer starts before step 1)."""
+    from nezha_tpu.cli.train import build_parser, run
+
+    steps = 300 if on_tpu else 20
+    metrics = run(build_parser().parse_args(
+        ["--config", "mlp_mnist", "--steps", str(steps),
+         "--batch-size", "256", "--log-every", str(steps // 2)]))
+    return metrics.get("examples_per_sec", 0.0)
+
+
 def main() -> int:
     import jax
 
@@ -263,6 +279,7 @@ def main() -> int:
     images_per_sec, rn50_mfu, rn50_spread = bench_resnet50(on_tpu, peak)
     bert_tps, bert_mfu, _ = bench_bert(on_tpu, peak)
     wrn_ips, wrn_mfu, _ = bench_wrn101(on_tpu, peak)
+    mlp_eps = bench_mlp(on_tpu)
 
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "bench_baseline.json")
@@ -307,6 +324,7 @@ def main() -> int:
         "resnet50_spread": round(rn50_spread, 4),
         "bert_base_tokens_per_sec_per_chip": round(bert_tps, 2),
         "wrn101_images_per_sec_per_chip": round(wrn_ips, 2),
+        "mlp_examples_per_sec": round(mlp_eps, 2),
     }
     if isinstance(rn50_base, (int, float)) and rn50_base > 0:
         extras["resnet50_vs_baseline"] = round(images_per_sec / rn50_base, 4)

@@ -99,10 +99,12 @@ def clip_scale_graph(shapes: Sequence[Tuple[int, ...]],
 
     ``optim.clip_by_global_norm``'s exact math (same eps) authored as IR
     nodes so `--clip-norm --engine graph` stays inside the op graph. The IR
-    has no min op; min(1, r) = 1 - relu(1 - r), which is exact for ANY
-    fp32 r (the algebraically-equal r - relu(r - 1) collapses to 0 once
-    r > 2^24: r-1 rounds to r and the subtraction cancels — a huge
-    clip_norm would silently zero every gradient)."""
+    has no min op; min(1, r) = 1 - relu(1 - r), exact for every r down to
+    ~2^-24 and for all r >= 1 — crucially including huge clip_norms, where
+    the algebraically-equal r - relu(r - 1) collapses to 0 (r-1 rounds to
+    r once r > 2^24, so the subtraction cancels and every gradient would
+    silently zero). Below r ~ 2^-24 this form underflows to exactly 0
+    where jnp.minimum keeps ~1e-8 — both freeze training identically."""
     g = Graph("clip_scale")
     total = None
     for i, s in enumerate(shapes):
