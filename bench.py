@@ -271,6 +271,11 @@ def bench_mlp(on_tpu: bool):
 def main() -> int:
     import jax
 
+    # Persistent compile cache (same-machine): repeat bench sessions reuse
+    # executables instead of paying the 20-40 s first-compile per config.
+    from nezha_tpu.utils import enable_persistent_compile_cache
+    enable_persistent_compile_cache()
+
     platform = jax.devices()[0].platform
     on_tpu = platform in ("tpu", "axon")
     peak = _peak_flops(platform)

@@ -4,11 +4,13 @@ sized to what a training framework needs on TPU: XLA-aware profiling via
 jax.profiler, JSONL metrics with async-dispatch-aware step timing, and a
 rank-tagged logger)."""
 
+from nezha_tpu.utils.compile_cache import enable_persistent_compile_cache
 from nezha_tpu.utils.logging import get_logger, set_rank
 from nezha_tpu.utils.metrics import MetricsLogger, StepTimer
 from nezha_tpu.utils.profiling import Tracer, annotate, profile_trace
 
 __all__ = [
+    "enable_persistent_compile_cache",
     "get_logger",
     "set_rank",
     "MetricsLogger",

@@ -18,9 +18,16 @@ if "--xla_force_host_platform_device_count" not in _flags:
 # Persistent XLA compilation cache: the suite is compile-bound on the
 # 1-core build box (~40 CLI tests each jitting multi-second programs), and
 # identical programs recur both across runs and across the worker processes
-# the multi-process tests spawn. Same-machine reuse only (the cache is
-# host-feature-specific); override the location with JAX_COMPILATION_CACHE_DIR.
-_jax_cache = os.environ.setdefault(
+# the multi-process tests spawn (workers inherit the env var set here).
+import sys as _sys
+
+_sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 ".."))
+from nezha_tpu.utils.compile_cache import (  # noqa: E402
+    enable_persistent_compile_cache,
+)
+
+os.environ.setdefault(
     "JAX_COMPILATION_CACHE_DIR",
     os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
                  ".jax_cache"))
@@ -28,8 +35,7 @@ _jax_cache = os.environ.setdefault(
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_compilation_cache_dir", _jax_cache)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+enable_persistent_compile_cache()
 
 import pytest  # noqa: E402
 
