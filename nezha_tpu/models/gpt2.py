@@ -38,6 +38,10 @@ class GPT2Config:
     # S x S score buffers, so B=32 trains where the xla path OOMs.
     attn_impl: str = "auto"  # "xla" | "flash" | "auto" | "ring" | "ulysses"
     sp_axis: str = "sp"
+    # ring/ulysses flash policy: None = auto (flash kernels on TPU,
+    # composed elsewhere); True/False force it — the escape hatch back to
+    # the composed sp paths on hardware without editing source.
+    sp_use_flash: "bool | None" = None
     # Fused LM head: apply() returns {"hidden", "wte"} instead of logits and
     # `lm_loss` computes the CE without materializing fp32 [B,S,V] (1.6 GB
     # at B=8 S=1024). 0 = off (logits API, decode/HF paths). -1 = dense
@@ -128,10 +132,12 @@ class Attention(Module):
                     and not under_auto_partitioner() else "xla")
         if impl == "ring":
             from nezha_tpu.parallel.ring import ring_attention
-            out = ring_attention(q, k, v, cfg.sp_axis, causal=True)
+            out = ring_attention(q, k, v, cfg.sp_axis, causal=True,
+                                 use_flash=cfg.sp_use_flash)
         elif impl == "ulysses":
             from nezha_tpu.parallel.sequence_parallel import ulysses_attention
-            out = ulysses_attention(q, k, v, cfg.sp_axis, causal=True)
+            out = ulysses_attention(q, k, v, cfg.sp_axis, causal=True,
+                                    use_flash=cfg.sp_use_flash)
         elif impl == "flash":
             from nezha_tpu.ops.pallas import flash_attention
             out = flash_attention(q, k, v, causal=True)

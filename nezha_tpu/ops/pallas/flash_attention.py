@@ -335,6 +335,32 @@ def _flash_bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k,
     return dq, dk, dv
 
 
+# ---------------------------------------------------- ring building blocks
+# Raw (no-VJP) entry points for ring attention (parallel/ring.py), which
+# authors its OWN custom VJP over the whole ring: the forward needs each
+# hop's (out, lse) pair to merge blocks log-sum-exp-stably, and the
+# backward re-runs the per-block kernels with the GLOBAL row lse (which
+# makes the recomputed p the true global softmax probability — the
+# standard multi-block flash backward).
+
+
+def flash_block_fwd(q, k, v, causal: bool, scale: Optional[float] = None,
+                    interpret: Optional[bool] = None):
+    """One block pair, no autodiff: -> (out [B,H,S,D], lse [B,H,S] fp32)."""
+    out, lse = _flash_call(q, k, v, causal, scale, None, None, interpret,
+                           return_lse=True)
+    return out, lse[..., 0]
+
+
+def flash_block_bwd(q, k, v, o, lse, do, causal: bool,
+                    scale: Optional[float] = None,
+                    interpret: Optional[bool] = None):
+    """Gradients for one block pair given the GLOBAL row lse [B,H,S] and
+    the GLOBAL output o (delta = rowsum(dO*O)): -> (dq, dk, dv)."""
+    return _flash_bwd_call(q, k, v, o, lse, do, causal, scale, None, None,
+                           interpret)
+
+
 # ------------------------------------------------------------- public API
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal: bool = True,

@@ -25,11 +25,24 @@ _NEG_BIG = -1e30  # finite "-inf" so fully-masked rows stay NaN-free
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = True,
-                   scale: float | None = None):
+                   scale: float | None = None,
+                   use_flash: bool | None = None):
     """q,k,v: local blocks [B, H, S_local, D]; sequence sharded over
     ``axis_name``. Returns the local output block [B, H, S_local, D].
     Must be called inside shard_map with ``axis_name`` a mesh axis.
+
+    ``use_flash=None`` auto-selects: per-hop Pallas flash blocks on TPU
+    (O(block²) scratch instead of the composed path's O(S_local²) scores —
+    the long-context enabler), composed XLA attention elsewhere. Pass
+    ``use_flash=True`` on CPU to run the flash path in interpret mode
+    (how CI executes it).
     """
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu"
+    if use_flash:
+        # scale=None passes through: the kernel layer owns the 1/sqrt(d)
+        # default (flash_attention._flash_call), one place only.
+        return _ring_flash(q, k, v, axis_name, causal, scale)
     world = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, h, s_local, d = q.shape
@@ -93,15 +106,131 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
     return out.astype(q.dtype)
 
 
-def ring_self_attention(mesh, q, k, v, axis: str = "sp", causal: bool = True):
+# ---------------------------------------------------------------------------
+# Flash-ring: per-hop Pallas flash blocks under a ring-level custom VJP.
+#
+# Forward: each hop runs the flash kernel on (Q_local, K_src, V_src) —
+# causal=True on the diagonal hop (src == idx), causal=False on
+# fully-visible past hops, skipped on future hops — and merges the per-hop
+# (out, lse) pairs log-sum-exp-stably. Backward is the classic ring
+# backward: circulate K/V around the ring AGAIN together with dK/dV
+# accumulators; each hop's flash_block_bwd uses the GLOBAL row lse (so the
+# recomputed p is the true global softmax probability) and after `world`
+# rotations every dK/dV block is back home. HBM per hop is the kernel's
+# O(block_q x block_k) scratch, never S_local x S_local scores.
+
+
+def _hop_case(idx, src, causal):
+    """0 = skip (future), 1 = diagonal (flash causal), 2 = full (past)."""
+    if not causal:
+        return jnp.int32(2)
+    return jnp.where(src > idx, 0, jnp.where(src == idx, 1, 2))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash(q, k, v, axis_name: str, causal: bool,
+                scale: float | None):
+    out, _ = _ring_flash_fwd(q, k, v, axis_name, causal, scale)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale):
+    from nezha_tpu.ops.pallas.flash_attention import flash_block_fwd
+
+    world = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    perm = [(i, (i + 1) % world) for i in range(world)]
+
+    def hop(i, carry):
+        o, lse, k_cur, v_cur = carry
+        src = (idx - i) % world
+
+        def skip(_):
+            return o, lse
+
+        def attend(diag_causal):
+            def fn(_):
+                o_i, lse_i = flash_block_fwd(q, k_cur, v_cur,
+                                             causal=diag_causal, scale=scale)
+                new = jnp.logaddexp(lse, lse_i)
+                w_old = jnp.exp(lse - new)[..., None]
+                w_new = jnp.exp(lse_i - new)[..., None]
+                return o * w_old + o_i.astype(jnp.float32) * w_new, new
+            return fn
+
+        o, lse = lax.switch(_hop_case(idx, src, causal),
+                            [skip, attend(True), attend(False)], None)
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        return o, lse, k_cur, v_cur
+
+    o0 = jnp.zeros((b, h, s_local, d), jnp.float32)
+    lse0 = jnp.full((b, h, s_local), _NEG_BIG, jnp.float32)
+    o, lse, _, _ = lax.fori_loop(0, world, hop, (o0, lse0, k, v))
+    out = o.astype(q.dtype)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, scale, residuals, g):
+    from nezha_tpu.ops.pallas.flash_attention import flash_block_bwd
+
+    q, k, v, out, lse = residuals
+    world = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % world) for i in range(world)]
+    g = g.astype(out.dtype)
+
+    def hop(i, carry):
+        dq, k_cur, v_cur, dk_cur, dv_cur = carry
+        src = (idx - i) % world
+
+        def skip(_):
+            return dq, dk_cur, dv_cur
+
+        def attend(diag_causal):
+            def fn(_):
+                dqi, dki, dvi = flash_block_bwd(q, k_cur, v_cur, out, lse, g,
+                                                causal=diag_causal,
+                                                scale=scale)
+                return (dq + dqi.astype(jnp.float32),
+                        dk_cur + dki.astype(jnp.float32),
+                        dv_cur + dvi.astype(jnp.float32))
+            return fn
+
+        dq, dk_cur, dv_cur = lax.switch(_hop_case(idx, src, causal),
+                                        [skip, attend(True), attend(False)],
+                                        None)
+        # dK/dV travel WITH their K/V block; after `world` rotations each
+        # accumulated gradient block is back at its owner.
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        dk_cur = lax.ppermute(dk_cur, axis_name, perm)
+        dv_cur = lax.ppermute(dv_cur, axis_name, perm)
+        return dq, k_cur, v_cur, dk_cur, dv_cur
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dkv0 = jnp.zeros(k.shape, jnp.float32)
+    dq, _, _, dk, dv = lax.fori_loop(
+        0, world, hop, (dq0, k, v, dkv0, jnp.zeros(v.shape, jnp.float32)))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_self_attention(mesh, q, k, v, axis: str = "sp", causal: bool = True,
+                        use_flash: bool | None = None):
     """Convenience wrapper: shard [B,H,S,D] tensors over ``axis`` on the
-    sequence dim and run ring attention, returning the full output."""
+    sequence dim and run ring attention, returning the full output.
+    ``use_flash`` passes through to :func:`ring_attention` (None = auto)."""
     from jax.sharding import PartitionSpec as P
 
     from nezha_tpu.parallel._compat import shard_map
 
     fn = shard_map(
-        partial(ring_attention, axis_name=axis, causal=causal),
+        partial(ring_attention, axis_name=axis, causal=causal,
+                use_flash=use_flash),
         mesh=mesh,
         in_specs=(P(None, None, axis, None),) * 3,
         out_specs=P(None, None, axis, None),

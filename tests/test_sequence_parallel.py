@@ -68,6 +68,18 @@ def test_ulysses_attention_grad_matches_full(devices8):
         causal=True, h=8)
 
 
+def test_ring_flash_grad_matches_full(devices8):
+    """Flash-ring attention (per-hop Pallas flash blocks + ring-level
+    custom VJP, parallel/ring.py:_ring_flash) vs dense reference —
+    forward AND gradients, causal and full. ``use_flash=True`` forces the
+    TPU path; the kernels run in interpret mode on CPU."""
+    for causal in (True, False):
+        _grad_parity(
+            partial(ring_attention, axis_name="sp", causal=causal,
+                    use_flash=True),
+            causal=causal, seed=1)
+
+
 def test_ulysses_flash_branch_grad_matches_full(devices8):
     """Execute the TPU flash-kernel branch of ulysses_attention (VERDICT r3
     weak #4): ``use_flash=True`` forces the Pallas path, which runs in
@@ -79,12 +91,13 @@ def test_ulysses_flash_branch_grad_matches_full(devices8):
         causal=True, h=8)
 
 
-def _tiny_gpt2(attn_impl="xla"):
+def _tiny_gpt2(attn_impl="xla", sp_use_flash=None):
     return GPT2(GPT2Config(vocab_size=128, max_positions=64, num_layers=2,
-                           num_heads=4, hidden_size=32, attn_impl=attn_impl))
+                           num_heads=4, hidden_size=32, attn_impl=attn_impl,
+                           sp_use_flash=sp_use_flash))
 
 
-def _sp_vs_single(attn_impl, mesh_axes):
+def _sp_vs_single(attn_impl, mesh_axes, sp_use_flash=None):
     """Run 3 identical steps single-device and sequence-parallel; params and
     losses must match."""
     mesh = parallel.make_mesh(mesh_axes)
@@ -96,7 +109,7 @@ def _sp_vs_single(attn_impl, mesh_axes):
     from nezha_tpu.models.gpt2 import lm_loss
     ref_step = make_train_step(ref_model, opt, lm_loss, donate=False)
 
-    sp_model = _tiny_gpt2(attn_impl)
+    sp_model = _tiny_gpt2(attn_impl, sp_use_flash=sp_use_flash)
     sp_state = parallel.replicate(
         mesh, jax.tree_util.tree_map(jnp.copy, ref_state))
     sp_step = make_sp_train_step(sp_model, opt, mesh, donate=False)
@@ -127,6 +140,13 @@ def test_sp_train_step_ring_matches_single(devices8):
 
 def test_sp_train_step_ulysses_matches_single(devices8):
     _sp_vs_single("ulysses", {"dp": 2, "sp": 4})
+
+
+def test_sp_train_step_ring_flash_matches_single(devices8):
+    """The FULL dp x sp training step with flash-ring attention (the TPU
+    default, forced on via cfg.sp_use_flash so CI executes it in interpret
+    mode) tracks single-device training step-for-step."""
+    _sp_vs_single("ring", {"dp": 2, "sp": 4}, sp_use_flash=True)
 
 
 def test_shard_lm_batch_rejects_ragged(devices8):
