@@ -1,8 +1,21 @@
-"""Shared CLI helpers (nezha-generate / nezha-export)."""
+"""Shared CLI helpers (nezha-train / nezha-generate / nezha-export)."""
 
 from __future__ import annotations
 
 import sys
+
+
+def setup_jax(args) -> None:
+    """The common jax preamble for every CLI entry: optional platform
+    override (must precede backend init), then the same-machine persistent
+    compile cache (re-runs of a config skip the 20-40 s TPU first
+    compile). One place so the entries cannot drift."""
+    import jax
+
+    if getattr(args, "platform", None):
+        jax.config.update("jax_platforms", args.platform)
+    from nezha_tpu.utils import enable_persistent_compile_cache
+    enable_persistent_compile_cache()
 
 
 def restore_variables_any(ckpt_dir: str, model, optimizer):

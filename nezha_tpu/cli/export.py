@@ -54,8 +54,8 @@ def _restore_params(args, model, optimizer):
 def run(args) -> dict:
     import jax
 
-    if args.platform:
-        jax.config.update("jax_platforms", args.platform)
+    from nezha_tpu.cli.common import setup_jax
+    setup_jax(args)
 
     from nezha_tpu import optim
     from nezha_tpu.models import convert

@@ -88,8 +88,8 @@ def _prompt_ids(args) -> np.ndarray:
 def run(args) -> dict:
     import jax
 
-    if args.platform:
-        jax.config.update("jax_platforms", args.platform)
+    from nezha_tpu.cli.common import setup_jax
+    setup_jax(args)
 
     from nezha_tpu.models.generate import generate
     from nezha_tpu.models.gpt2 import GPT2, GPT2Config

@@ -420,8 +420,8 @@ def run(args) -> Dict[str, float]:
 
     import jax
 
-    if args.platform:
-        jax.config.update("jax_platforms", args.platform)
+    from nezha_tpu.cli.common import setup_jax
+    setup_jax(args)
 
     from nezha_tpu import parallel
     from nezha_tpu.runtime import Prefetcher
