@@ -526,10 +526,14 @@ def run(args) -> Dict[str, float]:
             raise SystemExit(f"--engine graph supports --parallel dp (the "
                              f"IR's all_reduce path) or single-device, not "
                              f"{graph_mode!r}")
-        if graph_mode == "dp" and args.config != "mlp_mnist":
-            raise SystemExit("graph-engine dp is authored for mlp_mnist "
-                             "(graph/programs.py dp_momentum_update_graph); "
-                             "other configs run the module engine's dp")
+        _GRAPH_DP_CONFIGS = ("mlp_mnist", "resnet50_imagenet",
+                             "wrn101_large_batch")
+        if graph_mode == "dp" and args.config not in _GRAPH_DP_CONFIGS:
+            raise SystemExit("graph-engine dp is authored for the momentum "
+                             "configs (mlp_mnist, resnet50_imagenet, "
+                             "wrn101_large_batch — graph/programs.py "
+                             "dp_momentum_update_graph); other configs run "
+                             "the module engine's dp")
         if graph_mode == "single" and args.mesh:
             raise SystemExit("--mesh needs --parallel dp with the graph "
                              "engine (single-device IR does not partition)")
@@ -581,9 +585,16 @@ def run(args) -> Dict[str, float]:
                                  "batch stats only (no running BN stats); "
                                  "drop --eval/--eval-every")
             state = programs.init_graph_resnet_state(model, rng)
-            step_fn = programs.make_resnet_graph_train_step(
-                model, lr=0.1, clip_norm=args.clip_norm)
-            shard = programs.image_shard_fn()
+            if mode == "dp":
+                step_fn = programs.make_resnet_graph_dp_train_step(
+                    model, batch_size, lr=0.1, mesh=mesh)
+                img_shard = programs.image_shard_fn()
+                place = _make_batch_sharder(mesh, group)
+                shard = lambda b: place(img_shard(b))
+            else:
+                step_fn = programs.make_resnet_graph_train_step(
+                    model, lr=0.1, clip_norm=args.clip_norm)
+                shard = programs.image_shard_fn()
         elif args.config == "bert_base_zero1":
             state = programs.init_graph_bert_state(model, rng)
             sched = cfg.graph_opt["schedule"](args.steps)

@@ -147,6 +147,52 @@ def _apply_clip(clip_fn, scale_fns, grads):
     return [scale_fns[tuple(np.shape(g_))](g_, sc) for g_ in grads]
 
 
+def _dp_world(mesh, axis: str, global_batch: int) -> Tuple[int, int]:
+    """(world, local_batch) for a dp graph engine; loud on ragged batch."""
+    world = int(mesh.shape[axis])
+    if global_batch % world:
+        raise ValueError(f"global batch {global_batch} not divisible by "
+                         f"mesh axis {axis}={world}")
+    return world, global_batch // world
+
+
+def _dp_per_shard(vg, upd_fns, flatten_params, feed_keys, axis):
+    """Shared dp per-shard body (MLP and ResNet engines must not drift):
+    flatten -> loss+grads -> per-shape dp update graphs (the all_reduce is
+    an IR node inside them) -> pmean'd loss metric.
+
+    ``flatten_params(tree) -> (flat_list, unflatten_fn)``."""
+    from jax import lax
+
+    def per_shard(state, b):
+        flat_p, unf = flatten_params(state["params"])
+        flat_v, _ = flatten_params(state["vel"])
+        loss, grads = vg(*flat_p, *[b[k] for k in feed_keys])
+        new = [upd_fns[tuple(p_.shape)](p_, v_, gr)
+               for p_, v_, gr in zip(flat_p, flat_v, grads)]
+        new_p, new_v = zip(*new)
+        # Metric only (program semantics live in the IR): each shard's
+        # loss is its local-batch mean; the global mean is their pmean.
+        loss = lax.pmean(loss, axis)
+        return ({"params": unf(list(new_p)), "vel": unf(list(new_v))}, loss)
+
+    return per_shard
+
+
+def _dp_shard_map(mesh, axis, per_shard, state, b):
+    """shard_map wiring shared by the dp graph engines: state replicated,
+    batch leading-dim sharded over ``axis``."""
+    from jax.sharding import PartitionSpec as P
+
+    from nezha_tpu.parallel._compat import shard_map
+
+    tmap = jax.tree_util.tree_map
+    return shard_map(per_shard, mesh=mesh,
+                     in_specs=(tmap(lambda _: P(), state),
+                               tmap(lambda _: P(axis), b)),
+                     out_specs=(tmap(lambda _: P(), state), P()))
+
+
 def dp_momentum_update_graph(shape: Sequence[int], lr: float, beta: float,
                              axis_name: str, world: int) -> Graph:
     """IR graph: (param, velocity, LOCAL grad) -> (new_param, new_velocity)
@@ -184,17 +230,8 @@ def make_mlp_graph_dp_train_step(dims: Sequence[int], global_batch: int,
     place batches with ``parallel.shard_batch(mesh, b)`` (or feed host
     arrays and let jit shard them).
     """
-    from jax import lax
-    from jax.sharding import PartitionSpec as P
-
-    from nezha_tpu.parallel._compat import shard_map
-
     executor = executor or Executor()
-    world = int(dict(zip(mesh.axis_names, mesh.devices.shape))[axis])
-    if global_batch % world:
-        raise ValueError(f"global batch {global_batch} not divisible by "
-                         f"mesh axis {axis}={world}")
-    local_batch = global_batch // world
+    world, local_batch = _dp_world(mesh, axis, global_batch)
     loss_graph = mlp_loss_graph(dims, local_batch)
     loss_fn = to_callable(loss_graph)
     n_params = 2 * (len(dims) - 1)
@@ -204,32 +241,16 @@ def make_mlp_graph_dp_train_step(dims: Sequence[int], global_batch: int,
     upd_fns = {s: to_callable(dp_momentum_update_graph(s, lr, beta, axis,
                                                        world))
                for s in {tuple(s) for s in shapes}}
-
-    def per_shard(state, b):
-        flat_p = flatten(state["params"])
-        flat_v = flatten(state["vel"])
-        loss, grads = vg(*flat_p, b["image"], b["onehot"])
-        new_p, new_v = [], []
-        for p_, v_, gr in zip(flat_p, flat_v, grads):
-            pn, vn = upd_fns[tuple(p_.shape)](p_, v_, gr)
-            new_p.append(pn)
-            new_v.append(vn)
-        # Metric only (program semantics live in the IR): each shard's loss
-        # is its local-batch mean; the global mean is their pmean.
-        loss = lax.pmean(loss, axis)
-        return ({"params": unflatten(new_p), "vel": unflatten(new_v)}, loss)
+    per_shard = _dp_per_shard(
+        vg, upd_fns, lambda tree: (flatten(tree), unflatten),
+        feed_keys=("image", "onehot"), axis=axis)
 
     mapped = None
 
     def step(state, b):
         nonlocal mapped
         if mapped is None:
-            tmap = jax.tree_util.tree_map
-            mapped = shard_map(
-                per_shard, mesh=mesh,
-                in_specs=(tmap(lambda _: P(), state),
-                          tmap(lambda _: P(axis), b)),
-                out_specs=(tmap(lambda _: P(), state), P()))
+            mapped = _dp_shard_map(mesh, axis, per_shard, state, b)
         new_state, loss = executor.run(mapped, state, b)
         return new_state, {"loss": loss}
 
@@ -685,6 +706,62 @@ def init_graph_resnet_state(model, rng) -> dict:
     vel = jax.tree_util.tree_map(
         lambda x: np.zeros(np.shape(x), np.asarray(x).dtype), params)
     return {"params": params, "vel": vel}
+
+
+def make_resnet_graph_dp_train_step(model, global_batch: int, lr: float,
+                                    mesh, beta: float = 0.9,
+                                    axis: str = "dp",
+                                    executor: Executor = None):
+    """Data-parallel IR ResNet: per-shard loss graph -> ``jax.grad`` ->
+    :func:`dp_momentum_update_graph` (the gradient all-reduce as an IR
+    node), shard_map'd over ``mesh[axis]`` — the conv path through the
+    same op-graph + collectives shape as the MLP dp engine.
+
+    BatchNorm uses per-shard batch statistics — the standard DP-BN
+    semantics, identical to the module engine's dp step (which also
+    normalizes per-replica and only pmean-syncs the RUNNING stats this
+    training-mode engine doesn't track). Consequence: a dp run matches a
+    single-device run exactly only when every shard sees identical rows
+    (how the test pins the all-reduce), and statistically otherwise.
+
+    ``state`` layouts match :func:`make_resnet_graph_train_step`; batch =
+    {"image": [B,H,W,3], "labels": [B]} placed via ``parallel.shard_batch``;
+    graphs build per image size on first use.
+    """
+    executor = executor or Executor()
+    world, local_batch = _dp_world(mesh, axis, global_batch)
+    _built: Dict[int, callable] = {}
+
+    def build(params_template, size):
+        loss_graph = resnet_loss_graph(model.stage_sizes, params_template,
+                                       local_batch, size)
+        loss_fn = to_callable(loss_graph)
+        leaves = jax.tree_util.tree_leaves(params_template)
+        n_params = len(leaves)
+        vg = jax.value_and_grad(loss_fn, argnums=tuple(range(n_params)))
+        shapes = {tuple(np.shape(l)) for l in leaves}
+        upd = {s: to_callable(dp_momentum_update_graph(s, lr, beta, axis,
+                                                       world))
+               for s in shapes}
+
+        def flatten_params(tree):
+            flat, treedef = jax.tree_util.tree_flatten(tree)
+            return flat, (lambda ls:
+                          jax.tree_util.tree_unflatten(treedef, ls))
+
+        return _dp_per_shard(vg, upd, flatten_params,
+                             feed_keys=("image", "labels"), axis=axis)
+
+    def step(state, b):
+        size = b["image"].shape[1]
+        if size not in _built:
+            _built[size] = _dp_shard_map(
+                mesh, axis, build(state["params"], size), state, b)
+        new_state, loss = executor.run(_built[size], state, b)
+        return new_state, {"loss": loss}
+
+    step.executor = executor
+    return step
 
 
 def make_resnet_graph_train_step(model, lr: float, beta: float = 0.9,

@@ -281,6 +281,12 @@ def test_cli_graph_engine_dp(devices8, tmp_path, capsys):
     with pytest.raises(SystemExit, match="not divisible by mesh axis"):
         _run(["--config", "mlp_mnist", "--engine", "graph", "--parallel",
               "dp", "--steps", "1", "--batch-size", "60"])
+    # The conv path: graph-dp ResNet (tiny) trains over the mesh too.
+    metrics = _run(["--config", "resnet50_imagenet", "--model-preset",
+                    "tiny", "--engine", "graph", "--parallel", "dp",
+                    "--steps", "4", "--batch-size", "16",
+                    "--log-every", "2"])
+    assert np.isfinite(metrics["loss"])
     with pytest.raises(SystemExit, match="graph-engine dp is authored"):
         _run(["--config", "gpt2_124m", "--model-preset", "tiny", "--engine",
               "graph", "--parallel", "dp", "--steps", "1",

@@ -220,6 +220,46 @@ def test_graph_clip_matches_module():
     np.testing.assert_allclose(float(fn_tight(g5)), 0.01, rtol=1e-4)
 
 
+def test_graph_resnet_dp_matches_single_on_replicated_shards(devices8):
+    """The conv path through the IR-dp engine: with every dp shard fed
+    IDENTICAL rows, per-shard BN batch stats equal the single-device ones
+    and the all-reduce averages equal gradients — so the dp step must
+    match the single-device graph step on the local batch EXACTLY. (With
+    distinct rows, per-shard stats differ by design — standard DP-BN; see
+    make_resnet_graph_dp_train_step.)"""
+    from nezha_tpu import parallel
+    from nezha_tpu.models.resnet import ResNet
+
+    model = ResNet((1, 1), num_classes=10, in_channels=3)
+    local, size, world = 2, 16, 8
+    mesh = parallel.make_mesh({"dp": world})
+    state = programs.init_graph_resnet_state(model, jax.random.PRNGKey(0))
+    copy = lambda t: jax.tree_util.tree_map(np.copy, t)
+    ref_state, dp_state = copy(state), parallel.replicate(mesh, copy(state))
+
+    ref_step = programs.make_resnet_graph_train_step(model, lr=0.1)
+    dp_step = programs.make_resnet_graph_dp_train_step(
+        model, local * world, lr=0.1, mesh=mesh)
+
+    rng = np.random.RandomState(5)
+    for _ in range(2):
+        img = rng.rand(local, size, size, 3).astype(np.float32)
+        labels = rng.randint(0, 10, local).astype(np.int32)
+        ref_state, ref_m = ref_step(ref_state,
+                                    {"image": img, "labels": labels})
+        gb = {"image": np.tile(img, (world, 1, 1, 1)),
+              "labels": np.tile(labels, world)}
+        dp_state, dp_m = dp_step(dp_state, parallel.shard_batch(mesh, gb))
+        np.testing.assert_allclose(float(dp_m["loss"]), float(ref_m["loss"]),
+                                   rtol=1e-5, atol=1e-6)
+    for (ka, a), (_, b_) in zip(
+            jax.tree_util.tree_leaves_with_path(ref_state["params"]),
+            jax.tree_util.tree_leaves_with_path(dp_state["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=jax.tree_util.keystr(ka))
+
+
 def test_graph_dp_rejects_ragged_batch(devices8):
     from nezha_tpu import parallel
     import pytest
