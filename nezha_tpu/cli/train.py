@@ -371,12 +371,12 @@ def run(args) -> Dict[str, float]:
         # known, since ZeRO-1 needs the cross-rank norm.
         if not args.clip_norm > 0:  # also catches NaN (every compare False)
             raise SystemExit(f"--clip-norm must be > 0, got {args.clip_norm}")
-        if args.engine == "graph" and args.parallel == "dp":
-            raise SystemExit("--clip-norm with the graph engine's dp mode "
-                             "is unsupported: the clip must see the "
-                             "REDUCED gradients, but graph-dp's all_reduce "
-                             "lives inside the per-shape update graphs; "
-                             "use single-device graph or module-engine dp")
+        if args.engine == "graph" and args.parallel in ("dp", "zero1"):
+            raise SystemExit("--clip-norm with the graph engine's dp/zero1 "
+                             "modes is unsupported: the clip must see the "
+                             "REDUCED gradients, but their collectives "
+                             "live inside the update graphs; use "
+                             "single-device graph or the module engine")
     if args.eval_every is not None and args.eval_every < 1:
         raise SystemExit(f"--eval-every must be >= 1, got {args.eval_every}")
     if args.eval_batches is not None and args.eval_batches < 1:
@@ -522,9 +522,10 @@ def run(args) -> Dict[str, float]:
     # warning nor build a mesh it will never use.
     if args.engine == "graph":
         graph_mode = "single" if args.parallel == "config" else args.parallel
-        if graph_mode not in ("single", "dp"):
-            raise SystemExit(f"--engine graph supports --parallel dp (the "
-                             f"IR's all_reduce path) or single-device, not "
+        if graph_mode not in ("single", "dp", "zero1"):
+            raise SystemExit(f"--engine graph supports --parallel dp "
+                             f"(IR all_reduce) or zero1 (IR reduce_scatter "
+                             f"+ all_gather) or single-device, not "
                              f"{graph_mode!r}")
         _GRAPH_DP_CONFIGS = ("mlp_mnist", "resnet50_imagenet",
                              "wrn101_large_batch")
@@ -534,9 +535,22 @@ def run(args) -> Dict[str, float]:
                              "wrn101_large_batch — graph/programs.py "
                              "dp_momentum_update_graph); other configs run "
                              "the module engine's dp")
+        if graph_mode == "zero1":
+            if args.config != "mlp_mnist":
+                raise SystemExit("graph-engine zero1 is authored for "
+                                 "mlp_mnist (graph/programs.py "
+                                 "zero1_update_graph); other configs run "
+                                 "the module engine's zero1")
+            if group is not None and group.world_size > 1:
+                raise SystemExit("graph-engine zero1 is single-controller "
+                                 "(its flat dp-sharded state cannot be "
+                                 "fetched/checkpointed across OS "
+                                 "processes); multi-process zero1 runs the "
+                                 "module engine")
         if graph_mode == "single" and args.mesh:
-            raise SystemExit("--mesh needs --parallel dp with the graph "
-                             "engine (single-device IR does not partition)")
+            raise SystemExit("--mesh needs --parallel dp/zero1 with the "
+                             "graph engine (single-device IR does not "
+                             "partition)")
         if args.grad_allreduce != "fp32":
             raise SystemExit("--grad-allreduce int8 is the module engine's "
                              "dp/zero1 wire; the graph engine's all-reduce "
@@ -545,15 +559,15 @@ def run(args) -> Dict[str, float]:
 
         from nezha_tpu.graph import programs
         mode, mesh = graph_mode, None
-        if mode == "dp" and len(jax.devices()) == 1:
-            print("WARNING: --engine graph --parallel dp with 1 visible "
-                  "device; running single-device", file=sys.stderr)
+        if mode in ("dp", "zero1") and len(jax.devices()) == 1:
+            print(f"WARNING: --engine graph --parallel {mode} with 1 "
+                  f"visible device; running single-device", file=sys.stderr)
             mode = "single"
-        if mode == "dp":
+        if mode in ("dp", "zero1"):
             mesh_axes = _parse_mesh(args.mesh) or _parse_mesh("dp=-1")
             if list(mesh_axes) != ["dp"]:
-                raise SystemExit(f"graph-engine dp consumes mesh axis 'dp' "
-                                 f"only; got {list(mesh_axes)}")
+                raise SystemExit(f"graph-engine {mode} consumes mesh axis "
+                                 f"'dp' only; got {list(mesh_axes)}")
             mesh = parallel.make_mesh(mesh_axes)
             world = mesh.shape["dp"]
             if batch_size % world:
@@ -565,20 +579,27 @@ def run(args) -> Dict[str, float]:
         rng = jax.random.PRNGKey(args.seed)
         if args.config == "mlp_mnist":
             dims = [784, 256, 256, 10]
-            state = programs.init_graph_mlp_state(dims, rng)
-            if mode == "dp":
+            # dp: _make_batch_sharder pairs with _data_source, so
+            # multi-process launches feed LOCAL rows assembled
+            # process-locally like module-engine dp. zero1 is validated
+            # single-process above (its state fetch is single-controller).
+            onehot = programs.onehot_shard_fn(dims[-1])
+            if mode == "zero1":
+                state = programs.init_graph_mlp_zero1_state(dims, rng, mesh)
+                step_fn = programs.make_mlp_graph_zero1_train_step(
+                    dims, batch_size, lr=0.1, mesh=mesh)
+                shard = lambda b: parallel.shard_batch(mesh, onehot(b))
+            elif mode == "dp":
+                state = programs.init_graph_mlp_state(dims, rng)
                 step_fn = programs.make_mlp_graph_dp_train_step(
                     dims, batch_size, lr=0.1, mesh=mesh)
-                # _make_batch_sharder pairs with _data_source: multi-process
-                # launches feed LOCAL rows assembled process-locally, same
-                # as module-engine dp.
-                onehot = programs.onehot_shard_fn(dims[-1])
                 place = _make_batch_sharder(mesh, group)
                 shard = lambda b: place(onehot(b))
             else:
+                state = programs.init_graph_mlp_state(dims, rng)
                 step_fn = programs.make_mlp_graph_train_step(
                     dims, batch_size, lr=0.1, clip_norm=args.clip_norm)
-                shard = programs.onehot_shard_fn(dims[-1])
+                shard = onehot
         elif args.config in ("resnet50_imagenet", "wrn101_large_batch"):
             if args.eval or args.eval_every:
                 raise SystemExit("graph-engine ResNet runs training-mode "
@@ -619,6 +640,13 @@ def run(args) -> Dict[str, float]:
                 print(f"resumed from step {start_step}", file=sys.stderr)
         if mode == "dp":
             state = parallel.replicate(mesh, state)
+        elif mode == "zero1" and start_step:
+            # A resume restored numpy leaves; re-shard the flat 1-D state
+            # over dp. (Fresh init is already placed — no gather round-trip.)
+            from jax.sharding import NamedSharding, PartitionSpec as _P
+            _sh = NamedSharding(mesh, _P("dp"))
+            state = jax.tree_util.tree_map(
+                lambda x: jax.device_put(np.asarray(x), _sh), state)
         save_fn = None
     else:
         mode = cfg.parallel_mode if args.parallel == "config" else args.parallel
@@ -983,7 +1011,13 @@ def _run_eval(args, cfg, batch_size, mode, model, trainer, pspec,
     cache = cache if cache is not None else {}
     eval_model = model
     if args.engine == "graph":
-        variables = {"params": trainer.state["params"], "state": {}}
+        if "flat" in trainer.state:  # zero1's flat dp-sharded layout
+            from nezha_tpu.graph import programs as _programs
+            params = _programs.materialize_graph_zero1_params(
+                [784, 256, 256, 10], trainer.state)  # mlp_mnist only
+        else:
+            params = trainer.state["params"]
+        variables = {"params": params, "state": {}}
     elif mode == "pp":
         from nezha_tpu.parallel import pipeline as pp_mod
         variables = {"params": pp_mod.merge_pipeline_params(

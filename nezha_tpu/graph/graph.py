@@ -123,6 +123,9 @@ class Graph:
                          {"window": int(window), "stride": int(stride),
                           "padding": padding})
 
+    def concat(self, xs, axis: int = 0):
+        return self._add("concat", list(xs), {"axis": axis})
+
     def take(self, table, ids, axis=0):
         return self._add("take", [table, ids], {"axis": axis})
 
