@@ -527,14 +527,6 @@ def run(args) -> Dict[str, float]:
                              f"(IR all_reduce) or zero1 (IR reduce_scatter "
                              f"+ all_gather) or single-device, not "
                              f"{graph_mode!r}")
-        _GRAPH_DP_CONFIGS = ("mlp_mnist", "resnet50_imagenet",
-                             "wrn101_large_batch")
-        if graph_mode == "dp" and args.config not in _GRAPH_DP_CONFIGS:
-            raise SystemExit("graph-engine dp is authored for the momentum "
-                             "configs (mlp_mnist, resnet50_imagenet, "
-                             "wrn101_large_batch — graph/programs.py "
-                             "dp_momentum_update_graph); other configs run "
-                             "the module engine's dp")
         if graph_mode == "zero1":
             if args.config != "mlp_mnist":
                 raise SystemExit("graph-engine zero1 is authored for "
@@ -622,7 +614,8 @@ def run(args) -> Dict[str, float]:
             step_fn = programs.make_bert_graph_train_step(
                 model, lambda t: float(sched(_np.int32(t))),
                 weight_decay=cfg.graph_opt["weight_decay"],
-                clip_norm=args.clip_norm)
+                clip_norm=args.clip_norm,
+                mesh=mesh if mode == "dp" else None)
             shard = programs.bert_shard_fn()
         else:  # gpt2_124m: the transformer authored in the IR
             state = programs.init_graph_gpt2_state(model, rng)
@@ -630,8 +623,13 @@ def run(args) -> Dict[str, float]:
             step_fn = programs.make_gpt2_graph_train_step(
                 model, lambda t: float(sched(_np.int32(t))),
                 weight_decay=cfg.graph_opt["weight_decay"],
-                clip_norm=args.clip_norm)
+                clip_norm=args.clip_norm,
+                mesh=mesh if mode == "dp" else None)
             shard = programs.lm_shard_fn()
+        if mode == "dp" and args.config in ("gpt2_124m", "bert_base_zero1"):
+            base_shard = shard
+            place = _make_batch_sharder(mesh, group)
+            shard = lambda b: place(base_shard(b))
         start_step = 0
         if args.ckpt_dir:
             restored, start_step = ckpt.try_restore(args.ckpt_dir, state)

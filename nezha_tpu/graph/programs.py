@@ -553,18 +553,24 @@ def gpt2_loss_graph(cfg, param_template, batch: int, seq: int) -> Graph:
 
 
 def adamw_update_graph(shape: Sequence[int], b1=0.9, b2=0.999, eps=1e-8,
-                       weight_decay=0.1) -> Graph:
+                       weight_decay=0.1, axis_name: str = None,
+                       world: int = 1) -> Graph:
     """IR graph: (param, mu, nu, grad, step_f32, lr) -> (p', mu', nu').
 
     Matches ``optim.adamw``'s math (bias correction from the
-    post-increment step, decoupled weight decay on every leaf)."""
-    g = Graph("adamw_update")
+    post-increment step, decoupled weight decay on every leaf). With
+    ``axis_name`` set, the incoming gradient is a LOCAL shard and the
+    all-reduce mean over the mesh axis is authored as an IR node — ONE
+    body for both engines so single-device and dp AdamW cannot drift."""
+    g = Graph("dp_adamw_update" if axis_name else "adamw_update")
     p = g.placeholder(shape, name="param")
     m = g.placeholder(shape, name="mu")
     v = g.placeholder(shape, name="nu")
     grad = g.placeholder(shape, name="grad")
     t = g.placeholder((), name="step")   # post-increment, fp32
     lr = g.placeholder((), name="lr")
+    if axis_name is not None:
+        grad = g.all_reduce(grad, axis_name=axis_name) * (1.0 / world)
     m2 = m * b1 + grad * (1 - b1)
     v2 = v * b2 + (grad * grad) * (1 - b2)
     c1 = -(g.constant(np.float32(b1)) ** t) + 1.0
@@ -572,6 +578,17 @@ def adamw_update_graph(shape: Sequence[int], b1=0.9, b2=0.999, eps=1e-8,
     d = (m2 / c1) / ((v2 / c2) ** 0.5 + eps) + p * weight_decay
     g.output(p - d * lr, m2, v2)
     return g
+
+
+def dp_adamw_update_graph(shape: Sequence[int], b1=0.9, b2=0.999, eps=1e-8,
+                          weight_decay=0.1, axis_name: str = "dp",
+                          world: int = 1) -> Graph:
+    """The dp AdamW engine (GPT-2, BERT): delegates to
+    :func:`adamw_update_graph` with the collective enabled — same
+    collective shape as :func:`dp_momentum_update_graph`."""
+    return adamw_update_graph(shape, b1=b1, b2=b2, eps=eps,
+                              weight_decay=weight_decay,
+                              axis_name=axis_name, world=world)
 
 
 def init_graph_gpt2_state(model, rng) -> dict:
@@ -586,14 +603,32 @@ def init_graph_gpt2_state(model, rng) -> dict:
 def _make_adamw_ir_step(build_loss_graph, feed_keys: Tuple[str, ...],
                         shape_key: str, lr_schedule,
                         weight_decay: float, clip_norm: float = None,
+                        mesh=None, axis: str = "dp",
                         executor: Executor = None):
     """Shared IR-engine AdamW trainer: ``build_loss_graph(template, batch,
     seq) -> Graph`` whose placeholders are (*flat_params, *feed_keys
     tensors); state = {"params", "mu", "nu", "step"}; graphs built per
     (batch, seq) of ``b[shape_key]`` on first use. One implementation so
     the per-model engines (GPT-2, BERT) cannot drift apart. ``clip_norm``:
-    IR-authored global-norm clipping before the update graphs."""
+    IR-authored global-norm clipping before the update graphs.
+
+    ``mesh``: data-parallel over ``mesh[axis]`` — the loss graph builds at
+    the LOCAL batch, the update graphs become
+    :func:`dp_adamw_update_graph` (all_reduce as an IR node), and the
+    whole step runs inside shard_map (state/scalars replicated, feeds
+    leading-dim sharded). Mutually exclusive with ``clip_norm`` (the clip
+    must see reduced gradients; the CLI rejects the combo).
+    """
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from nezha_tpu.parallel._compat import shard_map
+
     executor = executor or Executor()
+    world = int(mesh.shape[axis]) if mesh is not None else 1
+    if mesh is not None and clip_norm is not None:
+        raise ValueError("clip_norm under graph-dp is unsupported (the "
+                         "all_reduce lives inside the update graphs)")
     _built: Dict[Tuple[int, int], dict] = {}
 
     def build(params_template, batch, seq):
@@ -603,8 +638,13 @@ def _make_adamw_ir_step(build_loss_graph, feed_keys: Tuple[str, ...],
         n_params = len(leaves)
         vg = jax.value_and_grad(loss_fn, argnums=tuple(range(n_params)))
         shapes = {tuple(np.shape(l)) for l in leaves}
-        upd = {s: to_callable(adamw_update_graph(
-            s, weight_decay=weight_decay)) for s in shapes}
+        if mesh is None:
+            upd = {s: to_callable(adamw_update_graph(
+                s, weight_decay=weight_decay)) for s in shapes}
+        else:
+            upd = {s: to_callable(dp_adamw_update_graph(
+                s, weight_decay=weight_decay, axis_name=axis, world=world))
+                for s in shapes}
         clip_fn, scale_fns = _make_clip(
             [np.shape(l) for l in leaves], clip_norm)
 
@@ -619,15 +659,27 @@ def _make_adamw_ir_step(build_loss_graph, feed_keys: Tuple[str, ...],
             new = [upd[tuple(x.shape)](x, m, v, gr, t_f32, lr)
                    for x, m, v, gr in zip(ps, ms, vs, grads)]
             new_p, new_m, new_v = zip(*new)
+            if mesh is not None:
+                loss = lax.pmean(loss, axis)  # metric only
             return (loss, *new_p, *new_m, *new_v)
 
+        if mesh is not None:
+            n_feeds = len(feed_keys)
+            whole_step = shard_map(
+                whole_step, mesh=mesh,
+                in_specs=(P(),) * (3 * n_params + 2) + (P(axis),) * n_feeds,
+                out_specs=(P(),) * (1 + 3 * n_params))
         return {"whole_step": whole_step, "n_params": n_params,
                 "loss_graph": loss_graph}
 
     def step(state, b):
-        batch, seq = b[shape_key].shape
+        batch, seq = b[shape_key].shape[:2]
+        if batch % world:
+            raise ValueError(f"global batch {batch} not divisible by "
+                             f"mesh axis {axis}={world}")
         if (batch, seq) not in _built:
-            _built[(batch, seq)] = build(state["params"], batch, seq)
+            _built[(batch, seq)] = build(state["params"], batch // world,
+                                         seq)
         so = _built[(batch, seq)]
         n = so["n_params"]
         flat_p, treedef = jax.tree_util.tree_flatten(state["params"])
@@ -651,17 +703,18 @@ def _make_adamw_ir_step(build_loss_graph, feed_keys: Tuple[str, ...],
 
 
 def make_gpt2_graph_train_step(model, lr_schedule, weight_decay: float = 0.1,
-                               clip_norm: float = None,
+                               clip_norm: float = None, mesh=None,
                                executor: Executor = None):
     """Trainer-compatible step over ``init_graph_gpt2_state`` state; batches
     are {"inputs": [B,S] i32, "targets": [B,S] i32} (see
-    :func:`lm_shard_fn`). Graphs are built per batch shape on first use."""
+    :func:`lm_shard_fn`). Graphs are built per batch shape on first use.
+    ``mesh``: dp over the mesh's "dp" axis (IR all_reduce)."""
     cfg = model.cfg
     return _make_adamw_ir_step(
         lambda tmpl, batch, seq: gpt2_loss_graph(cfg, tmpl, batch, seq),
         feed_keys=("inputs", "targets"), shape_key="inputs",
         lr_schedule=lr_schedule, weight_decay=weight_decay,
-        clip_norm=clip_norm, executor=executor)
+        clip_norm=clip_norm, mesh=mesh, executor=executor)
 
 
 def lm_shard_fn():
@@ -789,17 +842,18 @@ def init_graph_bert_state(model, rng) -> dict:
 
 def make_bert_graph_train_step(model, lr_schedule,
                                weight_decay: float = 0.01,
-                               clip_norm: float = None,
+                               clip_norm: float = None, mesh=None,
                                executor: Executor = None):
     """Trainer-compatible step over ``init_graph_bert_state`` state;
-    batches from :func:`bert_shard_fn`."""
+    batches from :func:`bert_shard_fn`. ``mesh``: dp (IR all_reduce)."""
     cfg = model.cfg
     return _make_adamw_ir_step(
         lambda tmpl, batch, seq: bert_loss_graph(cfg, tmpl, batch, seq),
         feed_keys=("tokens", "segment_ids", "attn_mask", "safe_labels",
                    "label_mask"),
         shape_key="tokens", lr_schedule=lr_schedule,
-        weight_decay=weight_decay, clip_norm=clip_norm, executor=executor)
+        weight_decay=weight_decay, clip_norm=clip_norm, mesh=mesh,
+        executor=executor)
 
 
 # ---------------------------------------------------------------------------

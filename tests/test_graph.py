@@ -493,6 +493,45 @@ def test_graph_gpt2_trains_and_matches_module_adamw():
                                    err_msg=jax.tree_util.keystr(ka))
 
 
+def test_graph_gpt2_dp_matches_single_graph(devices8):
+    """The AdamW configs through the IR-dp engine (dp_adamw_update_graph:
+    all_reduce as an IR node): dp=8 tracks the single-device graph engine
+    EXACTLY on the same global batch (no batch statistics in GPT-2, so
+    mean-of-shard grads == global grads)."""
+    import jax as _jax
+
+    from nezha_tpu import parallel
+
+    model = _tiny_gpt2_module()
+    sched = lambda t: 1e-3
+    mesh = parallel.make_mesh({"dp": 8})
+    ref_state = programs.init_graph_gpt2_state(model, _jax.random.PRNGKey(0))
+    dp_state = programs.init_graph_gpt2_state(model, _jax.random.PRNGKey(0))
+    ref_step = programs.make_gpt2_graph_train_step(model, sched,
+                                                   weight_decay=0.1)
+    dp_step = programs.make_gpt2_graph_train_step(model, sched,
+                                                  weight_decay=0.1,
+                                                  mesh=mesh)
+    shard = programs.lm_shard_fn()
+    rng = np.random.RandomState(4)
+    for _ in range(2):
+        b = shard({"tokens": rng.randint(0, 128, (8, 17)).astype(np.int32)})
+        ref_state, rm = ref_step(ref_state, b)
+        dp_state, dm = dp_step(dp_state, parallel.shard_batch(mesh, b))
+        np.testing.assert_allclose(float(dm["loss"]), float(rm["loss"]),
+                                   rtol=1e-5, atol=1e-6)
+    for (ka, a), (_, bb) in zip(
+            jax.tree_util.tree_leaves_with_path(ref_state["params"]),
+            jax.tree_util.tree_leaves_with_path(dp_state["params"])):
+        # psum-then-scale vs single-reduction order differ at ~1e-8 fp32;
+        # AdamW's early tiny-sqrt(nu) denominators amplify that on
+        # near-zero gradient elements (same band as the module-parity
+        # test above). Loss parity stays at 1e-5.
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   rtol=1e-4, atol=1e-5,
+                                   err_msg=jax.tree_util.keystr(ka))
+
+
 def test_graph_resnet_forward_matches_module():
     """The IR-composed bottleneck ResNet reproduces the module's training-
     mode loss (configs 2/5 expressible in the IR, VERDICT r2 missing #6)."""
