@@ -585,8 +585,7 @@ def run(args) -> Dict[str, float]:
                 state = programs.init_graph_mlp_state(dims, rng)
                 step_fn = programs.make_mlp_graph_dp_train_step(
                     dims, batch_size, lr=0.1, mesh=mesh)
-                place = _make_batch_sharder(mesh, group)
-                shard = lambda b: place(onehot(b))
+                shard = onehot  # placement hoisted below (all dp configs)
             else:
                 state = programs.init_graph_mlp_state(dims, rng)
                 step_fn = programs.make_mlp_graph_train_step(
@@ -601,9 +600,7 @@ def run(args) -> Dict[str, float]:
             if mode == "dp":
                 step_fn = programs.make_resnet_graph_dp_train_step(
                     model, batch_size, lr=0.1, mesh=mesh)
-                img_shard = programs.image_shard_fn()
-                place = _make_batch_sharder(mesh, group)
-                shard = lambda b: place(img_shard(b))
+                shard = programs.image_shard_fn()
             else:
                 step_fn = programs.make_resnet_graph_train_step(
                     model, lr=0.1, clip_norm=args.clip_norm)
@@ -626,10 +623,13 @@ def run(args) -> Dict[str, float]:
                 clip_norm=args.clip_norm,
                 mesh=mesh if mode == "dp" else None)
             shard = programs.lm_shard_fn()
-        if mode == "dp" and args.config in ("gpt2_124m", "bert_base_zero1"):
-            base_shard = shard
-            place = _make_batch_sharder(mesh, group)
-            shard = lambda b: place(base_shard(b))
+        if mode == "dp":
+            # One placement composition for every graph-dp config:
+            # _make_batch_sharder pairs with _data_source so multi-process
+            # launches feed LOCAL rows assembled process-locally.
+            _base_shard = shard
+            _place = _make_batch_sharder(mesh, group)
+            shard = lambda b: _place(_base_shard(b))
         start_step = 0
         if args.ckpt_dir:
             restored, start_step = ckpt.try_restore(args.ckpt_dir, state)

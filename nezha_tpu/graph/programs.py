@@ -580,12 +580,14 @@ def adamw_update_graph(shape: Sequence[int], b1=0.9, b2=0.999, eps=1e-8,
     return g
 
 
-def dp_adamw_update_graph(shape: Sequence[int], b1=0.9, b2=0.999, eps=1e-8,
-                          weight_decay=0.1, axis_name: str = "dp",
-                          world: int = 1) -> Graph:
+def dp_adamw_update_graph(shape: Sequence[int], axis_name: str, world: int,
+                          b1=0.9, b2=0.999, eps=1e-8,
+                          weight_decay=0.1) -> Graph:
     """The dp AdamW engine (GPT-2, BERT): delegates to
     :func:`adamw_update_graph` with the collective enabled — same
-    collective shape as :func:`dp_momentum_update_graph`."""
+    collective shape as :func:`dp_momentum_update_graph`. ``axis_name``
+    and ``world`` are required together (a defaulted world would turn the
+    mean into a silent sum)."""
     return adamw_update_graph(shape, b1=b1, b2=b2, eps=eps,
                               weight_decay=weight_decay,
                               axis_name=axis_name, world=world)
