@@ -237,6 +237,49 @@ def test_gpt2_flash_attention_matches_xla():
                                atol=2e-5, rtol=2e-5)
 
 
+def test_gpt2_pallas_ln_matches_xla():
+    """ln_impl='pallas' (the fused LN kernel, interpret on CPU) must match
+    the composed XLA layer norm through the whole model — forward AND one
+    training step's gradients (the experiments/gpt2_tune.py variant must
+    be exchangeable with the default before it can be flipped on-chip)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nezha_tpu import optim
+    from nezha_tpu.models.gpt2 import GPT2, GPT2Config, lm_loss
+    from nezha_tpu.train.loop import init_train_state, make_train_step
+
+    kw = dict(vocab_size=64, max_positions=32, num_layers=2, num_heads=4,
+              hidden_size=64)
+    m_xla = GPT2(GPT2Config(ln_impl="xla", **kw))
+    m_pal = GPT2(GPT2Config(ln_impl="pallas", **kw))
+    variables = m_xla.init(jax.random.PRNGKey(0))
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 64, (2, 33)), jnp.int32)
+    out1, _ = m_xla.apply(variables, tokens[:, :-1], training=False)
+    out2, _ = m_pal.apply(variables, tokens[:, :-1], training=False)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                               atol=2e-5, rtol=2e-5)
+
+    opt = optim.adamw(1e-3)
+    s1 = init_train_state(m_xla, opt, jax.random.PRNGKey(0))
+    s2 = init_train_state(m_pal, opt, jax.random.PRNGKey(0))
+    step1 = make_train_step(m_xla, opt, lm_loss, donate=False)
+    step2 = make_train_step(m_pal, opt, lm_loss, donate=False)
+    b = {"tokens": tokens}
+    s1, me1 = step1(s1, b)
+    s2, me2 = step2(s2, b)
+    np.testing.assert_allclose(float(me1["loss"]), float(me2["loss"]),
+                               rtol=2e-5)
+    for (ka, a), (_, bb) in zip(
+            jax.tree_util.tree_leaves_with_path(s1["variables"]["params"]),
+            jax.tree_util.tree_leaves_with_path(s2["variables"]["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   rtol=2e-4, atol=2e-5,
+                                   err_msg=jax.tree_util.keystr(ka))
+
+
 def test_gpt2_remat_matches_exact_gradients():
     """cfg.remat changes memory scheduling, not math: loss and grads must
     match the non-remat model bit-for-bit-ish, including dropout rng replay
