@@ -22,7 +22,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def measure(variant: dict, batch: int, seq: int, steps: int) -> dict:
+def measure(variant: dict, batch: int, seq: int, steps: int,
+            tiny: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -32,7 +33,9 @@ def measure(variant: dict, batch: int, seq: int, steps: int) -> dict:
     from nezha_tpu.tensor import bf16_policy
     from nezha_tpu.train.loop import init_train_state, make_train_step
 
-    cfg = GPT2Config(fused_loss_chunk=-1, **variant.get("cfg", {}))
+    small = dict(vocab_size=256, max_positions=max(seq, 64), num_layers=2,
+                 num_heads=4, hidden_size=64) if tiny else {}
+    cfg = GPT2Config(fused_loss_chunk=-1, **small, **variant.get("cfg", {}))
     model = GPT2(cfg, policy=bf16_policy())
     opt = optim.adamw(6e-4, weight_decay=0.1)
     state = init_train_state(model, opt, jax.random.PRNGKey(0))
@@ -81,11 +84,23 @@ def main() -> int:
     ap.add_argument("--seq", type=int, default=1024)
     ap.add_argument("--variants", nargs="+", default=None,
                     choices=[v["name"] for v in VARIANTS])
+    ap.add_argument("--tiny", action="store_true",
+                    help="seconds-scale model (CPU smoke of the harness; "
+                         "numbers are meaningless)")
     args = ap.parse_args()
+    if args.tiny:
+        # Pin the CPU backend BEFORE any jax call: the env var alone is
+        # not enough on the dev box (the ambient axon site hook overrides
+        # backend selection, and its plugin init hangs when the TPU
+        # tunnel is down — the exact situation --tiny exists for). Same
+        # pattern as tests/conftest.py.
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     for v in VARIANTS:
         if args.variants and v["name"] not in args.variants:
             continue
-        print(json.dumps(measure(v, args.batch, args.seq, args.steps)),
+        print(json.dumps(measure(v, args.batch, args.seq, args.steps,
+                                 tiny=args.tiny)),
               flush=True)
     return 0
 
