@@ -189,7 +189,14 @@ def main() -> int:
                     help="input size (shrink for CPU smoke runs)")
     ap.add_argument("--base-batch", type=int, default=None,
                     help="override every variant's batch (CPU smoke)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin the CPU backend (harness smoke during TPU "
+                         "tunnel outages; env vars alone cannot override "
+                         "the ambient axon plugin — see gpt2_tune --tiny)")
     args = ap.parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     global IMAGE_SIZE
     IMAGE_SIZE = args.image_size
     if args.base_batch:
