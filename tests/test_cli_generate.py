@@ -32,6 +32,32 @@ def test_generate_from_trained_checkpoint(tmp_path, capsys):
     assert again["tokens"] == out["tokens"]
 
 
+def test_generate_from_graph_engine_checkpoint(tmp_path, capsys):
+    """A GPT-2 trained with --engine graph checkpoints the IR trainer's
+    {"params","mu","nu","step"} layout; nezha-generate must read it (the
+    params are module-layout, so decode works unchanged)."""
+    ck = str(tmp_path / "ck")
+    train_run(train_parser().parse_args(
+        ["--config", "gpt2_124m", "--model-preset", "tiny", "--steps", "3",
+         "--batch-size", "8", "--engine", "graph", "--ckpt-dir", ck]))
+    out = _gen(["--ckpt-dir", ck, "--model-preset", "tiny",
+                "--prompt-tokens", "5,17,3", "--max-new-tokens", "6",
+                "--temperature", "0"])
+    assert out["prompt_len"] == 3
+    assert len(out["tokens"]) == 6
+    assert "graph-engine layout" in capsys.readouterr().err
+
+    # nezha-export reads the same layout (HF-keyed npz out).
+    from nezha_tpu.cli.export import build_parser as ep, run as erun
+    dest = str(tmp_path / "hf.npz")
+    summary = erun(ep().parse_args(
+        ["--config", "gpt2_124m", "--model-preset", "tiny",
+         "--ckpt-dir", ck, "--out", dest, "--format", "npz"]))
+    assert summary["keys"] > 0
+    import numpy as _np
+    assert any("wte" in k for k in _np.load(dest).files)
+
+
 def test_generate_random_init_and_prompt_file(tmp_path):
     toks = np.asarray([1, 2, 3, 4], np.uint16)
     pf = str(tmp_path / "p.bin")
