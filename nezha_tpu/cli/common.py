@@ -33,15 +33,13 @@ def restore_variables_any(ckpt_dir: str, model, optimizer):
 
     template = init_train_state(model, optimizer, jax.random.PRNGKey(0))
     if _is_graph_layout(ckpt_dir, ckpt):
-        # Graph-engine AdamW trainers write {"params", "mu", "nu", "step"}
-        # (params are module-layout either way, so the interchange is a
-        # straight read into the matching template).
-        import numpy as np
-
+        # Graph-engine trainers write {"params", ...optimizer slots}
+        # (AdamW: mu/nu/step; momentum: vel) with module-layout params.
+        # A params-only template restores just what the callers consume —
+        # restore ignores npz keys the template doesn't name, so the
+        # optimizer slots are never reconstructed.
         p = template["variables"]["params"]
-        g_restored, step = ckpt.try_restore(
-            ckpt_dir, {"params": p, "mu": p, "nu": p,
-                       "step": np.zeros((), np.int32)})
+        g_restored, step = ckpt.try_restore(ckpt_dir, {"params": p})
         print(f"restored step {step} (graph-engine layout) from "
               f"{ckpt_dir}", file=sys.stderr)
         return {"params": g_restored["params"], "state": {}}

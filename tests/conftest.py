@@ -62,6 +62,64 @@ def worker_env():
     return env
 
 
+class TwoRankElastic:
+    """Scaffolding for the elastic-recovery CLI tests: a 2-rank mlp_mnist
+    control-plane world (`--on-failure rejoin`, shared --ckpt-dir,
+    coordinator on rank 0), per-rank stderr files, metrics-line polling,
+    and guaranteed process reaping. Tests drive kills/relaunches."""
+
+    def __init__(self, tmp_path, rejoin_timeout="120"):
+        import socket
+        import sys
+
+        self.tmp_path = tmp_path
+        self.env = worker_env()
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            self.port = s.getsockname()[1]
+        self.ck = str(tmp_path / "ck")
+        self.base = [sys.executable, "-m", "nezha_tpu.cli.train",
+                     "--config", "mlp_mnist", "--batch-size", "64",
+                     "--platform", "cpu", "--log-every", "25",
+                     "--failure-check-every", "5", "--ckpt-dir", self.ck,
+                     "--coordinator", f"127.0.0.1:{self.port}",
+                     "--no-jax-distributed", "--on-failure", "rejoin",
+                     "--rejoin-timeout", str(rejoin_timeout)]
+        self.procs = []
+        self.errfiles = []
+
+    def launch(self, tag, extra):
+        import subprocess
+
+        errf = open(self.tmp_path / f"{tag}.err", "w+")
+        self.errfiles.append(errf)
+        p = subprocess.Popen(self.base + extra, stdout=subprocess.DEVNULL,
+                             stderr=errf, text=True, env=self.env)
+        self.procs.append(p)
+        return p
+
+    def err(self, tag) -> str:
+        return (self.tmp_path / f"{tag}.err").read_text()
+
+    def wait_for(self, tag, needle, proc, timeout=120):
+        """Poll a rank's stderr for ``needle`` while it stays alive."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while needle not in self.err(tag):
+            assert proc.poll() is None, self.err(tag)
+            assert time.monotonic() < deadline, self.err(tag)
+            time.sleep(0.25)
+
+    def cleanup(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        for f in self.errfiles:
+            f.close()
+
+
 def run_worker_processes(argv_per_rank, timeout=300):
     """Launch one OS process per argv list (modelling one-device hosts) and
     return [(returncode, stdout, stderr)]. Shared harness for the

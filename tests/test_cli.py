@@ -494,10 +494,7 @@ def test_cli_elastic_rejoin_continues(tmp_path):
     1, resumes from the rescue checkpoint; rank 0 sees the world heal,
     reloads the same checkpoint, and training CONTINUES in-process to
     completion on both ranks."""
-    import socket
-    import subprocess
-    import sys
-    import time
+    import pathlib
 
     import pytest
 
@@ -505,76 +502,66 @@ def test_cli_elastic_rejoin_continues(tmp_path):
     if not native_available():
         pytest.skip("native runtime not available")
 
-    from conftest import worker_env
-    env = worker_env()
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    ck = str(tmp_path / "ck")
-    base = [sys.executable, "-m", "nezha_tpu.cli.train",
-            "--config", "mlp_mnist", "--batch-size", "64",
-            "--platform", "cpu", "--log-every", "25",
-            "--failure-check-every", "5", "--ckpt-dir", ck,
-            "--coordinator", f"127.0.0.1:{port}", "--no-jax-distributed",
-            "--on-failure", "rejoin", "--rejoin-timeout", "120"]
-
-    errfiles = []
-
-    def launch(extra, tag):
-        errf = open(tmp_path / f"{tag}.err", "w+")
-        errfiles.append(errf)
-        p = subprocess.Popen(base + extra, stdout=subprocess.DEVNULL,
-                             stderr=errf, text=True, env=env)
-        return p
-
-    r0 = launch(["--steps", "2000", "--serve-coordinator",
-                 "--world-size", "2"], "r0")
-    r1 = launch(["--steps", "2000", "--rank-hint", "1"], "r1")
-    procs = [r0, r1]
+    from conftest import TwoRankElastic
+    cluster = TwoRankElastic(tmp_path)
     try:
+        r0 = cluster.launch("r0", ["--steps", "2000", "--serve-coordinator",
+                                   "--world-size", "2"])
+        r1 = cluster.launch("r1", ["--steps", "2000", "--rank-hint", "1"])
         # Kill rank 1 only once it is demonstrably mid-training (has
         # logged a metrics line), so the failure lands between steps.
-        deadline = time.monotonic() + 120
-        while '"step"' not in (tmp_path / "r1.err").read_text():
-            assert r1.poll() is None, (tmp_path / "r1.err").read_text()
-            assert time.monotonic() < deadline, "rank 1 never started"
-            time.sleep(0.25)
+        cluster.wait_for("r1", '"step"', r1)
         r1.kill()
         r1.wait()
 
         # Rank 0 must detect, checkpoint, and announce the wait.
-        deadline = time.monotonic() + 120
-        while "waiting for rejoin" not in (tmp_path / "r0.err").read_text():
-            assert r0.poll() is None, (tmp_path / "r0.err").read_text()
-            assert time.monotonic() < deadline, \
-                (tmp_path / "r0.err").read_text()
-            time.sleep(0.25)
-        import pathlib
-        assert list(pathlib.Path(ck).glob("step_*.npz"))  # rescue committed
+        cluster.wait_for("r0", "waiting for rejoin", r0)
+        assert list(pathlib.Path(cluster.ck).glob("step_*.npz"))  # rescue
 
         # Relaunch the dead rank into its old slot; both must finish.
-        r1b = launch(["--steps", "200", "--rank-hint", "1"], "r1b")
-        procs.append(r1b)
-        assert r0.wait(timeout=240) == 0, (tmp_path / "r0.err").read_text()
-        assert r1b.wait(timeout=240) == 0, (tmp_path / "r1b.err").read_text()
+        r1b = cluster.launch("r1b", ["--steps", "200", "--rank-hint", "1"])
+        assert r0.wait(timeout=240) == 0, cluster.err("r0")
+        assert r1b.wait(timeout=240) == 0, cluster.err("r1b")
     finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-                p.wait()
-        for f in errfiles:
-            f.close()
+        cluster.cleanup()
 
-    e0 = (tmp_path / "r0.err").read_text()
+    e0 = cluster.err("r0")
     assert "world healed; resumed from step" in e0
-    e1b = (tmp_path / "r1b.err").read_text()
-    assert "resumed from step" in e1b  # replacement restored the rescue ckpt
+    assert "resumed from step" in cluster.err("r1b")  # restored rescue ckpt
     # The loss stream continued: rank 0's logged steps are strictly
     # increasing through the failure and reach the full horizon.
     steps = [json.loads(l)["step"] for l in e0.splitlines()
              if l.startswith("{") and '"step"' in l]
     assert steps[-1] == 2000
     assert all(a < b for a, b in zip(steps, steps[1:]))  # no re-logged steps
+
+
+def test_cli_rejoin_timeout_gives_up_loudly(tmp_path):
+    """--on-failure rejoin with NO replacement: the survivor must not wait
+    forever — after --rejoin-timeout it raises (checkpoint already
+    committed), exiting nonzero with the timeout message."""
+    import pathlib
+
+    import pytest
+
+    from nezha_tpu.runtime.native import native_available
+    if not native_available():
+        pytest.skip("native runtime not available")
+
+    from conftest import TwoRankElastic
+    cluster = TwoRankElastic(tmp_path, rejoin_timeout="3")
+    try:
+        r0 = cluster.launch("r0", ["--steps", "2000", "--serve-coordinator",
+                                   "--world-size", "2"])
+        r1 = cluster.launch("r1", ["--steps", "2000", "--rank-hint", "1"])
+        cluster.wait_for("r1", '"step"', r1)
+        r1.kill()
+        r1.wait()
+        assert r0.wait(timeout=180) != 0  # gave up, loudly
+    finally:
+        cluster.cleanup()
+    assert "no replacement rejoined within 3s" in cluster.err("r0")
+    assert list(pathlib.Path(cluster.ck).glob("step_*.npz"))  # rescue saved
 
 
 def test_cli_on_failure_rejoin_validation():
