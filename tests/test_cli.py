@@ -646,6 +646,42 @@ def test_cli_two_process_dp_sharded_data(devices8, tmp_path):
     assert finals[0] == finals[1]  # replicated metrics agree across ranks
 
 
+def test_cli_two_process_graph_dp(devices8):
+    """Graph-engine dp across two OS processes: the IR all_reduce path
+    composes with the multi-process launch (process-local rows assembled
+    into the global batch) — replicated metrics must agree across ranks."""
+    import socket
+    import sys
+
+    from conftest import run_worker_processes
+    from nezha_tpu.runtime.native import native_available
+    if not native_available():
+        import pytest
+        pytest.skip("native runtime not available")
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    base = [sys.executable, "-m", "nezha_tpu.cli.train",
+            "--config", "mlp_mnist", "--engine", "graph",
+            "--parallel", "dp", "--steps", "3", "--batch-size", "16",
+            "--platform", "cpu", "--log-every", "1",
+            "--coordinator", f"127.0.0.1:{port}"]
+    results = run_worker_processes([
+        base + (["--serve-coordinator", "--world-size", "2"] if i == 0
+                else [])
+        for i in range(2)])
+    for rc, _, err in results:
+        assert rc == 0, err[-3000:]
+        # jax.distributed forms the 2-device global world — the degrade
+        # path must NOT fire, or the IR all_reduce never runs.
+        assert "running single-device" not in err, err[-2000:]
+    finals = [json.loads(out.strip().splitlines()[-1])["final"]["loss"]
+              for _, out, _ in results]
+    assert np.isfinite(finals[0])
+    assert finals[0] == finals[1]  # replicated metrics agree across ranks
+
+
 def test_cli_dropout_pipelines(devices8):
     """--dropout works in pp mode (per-layer/microbatch keys through the
     GPipe schedule) and is rejected where it cannot apply."""
