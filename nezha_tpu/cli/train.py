@@ -511,7 +511,8 @@ def run(args) -> Dict[str, float]:
         eval0 = cfg.eval_batches
         cfg.build_model = lambda: build0(max_positions=sl)
         if sp0 is not None:
-            cfg.sp_model = lambda impl: sp0(impl, max_positions=sl)
+            cfg.sp_model = lambda impl, **ov: sp0(impl, max_positions=sl,
+                                                  **ov)
         cfg.batches = lambda bs: batches0(bs, seq_len=sl)
         if eval0 is not None:
             cfg.eval_batches = lambda bs: eval0(bs, seq_len=sl)
@@ -547,6 +548,10 @@ def run(args) -> Dict[str, float]:
             raise SystemExit("--grad-allreduce int8 is the module engine's "
                              "dp/zero1 wire; the graph engine's all-reduce "
                              "is an IR op (fp32 only)")
+        if args.sp_flash != "auto":
+            raise SystemExit("--sp-flash tunes the sequence-parallel "
+                             "attention kernels; it needs --parallel sp "
+                             "(module engine)")
         import numpy as _np
 
         from nezha_tpu.graph import programs
@@ -652,7 +657,16 @@ def run(args) -> Dict[str, float]:
             raise SystemExit("--mesh has no effect in single-device mode; "
                              "drop it or pick a --parallel mode that "
                              "consumes it")
-        if mode != "single" and len(jax.devices()) == 1:
+        # An EXPLICIT all-ones mesh (e.g. --mesh dp=1,sp=1) fits one device
+        # by construction and must run the requested mode — it is the
+        # 1-chip smoke of a parallel path (kernel compiles, shard_map
+        # wiring), not a mis-launch.
+        _req = _parse_mesh(args.mesh)
+        _req_size = 1
+        for _v in (_req or {"": -1}).values():
+            _req_size *= _v  # any -1 ("all devices") counts as multi
+        if (mode != "single" and len(jax.devices()) == 1
+                and _req_size != 1):
             # Degrade, but never silently: a mis-launched multi-host job
             # would otherwise "succeed" at 1/Nth scale.
             print(f"WARNING: config {args.config!r} requests parallel mode "
@@ -667,6 +681,10 @@ def run(args) -> Dict[str, float]:
             raise SystemExit("--grad-allreduce int8 is the dp/zero1 "
                              f"gradient wire format; mode {mode!r} does "
                              "not consume it (reject, don't ignore)")
+        if args.sp_flash != "auto" and mode != "sp":
+            raise SystemExit(f"--sp-flash tunes the sequence-parallel "
+                             f"attention kernels; mode {mode!r} does not "
+                             f"consume it (reject, don't ignore)")
         if args.optimizer in ("lars", "lamb") and mode == "zero1":
             raise SystemExit(f"--optimizer {args.optimizer} computes "
                              f"layerwise trust ratios, which ZeRO-1's flat "
@@ -719,7 +737,10 @@ def run(args) -> Dict[str, float]:
                 raise SystemExit(f"config {args.config!r} has no sequence-"
                                  f"parallel model; --parallel sp supports: "
                                  f"gpt2_124m")
-            model = cfg.sp_model(args.attn_impl)
+            model = cfg.sp_model(
+                args.attn_impl,
+                sp_use_flash={"auto": None, "on": True,
+                              "off": False}[args.sp_flash])
         else:
             model = cfg.build_model()
         if args.clip_norm is not None:
@@ -1073,6 +1094,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "parallel)")
     p.add_argument("--microbatches", type=int, default=4,
                    help="pipeline microbatches per step (--parallel pp)")
+    p.add_argument("--sp-flash", default="auto",
+                   choices=["auto", "on", "off"],
+                   help="ring/ulysses flash kernels: auto = Pallas on TPU "
+                        "backends, composed XLA elsewhere; off = force the "
+                        "composed fallback (the on-hardware escape hatch); "
+                        "on = force flash (interpret mode off-TPU)")
     p.add_argument("--attn-impl", default="ring", choices=["ring", "ulysses"],
                    help="sequence-parallel attention (--parallel sp)")
     p.add_argument("--seq-len", type=int, default=None,

@@ -161,6 +161,45 @@ def test_cli_moe_ep_gspmd_matches_single(devices8):
               "--parallel", "gspmd", "--mesh", "dp=1,tp=1,ep=8"])
 
 
+def test_cli_sp_flash_matches_single(devices8):
+    """--sp-flash on forces the flash-ring path from the CLI (interpret
+    mode on CPU) and still matches single-device numerics; the flag is
+    rejected where no sp kernels run."""
+    import pytest
+    ref = _final_losses("gpt2_124m", 3, 8, ["--parallel", "single"])
+    spf = _final_losses("gpt2_124m", 3, 8,
+                        ["--parallel", "sp", "--mesh", "dp=2,sp=4",
+                         "--attn-impl", "ring", "--sp-flash", "on"])
+    np.testing.assert_allclose(spf, ref, rtol=1e-3)
+    with pytest.raises(SystemExit, match="does not consume it"):
+        _run(["--config", "mlp_mnist", "--steps", "1", "--batch-size", "8",
+              "--sp-flash", "off"])
+    with pytest.raises(SystemExit, match="needs --parallel sp"):
+        _run(["--config", "mlp_mnist", "--engine", "graph", "--steps", "1",
+              "--batch-size", "8", "--sp-flash", "off"])
+
+
+def test_cli_sp_one_chip_smoke(devices8):
+    """The 1-chip sp smoke BENCH_NOTES prescribes: an EXPLICIT all-ones
+    mesh (--mesh dp=1,sp=1) must RUN the sp mode on a single visible
+    device (no degrade — it is the kernel/wiring smoke), with --sp-flash
+    working in both positions."""
+    import sys
+
+    from conftest import run_worker_processes
+    base = [sys.executable, "-m", "nezha_tpu.cli.train",
+            "--config", "gpt2_124m", "--model-preset", "tiny",
+            "--parallel", "sp", "--mesh", "dp=1,sp=1",
+            "--platform", "cpu", "--steps", "2", "--batch-size", "4",
+            "--log-every", "1"]
+    results = run_worker_processes([base + ["--sp-flash", "on"],
+                                    base + ["--sp-flash", "off"]])
+    for rc, out, err in results:
+        assert rc == 0, err[-3000:]
+        assert "only 1 device" not in err  # ran sp, not the degrade
+        assert json.loads(out.strip().splitlines()[-1])["final"]["loss"] > 0
+
+
 def test_cli_sp_ulysses(devices8):
     """--attn-impl ulysses: the all-to-all sequence-parallel path from the
     CLI (heads 4 divisible by sp=4)."""
