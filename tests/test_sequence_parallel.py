@@ -28,29 +28,38 @@ def _qkv(b=2, h=4, s=64, d=16, seed=0):
             jax.random.normal(kv, (b, h, s, d)))
 
 
-def _grad_parity(sp_attn_fn, causal, seed=0, h=4):
+def _grad_parity(sp_attn_fn, causal, seed=0, h=4, dtype=None,
+                 rtol=2e-4, atol=2e-5):
     """grad of a weighted-sum loss through the sharded attention must match
-    the dense single-device attention's grad."""
+    the dense single-device attention's grad. ``dtype`` casts the q/k/v
+    inputs (e.g. bf16, with loosened tolerances); the loss accumulates in
+    fp32 either way."""
     mesh = parallel.make_mesh({"sp": 8})
     q, k, v = _qkv(seed=seed, h=h)
-    w = jax.random.normal(jax.random.PRNGKey(9), q.shape)
+    if dtype is not None:
+        q, k, v = (x.astype(dtype) for x in (q, k, v))
+    w = jax.random.normal(jax.random.PRNGKey(9), q.shape, jnp.float32)
 
     mapped = shard_map(sp_attn_fn, mesh=mesh,
                        in_specs=(P(None, None, "sp", None),) * 3,
                        out_specs=P(None, None, "sp", None))
 
     def sp_loss(q, k, v):
-        return jnp.sum(mapped(q, k, v) * w)
+        return jnp.sum(mapped(q, k, v).astype(jnp.float32) * w)
 
     def ref_loss(q, k, v):
         mask = ops.causal_mask(q.shape[2], q.shape[2]) if causal else None
-        return jnp.sum(ops.dot_product_attention(q, k, v, mask=mask) * w)
+        return jnp.sum(
+            ops.dot_product_attention(q, k, v, mask=mask).astype(jnp.float32)
+            * w)
 
     g_sp = jax.jit(jax.grad(sp_loss, argnums=(0, 1, 2)))(q, k, v)
     g_ref = jax.jit(jax.grad(ref_loss, argnums=(0, 1, 2)))(q, k, v)
     for a, b, name in zip(g_sp, g_ref, "qkv"):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=2e-4, atol=2e-5,
+        af = np.asarray(a, np.float32)
+        bf = np.asarray(b, np.float32)
+        assert np.all(np.isfinite(af)), f"d{name} not finite"
+        np.testing.assert_allclose(af, bf, rtol=rtol, atol=atol,
                                    err_msg=f"d{name} mismatch")
 
 
@@ -78,6 +87,15 @@ def test_ring_flash_grad_matches_full(devices8):
             partial(ring_attention, axis_name="sp", causal=causal,
                     use_flash=True),
             causal=causal, seed=1)
+
+
+def test_ring_flash_bf16_trains_finite(devices8):
+    """bf16 inputs through the flash-ring (the training dtype on TPU): the
+    fp32 merge/cast seams must produce finite gradients that track the
+    dense bf16 reference within bf16 tolerance."""
+    _grad_parity(
+        partial(ring_attention, axis_name="sp", causal=True, use_flash=True),
+        causal=True, dtype=jnp.bfloat16, rtol=0.1, atol=0.1)
 
 
 def test_ulysses_flash_branch_grad_matches_full(devices8):
