@@ -1,0 +1,65 @@
+#!/bin/bash
+# Detached TPU-tunnel watchdog (round 4). The axon tunnel comes and goes;
+# round 3 lost its entire measurement set to an outage. This loop probes
+# every ~8 min and, whenever the tunnel answers, runs the next PENDING
+# measurement steps (most valuable first, finest granularity) so even a
+# short window banks real numbers. Each completed step drops a marker in
+# artifacts/wd_done/ so a restart never redoes work.
+#
+# Launch:  nohup bash experiments/chip_watchdog.sh >> artifacts/watchdog.log 2>&1 &
+# Outputs: artifacts/gpt2_tune_r04.jsonl, artifacts/rn50_variants_r04.jsonl,
+#          artifacts/rn50_breakdown_r04.txt, artifacts/sp_smoke_r04.log
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p artifacts/wd_done
+
+probe() {
+  timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1
+}
+
+run_step() {  # $1 marker, $2 timeout_s, rest: command (appends stdout to $3)
+  local name="$1" tmo="$2" out="$3"; shift 3
+  [ -e "artifacts/wd_done/$name" ] && return 0
+  echo "$(date -u +%H:%M:%SZ) step $name START"
+  if timeout "$tmo" "$@" >> "$out" 2>> "artifacts/wd_err_$name.log"; then
+    touch "artifacts/wd_done/$name"
+    echo "$(date -u +%H:%M:%SZ) step $name DONE"
+    return 0
+  fi
+  echo "$(date -u +%H:%M:%SZ) step $name FAILED/TIMEOUT (will retry)"
+  pkill -9 -f "experiments/gpt2_tune.py" 2>/dev/null
+  pkill -9 -f "experiments/rn50_probe.py" 2>/dev/null
+  pkill -9 -f "nezha_tpu.cli.train" 2>/dev/null
+  return 1
+}
+
+all_done() {
+  for s in gpt2_ab rn50_s2d_b256 gpt2_rest rn50_nodonate rn50_probe sp_smoke; do
+    [ -e "artifacts/wd_done/$s" ] || return 1
+  done
+  return 0
+}
+
+while ! all_done; do
+  if probe; then
+    echo "$(date -u +%H:%M:%SZ) tunnel UP"
+    run_step gpt2_ab 1500 artifacts/gpt2_tune_r04.jsonl \
+      python experiments/gpt2_tune.py --variants baseline ln_pallas || continue
+    run_step rn50_s2d_b256 1500 artifacts/rn50_variants_r04.jsonl \
+      python experiments/rn50_probe.py --variants s2d b256 || continue
+    run_step gpt2_rest 1800 artifacts/gpt2_tune_r04.jsonl \
+      python experiments/gpt2_tune.py --variants attn_xla remat no_donate || continue
+    run_step rn50_nodonate 1200 artifacts/rn50_variants_r04.jsonl \
+      python experiments/rn50_probe.py --variants no_donate || continue
+    run_step rn50_probe 1500 artifacts/rn50_breakdown_r04.txt \
+      python experiments/rn50_probe.py --probe || continue
+    run_step sp_smoke 1200 artifacts/sp_smoke_r04.log \
+      python -m nezha_tpu.cli.train --config gpt2_124m --steps 3 \
+        --batch-size 2 --seq-len 512 --parallel sp --mesh dp=1,sp=1 \
+        --sp-flash on --log-every 1 || continue
+  else
+    echo "$(date -u +%H:%M:%SZ) probe failed/hung"
+  fi
+  sleep 480
+done
+echo "$(date -u +%H:%M:%SZ) ALL MEASUREMENT STEPS DONE"

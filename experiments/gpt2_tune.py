@@ -96,6 +96,8 @@ def main() -> int:
         # pattern as tests/conftest.py.
         import jax
         jax.config.update("jax_platforms", "cpu")
+    from nezha_tpu.utils import enable_persistent_compile_cache
+    enable_persistent_compile_cache()
     for v in VARIANTS:
         if args.variants and v["name"] not in args.variants:
             continue

@@ -197,6 +197,8 @@ def main() -> int:
     if args.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
+    from nezha_tpu.utils import enable_persistent_compile_cache
+    enable_persistent_compile_cache()
     global IMAGE_SIZE
     IMAGE_SIZE = args.image_size
     if args.base_batch:
