@@ -34,7 +34,8 @@ run_step() {  # $1 marker, $2 timeout_s, rest: command (appends stdout to $3)
 }
 
 all_done() {
-  for s in gpt2_ab rn50_s2d_b256 gpt2_rest rn50_nodonate rn50_probe sp_smoke; do
+  for s in gpt2_ab rn50_s2d_b256 gpt2_rest rn50_nodonate rn50_probe \
+           rn50_stages sp_smoke longctx; do
     [ -e "artifacts/wd_done/$s" ] || return 1
   done
   return 0
@@ -53,10 +54,17 @@ while ! all_done; do
       python experiments/rn50_probe.py --variants no_donate || continue
     run_step rn50_probe 1500 artifacts/rn50_breakdown_r04.txt \
       python experiments/rn50_probe.py --probe || continue
+    run_step rn50_stages 1500 artifacts/rn50_stages_r04.txt \
+      python experiments/rn50_probe.py --stages || continue
     run_step sp_smoke 1200 artifacts/sp_smoke_r04.log \
       python -m nezha_tpu.cli.train --config gpt2_124m --steps 3 \
         --batch-size 2 --seq-len 512 --parallel sp --mesh dp=1,sp=1 \
         --sp-flash on --log-every 1 || continue
+    # Long-context single-chip: S=8192 with per-block remat + flash attn.
+    # Second window's examples_per_sec excludes compile; x8192 = tokens/s.
+    run_step longctx 1500 artifacts/longctx_r04.log \
+      python -m nezha_tpu.cli.train --config gpt2_124m --steps 24 \
+        --batch-size 1 --seq-len 8192 --remat --log-every 12 || continue
   else
     echo "$(date -u +%H:%M:%SZ) probe failed/hung"
   fi

@@ -177,12 +177,115 @@ def probe() -> None:
     print(f"stem conv fwd+bwd: {dt_s*1e3:.2f} ms")
 
 
+def stages(batch: int = 128) -> None:
+    """Per-stage fwd+bwd time AND HLO bytes-accessed (default B=128, s2d).
+
+    The r3/r4 whole-step numbers say "bandwidth-bound somewhere"; this
+    ranks the four bottleneck stages + stem + head so the traffic work
+    aims at the hungriest stage instead of the whole network.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nezha_tpu import nn, ops
+    from nezha_tpu.models.resnet import resnet50
+    from nezha_tpu.nn.module import run_child
+    from nezha_tpu.tensor import bf16_policy
+
+    B, size = batch, IMAGE_SIZE
+    model = resnet50(stem="s2d", policy=bf16_policy())
+    variables = model.init(jax.random.PRNGKey(0))
+
+    sizes, idx, groups = (3, 4, 6, 3), 0, []
+    for n in sizes:
+        groups.append(list(range(idx, idx + n)))
+        idx += n
+    s4 = size // 4
+    in_shapes = [(B, s4, s4, 64), (B, s4, s4, 256),
+                 (B, s4 // 2, s4 // 2, 512), (B, s4 // 4, s4 // 4, 1024)]
+
+    def timed_grad(f, *args, n=10):
+        """compile f's grad (wrt all args), time it, report ms + HLO GB."""
+        g = jax.jit(jax.grad(f, argnums=tuple(range(len(args)))))
+        compiled = g.lower(*args).compile()
+        gb = None
+        try:
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0]
+            gb = cost.get("bytes accessed", 0) / 1e9
+        except Exception:
+            pass
+        out = compiled(*args)
+        float(jax.tree_util.tree_leaves(out)[0].sum())
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = compiled(*args)
+        float(jax.tree_util.tree_leaves(out)[0].sum())
+        return (time.perf_counter() - t0) / n * 1e3, gb
+
+    rng = np.random.RandomState(0)
+    img = jnp.asarray(rng.rand(B, size, size, 3).astype(np.float32))
+
+    def stem_f(params, x):
+        v = {"params": params, "state": variables["state"]}
+        states: dict = {}
+        from nezha_tpu.models.resnet import _space_to_depth_stem
+        pol = model.stem_conv.policy
+        y = _space_to_depth_stem(pol.cast_to_compute(x),
+                                 pol.cast_to_compute(params["stem_conv"]["w"]))
+        y = run_child(model.stem_bn, "stem_bn", v, states, y, training=True)
+        y = jnp.maximum(y, 0)
+        return jnp.sum(jnp.asarray(nn.max_pool(y, 3, 2, "SAME"), jnp.float32))
+
+    ms, gb = timed_grad(stem_f, variables["params"], img)
+    print(f"stem(s2d)+bn+pool : {ms:7.2f} ms  {gb and f'{gb:6.1f} GB'}")
+
+    for s, g in enumerate(groups):
+        x = jnp.asarray(rng.rand(*in_shapes[s]).astype(np.float32),
+                        jnp.bfloat16)
+
+        def stage_f(params, xin, _g=tuple(g)):
+            v = {"params": params, "state": variables["state"]}
+            states: dict = {}
+            out = xin
+            for i in _g:
+                out = run_child(model.blocks[i], f"blocks{i}", v, states,
+                                out, training=True)
+            return jnp.sum(jnp.asarray(out, jnp.float32))
+
+        ms, gb = timed_grad(stage_f, variables["params"], x)
+        print(f"stage{s + 1} ({len(g)} blocks) : {ms:7.2f} ms  "
+              f"{gb and f'{gb:6.1f} GB'}")
+
+    xh = jnp.asarray(
+        rng.rand(B, s4 // 8, s4 // 8, 2048).astype(np.float32),
+        jnp.bfloat16)
+    lbl = jnp.asarray(rng.randint(0, 1000, B), jnp.int32)
+
+    def head_f(params, xin):
+        v = {"params": params, "state": variables["state"]}
+        states: dict = {}
+        pooled = nn.global_avg_pool(xin)
+        logits = run_child(model.head, "head", v, states, pooled,
+                           training=True)
+        return ops.softmax_cross_entropy_with_integer_labels(
+            jnp.asarray(logits, jnp.float32), lbl).mean()
+
+    ms, gb = timed_grad(head_f, variables["params"], xh)
+    print(f"pool+head+CE      : {ms:7.2f} ms  {gb and f'{gb:6.1f} GB'}")
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--probe", action="store_true",
                     help="run the step-breakdown probe instead of the "
                          "variant matrix")
+    ap.add_argument("--stages", action="store_true",
+                    help="per-stage fwd+bwd time + HLO bytes (traffic "
+                         "ranking)")
     ap.add_argument("--variants", nargs="+", default=None,
                     choices=[v["name"] for v in VARIANTS])
     ap.add_argument("--image-size", type=int, default=224,
@@ -206,6 +309,9 @@ def main() -> int:
             v["batch"] = args.base_batch
     if args.probe:
         probe()
+        return 0
+    if args.stages:
+        stages(batch=args.base_batch or 128)
         return 0
     for v in VARIANTS:
         if args.variants and v["name"] not in args.variants:
