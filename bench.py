@@ -188,7 +188,10 @@ def bench_bert(on_tpu: bool, peak):
 
     batch, seq = (16, 512) if on_tpu else (2, 64)
     steps_target = 10 if on_tpu else 2
-    cfg = BertConfig() if on_tpu else BertConfig(num_layers=2)
+    # fused_loss_chunk=-1: never materializes the fp32 [16,512,30522]
+    # logits (~1 GB/step) — same fused-logsumexp head as GPT-2.
+    cfg = (BertConfig(fused_loss_chunk=-1) if on_tpu
+           else BertConfig(num_layers=2))
 
     model = Bert(cfg, policy=bf16_policy())
     opt = optim.adamw(1e-4, weight_decay=0.01)

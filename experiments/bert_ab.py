@@ -1,0 +1,82 @@
+"""BERT MLM head A/B on the real chip: fp32 dense logits vs the fused
+bf16-logsumexp head (BertConfig.fused_loss_chunk=-1).
+
+The fp32 [16,512,30522] logit tensor is ~1 GB written+read per step at the
+bench geometry; GPT-2's identical fusion measured +3%. One JSON line per
+variant (median-of-3 windows), same timing discipline as bench.py.
+
+Usage: python experiments/bert_ab.py [--steps 10] [--tiny]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure(fused: bool, steps: int, tiny: bool) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nezha_tpu import optim
+    from nezha_tpu.models.bert import Bert, BertConfig, mlm_loss
+    from nezha_tpu.tensor import bf16_policy
+    from nezha_tpu.train.loop import init_train_state, make_train_step
+
+    batch, seq = (2, 64) if tiny else (16, 512)
+    kw = dict(num_layers=2) if tiny else {}
+    cfg = BertConfig(fused_loss_chunk=-1 if fused else 0, **kw)
+    model = Bert(cfg, policy=bf16_policy())
+    opt = optim.adamw(1e-4, weight_decay=0.01)
+    state = init_train_state(model, opt, jax.random.PRNGKey(0))
+    step = make_train_step(model, opt, mlm_loss)
+
+    r = np.random.RandomState(0)
+    tokens = r.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    labels = np.full_like(tokens, -100)
+    mask = r.rand(batch, seq) < 0.15
+    labels[mask] = tokens[mask]
+    b = {"tokens": jnp.asarray(tokens), "labels": jnp.asarray(labels),
+         "segment_ids": jnp.zeros_like(jnp.asarray(tokens)),
+         "padding_mask": jnp.ones((batch, seq), bool)}
+
+    compiled = step.lower(state, b).compile()
+    state, m = compiled(state, b)
+    state, m = compiled(state, b)
+    float(m["loss"])
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = compiled(state, b)
+        float(m["loss"])
+        rates.append(steps / (time.perf_counter() - t0))
+    rates.sort()
+    return {"variant": "fused" if fused else "dense_fp32",
+            "tokens_per_sec": round(batch * seq * rates[1], 1),
+            "loss": float(m["loss"]),
+            "spread": round((rates[-1] - rates[0]) / rates[1], 4)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--tiny", action="store_true",
+                    help="CPU harness smoke (numbers meaningless)")
+    args = ap.parse_args()
+    if args.tiny:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    from nezha_tpu.utils import enable_persistent_compile_cache
+    enable_persistent_compile_cache()
+    for fused in (False, True):
+        print(json.dumps(measure(fused, args.steps, args.tiny)), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,14 +28,15 @@ run_step() {  # $1 marker, $2 timeout_s, rest: command (appends stdout to $3)
   fi
   echo "$(date -u +%H:%M:%SZ) step $name FAILED/TIMEOUT (will retry)"
   pkill -9 -f "experiments/gpt2_tune.py" 2>/dev/null
+  pkill -9 -f "experiments/bert_ab.py" 2>/dev/null
   pkill -9 -f "experiments/rn50_probe.py" 2>/dev/null
   pkill -9 -f "nezha_tpu.cli.train" 2>/dev/null
   return 1
 }
 
 all_done() {
-  for s in gpt2_ab rn50_s2d_b256 gpt2_rest rn50_nodonate rn50_probe \
-           rn50_stages sp_smoke longctx; do
+  for s in gpt2_ab bert_ab rn50_s2d_b256 gpt2_rest rn50_nodonate \
+           rn50_probe rn50_stages sp_smoke longctx; do
     [ -e "artifacts/wd_done/$s" ] || return 1
   done
   return 0
@@ -46,6 +47,8 @@ while ! all_done; do
     echo "$(date -u +%H:%M:%SZ) tunnel UP"
     run_step gpt2_ab 1500 artifacts/gpt2_tune_r04.jsonl \
       python experiments/gpt2_tune.py --variants baseline ln_pallas || continue
+    run_step bert_ab 1500 artifacts/bert_ab_r04.jsonl \
+      python experiments/bert_ab.py || continue
     run_step rn50_s2d_b256 1500 artifacts/rn50_variants_r04.jsonl \
       python experiments/rn50_probe.py --variants s2d b256 || continue
     run_step gpt2_rest 1800 artifacts/gpt2_tune_r04.jsonl \

@@ -161,7 +161,9 @@ def _configs() -> Dict[str, Config]:
                                                          **ov),
             graph_opt={"schedule": gpt2_sched, "weight_decay": 0.1}),
         "bert_base_zero1": Config(
-            build_model=lambda: models.bert_base(),
+            # fused_loss_chunk=-1: bf16 MLM logits with the fp32 upcast
+            # fused into logsumexp (same default as gpt2_124m's head).
+            build_model=lambda: models.bert_base(fused_loss_chunk=-1),
             loss_fn=bert_mod.mlm_loss,
             batches=lambda bs: data.synthetic_mlm_batches(bs, seq_len=512),
             build_optimizer=lambda steps: optim.adamw(

@@ -32,6 +32,12 @@ class BertConfig:
     # Published BERT checkpoints use 1e-12 (HF layer_norm_eps); kept in the
     # config so converted weights reproduce the torch reference exactly.
     ln_eps: float = 1e-12
+    # Same protocol as GPT2Config.fused_loss_chunk: 0 -> dense fp32 logits
+    # returned from apply(); -1 -> defer the tied decoder to the loss so the
+    # CE keeps bf16 logits with the fp32 upcast fused into logsumexp (never
+    # materializes fp32 [B,S,30522] — ~1 GB/step at B=16 S=512); >0 ->
+    # sequence-chunked scan. Training-only; eval/convert paths get logits.
+    fused_loss_chunk: int = 0
 
 
 class EncoderLayer(Module):
@@ -140,6 +146,15 @@ class Bert(Module):
         y = ops.gelu(y, approximate=False)  # original BERT uses erf GELU
         y = run_child(self.mlm_ln, "mlm_ln", variables, states, y,
                       training=training)
+        if self.cfg.fused_loss_chunk and training:
+            # Defer the tied decoder to the loss (mlm_loss ->
+            # ops.lm_ce_from_fused): bf16 logits with the fp32 upcast fused
+            # into logsumexp, or a chunked scan — the fp32 [B,S,V] tensor is
+            # never written to HBM. Same protocol as GPT-2's fused head.
+            wte = child_vars(variables, "tok_emb")["params"]["embedding"]
+            return {"hidden": y, "wte": wte,
+                    "bias": variables["params"]["mlm_bias"],
+                    "chunk": self.cfg.fused_loss_chunk}, states
         logits = self.tok_emb.attend(child_vars(variables, "tok_emb"), y)
         logits = logits + self.policy.cast_to_compute(
             variables["params"]["mlm_bias"])
@@ -151,6 +166,10 @@ def bert_base(policy: Policy | None = None, **overrides) -> Bert:
     return Bert(cfg, policy=policy or bf16_policy())
 
 
-def mlm_loss(logits, batch):
+def mlm_loss(out, batch):
+    """MLM CE over the 15% corrupted positions (labels == -100 elsewhere).
+    Accepts dense logits or the fused-head dict (BertConfig.fused_loss_chunk)."""
+    if isinstance(out, dict):
+        return ops.lm_ce_from_fused(out, batch["labels"], ignore_index=-100)
     return ops.softmax_cross_entropy_with_integer_labels(
-        logits, batch["labels"], ignore_index=-100)
+        out, batch["labels"], ignore_index=-100)
