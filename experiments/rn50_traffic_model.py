@@ -1,0 +1,73 @@
+"""Analytic HBM-traffic floor for the RN50 train step (B=128, 224px, bf16).
+
+Pairs with `rn50_probe.py --stages` (measured per-stage ms + HLO
+bytes-accessed): the ratio measured/analytic per stage says where XLA's
+lowering spends bandwidth above the model's own needs (e.g. conv-backward
+transpose materialization), and how much of the step is irreducible at
+this geometry. Pure arithmetic — runs anywhere, no jax needed.
+
+Model of one training step per tensor X of a conv/BN/relu chain:
+  fwd:  conv writes X once; BN reads X for stats, reads X again for
+        normalize, writes Y; relu/residual fuse into the BN write.
+  bwd:  dX chain: read dY, read saved activations (conv input for dW and
+        dX, BN input for its backward), write dX. Counted as: each saved
+        activation read twice (dW + dX paths), each gradient tensor
+        written once and read once.
+Weights + optimizer: momentum fp32 (25.6M params): read w, read m, write
+both, plus bf16 cast write/read per step.
+"""
+
+B = 128
+BPE = 2  # bf16
+
+# (H, W, C_out) of every conv output in RN50 at 224px input, s2d stem.
+# Bottleneck stage s: [1x1 C, 3x3 C, 1x1 4C] x blocks, C = 64*2^s.
+def stage_tensors():
+    stages = []
+    # stem: s2d conv output 112x112x64, maxpool out 56x56x64
+    stages.append(("stem", [(112, 112, 64), (56, 56, 64)]))
+    sizes = {0: (56, 3), 1: (28, 4), 2: (14, 6), 3: (7, 3)}
+    for s, (hw, blocks) in sizes.items():
+        c = 64 * (2 ** s)
+        t = []
+        for b in range(blocks):
+            # downsample conv in block 0 of stages 1-3 runs at the OUT res
+            t += [(hw, hw, c), (hw, hw, c), (hw, hw, 4 * c)]
+            if b == 0:
+                t += [(hw, hw, 4 * c)]  # projection shortcut
+        stages.append((f"stage{s + 1}", t))
+    return stages
+
+
+def gb(n):
+    return n * B * BPE / 1e9
+
+
+def main():
+    total = 0.0
+    print(f"analytic HBM floor, B={B} bf16 (GB/step)")
+    print(f"{'stage':8} {'fwd_write':>9} {'fwd_read':>8} {'bwd':>8} "
+          f"{'total':>8}")
+    for name, tensors in stage_tensors():
+        elems = sum(h * w * c for h, w, c in tensors)
+        fwd_w = gb(elems)            # conv/BN outputs written once
+        fwd_r = gb(elems) * 2        # BN stats + normalize reads
+        # bwd: read dY once + saved acts twice (dW, dX), write dX once
+        bwd = gb(elems) * 4
+        t = fwd_w + fwd_r + bwd
+        total += t
+        print(f"{name:8} {fwd_w:9.2f} {fwd_r:8.2f} {bwd:8.2f} {t:8.2f}")
+    # params: 25.6M; momentum fp32: read w,m + write w,m (4B each) + bf16
+    # compute copy write+read
+    p = 25.6e6
+    opt = (4 * p * 4 + 2 * p * 2) / 1e9
+    total += opt
+    print(f"{'opt/w':8} {'':9} {'':8} {'':8} {opt:8.2f}")
+    print(f"{'TOTAL':8} {'':9} {'':8} {'':8} {total:8.2f}")
+    print()
+    print("vs v5e HBM ~819 GB/s:", f"{total / 819 * 1e3:.1f} ms/step floor",
+          f"= {B / (total / 819):.0f} img/s ceiling (bandwidth-only)")
+
+
+if __name__ == "__main__":
+    main()
