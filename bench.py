@@ -63,16 +63,18 @@ def _peak_flops(platform: str):
 
 
 def _time_steps(step, state, batch, steps_target: int, budget_s: float,
-                windows: int = 3):
+                windows: int = 5):
     """Warm up, then time ``windows`` independent windows of
     ``steps_target`` steps each (host-fetch barrier per window) and return
     (median steps/sec, relative spread).
 
-    Median-of-3 so the regression tracker can see single-digit-percent
+    Median-of-N so the regression tracker can see single-digit-percent
     moves through host jitter (VERDICT r2 weak #1: one window hid a 7%
-    RN50 regression inside an assumed ±8% noise band). On the tunneled
-    `axon` platform block_until_ready can return before the computation
-    finishes — only a host fetch is a true barrier there.
+    RN50 regression inside an assumed ±8% noise band; the r4 GPT-2 run
+    saw one-window excursions of 15% through tunnel jitter — windows are
+    ~seconds, compile dominates, so five are as cheap as three). On the
+    tunneled `axon` platform block_until_ready can return before the
+    computation finishes — only a host fetch is a true barrier there.
     """
     for _ in range(2):
         state, m = step(state, batch)
@@ -273,6 +275,13 @@ def bench_mlp(on_tpu: bool):
 
 def main() -> int:
     import jax
+
+    if os.environ.get("NEZHA_BENCH_CPU"):
+        # Harness smoke during TPU-tunnel outages: the ambient axon plugin
+        # hangs in backend init when the tunnel is down, and JAX_PLATFORMS
+        # alone cannot override the site hook (same pattern as
+        # tests/conftest.py and gpt2_tune --tiny). Numbers are meaningless.
+        jax.config.update("jax_platforms", "cpu")
 
     # Persistent compile cache (same-machine): repeat bench sessions reuse
     # executables instead of paying the 20-40 s first-compile per config.
