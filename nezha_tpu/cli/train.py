@@ -492,6 +492,24 @@ def run(args) -> Dict[str, float]:
                              f"{args.dropout}")
         _wrap_model_overrides(cfg, dropout=args.dropout)
 
+    if args.label_smoothing:
+        # Standard ImageNet recipe: train against (1-eps)*one_hot + eps/V.
+        if args.config not in ("mlp_mnist",) + _IMAGE_CONFIGS:
+            raise SystemExit("--label-smoothing applies to the integer-"
+                             "label CE configs (mlp_mnist, "
+                             + ", ".join(_IMAGE_CONFIGS) + ")")
+        if args.engine == "graph":
+            raise SystemExit("the graph engine's programs author the plain "
+                             "CE; drop --engine graph")
+        if not 0.0 < args.label_smoothing < 1.0:
+            raise SystemExit(f"--label-smoothing must be in (0, 1), got "
+                             f"{args.label_smoothing}")
+        from nezha_tpu import ops
+        eps = args.label_smoothing
+        cfg.loss_fn = lambda logits, b: \
+            ops.softmax_cross_entropy_with_integer_labels(
+                logits, b["label"], label_smoothing=eps)
+
     if args.remat:
         # Block rematerialization: the long-context/big-batch memory knob
         # (jax.checkpoint per transformer block; see GPT2Config.remat).
@@ -1130,6 +1148,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="gpt2_124m only: dropout rate override (works in "
                         "every parallel mode incl. pp, where per-(layer, "
                         "microbatch) keys thread through the schedule)")
+    p.add_argument("--label-smoothing", type=float, default=None,
+                   help="integer-label CE configs (mlp/resnet/wrn): train "
+                        "against (1-eps)*one_hot + eps/num_classes")
     p.add_argument("--remat", action="store_true",
                    help="gpt2_124m only: rematerialize each block in "
                         "backward (jax.checkpoint) — O(1) activation "

@@ -16,14 +16,23 @@ def cross_entropy_with_logits(logits, labels_onehot):
     return -jnp.mean(jnp.sum(labels_onehot * logp, axis=-1))
 
 
-def softmax_cross_entropy_with_integer_labels(logits, labels, ignore_index: int | None = None):
+def softmax_cross_entropy_with_integer_labels(logits, labels,
+                                              ignore_index: int | None = None,
+                                              label_smoothing: float = 0.0):
     """Mean CE over integer labels; positions equal to ``ignore_index`` are
-    masked out (BERT MLM uses this for unmasked positions)."""
+    masked out (BERT MLM uses this for unmasked positions).
+
+    ``label_smoothing=eps`` trains against ``(1-eps)*one_hot + eps/V``
+    (the standard ImageNet recipe) — computed as a blend of the picked
+    log-prob and the mean log-prob, so no [.., V] target tensor is built."""
     logits = jnp.asarray(logits, jnp.float32)
     logp = log_softmax(logits)
     safe_labels = jnp.where(labels == (ignore_index if ignore_index is not None else -1),
                             0, labels)
     picked = jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    if label_smoothing:
+        eps = label_smoothing
+        picked = (1.0 - eps) * picked + eps * jnp.mean(logp, axis=-1)
     if ignore_index is None:
         return -jnp.mean(picked)
     mask = (labels != ignore_index).astype(jnp.float32)

@@ -62,6 +62,19 @@ def test_cli_dp_int8_allreduce(devices8, capsys):
               "--mesh", "dp=4,sp=2", "--grad-allreduce", "int8"])
 
 
+def test_cli_label_smoothing():
+    """--label-smoothing trains the CE configs; non-CE configs reject."""
+    import pytest
+    metrics = _run(["--config", "mlp_mnist", "--steps", "4",
+                    "--batch-size", "64", "--label-smoothing", "0.1",
+                    "--log-every", "2"])
+    assert np.isfinite(metrics["loss"])
+    with pytest.raises(SystemExit, match="label-smoothing"):
+        _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--steps", "1", "--batch-size", "4",
+              "--label-smoothing", "0.1"])
+
+
 def test_mesh_parsing():
     from nezha_tpu.cli.train import _parse_mesh
     assert _parse_mesh("dp=4,sp=2") == {"dp": 4, "sp": 2}

@@ -124,6 +124,24 @@ def test_masked_ce_ignore_index():
     np.testing.assert_allclose(float(loss), np.log(5), rtol=1e-5)
 
 
+def test_label_smoothing_matches_soft_target_ce():
+    """label_smoothing=eps == CE against (1-eps)*one_hot + eps/V."""
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(4, 7), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, 7, 4), jnp.int32)
+    eps = 0.1
+    smoothed = ops.softmax_cross_entropy_with_integer_labels(
+        logits, labels, label_smoothing=eps)
+    soft = (1 - eps) * jax.nn.one_hot(labels, 7) + eps / 7
+    ref = ops.cross_entropy_with_logits(logits, soft)
+    np.testing.assert_allclose(float(smoothed), float(ref), rtol=1e-6)
+    # eps=0 is exactly the plain CE
+    base = ops.softmax_cross_entropy_with_integer_labels(logits, labels)
+    zero = ops.softmax_cross_entropy_with_integer_labels(
+        logits, labels, label_smoothing=0.0)
+    np.testing.assert_allclose(float(zero), float(base), rtol=0)
+
+
 def test_causal_mask_and_attention():
     q = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 8, 16))
     out = ops.dot_product_attention(q, q, q, mask=ops.causal_mask(8, 8))
