@@ -52,6 +52,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--temperature", type=float, default=1.0,
                    help="0 = greedy argmax")
     p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--top-p", type=float, default=None,
+                   help="nucleus sampling: keep the smallest prefix of "
+                        "descending-prob tokens with mass >= p")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. cpu)")
@@ -136,6 +139,7 @@ def run(args) -> dict:
     out = generate(model, variables, prompt,
                    max_new_tokens=args.max_new_tokens,
                    temperature=args.temperature, top_k=args.top_k,
+                   top_p=args.top_p,
                    rng=jax.random.PRNGKey(args.seed))
     new_tokens = np.asarray(out)[0, prompt.shape[1]:].tolist()
     result = {"prompt_len": int(prompt.shape[1]), "tokens": new_tokens}

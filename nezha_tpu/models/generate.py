@@ -4,7 +4,7 @@ One compiled prefill (whole prompt writes layer caches) + one compiled
 decode step reused for every generated token (`lax.scan`, static shapes,
 traced position scalar) — the XLA-friendly decode loop: no per-token
 recompilation, no growing shapes, cache updates via dynamic_update_slice.
-Sampling: greedy, temperature, and top-k.
+Sampling: greedy, temperature, top-k, and top-p (nucleus).
 """
 
 from __future__ import annotations
@@ -34,14 +34,27 @@ def _caches_from_states(model: GPT2, states: dict, prev: list) -> list:
             for i in range(model.cfg.num_layers)]
 
 
-def _sample(logits, rng, temperature: float, top_k: Optional[int]):
-    """logits [B, V] -> token ids [B]."""
+def _sample(logits, rng, temperature: float, top_k: Optional[int],
+            top_p: Optional[float] = None):
+    """logits [B, V] -> token ids [B]. top-k truncation applies before
+    top-p nucleus filtering (HF convention when both are set)."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / jnp.maximum(temperature, 1e-6)
     if top_k is not None:
         kth = lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        # Nucleus: keep the smallest prefix of descending-prob tokens whose
+        # mass reaches top_p. Exclusive cumsum so the first token always
+        # survives (top_p -> 0 degrades to argmax, never to an empty set).
+        sorted_logits = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        exclusive_cum = jnp.cumsum(probs, axis=-1) - probs
+        keep = exclusive_cum < top_p
+        threshold = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+        logits = jnp.where(logits < threshold, -jnp.inf, logits)
     return jax.random.categorical(rng, logits).astype(jnp.int32)
 
 
@@ -62,13 +75,13 @@ def _prefill_fn(model: GPT2):
 
 @functools.lru_cache(maxsize=64)
 def _decode_fn(model: GPT2, temperature: float, top_k: Optional[int],
-               max_new_tokens: int):
+               top_p: Optional[float], max_new_tokens: int):
     @jax.jit
     def decode(variables, last_logits, cache, pos0, rng):
         def step(carry, _):
             logits, cache, pos, rng = carry
             rng, sub = jax.random.split(rng)
-            tok = _sample(logits, sub, temperature, top_k)
+            tok = _sample(logits, sub, temperature, top_k, top_p)
             out, states = model.apply(variables, tok[:, None],
                                       training=False, cache=cache, pos=pos)
             new_cache = _caches_from_states(model, states, cache)
@@ -81,7 +94,7 @@ def _decode_fn(model: GPT2, temperature: float, top_k: Optional[int],
         (logits, _, _, rng), tokens = lax.scan(
             step, init, None, length=max_new_tokens - 1)
         _, sub = jax.random.split(rng)
-        final = _sample(logits, sub, temperature, top_k)
+        final = _sample(logits, sub, temperature, top_k, top_p)
         tokens = jnp.concatenate([tokens, final[None, :]], axis=0)
         return tokens.T  # [steps, B] -> [B, steps]
 
@@ -91,12 +104,13 @@ def _decode_fn(model: GPT2, temperature: float, top_k: Optional[int],
 def generate(model: GPT2, variables: dict, prompt: jax.Array,
              max_new_tokens: int, temperature: float = 0.0,
              top_k: Optional[int] = None,
+             top_p: Optional[float] = None,
              rng: Optional[jax.Array] = None,
              cache_dtype=jnp.bfloat16) -> jax.Array:
     """Generate ``[B, prompt_len + max_new_tokens]`` token ids.
 
     ``temperature=0`` is greedy decoding; otherwise categorical sampling
-    (optionally top-k truncated). Compiles exactly two programs per
+    (optionally top-k truncated and/or top-p nucleus-filtered). Compiles exactly two programs per
     (model, sampling config, shapes) — prefill and the scanned
     single-token step — reused across calls.
     """
@@ -112,6 +126,7 @@ def generate(model: GPT2, variables: dict, prompt: jax.Array,
 
     cache = init_cache(model, b, max_len, cache_dtype)
     last_logits, cache = _prefill_fn(model)(variables, prompt, cache)
-    new_tokens = _decode_fn(model, temperature, top_k, max_new_tokens)(
+    new_tokens = _decode_fn(model, temperature, top_k, top_p,
+                            max_new_tokens)(
         variables, last_logits, cache, jnp.int32(s), rng)
     return jnp.concatenate([prompt, new_tokens], axis=1)

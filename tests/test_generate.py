@@ -76,3 +76,36 @@ def test_generate_respects_max_positions(model_and_vars):
     prompt = np.zeros((1, 60), np.int32)
     with pytest.raises(ValueError, match="max_positions"):
         generate(model, variables, prompt, max_new_tokens=10)
+
+
+def test_top_p_nucleus_filtering():
+    """Sampled ids stay inside the nucleus; tiny top_p degrades to argmax
+    (the first token always survives the exclusive-cumsum mask)."""
+    from nezha_tpu.models.generate import _sample
+    # probs ~ [0.62, 0.23, 0.084, 0.031, ...]: nucleus(0.5) = {0}
+    logits = jnp.asarray([[5.0, 4.0, 3.0, 2.0, 1.0]], jnp.float32)
+    for i in range(20):
+        tok = _sample(logits, jax.random.PRNGKey(i), 1.0, None, 0.5)
+        assert int(tok[0]) == 0
+    # nucleus(0.9) = {0, 1, 2}; over many draws nothing outside appears
+    seen = {int(_sample(logits, jax.random.PRNGKey(i), 1.0, None, 0.9)[0])
+            for i in range(200)}
+    assert seen <= {0, 1, 2} and len(seen) > 1
+    # top_p=1.0 is a no-op: identical draw to the unfiltered path
+    for i in range(5):
+        a = _sample(logits, jax.random.PRNGKey(i), 1.0, None, 1.0)
+        b = _sample(logits, jax.random.PRNGKey(i), 1.0, None, None)
+        assert int(a[0]) == int(b[0])
+
+
+def test_generate_with_top_p(model_and_vars):
+    model, variables = model_and_vars
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    out = generate(model, variables, prompt, max_new_tokens=6,
+                   temperature=0.8, top_k=None, top_p=0.9,
+                   rng=jax.random.PRNGKey(0))
+    assert out.shape == (1, 10)
+    out2 = generate(model, variables, prompt, max_new_tokens=6,
+                    temperature=0.8, top_k=None, top_p=0.9,
+                    rng=jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
