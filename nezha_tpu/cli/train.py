@@ -153,12 +153,12 @@ def _configs() -> Dict[str, Config]:
                   "batches": tiny_tokens,
                   "eval_batches": lambda bs, seq_len=64: itertools.islice(
                       tiny_tokens(bs, seed=1, seq_len=seq_len), 4),
-                  "sp_model": lambda impl, **ov: tiny_gpt2(attn_impl=impl,
-                                                           **ov)},
+                  "sp_model": lambda impl, **ov: tiny_gpt2(
+                      attn_impl=impl, fused_loss_chunk=-1, **ov)},
             tp_rules=GPT2_TP_RULES,
             pipeline_spec=pp_mod.gpt2_pipeline_spec,
-            sp_model=lambda impl, **ov: models.gpt2_124m(attn_impl=impl,
-                                                         **ov),
+            sp_model=lambda impl, **ov: models.gpt2_124m(
+                attn_impl=impl, fused_loss_chunk=-1, **ov),
             graph_opt={"schedule": gpt2_sched, "weight_decay": 0.1}),
         "bert_base_zero1": Config(
             # fused_loss_chunk=-1: bf16 MLM logits with the fp32 upcast
