@@ -320,6 +320,13 @@ def gpt2_pipeline_spec(model) -> PipelineSpec:
     def head_fn(outer, x):
         variables = {"params": outer, "state": {}}
         x, _ = model.ln_f.apply(child_vars(variables, "ln_f"), x)
+        if cfg.fused_loss_chunk:
+            # Same fused-head protocol as GPT2.apply: the loss (lm_loss ->
+            # lm_objective) computes bf16 logits with the fp32 upcast fused
+            # into logsumexp — the pipeline otherwise materializes the full
+            # fp32 [B,S,V] on the last stage's exit.
+            wte = child_vars(variables, "wte")["params"]["embedding"]
+            return {"hidden": x, "wte": wte, "chunk": cfg.fused_loss_chunk}
         logits = model.wte.attend(child_vars(variables, "wte"), x)
         return jnp.asarray(logits, jnp.float32)
 

@@ -88,6 +88,34 @@ def test_pipeline_train_step_matches_single(devices8):
                                    rtol=5e-4, atol=5e-4, err_msg=str(ka))
 
 
+def test_pipeline_fused_head_matches_single(devices8):
+    """A fused_loss_chunk model pipelines through the dict-output head_fn
+    (the last stage never materializes fp32 [B,S,V]) and matches plain
+    single-device fused training."""
+    model = GPT2(GPT2Config(vocab_size=64, max_positions=16, num_layers=4,
+                            num_heads=2, hidden_size=32,
+                            fused_loss_chunk=-1))
+    opt = optim.adamw(1e-3)
+    rng = jax.random.PRNGKey(0)
+
+    ref_state = init_train_state(model, opt, rng)
+    ref_step = make_train_step(model, opt, lm_loss, donate=False)
+
+    mesh = parallel.make_mesh({"dp": 2, "pp": 4})
+    spec = pp.gpt2_pipeline_spec(model)
+    variables = model.init(rng)
+    pstate = pp.init_pipeline_state(variables, spec, opt, mesh, rng)
+    pstep = pp.make_pipeline_train_step(spec, opt, lm_loss, mesh,
+                                        num_microbatches=4, donate=False)
+
+    for i in range(3):
+        batch = _batch(seed=i)
+        ref_state, ref_m = ref_step(ref_state, batch)
+        pstate, pm = pstep(pstate, batch)
+        np.testing.assert_allclose(float(pm["loss"]), float(ref_m["loss"]),
+                                   rtol=1e-4, atol=1e-4)
+
+
 def test_pipeline_dropout_rng_plumbing_is_identity_at_rate_zero(devices8):
     """dropout_rng=True threads keys through embed + every (layer,
     microbatch) application; with rate 0 the masks are identity, so the
