@@ -205,9 +205,10 @@ def bench_bert(on_tpu: bool, peak):
     labels = np.full_like(tokens, -100)
     mask = r.rand(batch, seq) < 0.15
     labels[mask] = tokens[mask]
+    # No padding_mask: full-length batches; its all-True mask would force
+    # composed-XLA attention off the flash path (BertConfig.attn_impl).
     b = {"tokens": jnp.asarray(tokens), "labels": jnp.asarray(labels),
-         "segment_ids": jnp.zeros_like(jnp.asarray(tokens)),
-         "padding_mask": jnp.ones((batch, seq), bool)}
+         "segment_ids": jnp.zeros_like(jnp.asarray(tokens))}
 
     step, _ = _aot_compile(step, state, b)
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(

@@ -1,9 +1,12 @@
-"""BERT MLM head A/B on the real chip: fp32 dense logits vs the fused
-bf16-logsumexp head (BertConfig.fused_loss_chunk=-1).
+"""BERT A/B on the real chip: (1) fp32 dense logits vs the fused
+bf16-logsumexp head (BertConfig.fused_loss_chunk=-1), (2) composed XLA
+attention vs the non-causal Pallas flash kernel (BertConfig.attn_impl).
 
 The fp32 [16,512,30522] logit tensor is ~1 GB written+read per step at the
-bench geometry; GPT-2's identical fusion measured +3%. One JSON line per
-variant (median-of-3 windows), same timing discipline as bench.py.
+bench geometry (GPT-2's identical fusion measured +3%); the S=512
+bidirectional score tensors are ~100 MB/layer/direction (GPT-2's flash
+measured +17% e2e at S=1024 causal). One JSON line per variant
+(median-of-3 windows), same timing discipline as bench.py.
 
 Usage: python experiments/bert_ab.py [--steps 10] [--tiny]
 """
@@ -17,7 +20,19 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def measure(fused: bool, steps: int, tiny: bool) -> dict:
+VARIANTS = [
+    # r2-r4 bench configuration (the 117.5k tok/s morning-of-r4 number)
+    {"name": "dense_fp32", "cfg": {"fused_loss_chunk": 0,
+                                   "attn_impl": "xla"}},
+    # fused bf16-logit CE alone
+    {"name": "fused", "cfg": {"fused_loss_chunk": -1, "attn_impl": "xla"}},
+    # + non-causal flash attention (the new TPU default)
+    {"name": "fused_flash", "cfg": {"fused_loss_chunk": -1,
+                                    "attn_impl": "flash"}},
+]
+
+
+def measure(variant: dict, steps: int, tiny: bool) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -29,7 +44,7 @@ def measure(fused: bool, steps: int, tiny: bool) -> dict:
 
     batch, seq = (2, 64) if tiny else (16, 512)
     kw = dict(num_layers=2) if tiny else {}
-    cfg = BertConfig(fused_loss_chunk=-1 if fused else 0, **kw)
+    cfg = BertConfig(**variant["cfg"], **kw)
     model = Bert(cfg, policy=bf16_policy())
     opt = optim.adamw(1e-4, weight_decay=0.01)
     state = init_train_state(model, opt, jax.random.PRNGKey(0))
@@ -40,9 +55,10 @@ def measure(fused: bool, steps: int, tiny: bool) -> dict:
     labels = np.full_like(tokens, -100)
     mask = r.rand(batch, seq) < 0.15
     labels[mask] = tokens[mask]
+    # No padding_mask: full-length batches; its all-True mask would force
+    # composed-XLA attention off the flash path (BertConfig.attn_impl).
     b = {"tokens": jnp.asarray(tokens), "labels": jnp.asarray(labels),
-         "segment_ids": jnp.zeros_like(jnp.asarray(tokens)),
-         "padding_mask": jnp.ones((batch, seq), bool)}
+         "segment_ids": jnp.zeros_like(jnp.asarray(tokens))}
 
     compiled = step.lower(state, b).compile()
     state, m = compiled(state, b)
@@ -56,7 +72,7 @@ def measure(fused: bool, steps: int, tiny: bool) -> dict:
         float(m["loss"])
         rates.append(steps / (time.perf_counter() - t0))
     rates.sort()
-    return {"variant": "fused" if fused else "dense_fp32",
+    return {"variant": variant["name"],
             "tokens_per_sec": round(batch * seq * rates[1], 1),
             "loss": float(m["loss"]),
             "spread": round((rates[-1] - rates[0]) / rates[1], 4)}
@@ -73,8 +89,8 @@ def main() -> int:
         jax.config.update("jax_platforms", "cpu")
     from nezha_tpu.utils import enable_persistent_compile_cache
     enable_persistent_compile_cache()
-    for fused in (False, True):
-        print(json.dumps(measure(fused, args.steps, args.tiny)), flush=True)
+    for v in VARIANTS:
+        print(json.dumps(measure(v, args.steps, args.tiny)), flush=True)
     return 0
 
 

@@ -64,11 +64,14 @@ def synthetic_mlm_batches(batch_size: int, seq_len: int = 512,
         labels[mask] = tokens[mask]
         tokens = tokens.copy()
         tokens[mask] = mask_token
+        # No padding_mask: these are full-length packed batches, so a mask
+        # would be all-True — semantically identical to none, but its mere
+        # presence forces composed-XLA attention (the flash kernel has no
+        # arbitrary-mask path; see BertConfig.attn_impl).
         pool.append({
             "tokens": tokens,
             "labels": labels,
             "segment_ids": np.zeros_like(tokens),
-            "padding_mask": np.ones((batch_size, seq_len), dtype=bool),
         })
     i = 0
     while True:

@@ -38,6 +38,12 @@ class BertConfig:
     # materializes fp32 [B,S,30522] — ~1 GB/step at B=16 S=512); >0 ->
     # sequence-chunked scan. Training-only; eval/convert paths get logits.
     fused_loss_chunk: int = 0
+    # "auto": the Pallas flash kernel (causal=False) on TPU backends when a
+    # layer sees NO padding mask — full-length batches, the packed-sequence
+    # pretraining shape; the kernel has no arbitrary-mask path, so any
+    # padding_mask falls back to composed XLA attention. Mirrors
+    # GPT2Config.attn_impl (incl. the GSPMD auto-partitioner fallback).
+    attn_impl: str = "auto"  # "xla" | "flash" | "auto"
 
 
 class EncoderLayer(Module):
@@ -66,7 +72,22 @@ class EncoderLayer(Module):
         states: dict = {}
         qkv = run_child(self.qkv, "qkv", variables, states, x, training=training)
         qkv = qkv.reshape(b, s, 3, cfg.num_heads, d).transpose(2, 0, 3, 1, 4)
-        att = ops.dot_product_attention(qkv[0], qkv[1], qkv[2], mask=mask)
+        impl = cfg.attn_impl
+        if impl == "auto":
+            import jax
+
+            from nezha_tpu.parallel.gspmd import under_auto_partitioner
+            impl = ("flash" if mask is None
+                    and jax.default_backend() == "tpu"
+                    and not under_auto_partitioner() else "xla")
+        if impl == "flash":
+            if mask is not None:
+                raise ValueError("attn_impl='flash' cannot apply a padding "
+                                 "mask; drop padding_mask or use 'xla'")
+            from nezha_tpu.ops.pallas import flash_attention
+            att = flash_attention(qkv[0], qkv[1], qkv[2], causal=False)
+        else:
+            att = ops.dot_product_attention(qkv[0], qkv[1], qkv[2], mask=mask)
         att = att.transpose(0, 2, 1, 3).reshape(b, s, h)
         att = run_child(self.attn_out, "attn_out", variables, states, att,
                         training=training)

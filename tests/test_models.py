@@ -237,6 +237,57 @@ def test_gpt2_flash_attention_matches_xla():
                                atol=2e-5, rtol=2e-5)
 
 
+def test_bert_flash_attention_matches_xla():
+    """BertConfig.attn_impl='flash' (non-causal Pallas kernel, interpret on
+    CPU) must match composed XLA attention on the same weights — forward
+    AND one training step (loss + a couple of grads) — on full-length
+    (no-padding) batches, the shape where 'auto' picks it on TPU."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nezha_tpu import optim
+    from nezha_tpu.models.bert import Bert, BertConfig, mlm_loss
+    from nezha_tpu.train.loop import init_train_state, make_train_step
+
+    kw = dict(vocab_size=64, max_positions=32, num_layers=2, num_heads=4,
+              hidden_size=64)
+    m_xla = Bert(BertConfig(attn_impl="xla", **kw))
+    m_flash = Bert(BertConfig(attn_impl="flash", **kw))
+    variables = m_xla.init(jax.random.PRNGKey(0))
+    r = np.random.RandomState(0)
+    tokens = r.randint(0, 64, (2, 32)).astype(np.int32)
+    labels = np.full_like(tokens, -100)
+    sel = r.rand(2, 32) < 0.2
+    labels[sel] = tokens[sel]
+    batch = {"tokens": jnp.asarray(tokens), "labels": jnp.asarray(labels)}
+
+    out1, _ = m_xla.apply(variables, batch, training=False)
+    out2, _ = m_flash.apply(variables, batch, training=False)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                               atol=2e-5, rtol=2e-5)
+
+    opt = optim.adamw(1e-3)
+    s1 = init_train_state(m_xla, opt, jax.random.PRNGKey(0))
+    s2 = init_train_state(m_flash, opt, jax.random.PRNGKey(0))
+    step1 = make_train_step(m_xla, opt, mlm_loss, donate=False)
+    step2 = make_train_step(m_flash, opt, mlm_loss, donate=False)
+    s1, me1 = step1(s1, batch)
+    s2, me2 = step2(s2, batch)
+    np.testing.assert_allclose(float(me1["loss"]), float(me2["loss"]),
+                               rtol=1e-5)
+    qkv1 = s1["variables"]["params"]["layers0"]["qkv"]["w"]
+    qkv2 = s2["variables"]["params"]["layers0"]["qkv"]["w"]
+    np.testing.assert_allclose(np.asarray(qkv1), np.asarray(qkv2),
+                               atol=1e-5, rtol=1e-4)
+    # A padding mask must refuse the flash impl loudly, never mis-attend.
+    import pytest
+    pm = jnp.ones((2, 32), bool)
+    with pytest.raises(ValueError, match="padding"):
+        m_flash.apply(variables, {"tokens": batch["tokens"],
+                                  "padding_mask": pm}, training=False)
+
+
 def test_gpt2_pallas_ln_matches_xla():
     """ln_impl='pallas' (the fused LN kernel, interpret on CPU) must match
     the composed XLA layer norm through the whole model — forward AND one
