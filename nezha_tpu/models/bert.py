@@ -65,7 +65,7 @@ class EncoderLayer(Module):
         self.drop = nn.Dropout(cfg.dropout)
 
     def apply(self, variables: Variables, x, mask=None, training: bool = False,
-              rng=None):
+              rng=None, kv_lengths=None):
         cfg = self.cfg
         b, s, h = x.shape
         d = h // cfg.num_heads
@@ -82,11 +82,19 @@ class EncoderLayer(Module):
                     and not under_auto_partitioner() else "xla")
         if impl == "flash":
             if mask is not None:
-                raise ValueError("attn_impl='flash' cannot apply a padding "
-                                 "mask; drop padding_mask or use 'xla'")
+                raise ValueError("attn_impl='flash' cannot apply an "
+                                 "arbitrary padding mask; use right-padded "
+                                 "batches with kv_lengths, or 'xla'")
             from nezha_tpu.ops.pallas import flash_attention
-            att = flash_attention(qkv[0], qkv[1], qkv[2], causal=False)
+            att = flash_attention(qkv[0], qkv[1], qkv[2], causal=False,
+                                  kv_lengths=kv_lengths)
         else:
+            if kv_lengths is not None and mask is None:
+                # Same right-padding contract as the flash path, composed:
+                # a prefix mask built from the lengths.
+                import jax.numpy as jnp
+                mask = ops.make_attention_mask(
+                    jnp.arange(s)[None, :] < kv_lengths[:, None])
             att = ops.dot_product_attention(qkv[0], qkv[1], qkv[2], mask=mask)
         att = att.transpose(0, 2, 1, 3).reshape(b, s, h)
         att = run_child(self.attn_out, "attn_out", variables, states, att,
@@ -138,6 +146,15 @@ class Bert(Module):
         tokens = batch["tokens"]
         segment_ids = batch.get("segment_ids")
         padding_mask = batch.get("padding_mask")
+        # Right-padded batches: "kv_lengths" ([B] int32, each >= 1) keeps
+        # the flash path (the kernel masks key columns >= length); the
+        # composed path builds the equivalent prefix mask. Mutually
+        # exclusive with an explicit padding_mask.
+        kv_lengths = batch.get("kv_lengths") if isinstance(batch, dict) \
+            else None
+        if kv_lengths is not None and padding_mask is not None:
+            raise ValueError("pass either padding_mask or kv_lengths, "
+                             "not both")
         states: dict = {}
         s = tokens.shape[1]
         if s > self.cfg.max_positions:
@@ -161,7 +178,8 @@ class Bert(Module):
                 if padding_mask is not None else None)
         for i, layer in enumerate(self.layers):
             x = run_child(layer, f"layers{i}", variables, states, x,
-                          mask=mask, training=training, rng=rng)
+                          mask=mask, training=training, rng=rng,
+                          kv_lengths=kv_lengths)
         y = run_child(self.mlm_dense, "mlm_dense", variables, states, x,
                       training=training)
         y = ops.gelu(y, approximate=False)  # original BERT uses erf GELU

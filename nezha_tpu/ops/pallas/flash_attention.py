@@ -72,9 +72,17 @@ def _causal_mask(s, qi, ki, block_q, block_k):
     return jnp.where(kpos <= qpos, s, _NEG_BIG)
 
 
+def _length_mask(s, ki, block_k, kv_len):
+    """Mask key columns at positions >= kv_len (right-padding support).
+    ``kv_len`` is a traced scalar read from the per-batch lengths input."""
+    kpos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(kpos < kv_len, s, _NEG_BIG)
+
+
 # ---------------------------------------------------------------- forward
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale: float, causal: bool, block_q: int, block_k: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, lse_ref, m_scr, l_scr,
+                acc_scr, *, scale: float, causal: bool, block_q: int,
+                block_k: int):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -99,6 +107,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                             preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
+        if len_ref is not None:
+            s = _length_mask(s, ki, block_k, len_ref[0, 0])
 
         m_prev = m_scr[:, :1]                                # [bq, 1]
         l_prev = l_scr[:, :1]
@@ -122,7 +132,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
 
 def _flash_call(q, k, v, causal, scale, block_q, block_k, interpret,
-                return_lse: bool = False):
+                return_lse: bool = False, kv_lengths=None):
     b, h, s_q, d = q.shape
     s_k = k.shape[2]
     if causal and s_q != s_k:
@@ -137,14 +147,28 @@ def _flash_call(q, k, v, causal, scale, block_q, block_k, interpret,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
+    has_len = kv_lengths is not None
     grid = (b, h, s_q // bq, s_k // bk)
     full = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                              block_q=bq, block_k=bk)
-    if return_lse:
+    # The kernel's (len_ref, lse_ref) slots are optional: wrappers splice
+    # None into whichever positional slots this call doesn't wire.
+    if has_len and return_lse:
         kernel = full
-    else:  # no lse output ref: splice None into its positional slot
+    elif has_len:
+        def kernel(q_ref, k_ref, v_ref, len_ref, o_ref, m_scr, l_scr,
+                   acc_scr):
+            full(q_ref, k_ref, v_ref, len_ref, o_ref, None, m_scr, l_scr,
+                 acc_scr)
+    elif return_lse:
+        def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                   acc_scr):
+            full(q_ref, k_ref, v_ref, None, o_ref, lse_ref, m_scr, l_scr,
+                 acc_scr)
+    else:
         def kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr):
-            full(q_ref, k_ref, v_ref, o_ref, None, m_scr, l_scr, acc_scr)
+            full(q_ref, k_ref, v_ref, None, o_ref, None, m_scr, l_scr,
+                 acc_scr)
     kwargs = {}
     if not interpret:
         kwargs["compiler_params"] = pltpu.CompilerParams(
@@ -155,6 +179,16 @@ def _flash_call(q, k, v, causal, scale, block_q, block_k, interpret,
                pltpu.VMEM((bq, d), jnp.float32)]
     qo_spec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0))
     kv_spec = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0))
+    in_specs = [qo_spec, kv_spec, kv_spec]
+    operands = [q, k, v]
+    if has_len:
+        # Lengths ride as a [B, LANES] int32 lane-broadcast (the TPU-native
+        # small-operand layout); each program reads its batch row's scalar.
+        len2d = jnp.broadcast_to(
+            jnp.asarray(kv_lengths, jnp.int32)[:, None], (b, _LANES))
+        in_specs.append(pl.BlockSpec((1, _LANES),
+                                     lambda b_, h_, qi, ki: (b_, 0)))
+        operands.append(len2d)
     out_specs = qo_spec
     out_shape = jax.ShapeDtypeStruct(q.shape, q.dtype)
     if return_lse:
@@ -166,23 +200,26 @@ def _flash_call(q, k, v, causal, scale, block_q, block_k, interpret,
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[qo_spec, kv_spec, kv_spec],
+        in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=scratch,
         interpret=interpret,
         **kwargs,
-    )(q, k, v)
+    )(*operands)
 
 
 # --------------------------------------------------------------- backward
-def _recompute_p(q_ref, k_ref, lse_ref, qi, ki, scale, causal, bq, bk):
+def _recompute_p(q_ref, k_ref, lse_ref, qi, ki, scale, causal, bq, bk,
+                 len_ref=None):
     q = q_ref[0, 0]                                          # [bq, d]
     k = k_ref[0, 0]                                          # [bk, d]
     s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                         preferred_element_type=jnp.float32) * scale
     if causal:
         s = _causal_mask(s, qi, ki, bq, bk)
+    if len_ref is not None:
+        s = _length_mask(s, ki, bk, len_ref[0, 0])
     return jnp.exp(s - lse_ref[0, 0][:, :1])                 # [bq, bk]
 
 
@@ -197,8 +234,9 @@ def _ds_block(p, do, o, v, scale):
     return p * (dp - delta) * scale
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
-                   dq_scr, delta_scr, *, scale, causal, block_q, block_k):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, len_ref,
+                   dq_ref, dq_scr, delta_scr, *, scale, causal, block_q,
+                   block_k):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -217,7 +255,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
     @pl.when(run)
     def _block():
         p = _recompute_p(q_ref, k_ref, lse_ref, qi, ki, scale, causal,
-                         block_q, block_k)
+                         block_q, block_k, len_ref)
         do = do_ref[0, 0]
         v = v_ref[0, 0]
         k = k_ref[0, 0]
@@ -233,9 +271,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
         dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref,
-                    dv_ref, dk_scr, dv_scr, *, scale, causal, block_q,
-                    block_k):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, len_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+                    block_q, block_k):
     ki = pl.program_id(2)
     qi = pl.program_id(3)
 
@@ -250,7 +288,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref,
     @pl.when(run)
     def _block():
         p = _recompute_p(q_ref, k_ref, lse_ref, qi, ki, scale, causal,
-                         block_q, block_k)
+                         block_q, block_k, len_ref)
         do = do_ref[0, 0]
         o = o_ref[0, 0]
         v = v_ref[0, 0]
@@ -270,7 +308,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref,
 
 
 def _flash_bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k,
-                    interpret):
+                    interpret, kv_lengths=None):
     b, h, s_q, d = q.shape
     s_k = k.shape[2]
     if causal and s_q != s_k:
@@ -304,26 +342,47 @@ def _flash_bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k,
     # scratch, not a residual pinned across the whole forward pass).
     lse = jnp.broadcast_to(lse[..., None], lse.shape + (_LANES,))
 
+    has_len = kv_lengths is not None
+    operands = [q, k, v, o, do, lse]
+    len_specs = []
+    if has_len:
+        len2d = jnp.broadcast_to(
+            jnp.asarray(kv_lengths, jnp.int32)[:, None], (b, _LANES))
+        operands.append(len2d)
+        len_specs = [pl.BlockSpec((1, _LANES), lambda b_, h_, i, j: (b_, 0))]
+
+    dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                                  block_q=bq, block_k=bk)
+    dkv_kernel = functools.partial(_bwd_dkv_kernel, scale=scale,
+                                   causal=causal, block_q=bq, block_k=bk)
+    if not has_len:  # splice None into the kernels' len_ref slot
+        dq_full, dkv_full = dq_kernel, dkv_kernel
+
+        def dq_kernel(q_, k_, v_, o_, do_, lse_, dq_, s1, s2):
+            dq_full(q_, k_, v_, o_, do_, lse_, None, dq_, s1, s2)
+
+        def dkv_kernel(q_, k_, v_, o_, do_, lse_, dk_, dv_, s1, s2):
+            dkv_full(q_, k_, v_, o_, do_, lse_, None, dk_, dv_, s1, s2)
+
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk),
+        dq_kernel,
         grid=(b, h, s_q // bq, s_k // bk),
         in_specs=[qo_spec(True), kv_spec(True), kv_spec(True), qo_spec(True),
-                  qo_spec(True), lse_spec(True)],
+                  qo_spec(True), lse_spec(True)] + len_specs,
         out_specs=qo_spec(True),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32),
                         pltpu.VMEM((bq, _LANES), jnp.float32)],
         interpret=interpret,
         **kwargs,
-    )(q, k, v, o, do, lse)
+    )(*operands)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk),
+        dkv_kernel,
         grid=(b, h, s_k // bk, s_q // bq),
         in_specs=[qo_spec(False), kv_spec(False), kv_spec(False),
-                  qo_spec(False), qo_spec(False), lse_spec(False)],
+                  qo_spec(False), qo_spec(False), lse_spec(False)]
+        + len_specs,
         out_specs=[kv_spec(False), kv_spec(False)],
         out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)],
@@ -331,7 +390,7 @@ def _flash_bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k,
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
         **kwargs,
-    )(q, k, v, o, do, lse)
+    )(*operands)
     return dq, dk, dv
 
 
@@ -363,18 +422,11 @@ def flash_block_bwd(q, k, v, o, lse, do, causal: bool,
 
 # ------------------------------------------------------------- public API
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def flash_attention(q, k, v, causal: bool = True,
-                    scale: Optional[float] = None,
-                    block_q: Optional[int] = None,
-                    block_k: Optional[int] = None,
-                    interpret: Optional[bool] = None):
-    """q, k, v: [B, H, S, D] -> [B, H, S, D].
-
-    ``block_q``/``block_k`` default to the measured-best sizes for the
-    sequence length (see ``_auto_blocks``). ``interpret=None``
-    auto-selects: compiled on TPU backends, interpreter elsewhere (so CPU
-    tests run the same kernel code).
-    """
+def _flash_attention_dense(q, k, v, causal: bool = True,
+                           scale: Optional[float] = None,
+                           block_q: Optional[int] = None,
+                           block_k: Optional[int] = None,
+                           interpret: Optional[bool] = None):
     return _flash_call(q, k, v, causal, scale, block_q, block_k, interpret)
 
 
@@ -393,4 +445,60 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
                            block_k, interpret)
 
 
-flash_attention.defvjp(_flash_fwd, _flash_bwd)
+_flash_attention_dense.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_attention_varlen(q, k, v, kv_lengths, causal, scale, block_q,
+                            block_k, interpret):
+    return _flash_call(q, k, v, causal, scale, block_q, block_k, interpret,
+                       kv_lengths=kv_lengths)
+
+
+def _flash_varlen_fwd(q, k, v, kv_lengths, causal, scale, block_q, block_k,
+                      interpret):
+    out, lse = _flash_call(q, k, v, causal, scale, block_q, block_k,
+                           interpret, return_lse=True, kv_lengths=kv_lengths)
+    return out, (q, k, v, out, lse[..., 0], kv_lengths)
+
+
+def _flash_varlen_bwd(causal, scale, block_q, block_k, interpret, residuals,
+                      g):
+    import numpy as np
+
+    q, k, v, out, lse, kv_lengths = residuals
+    dq, dk, dv = _flash_bwd_call(q, k, v, out, lse, g, causal, scale,
+                                 block_q, block_k, interpret,
+                                 kv_lengths=kv_lengths)
+    # Integer lengths carry no gradient: the float0 zero cotangent.
+    dlen = np.zeros(kv_lengths.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dlen
+
+
+_flash_attention_varlen.defvjp(_flash_varlen_fwd, _flash_varlen_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    scale: Optional[float] = None,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
+                    interpret: Optional[bool] = None,
+                    kv_lengths=None):
+    """q, k, v: [B, H, S, D] -> [B, H, S, D].
+
+    ``block_q``/``block_k`` default to the measured-best sizes for the
+    sequence length (see ``_auto_blocks``). ``interpret=None``
+    auto-selects: compiled on TPU backends, interpreter elsewhere (so CPU
+    tests run the same kernel code).
+
+    ``kv_lengths`` ([B] int32, each >= 1) masks key/value positions at or
+    beyond each batch row's length — the right-padding contract (BERT on
+    real, unpacked data). Query rows beyond the length produce arbitrary
+    finite outputs; downstream must mask them (MLM's -100 labels do).
+    Gradients for padded keys/values are exactly zero.
+    """
+    if kv_lengths is None:
+        return _flash_attention_dense(q, k, v, causal, scale, block_q,
+                                      block_k, interpret)
+    return _flash_attention_varlen(q, k, v, kv_lengths, causal, scale,
+                                   block_q, block_k, interpret)

@@ -288,6 +288,47 @@ def test_bert_flash_attention_matches_xla():
                                   "padding_mask": pm}, training=False)
 
 
+def test_bert_kv_lengths_flash_matches_xla_prefix_mask():
+    """Right-padded batches via kv_lengths: the varlen flash path (interpret
+    on CPU) and the composed-XLA prefix mask agree on loss AND on logits at
+    valid positions (padded rows are unspecified and loss-masked)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nezha_tpu.models.bert import Bert, BertConfig, mlm_loss
+
+    kw = dict(vocab_size=64, max_positions=32, num_layers=2, num_heads=4,
+              hidden_size=64)
+    m_xla = Bert(BertConfig(attn_impl="xla", **kw))
+    m_flash = Bert(BertConfig(attn_impl="flash", **kw))
+    variables = m_xla.init(jax.random.PRNGKey(0))
+    r = np.random.RandomState(1)
+    tokens = r.randint(0, 64, (2, 32)).astype(np.int32)
+    lengths = np.asarray([20, 32], np.int32)
+    labels = np.full_like(tokens, -100)
+    sel = r.rand(2, 32) < 0.3
+    sel &= np.arange(32)[None, :] < lengths[:, None]  # only valid positions
+    labels[sel] = tokens[sel]
+    batch = {"tokens": jnp.asarray(tokens), "labels": jnp.asarray(labels),
+             "kv_lengths": jnp.asarray(lengths)}
+
+    out1, _ = m_xla.apply(variables, batch, training=False)
+    out2, _ = m_flash.apply(variables, batch, training=False)
+    valid = (np.arange(32)[None, :] < lengths[:, None])[..., None]
+    np.testing.assert_allclose(np.where(valid, np.asarray(out1), 0),
+                               np.where(valid, np.asarray(out2), 0),
+                               atol=2e-5, rtol=2e-5)
+    l1 = mlm_loss(out1, batch)
+    l2 = mlm_loss(out2, batch)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    # Both length knobs at once is ambiguous — reject.
+    import pytest
+    with pytest.raises(ValueError, match="not both"):
+        m_xla.apply(variables, {**batch,
+                                "padding_mask": jnp.ones((2, 32), bool)})
+
+
 def test_gpt2_pallas_ln_matches_xla():
     """ln_impl='pallas' (the fused LN kernel, interpret on CPU) must match
     the composed XLA layer norm through the whole model — forward AND one

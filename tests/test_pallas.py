@@ -181,3 +181,66 @@ def test_fused_layer_norm_mixed_param_dtypes_grad():
     g = jax.grad(lambda s, b: jnp.sum(fused_layer_norm(x, s, b)),
                  argnums=(0, 1))(scale, bias)
     assert g[0].dtype == jnp.float32 and g[1].dtype == jnp.bfloat16
+
+
+def _varlen_setup(s=32, lengths=(20, 32)):
+    q, k, v = _qkv(b=len(lengths), h=2, s=s, d=16)
+    lens = jnp.asarray(lengths, jnp.int32)
+    # Additive mask equivalent to the kernel's right-padding contract:
+    # key positions >= length get -inf for every query row.
+    kpos = jnp.arange(s)[None, None, None, :]
+    mask = jnp.where(kpos < lens[:, None, None, None], 0.0, -jnp.inf)
+    # Valid-row selector [B, 1, S, 1] for comparisons/losses: padded QUERY
+    # rows are unspecified in the kernel contract.
+    valid_q = (jnp.arange(s)[None, :] < lens[:, None])[:, None, :, None]
+    return q, k, v, lens, mask, valid_q
+
+
+def test_flash_varlen_matches_masked_xla():
+    """kv_lengths == additive prefix mask on the valid query rows (fwd),
+    multi-block so the length boundary crosses block edges."""
+    q, k, v, lens, mask, valid_q = _varlen_setup(s=32, lengths=(20, 32))
+    out_f = flash_attention(q, k, v, causal=False, block_q=8, block_k=8,
+                            kv_lengths=lens)
+    out_r = ops.dot_product_attention(q, k, v, mask=mask)
+    np.testing.assert_allclose(np.asarray(jnp.where(valid_q, out_f, 0.0)),
+                               np.asarray(jnp.where(valid_q, out_r, 0.0)),
+                               rtol=5e-4, atol=5e-5)
+
+
+def test_flash_varlen_grads_match_masked_xla():
+    """Gradients through the varlen custom VJP match the masked composed
+    path on valid rows; padded keys/values get exactly zero gradient."""
+    q, k, v, lens, mask, valid_q = _varlen_setup(s=32, lengths=(20, 32))
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, causal=False, block_q=8, block_k=8,
+                              kv_lengths=lens)
+        return jnp.sum(jnp.where(valid_q, out, 0.0) ** 2)
+
+    def loss_ref(q, k, v):
+        out = ops.dot_product_attention(q, k, v, mask=mask)
+        return jnp.sum(jnp.where(valid_q, out, 0.0) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
+    # Padded key/value positions (row 0: length 20 of 32) carry no grad.
+    dk, dv = np.asarray(g1[1]), np.asarray(g1[2])
+    assert np.all(dk[0, :, 20:, :] == 0.0)
+    assert np.all(dv[0, :, 20:, :] == 0.0)
+    assert np.any(dk[0, :, :20, :] != 0.0)
+
+
+def test_flash_varlen_jits_and_batches_lengths():
+    """kv_lengths is a traced operand: one compiled program serves
+    different length values (no per-batch recompilation)."""
+    q, k, v, _, _, _ = _varlen_setup(s=32, lengths=(20, 32))
+    f = jax.jit(lambda q, k, v, l: flash_attention(
+        q, k, v, causal=False, kv_lengths=l))
+    o1 = f(q, k, v, jnp.asarray([20, 32], jnp.int32))
+    o2 = f(q, k, v, jnp.asarray([32, 8], jnp.int32))
+    assert o1.shape == o2.shape == q.shape
+    assert not np.allclose(np.asarray(o1), np.asarray(o2))
