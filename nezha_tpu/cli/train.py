@@ -277,6 +277,29 @@ def _data_source(args, cfg, batch_size: int, group=None):
                           + (f" (shard {rank}/{world})" if shard else ""),
                           file=sys.stderr)
                     return iter(loader), loader.close
+        elif args.config == "bert_base_zero1":
+            # MLM pretraining on the same packed-token format as GPT-2:
+            # random [B, S] windows + dynamic masking per batch
+            # (data/mlm.py; 80/10/10 recipe, labels -100 off-prediction).
+            from nezha_tpu.data.mlm import mlm_batches_from_tokens
+            tiny = args.model_preset == "tiny"
+            seq, vocab = (64, 512) if tiny else (512, 30522)
+            for name, dtype in (("train.tokens.u16", np.uint16),
+                                ("train.tokens.i32", np.int32)):
+                tok = os.path.join(args.data_dir, name)
+                if os.path.exists(tok):
+                    loader = TokenLoader(tok, seq_len=seq, batch_size=local,
+                                         dtype=dtype, seed=args.seed,
+                                         **shard)
+                    print(f"data: {loader.num_tokens} tokens from {tok} "
+                          f"(dynamic MLM masking)"
+                          + (f" (shard {rank}/{world})" if shard else ""),
+                          file=sys.stderr)
+                    it = mlm_batches_from_tokens(
+                        iter(loader), vocab_size=vocab,
+                        mask_token=min(103, vocab - 1), seed=args.seed,
+                        drop_last_column=True)
+                    return it, loader.close
         elif args.config == "mlp_mnist":
             os.environ.setdefault("NEZHA_DATA_DIR", args.data_dir)
             if os.path.isdir(os.path.join(args.data_dir, "mnist")):

@@ -9,6 +9,7 @@ from nezha_tpu.data.native import (
     TokenLoader,
     write_image_records,
 )
+from nezha_tpu.data.mlm import mlm_batches_from_tokens
 from nezha_tpu.data.synthetic import (
     synthetic_image_batches,
     synthetic_token_batches,
@@ -20,4 +21,5 @@ __all__ = [
     "MnistLoader", "TokenLoader",
     "ImageRecordLoader", "write_image_records",
     "synthetic_image_batches", "synthetic_token_batches", "synthetic_mlm_batches",
+    "mlm_batches_from_tokens",
 ]

@@ -1,0 +1,62 @@
+"""Dynamic MLM masking over real token streams — BERT pretraining data.
+
+Wraps any ``{"tokens": [B, S]}`` / ``[B, S+1]`` integer-token batch stream
+(the native ``TokenLoader``'s GPT-shape windows included — the trailing
+next-token column is dropped) with the BERT masking recipe, re-rolled per
+batch (dynamic masking, the RoBERTa refinement of BERT's static dumps):
+
+- ``mask_rate`` of positions are selected for prediction;
+- of those, 80% are replaced with ``mask_token``, 10% with a uniformly
+  random id, 10% left unchanged;
+- ``labels`` carry the ORIGINAL id at selected positions and -100
+  elsewhere (``ops.softmax_cross_entropy_with_integer_labels``'s
+  ``ignore_index`` contract, same as the synthetic generator).
+
+The output batches are full-length (no padding), so the flash attention
+path stays engaged (`BertConfig.attn_impl="auto"`).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator
+
+import numpy as np
+
+
+def mlm_batches_from_tokens(batches: Iterable, vocab_size: int,
+                            mask_token: int = 103,
+                            mask_rate: float = 0.15,
+                            seed: int = 0,
+                            drop_last_column: bool = False) -> Iterator[dict]:
+    """-> ``{"tokens", "labels", "segment_ids"}`` int32 [B, S] batches.
+
+    ``drop_last_column=True`` for GPT-shape ``[B, S+1]`` sources (the
+    native ``TokenLoader``) whose trailing next-token column MLM doesn't
+    use."""
+    if not 0 < mask_rate < 1:
+        raise ValueError(f"mask_rate must be in (0, 1), got {mask_rate}")
+    if not 0 <= mask_token < vocab_size:
+        raise ValueError(f"mask_token {mask_token} outside vocab "
+                         f"[0, {vocab_size})")
+    r = np.random.RandomState(seed)
+    for b in batches:
+        tokens = np.asarray(b["tokens"] if isinstance(b, dict) else b)
+        if tokens.ndim != 2:
+            raise ValueError(f"expected [B, S] tokens, got {tokens.shape}")
+        if drop_last_column:
+            tokens = tokens[:, :-1]
+        tokens = tokens.astype(np.int32, copy=True)
+        if tokens.max(initial=0) >= vocab_size:
+            raise ValueError(
+                f"token id {tokens.max()} >= vocab_size {vocab_size} "
+                f"(wrong --data-dir for this model?)")
+        sel = r.rand(*tokens.shape) < mask_rate
+        labels = np.where(sel, tokens, -100).astype(np.int32)
+        roll = r.rand(*tokens.shape)
+        masked = sel & (roll < 0.8)
+        random_sub = sel & (roll >= 0.8) & (roll < 0.9)
+        tokens[masked] = mask_token
+        tokens[random_sub] = r.randint(
+            0, vocab_size, int(random_sub.sum()), dtype=np.int32)
+        yield {"tokens": tokens, "labels": labels,
+               "segment_ids": np.zeros_like(tokens)}

@@ -883,3 +883,22 @@ def test_cli_knob_composition(devices8, tmp_path):
     assert any(k.startswith("eval_") for k in m)
     kept = list(tmp_path.glob("step_*.sharded"))
     assert len(kept) == 1  # retention pruned to the newest
+
+
+def test_cli_bert_real_token_data(devices8, tmp_path):
+    """Config 4 on real data: packed tokens -> native TokenLoader ->
+    dynamic MLM masking -> ZeRO-1 training (the same .tokens.u16 format
+    GPT-2 consumes)."""
+    import pytest
+    try:
+        from nezha_tpu.data.native import load_library
+        load_library()
+    except Exception:
+        pytest.skip("native runtime not available")
+    rng = np.random.RandomState(0)
+    (tmp_path / "train.tokens.u16").write_bytes(
+        rng.randint(0, 512, 8192).astype(np.uint16).tobytes())
+    metrics = _run(["--config", "bert_base_zero1", "--model-preset", "tiny",
+                    "--steps", "2", "--batch-size", "8", "--log-every", "1",
+                    "--data-dir", str(tmp_path)])
+    assert np.isfinite(metrics["loss"])

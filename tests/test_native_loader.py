@@ -321,3 +321,54 @@ def test_records_uneven_shards_serve_equal_counts(tmp_path):
         seen.extend(np.concatenate(labels).tolist())
     assert counts == [3, 3, 3]  # floor(10/3) each, no ragged shard
     assert len(seen) == len(set(seen)) == 36  # disjoint, 4 records dropped
+
+
+def test_mlm_masking_recipe():
+    """data.mlm: ~mask_rate positions selected; of those ~80% mask_token,
+    ~10% random, ~10% unchanged; labels carry originals exactly at
+    selections; off-selection labels are -100 and tokens untouched."""
+    import numpy as np
+
+    from nezha_tpu.data.mlm import mlm_batches_from_tokens
+
+    rng = np.random.RandomState(0)
+    orig = rng.randint(0, 200, (64, 257)).astype(np.int32)  # [B, S+1]
+    out = next(mlm_batches_from_tokens([{"tokens": orig}], vocab_size=256,
+                                       mask_token=255, seed=1,
+                                       drop_last_column=True))
+    tokens, labels = out["tokens"], out["labels"]
+    assert tokens.shape == labels.shape == (64, 256)
+    base = orig[:, :-1]
+    sel = labels != -100
+    rate = sel.mean()
+    assert 0.10 < rate < 0.20, rate
+    np.testing.assert_array_equal(labels[sel], base[sel])
+    np.testing.assert_array_equal(tokens[~sel], base[~sel])
+    masked = (tokens == 255) & sel
+    changed = sel & (tokens != base) & ~masked
+    kept = sel & (tokens == base)
+    n = sel.sum()
+    assert 0.7 < masked.sum() / n < 0.9
+    assert changed.sum() / n < 0.2
+    assert kept.sum() / n < 0.2
+    # Dynamic: a second pass re-rolls the selection.
+    out2 = next(mlm_batches_from_tokens([{"tokens": orig}], vocab_size=256,
+                                        mask_token=255, seed=2,
+                                        drop_last_column=True))
+    assert (out2["labels"] != labels).any()
+
+
+def test_mlm_wrapper_rejects_bad_args():
+    import numpy as np
+    import pytest
+
+    from nezha_tpu.data.mlm import mlm_batches_from_tokens
+
+    toks = [{"tokens": np.zeros((2, 8), np.int32)}]
+    with pytest.raises(ValueError, match="mask_rate"):
+        next(mlm_batches_from_tokens(toks, 256, mask_rate=0.0))
+    with pytest.raises(ValueError, match="outside vocab"):
+        next(mlm_batches_from_tokens(toks, 256, mask_token=256))
+    big = [{"tokens": np.full((2, 8), 600, np.int32)}]
+    with pytest.raises(ValueError, match="vocab_size"):
+        next(mlm_batches_from_tokens(big, 256))
