@@ -282,8 +282,16 @@ def _data_source(args, cfg, batch_size: int, group=None):
             # random [B, S] windows + dynamic masking per batch
             # (data/mlm.py; 80/10/10 recipe, labels -100 off-prediction).
             from nezha_tpu.data.mlm import mlm_batches_from_tokens
-            tiny = args.model_preset == "tiny"
-            seq, vocab = (64, 512) if tiny else (512, 30522)
+            # Geometry comes from the ACTUAL model config (module
+            # construction is paramless and cheap), so preset/default
+            # edits can't drift the data path out from under the model.
+            mcfg = cfg.build_model().cfg
+            seq, vocab = mcfg.max_positions, mcfg.vocab_size
+            # 103 is [MASK] for BERT-wordpiece-tokenized data; byte-packed
+            # text (data.pack: ids 0-255) needs an id real data can't
+            # produce — pass --mlm-mask-token (e.g. 256+) there.
+            mask_token = (args.mlm_mask_token if args.mlm_mask_token
+                          is not None else min(103, vocab - 1))
             for name, dtype in (("train.tokens.u16", np.uint16),
                                 ("train.tokens.i32", np.int32)):
                 tok = os.path.join(args.data_dir, name)
@@ -292,12 +300,13 @@ def _data_source(args, cfg, batch_size: int, group=None):
                                          dtype=dtype, seed=args.seed,
                                          **shard)
                     print(f"data: {loader.num_tokens} tokens from {tok} "
-                          f"(dynamic MLM masking)"
+                          f"(dynamic MLM masking, mask_token="
+                          f"{mask_token})"
                           + (f" (shard {rank}/{world})" if shard else ""),
                           file=sys.stderr)
                     it = mlm_batches_from_tokens(
                         iter(loader), vocab_size=vocab,
-                        mask_token=min(103, vocab - 1), seed=args.seed,
+                        mask_token=mask_token, seed=args.seed,
                         drop_last_column=True)
                     return it, loader.close
         elif args.config == "mlp_mnist":
@@ -532,6 +541,11 @@ def run(args) -> Dict[str, float]:
         cfg.loss_fn = lambda logits, b: \
             ops.softmax_cross_entropy_with_integer_labels(
                 logits, b["label"], label_smoothing=eps)
+
+    if args.mlm_mask_token is not None and (
+            args.config != "bert_base_zero1" or not args.data_dir):
+        raise SystemExit("--mlm-mask-token applies to bert_base_zero1 "
+                         "with --data-dir (the dynamic-MLM data path)")
 
     if args.remat:
         # Block rematerialization: the long-context/big-batch memory knob
@@ -1174,6 +1188,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--label-smoothing", type=float, default=None,
                    help="integer-label CE configs (mlp/resnet/wrn): train "
                         "against (1-eps)*one_hot + eps/num_classes")
+    p.add_argument("--mlm-mask-token", type=int, default=None,
+                   help="bert --data-dir only: [MASK] id (default 103, the "
+                        "BERT-wordpiece convention; byte-packed text needs "
+                        "an id >= 256 so masks are unambiguous)")
     p.add_argument("--remat", action="store_true",
                    help="gpt2_124m only: rematerialize each block in "
                         "backward (jax.checkpoint) — O(1) activation "

@@ -46,10 +46,13 @@ def mlm_batches_from_tokens(batches: Iterable, vocab_size: int,
         if drop_last_column:
             tokens = tokens[:, :-1]
         tokens = tokens.astype(np.int32, copy=True)
-        if tokens.max(initial=0) >= vocab_size:
+        if tokens.max(initial=0) >= vocab_size or tokens.min(initial=0) < 0:
+            # Loud, not silent: out-of-range ids would otherwise hit the
+            # embedding gather, where XLA clips/wraps indices quietly.
             raise ValueError(
-                f"token id {tokens.max()} >= vocab_size {vocab_size} "
-                f"(wrong --data-dir for this model?)")
+                f"token ids outside [0, {vocab_size}) in the stream "
+                f"(min {tokens.min()}, max {tokens.max()}; wrong "
+                f"--data-dir for this model?)")
         sel = r.rand(*tokens.shape) < mask_rate
         labels = np.where(sel, tokens, -100).astype(np.int32)
         roll = r.rand(*tokens.shape)

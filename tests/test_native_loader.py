@@ -370,5 +370,8 @@ def test_mlm_wrapper_rejects_bad_args():
     with pytest.raises(ValueError, match="outside vocab"):
         next(mlm_batches_from_tokens(toks, 256, mask_token=256))
     big = [{"tokens": np.full((2, 8), 600, np.int32)}]
-    with pytest.raises(ValueError, match="vocab_size"):
+    with pytest.raises(ValueError, match="outside"):
         next(mlm_batches_from_tokens(big, 256))
+    neg = [{"tokens": np.full((2, 8), -3, np.int32)}]
+    with pytest.raises(ValueError, match="outside"):
+        next(mlm_batches_from_tokens(neg, 256))
