@@ -1,0 +1,36 @@
+#!/bin/bash
+# Companion to chip_watchdog.sh: whenever a measurement step lands (its
+# marker appears in artifacts/wd_done/), commit the corresponding artifact
+# so a banked number can never be lost to a session stall. Exits when all
+# steps are committed.
+set -u
+cd "$(dirname "$0")/.."
+
+declare -A FILES=(
+  [gpt2_ab]="artifacts/gpt2_tune_r04.jsonl"
+  [bert_ab]="artifacts/bert_ab_r04.jsonl"
+  [rn50_s2d_b256]="artifacts/rn50_variants_r04.jsonl"
+  [gpt2_rest]="artifacts/gpt2_tune_r04.jsonl"
+  [rn50_nodonate]="artifacts/rn50_variants_r04.jsonl"
+  [rn50_probe]="artifacts/rn50_breakdown_r04.txt"
+  [rn50_stages]="artifacts/rn50_stages_r04.txt"
+  [sp_smoke]="artifacts/sp_smoke_r04.log"
+  [longctx]="artifacts/longctx_r04.log"
+)
+
+committed() { git log --oneline -20 | grep -q "wd-commit: $1"; }
+
+while :; do
+  all=1
+  for s in "${!FILES[@]}"; do
+    if [ -e "artifacts/wd_done/$s" ] && ! committed "$s"; then
+      git add "${FILES[$s]}" 2>/dev/null
+      git commit -q -m "wd-commit: $s measurement banked (${FILES[$s]})" \
+        2>/dev/null && echo "$(date -u +%H:%M:%SZ) committed $s"
+    fi
+    [ -e "artifacts/wd_done/$s" ] && committed "$s" || all=0
+  done
+  [ "$all" = 1 ] && break
+  sleep 120
+done
+echo "$(date -u +%H:%M:%SZ) all measurements committed"
