@@ -61,21 +61,16 @@ def measure(variant: dict, steps: int, tiny: bool) -> dict:
          "segment_ids": jnp.zeros_like(jnp.asarray(tokens))}
 
     compiled = step.lower(state, b).compile()
-    state, m = compiled(state, b)
-    state, m = compiled(state, b)
-    float(m["loss"])
-    rates = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, m = compiled(state, b)
-        float(m["loss"])
-        rates.append(steps / (time.perf_counter() - t0))
-    rates.sort()
+    # Same timing discipline as bench.py (median-of-5 windows, host-fetch
+    # barriers): the deltas measured here (+3%-ish) are smaller than the
+    # 15% one-window tunnel excursions bench.py documents.
+    from bench import _time_steps
+    # (state buffers are donated inside the timing loop — no further calls
+    # on the original state are legal afterwards.)
+    sps, spread = _time_steps(compiled, state, b, steps, 60.0)
     return {"variant": variant["name"],
-            "tokens_per_sec": round(batch * seq * rates[1], 1),
-            "loss": float(m["loss"]),
-            "spread": round((rates[-1] - rates[0]) / rates[1], 4)}
+            "tokens_per_sec": round(batch * seq * sps, 1),
+            "spread": round(spread, 4)}
 
 
 def main() -> int:

@@ -1,76 +1,103 @@
 #!/bin/bash
 # Detached TPU-tunnel watchdog (round 4). The axon tunnel comes and goes;
 # round 3 lost its entire measurement set to an outage. This loop probes
-# every ~8 min and, whenever the tunnel answers, runs the next PENDING
-# measurement steps (most valuable first, finest granularity) so even a
-# short window banks real numbers. Each completed step drops a marker in
-# artifacts/wd_done/ so a restart never redoes work.
+# every ~8 min and, whenever the tunnel answers, runs the PENDING
+# measurement steps in value order so even a short window banks real
+# numbers. Each completed step drops a marker in artifacts/wd_done/ so a
+# restart never redoes work.
 #
-# Launch:  nohup bash experiments/chip_watchdog.sh >> artifacts/watchdog.log 2>&1 &
-# Outputs: artifacts/gpt2_tune_r04.jsonl, artifacts/rn50_variants_r04.jsonl,
-#          artifacts/rn50_breakdown_r04.txt, artifacts/sp_smoke_r04.log
+# Hardening (r4 review findings):
+# - step stdout goes to a temp file and is appended to the banked artifact
+#   only on rc=0 — a timeout can't leave truncated/duplicate JSON lines;
+# - a step failing repeatedly (3x) is given up (marker *.givenup) instead
+#   of starving every later step in a tight retry loop;
+# - after any failure the tunnel is re-probed before the next step so a
+#   dead tunnel ends the pass instead of burning the remaining steps.
+#
+# Launch:  nohup bash experiments/chip_watchdog.sh >> artifacts/watchdog_r04.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p artifacts/wd_done
+
+STEPS=(gpt2_ab bert_ab rn50_s2d_b256 gpt2_rest rn50_nodonate rn50_probe
+       rn50_stages sp_smoke longctx)
 
 probe() {
   timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1
 }
 
-run_step() {  # $1 marker, $2 timeout_s, rest: command (appends stdout to $3)
-  local name="$1" tmo="$2" out="$3"; shift 3
-  [ -e "artifacts/wd_done/$name" ] && return 0
+step_cmd() {  # $1 step -> echoes "timeout_s|artifact|command..."
+  case "$1" in
+    gpt2_ab)       echo "1500|artifacts/gpt2_tune_r04.jsonl|python experiments/gpt2_tune.py --variants baseline ln_pallas" ;;
+    bert_ab)       echo "1500|artifacts/bert_ab_r04.jsonl|python experiments/bert_ab.py" ;;
+    rn50_s2d_b256) echo "1500|artifacts/rn50_variants_r04.jsonl|python experiments/rn50_probe.py --variants s2d b256" ;;
+    gpt2_rest)     echo "1800|artifacts/gpt2_tune_r04.jsonl|python experiments/gpt2_tune.py --variants attn_xla remat no_donate" ;;
+    rn50_nodonate) echo "1200|artifacts/rn50_variants_r04.jsonl|python experiments/rn50_probe.py --variants no_donate" ;;
+    rn50_probe)    echo "1500|artifacts/rn50_breakdown_r04.txt|python experiments/rn50_probe.py --probe" ;;
+    rn50_stages)   echo "1500|artifacts/rn50_stages_r04.txt|python experiments/rn50_probe.py --stages" ;;
+    sp_smoke)      echo "1200|artifacts/sp_smoke_r04.log|python -m nezha_tpu.cli.train --config gpt2_124m --steps 3 --batch-size 2 --seq-len 512 --parallel sp --mesh dp=1,sp=1 --sp-flash on --log-every 1" ;;
+    longctx)       echo "1500|artifacts/longctx_r04.log|python -m nezha_tpu.cli.train --config gpt2_124m --steps 24 --batch-size 1 --seq-len 8192 --remat --log-every 12" ;;
+  esac
+}
+
+resolved() {  # done or given up
+  [ -e "artifacts/wd_done/$1" ] || [ -e "artifacts/wd_done/$1.givenup" ]
+}
+
+all_resolved() {
+  for s in "${STEPS[@]}"; do resolved "$s" || return 1; done
+  return 0
+}
+
+run_step() {  # $1 step name; returns 0 ok, 1 failed
+  local name="$1" spec tmo out cmd
+  spec="$(step_cmd "$name")"
+  tmo="${spec%%|*}"; spec="${spec#*|}"
+  out="${spec%%|*}"; cmd="${spec#*|}"
+  local tmp="artifacts/.wd_tmp_$name"
   echo "$(date -u +%H:%M:%SZ) step $name START"
-  if timeout "$tmo" "$@" >> "$out" 2>> "artifacts/wd_err_$name.log"; then
+  if timeout "$tmo" $cmd > "$tmp" 2>> "artifacts/wd_err_$name.log"; then
+    cat "$tmp" >> "$out"
+    rm -f "$tmp"
     touch "artifacts/wd_done/$name"
     echo "$(date -u +%H:%M:%SZ) step $name DONE"
     return 0
   fi
-  echo "$(date -u +%H:%M:%SZ) step $name FAILED/TIMEOUT (will retry)"
+  rm -f "$tmp"
   pkill -9 -f "experiments/gpt2_tune.py" 2>/dev/null
   pkill -9 -f "experiments/bert_ab.py" 2>/dev/null
   pkill -9 -f "experiments/rn50_probe.py" 2>/dev/null
   pkill -9 -f "nezha_tpu.cli.train" 2>/dev/null
+  local fails_file="artifacts/wd_done/.fails_$name"
+  local fails=$(( $(cat "$fails_file" 2>/dev/null || echo 0) + 1 ))
+  echo "$fails" > "$fails_file"
+  if [ "$fails" -ge 3 ]; then
+    touch "artifacts/wd_done/$name.givenup"
+    echo "$(date -u +%H:%M:%SZ) step $name GIVEN UP after $fails failures"
+  else
+    echo "$(date -u +%H:%M:%SZ) step $name FAILED ($fails/3, will retry)"
+  fi
   return 1
 }
 
-all_done() {
-  for s in gpt2_ab bert_ab rn50_s2d_b256 gpt2_rest rn50_nodonate \
-           rn50_probe rn50_stages sp_smoke longctx; do
-    [ -e "artifacts/wd_done/$s" ] || return 1
-  done
-  return 0
-}
-
-while ! all_done; do
+while ! all_resolved; do
   if probe; then
     echo "$(date -u +%H:%M:%SZ) tunnel UP"
-    run_step gpt2_ab 1500 artifacts/gpt2_tune_r04.jsonl \
-      python experiments/gpt2_tune.py --variants baseline ln_pallas || continue
-    run_step bert_ab 1500 artifacts/bert_ab_r04.jsonl \
-      python experiments/bert_ab.py || continue
-    run_step rn50_s2d_b256 1500 artifacts/rn50_variants_r04.jsonl \
-      python experiments/rn50_probe.py --variants s2d b256 || continue
-    run_step gpt2_rest 1800 artifacts/gpt2_tune_r04.jsonl \
-      python experiments/gpt2_tune.py --variants attn_xla remat no_donate || continue
-    run_step rn50_nodonate 1200 artifacts/rn50_variants_r04.jsonl \
-      python experiments/rn50_probe.py --variants no_donate || continue
-    run_step rn50_probe 1500 artifacts/rn50_breakdown_r04.txt \
-      python experiments/rn50_probe.py --probe || continue
-    run_step rn50_stages 1500 artifacts/rn50_stages_r04.txt \
-      python experiments/rn50_probe.py --stages || continue
-    run_step sp_smoke 1200 artifacts/sp_smoke_r04.log \
-      python -m nezha_tpu.cli.train --config gpt2_124m --steps 3 \
-        --batch-size 2 --seq-len 512 --parallel sp --mesh dp=1,sp=1 \
-        --sp-flash on --log-every 1 || continue
-    # Long-context single-chip: S=8192 with per-block remat + flash attn.
-    # Second window's examples_per_sec excludes compile; x8192 = tokens/s.
-    run_step longctx 1500 artifacts/longctx_r04.log \
-      python -m nezha_tpu.cli.train --config gpt2_124m --steps 24 \
-        --batch-size 1 --seq-len 8192 --remat --log-every 12 || continue
+    for s in "${STEPS[@]}"; do
+      resolved "$s" && continue
+      if ! run_step "$s"; then
+        # Distinguish "step is broken" from "tunnel died mid-step": only
+        # continue down the list while the tunnel still answers.
+        if ! probe; then
+          echo "$(date -u +%H:%M:%SZ) tunnel lost mid-pass"
+          break
+        fi
+      fi
+    done
   else
     echo "$(date -u +%H:%M:%SZ) probe failed/hung"
   fi
+  all_resolved && break
   sleep 480
 done
-echo "$(date -u +%H:%M:%SZ) ALL MEASUREMENT STEPS DONE"
+echo "$(date -u +%H:%M:%SZ) ALL MEASUREMENT STEPS RESOLVED"

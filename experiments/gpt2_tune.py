@@ -46,26 +46,19 @@ def measure(variant: dict, batch: int, seq: int, steps: int,
         0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32)
     b = {"tokens": jnp.asarray(tokens)}
 
-    # Warm (compile), then 3 timing windows; median.
-    for _ in range(2):
-        state, m = step(state, b)
-    float(m["loss"])
-    rates = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, m = step(state, b)
-        float(m["loss"])
-        rates.append(steps / (time.perf_counter() - t0))
-    rates.sort()
-    tps = batch * seq * rates[1]
+    # bench.py's timing discipline (median-of-5 windows, host-fetch
+    # barriers) — the levers here are few-% items, smaller than one-window
+    # tunnel excursions.
+    from bench import _time_steps
+    sps, spread = _time_steps(step, state, b, steps, 60.0)
+    tps = batch * seq * sps
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(
         state["variables"]["params"]))
     flops = (6 * n_params + 6 * cfg.num_layers * cfg.hidden_size * seq) \
         * batch * seq
     return {"variant": variant["name"], "tokens_per_sec": round(tps, 1),
-            "mfu": round(flops * rates[1] / 197e12, 4),
-            "spread": round((rates[-1] - rates[0]) / rates[1], 4)}
+            "mfu": round(flops * sps / 197e12, 4),
+            "spread": round(spread, 4)}
 
 
 VARIANTS = [

@@ -73,24 +73,14 @@ def measure(variant: dict, steps: int) -> dict:
         flops = cost.get("flops") or None
     except Exception:
         pass
-    # Threading state through the loop keeps donation legal (each step
-    # consumes the previous step's output buffers).
-    state, m = compiled(state, b)
-    state, m = compiled(state, b)
-    float(m["loss"])
-    rates = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, m = compiled(state, b)
-        float(m["loss"])
-        rates.append(steps / (time.perf_counter() - t0))
-    rates.sort()
+    # bench.py's timing discipline (median-of-5 windows, host-fetch
+    # barriers; state threads through, so donation stays legal).
+    from bench import _time_steps
+    sps, spread = _time_steps(compiled, state, b, steps, 90.0)
     return {"variant": variant["name"], "batch": batch,
-            "images_per_sec": round(batch * rates[1], 1),
-            "mfu": round(flops * rates[1] / PEAK_FLOPS, 4)
-            if flops else None,
-            "spread": round((rates[-1] - rates[0]) / rates[1], 4)}
+            "images_per_sec": round(batch * sps, 1),
+            "mfu": round(flops * sps / PEAK_FLOPS, 4) if flops else None,
+            "spread": round(spread, 4)}
 
 
 VARIANTS = [

@@ -1,36 +1,39 @@
 #!/bin/bash
-# Companion to chip_watchdog.sh: whenever a measurement step lands (its
-# marker appears in artifacts/wd_done/), commit the corresponding artifact
-# so a banked number can never be lost to a session stall. Exits when all
-# steps are committed.
+# Companion to chip_watchdog.sh: whenever new measurement output lands in
+# artifacts/, commit it so a banked number can never be lost to a session
+# stall. One commit per sweep covering every changed artifact (steps share
+# ledger files, so per-step commits would race); completion is judged by
+# the watchdog's own markers, not git history. Exits when every step is
+# resolved (done or given up) and the last sweep found nothing to commit.
 set -u
 cd "$(dirname "$0")/.."
 
-declare -A FILES=(
-  [gpt2_ab]="artifacts/gpt2_tune_r04.jsonl"
-  [bert_ab]="artifacts/bert_ab_r04.jsonl"
-  [rn50_s2d_b256]="artifacts/rn50_variants_r04.jsonl"
-  [gpt2_rest]="artifacts/gpt2_tune_r04.jsonl"
-  [rn50_nodonate]="artifacts/rn50_variants_r04.jsonl"
-  [rn50_probe]="artifacts/rn50_breakdown_r04.txt"
-  [rn50_stages]="artifacts/rn50_stages_r04.txt"
-  [sp_smoke]="artifacts/sp_smoke_r04.log"
-  [longctx]="artifacts/longctx_r04.log"
-)
+ARTIFACTS=(artifacts/gpt2_tune_r04.jsonl artifacts/bert_ab_r04.jsonl
+           artifacts/rn50_variants_r04.jsonl artifacts/rn50_breakdown_r04.txt
+           artifacts/rn50_stages_r04.txt artifacts/sp_smoke_r04.log
+           artifacts/longctx_r04.log)
+STEPS=(gpt2_ab bert_ab rn50_s2d_b256 gpt2_rest rn50_nodonate rn50_probe
+       rn50_stages sp_smoke longctx)
 
-committed() { git log --oneline -20 | grep -q "wd-commit: $1"; }
+all_resolved() {
+  for s in "${STEPS[@]}"; do
+    [ -e "artifacts/wd_done/$s" ] || [ -e "artifacts/wd_done/$s.givenup" ] \
+      || return 1
+  done
+  return 0
+}
 
 while :; do
-  all=1
-  for s in "${!FILES[@]}"; do
-    if [ -e "artifacts/wd_done/$s" ] && ! committed "$s"; then
-      git add "${FILES[$s]}" 2>/dev/null
-      git commit -q -m "wd-commit: $s measurement banked (${FILES[$s]})" \
-        2>/dev/null && echo "$(date -u +%H:%M:%SZ) committed $s"
-    fi
-    [ -e "artifacts/wd_done/$s" ] && committed "$s" || all=0
+  for f in "${ARTIFACTS[@]}"; do
+    [ -e "$f" ] && git add "$f" 2>/dev/null
   done
-  [ "$all" = 1 ] && break
+  if ! git diff --cached --quiet; then
+    git commit -q -m "wd-commit: bank chip measurement artifacts" &&
+      echo "$(date -u +%H:%M:%SZ) committed banked artifacts"
+  fi
+  if all_resolved && git diff --cached --quiet; then
+    break
+  fi
   sleep 120
 done
-echo "$(date -u +%H:%M:%SZ) all measurements committed"
+echo "$(date -u +%H:%M:%SZ) all measurements resolved and committed"

@@ -46,12 +46,16 @@ def _sample(logits, rng, temperature: float, top_k: Optional[int],
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p is not None and top_p < 1.0:
         # Nucleus: keep the smallest prefix of descending-prob tokens whose
-        # mass reaches top_p. Exclusive cumsum so the first token always
-        # survives (top_p -> 0 degrades to argmax, never to an empty set).
+        # mass reaches top_p. The explicit rank==0 term keeps the top token
+        # even at top_p <= 0 (exclusive-cumsum alone would empty the set
+        # there and categorical over all--inf rows silently emits id 0) —
+        # top_p -> 0 degrades to argmax, never to an empty set.
         sorted_logits = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         exclusive_cum = jnp.cumsum(probs, axis=-1) - probs
-        keep = exclusive_cum < top_p
+        rank = lax.broadcasted_iota(jnp.int32, sorted_logits.shape,
+                                    sorted_logits.ndim - 1)
+        keep = (exclusive_cum < top_p) | (rank == 0)
         threshold = jnp.min(
             jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
         logits = jnp.where(logits < threshold, -jnp.inf, logits)

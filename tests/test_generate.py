@@ -96,6 +96,14 @@ def test_top_p_nucleus_filtering():
         a = _sample(logits, jax.random.PRNGKey(i), 1.0, None, 1.0)
         b = _sample(logits, jax.random.PRNGKey(i), 1.0, None, None)
         assert int(a[0]) == int(b[0])
+    # top_p <= 0 degrades to argmax — never to an empty nucleus (which
+    # categorical would silently turn into always-id-0). Max logit is at
+    # index 0 here, so assert via a shifted copy whose argmax is index 3.
+    shifted = jnp.asarray([[1.0, 2.0, 3.0, 5.0, 4.0]], jnp.float32)
+    for p in (0.0, -1.0):
+        for i in range(10):
+            tok = _sample(shifted, jax.random.PRNGKey(i), 1.0, None, p)
+            assert int(tok[0]) == 3
 
 
 def test_generate_with_top_p(model_and_vars):
