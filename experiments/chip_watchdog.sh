@@ -1,26 +1,39 @@
 #!/bin/bash
-# Detached TPU-tunnel watchdog (round 4). The axon tunnel comes and goes;
-# round 3 lost its entire measurement set to an outage. This loop probes
+# Detached TPU-tunnel watchdog (round 5). The axon tunnel comes and goes
+# (r3: total outage; r4: one 20-min window in ~20 h). This loop probes
 # every ~8 min and, whenever the tunnel answers, runs the PENDING
 # measurement steps in value order so even a short window banks real
-# numbers. Each completed step drops a marker in artifacts/wd_done/ so a
+# numbers. Each completed step drops a marker in artifacts/wd_done_r05/ so a
 # restart never redoes work.
 #
-# Hardening (r4 review findings):
+# Hardening (r4 review findings + r4 advisor):
 # - step stdout goes to a temp file and is appended to the banked artifact
 #   only on rc=0 — a timeout can't leave truncated/duplicate JSON lines;
 # - a step failing repeatedly (3x) is given up (marker *.givenup) instead
 #   of starving every later step in a tight retry loop;
 # - after any failure the tunnel is re-probed before the next step so a
-#   dead tunnel ends the pass instead of burning the remaining steps.
+#   dead tunnel ends the pass instead of burning the remaining steps;
+# - each step runs under setsid in its own process group and cleanup
+#   kills THAT group only (kill -9 -- -PID) — no pkill pattern matching
+#   that could hit an operator's concurrent run (ADVICE r4).
 #
-# Launch:  nohup bash experiments/chip_watchdog.sh >> artifacts/watchdog_r04.log 2>&1 &
+# Round-5 queue rationale (VERDICT r4 "Next round"):
+# 1. rn50_stages — per-stage traffic probe, the round-5 headline
+#    diagnosis (never run on chip).
+# 2. bench_full — full bench.py as the measurement of record (r4's
+#    driver bench failed; the builder-banked run saved the round).
+# 3. gpt2_ab / bert_ab — flip ln_impl / attn defaults on evidence.
+# 4. the rest: rn50 variants, gpt2 trunk levers, mlp profile, graph-IR
+#    GPT-2 vs module engine, sp smoke, long-context point.
+#
+# Launch:  nohup bash experiments/chip_watchdog.sh >> artifacts/watchdog_r05.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
-mkdir -p artifacts/wd_done
+mkdir -p artifacts/wd_done_r05
 
-STEPS=(gpt2_ab bert_ab rn50_s2d_b256 gpt2_rest rn50_nodonate rn50_probe
-       rn50_stages sp_smoke longctx)
+STEPS=(rn50_stages bench_full gpt2_ab bert_ab rn50_s2d_b256 gpt2_scan
+       gpt2_rest mlp_profile graph_gpt2 rn50_nodonate rn50_probe
+       sp_smoke longctx)
 
 probe() {
   timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1
@@ -28,20 +41,24 @@ probe() {
 
 step_cmd() {  # $1 step -> echoes "timeout_s|artifact|command..."
   case "$1" in
-    gpt2_ab)       echo "1500|artifacts/gpt2_tune_r04.jsonl|python experiments/gpt2_tune.py --variants baseline ln_pallas" ;;
-    bert_ab)       echo "1500|artifacts/bert_ab_r04.jsonl|python experiments/bert_ab.py" ;;
-    rn50_s2d_b256) echo "1500|artifacts/rn50_variants_r04.jsonl|python experiments/rn50_probe.py --variants s2d b256" ;;
-    gpt2_rest)     echo "1800|artifacts/gpt2_tune_r04.jsonl|python experiments/gpt2_tune.py --variants attn_xla remat no_donate" ;;
-    rn50_nodonate) echo "1200|artifacts/rn50_variants_r04.jsonl|python experiments/rn50_probe.py --variants no_donate" ;;
-    rn50_probe)    echo "1500|artifacts/rn50_breakdown_r04.txt|python experiments/rn50_probe.py --probe" ;;
-    rn50_stages)   echo "1500|artifacts/rn50_stages_r04.txt|python experiments/rn50_probe.py --stages" ;;
-    sp_smoke)      echo "1200|artifacts/sp_smoke_r04.log|python -m nezha_tpu.cli.train --config gpt2_124m --steps 3 --batch-size 2 --seq-len 512 --parallel sp --mesh dp=1,sp=1 --sp-flash on --log-every 1" ;;
-    longctx)       echo "1500|artifacts/longctx_r04.log|python -m nezha_tpu.cli.train --config gpt2_124m --steps 24 --batch-size 1 --seq-len 8192 --remat --log-every 12" ;;
+    rn50_stages)   echo "1500|artifacts/rn50_stages_r05.txt|python experiments/rn50_probe.py --stages" ;;
+    bench_full)    echo "2400|artifacts/bench_r05_live.json|python bench.py" ;;
+    gpt2_ab)       echo "1500|artifacts/gpt2_tune_r05.jsonl|python experiments/gpt2_tune.py --variants baseline ln_pallas" ;;
+    bert_ab)       echo "1500|artifacts/bert_ab_r05.jsonl|python experiments/bert_ab.py" ;;
+    rn50_s2d_b256) echo "1500|artifacts/rn50_variants_r05.jsonl|python experiments/rn50_probe.py --variants s2d b256" ;;
+    gpt2_scan)     echo "1500|artifacts/gpt2_tune_r05.jsonl|python experiments/gpt2_tune.py --variants scan" ;;
+    gpt2_rest)     echo "1800|artifacts/gpt2_tune_r05.jsonl|python experiments/gpt2_tune.py --variants attn_xla remat no_donate" ;;
+    mlp_profile)   echo "900|artifacts/mlp_profile_r05.txt|python experiments/mlp_probe.py" ;;
+    graph_gpt2)    echo "1500|artifacts/graph_gpt2_r05.jsonl|python experiments/graph_bench.py" ;;
+    rn50_nodonate) echo "1200|artifacts/rn50_variants_r05.jsonl|python experiments/rn50_probe.py --variants no_donate" ;;
+    rn50_probe)    echo "1500|artifacts/rn50_breakdown_r05.txt|python experiments/rn50_probe.py --probe" ;;
+    sp_smoke)      echo "1200|artifacts/sp_smoke_r05.log|python -m nezha_tpu.cli.train --config gpt2_124m --steps 3 --batch-size 2 --seq-len 512 --parallel sp --mesh dp=1,sp=1 --sp-flash on --log-every 1" ;;
+    longctx)       echo "1500|artifacts/longctx_r05.log|python -m nezha_tpu.cli.train --config gpt2_124m --steps 24 --batch-size 1 --seq-len 8192 --remat --log-every 12" ;;
   esac
 }
 
 resolved() {  # done or given up
-  [ -e "artifacts/wd_done/$1" ] || [ -e "artifacts/wd_done/$1.givenup" ]
+  [ -e "artifacts/wd_done_r05/$1" ] || [ -e "artifacts/wd_done_r05/$1.givenup" ]
 }
 
 all_resolved() {
@@ -56,23 +73,24 @@ run_step() {  # $1 step name; returns 0 ok, 1 failed
   out="${spec%%|*}"; cmd="${spec#*|}"
   local tmp="artifacts/.wd_tmp_$name"
   echo "$(date -u +%H:%M:%SZ) step $name START"
-  if timeout "$tmo" $cmd > "$tmp" 2>> "artifacts/wd_err_$name.log"; then
+  # setsid: the child leads its own process group so cleanup can kill
+  # exactly that group (grandchildren included) and nothing else.
+  setsid timeout "$tmo" $cmd > "$tmp" 2>> "artifacts/wd_err_$name.log" &
+  local pid=$!
+  if wait "$pid"; then
     cat "$tmp" >> "$out"
     rm -f "$tmp"
-    touch "artifacts/wd_done/$name"
+    touch "artifacts/wd_done_r05/$name"
     echo "$(date -u +%H:%M:%SZ) step $name DONE"
     return 0
   fi
+  kill -9 -- "-$pid" 2>/dev/null
   rm -f "$tmp"
-  pkill -9 -f "experiments/gpt2_tune.py" 2>/dev/null
-  pkill -9 -f "experiments/bert_ab.py" 2>/dev/null
-  pkill -9 -f "experiments/rn50_probe.py" 2>/dev/null
-  pkill -9 -f "nezha_tpu.cli.train" 2>/dev/null
-  local fails_file="artifacts/wd_done/.fails_$name"
+  local fails_file="artifacts/wd_done_r05/.fails_$name"
   local fails=$(( $(cat "$fails_file" 2>/dev/null || echo 0) + 1 ))
   echo "$fails" > "$fails_file"
   if [ "$fails" -ge 3 ]; then
-    touch "artifacts/wd_done/$name.givenup"
+    touch "artifacts/wd_done_r05/$name.givenup"
     echo "$(date -u +%H:%M:%SZ) step $name GIVEN UP after $fails failures"
   else
     echo "$(date -u +%H:%M:%SZ) step $name FAILED ($fails/3, will retry)"

@@ -64,6 +64,7 @@ def measure(variant: dict, batch: int, seq: int, steps: int,
 VARIANTS = [
     {"name": "baseline"},
     {"name": "ln_pallas", "cfg": {"ln_impl": "pallas"}},
+    {"name": "scan", "cfg": {"scan_layers": True}},  # one-block trunk scan
     {"name": "attn_xla", "cfg": {"attn_impl": "xla"}},
     {"name": "remat", "cfg": {"remat": True}},  # cost of the memory knob
     {"name": "no_donate", "donate": False},

@@ -1,0 +1,126 @@
+"""Graph-IR engine vs module engine on GPT-2 — the VERDICT r4 item-6
+measurement: is the StableHLO-lowered IR path within 5% of the module
+path, or is it a correctness/portability engine with a quantified gap?
+
+Three points, one JSON line each (bench.py timing discipline):
+
+  - module_bf16:  the production module config (bf16 policy, Pallas flash
+                  attention, fused logsumexp head) — the number of record.
+  - module_fp32_xla: module engine configured like today's IR program
+                  (fp32 policy, composed XLA attention, dense fp32-logit
+                  CE) — isolates ENGINE overhead from FEATURE gap.
+  - graph_ir:     gpt2_loss_graph + IR-authored AdamW update
+                  (graph/programs.py), StableHLO via graph/lower.py.
+
+If graph_ir ~= module_fp32_xla, the IR engine itself is sound and the gap
+to module_bf16 is feature coverage (bf16 policy + flash node + fused
+head in the IR — the written-down backlog). The conclusion goes to
+BENCH_NOTES.md and docs/DESIGN.md.
+
+Usage: python experiments/graph_bench.py [--steps 12] [--batch 8] [--seq 1024]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _flops(cfg, n_params: int, batch: int, seq: int) -> float:
+    return (6 * n_params + 6 * cfg.num_layers * cfg.hidden_size * seq) \
+        * batch * seq
+
+
+def measure_module(name: str, batch: int, seq: int, steps: int, tiny: bool,
+                   bf16: bool) -> dict:
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+
+    from nezha_tpu import optim
+    from nezha_tpu.models.gpt2 import GPT2, GPT2Config, lm_loss
+    from nezha_tpu.tensor import bf16_policy
+    from nezha_tpu.tensor.policy import DEFAULT_POLICY
+    from nezha_tpu.train.loop import init_train_state, make_train_step
+
+    small = dict(vocab_size=256, max_positions=max(seq, 64), num_layers=2,
+                 num_heads=4, hidden_size=64) if tiny else {}
+    if bf16:
+        cfg = GPT2Config(fused_loss_chunk=-1, **small)
+        model = GPT2(cfg, policy=bf16_policy())
+    else:  # mirror today's IR program: fp32, composed attention, dense CE
+        cfg = GPT2Config(attn_impl="xla", **small)
+        model = GPT2(cfg, policy=DEFAULT_POLICY)
+    opt = optim.adamw(6e-4, weight_decay=0.1)
+    state = init_train_state(model, opt, jax.random.PRNGKey(0))
+    step = make_train_step(model, opt, lm_loss)
+    tokens = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32)
+    b = {"tokens": jnp.asarray(tokens)}
+
+    from bench import _time_steps
+    sps, spread = _time_steps(step, state, b, steps, 90.0)
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(
+        state["variables"]["params"]))
+    return {"engine": name, "tokens_per_sec": round(batch * seq * sps, 1),
+            "mfu": round(_flops(cfg, n_params, batch, seq) * sps / 197e12, 4),
+            "spread": round(spread, 4)}
+
+
+def measure_graph(batch: int, seq: int, steps: int, tiny: bool) -> dict:
+    import jax
+    import numpy as np
+
+    from nezha_tpu.graph import programs
+    from nezha_tpu.models.gpt2 import GPT2, GPT2Config
+
+    small = dict(vocab_size=256, max_positions=max(seq, 64), num_layers=2,
+                 num_heads=4, hidden_size=64) if tiny else {}
+    cfg = GPT2Config(**small)
+    model = GPT2(cfg)  # fp32 default policy — what the IR program mirrors
+    state = programs.init_graph_gpt2_state(model, jax.random.PRNGKey(0))
+    step = programs.make_gpt2_graph_train_step(model, lambda t: 6e-4,
+                                               weight_decay=0.1)
+    shard = programs.lm_shard_fn()
+    tokens = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32)
+    b = shard({"tokens": tokens})
+
+    from bench import _time_steps
+    sps, spread = _time_steps(step, state, b, steps, 120.0)
+    n_params = sum(np.size(x) for x in jax.tree_util.tree_leaves(
+        state["params"]))
+    return {"engine": "graph_ir",
+            "tokens_per_sec": round(batch * seq * sps, 1),
+            "mfu": round(_flops(cfg, n_params, batch, seq) * sps / 197e12, 4),
+            "spread": round(spread, 4)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--tiny", action="store_true",
+                    help="seconds-scale CPU smoke of the harness")
+    args = ap.parse_args()
+    if args.tiny:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    from nezha_tpu.utils import enable_persistent_compile_cache
+    enable_persistent_compile_cache()
+    for fn in (lambda: measure_module("module_bf16", args.batch, args.seq,
+                                      args.steps, args.tiny, bf16=True),
+               lambda: measure_module("module_fp32_xla", args.batch,
+                                      args.seq, args.steps, args.tiny,
+                                      bf16=False),
+               lambda: measure_graph(args.batch, args.seq, args.steps,
+                                     args.tiny)):
+        print(json.dumps(fn()), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,33 +5,56 @@
 # ledger files, so per-step commits would race); completion is judged by
 # the watchdog's own markers, not git history. Exits when every step is
 # resolved (done or given up) and the last sweep found nothing to commit.
+#
+# The commit is pathspec-limited to the ledger files (ADVICE r4): anything
+# an operator has staged in the shared index stays staged and untouched.
 set -u
 cd "$(dirname "$0")/.."
 
-ARTIFACTS=(artifacts/gpt2_tune_r04.jsonl artifacts/bert_ab_r04.jsonl
-           artifacts/rn50_variants_r04.jsonl artifacts/rn50_breakdown_r04.txt
-           artifacts/rn50_stages_r04.txt artifacts/sp_smoke_r04.log
-           artifacts/longctx_r04.log)
-STEPS=(gpt2_ab bert_ab rn50_s2d_b256 gpt2_rest rn50_nodonate rn50_probe
-       rn50_stages sp_smoke longctx)
+ARTIFACTS=(artifacts/rn50_stages_r05.txt artifacts/bench_r05_live.json
+           artifacts/gpt2_tune_r05.jsonl artifacts/bert_ab_r05.jsonl
+           artifacts/rn50_variants_r05.jsonl artifacts/mlp_profile_r05.txt
+           artifacts/graph_gpt2_r05.jsonl artifacts/rn50_breakdown_r05.txt
+           artifacts/sp_smoke_r05.log artifacts/longctx_r05.log)
+STEPS=(rn50_stages bench_full gpt2_ab bert_ab rn50_s2d_b256 gpt2_scan
+       gpt2_rest mlp_profile graph_gpt2 rn50_nodonate rn50_probe
+       sp_smoke longctx)
 
 all_resolved() {
   for s in "${STEPS[@]}"; do
-    [ -e "artifacts/wd_done/$s" ] || [ -e "artifacts/wd_done/$s.givenup" ] \
+    [ -e "artifacts/wd_done_r05/$s" ] || [ -e "artifacts/wd_done_r05/$s.givenup" ] \
       || return 1
   done
   return 0
 }
 
-while :; do
+changed() {  # any artifact new or modified vs HEAD?
   for f in "${ARTIFACTS[@]}"; do
-    [ -e "$f" ] && git add "$f" 2>/dev/null
+    [ -e "$f" ] || continue
+    if ! git ls-files --error-unmatch "$f" >/dev/null 2>&1; then
+      return 0  # exists but untracked
+    fi
+    git diff --quiet HEAD -- "$f" || return 0
   done
-  if ! git diff --cached --quiet; then
-    git commit -q -m "wd-commit: bank chip measurement artifacts" &&
-      echo "$(date -u +%H:%M:%SZ) committed banked artifacts"
+  return 1
+}
+
+while :; do
+  if changed; then
+    # Pathspec-limit the commit to the artifacts that EXIST this sweep —
+    # listing not-yet-created files makes git abort with "pathspec did
+    # not match" and would block banking everything else.
+    existing=()
+    for f in "${ARTIFACTS[@]}"; do
+      [ -e "$f" ] && existing+=("$f")
+    done
+    if [ "${#existing[@]}" -gt 0 ]; then
+      git add -- "${existing[@]}" 2>/dev/null
+      git commit -q -m "wd-commit: bank chip measurement artifacts" -- "${existing[@]}" &&
+        echo "$(date -u +%H:%M:%SZ) committed banked artifacts"
+    fi
   fi
-  if all_resolved && git diff --cached --quiet; then
+  if all_resolved && ! changed; then
     break
   fi
   sleep 120

@@ -296,6 +296,21 @@ def _data_source(args, cfg, batch_size: int, group=None):
                                 ("train.tokens.i32", np.int32)):
                 tok = os.path.join(args.data_dir, name)
                 if os.path.exists(tok):
+                    if args.mlm_mask_token is None:
+                        # Byte-packed corpora (data.pack: ids 0-255) make
+                        # the defaulted mask id 103 a REAL byte — genuine
+                        # 0x67 tokens would be indistinguishable from
+                        # [MASK]. Sample the stream and refuse rather than
+                        # train on ambiguous symbols (ADVICE r4).
+                        sample = np.fromfile(tok, dtype=dtype, count=32768)
+                        if sample.size and int(sample.max()) < 256:
+                            raise SystemExit(
+                                f"{tok} looks byte-packed (sampled ids all "
+                                f"< 256), so the default mask_token "
+                                f"{mask_token} is a real byte value; pass "
+                                f"an explicit --mlm-mask-token (>= 256 "
+                                f"reserves an id byte data cannot produce) "
+                                f"or use a WordPiece-tokenized corpus")
                     loader = TokenLoader(tok, seq_len=seq, batch_size=local,
                                          dtype=dtype, seed=args.seed,
                                          **shard)
@@ -556,6 +571,24 @@ def run(args) -> Dict[str, float]:
             raise SystemExit("--remat is a jax.checkpoint knob; the graph "
                              "engine does not rematerialize")
         _wrap_model_overrides(cfg, remat=True)
+
+    if args.scan_layers:
+        # Scan trunk: a params-layout change (h_scan, leading layer dim),
+        # so restrict to the paths whose param handling is layout-agnostic
+        # and parity-tested; gspmd TP rules and the pipeline/sp builders
+        # address h{i} names explicitly.
+        if args.config != "gpt2_124m":
+            raise SystemExit("--scan-layers applies to gpt2_124m")
+        if args.engine == "graph":
+            raise SystemExit("--scan-layers is a module-engine knob; the "
+                             "graph engine authors its own trunk IR")
+        eff = cfg.parallel_mode if args.parallel == "config" \
+            else args.parallel
+        if eff not in ("single", "dp", "zero1"):
+            raise SystemExit("--scan-layers supports --parallel "
+                             "single/dp/zero1 (gspmd TP rules and the "
+                             "pp/sp builders address unrolled h{i} names)")
+        _wrap_model_overrides(cfg, scan_layers=True)
 
     if args.seq_len:
         # Long-context override: resize position table + data together.
@@ -1198,6 +1231,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "residuals per block for ~1/3 extra FLOPs; the "
                         "long-context memory knob (pairs with --seq-len "
                         "and --parallel sp)")
+    p.add_argument("--scan-layers", action="store_true",
+                   help="gpt2_124m only (single/dp/zero1, module engine): "
+                        "layer-stacked trunk applied via lax.scan — one "
+                        "compiled block program instead of num_layers "
+                        "inlined copies (params live under h_scan with a "
+                        "leading layer dim; see GPT2Config.scan_layers)")
     p.add_argument("--grad-allreduce", default="fp32",
                    choices=["fp32", "int8"],
                    help="dp/zero1 gradient wire format: exact fp32 or "

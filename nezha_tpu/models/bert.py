@@ -91,10 +91,13 @@ class EncoderLayer(Module):
         else:
             if kv_lengths is not None and mask is None:
                 # Same right-padding contract as the flash path, composed:
-                # a prefix mask built from the lengths.
+                # a prefix mask built from the lengths, clamped to >= 1 so
+                # a fully-padded row attends to position 0 instead of
+                # NaN-ing the softmax (flash_attention clamps identically).
                 import jax.numpy as jnp
                 mask = ops.make_attention_mask(
-                    jnp.arange(s)[None, :] < kv_lengths[:, None])
+                    jnp.arange(s)[None, :]
+                    < jnp.maximum(kv_lengths, 1)[:, None])
             att = ops.dot_product_attention(qkv[0], qkv[1], qkv[2], mask=mask)
         att = att.transpose(0, 2, 1, 3).reshape(b, s, h)
         att = run_child(self.attn_out, "attn_out", variables, states, att,

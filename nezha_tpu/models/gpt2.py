@@ -67,6 +67,16 @@ class GPT2Config:
     # with fused_loss_chunk>0 and --parallel sp). Training-only; the
     # KV-cache decode path never remats.
     remat: bool = False
+    # Layer-stacked trunk applied via lax.scan: ONE traced/compiled block
+    # program instead of num_layers inlined copies — cuts XLA trace/
+    # compile time and per-layer scheduling overhead (the r4 trunk-MFU
+    # lever; A/B via experiments/gpt2_tune.py --variants scan). Changes
+    # the params layout: blocks live under "h_scan" with a leading
+    # [num_layers] dim on every leaf (convert with
+    # stack_layer_params/unstack_layer_params). Homogeneous blocks only
+    # (incompatible with moe_experts). Decode still runs per-layer so the
+    # KV-cache/generate path is unchanged.
+    scan_layers: bool = False
 
 
 class Attention(Module):
@@ -201,6 +211,89 @@ class Block(Module):
         return x + y, states
 
 
+class ScannedBlocks(Module):
+    """``num_layers`` homogeneous :class:`Block`s with layer-stacked
+    parameters, applied via ``lax.scan``.
+
+    Every param leaf carries a leading ``[num_layers]`` dim; the scan body
+    slices one layer per iteration, so XLA compiles ONE block program
+    (reference inlines per-layer graph nodes — SURVEY.md §1; on TPU the
+    unrolled trace costs compile time and inter-layer scheduling, which is
+    what this removes). Per-layer dropout RNGs are pre-split outside the
+    scan with the SAME ``h{i}`` derivation as the unrolled trunk, so the
+    two layouts are bit-identical in expectation and in tests.
+    """
+
+    def __init__(self, cfg: GPT2Config, policy: Policy):
+        self.cfg = cfg
+        self.policy = policy
+        # Template holding the single-block structure; its params are
+        # never used directly (init stacks per-layer inits instead).
+        self.block = Block(cfg, policy)
+
+    def init(self, rng: jax.Array) -> Variables:
+        inits = [self.block.init(child_rng(rng, f"h{i}"))
+                 for i in range(self.cfg.num_layers)]
+        params = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *[v["params"] for v in inits])
+        if any(v["state"] for v in inits):
+            raise ValueError("scan_layers requires stateless blocks")
+        return {"params": params, "state": {}}
+
+    def apply(self, variables: Variables, x, training: bool = False,
+              rng=None, pos=None):
+        cfg = self.cfg
+        L = cfg.num_layers
+        stacked = variables["params"]
+        if rng is not None:
+            rngs = jnp.stack([child_rng(rng, f"h{i}") for i in range(L)])
+        else:
+            rngs = None
+
+        def body(carry, layer):
+            lparams, lrng = layer
+            y, st = self.block.apply({"params": lparams, "state": {}},
+                                     carry, training=training, rng=lrng,
+                                     pos=pos)
+            # Homogeneous stateless blocks (MoE is rejected at config
+            # time); anything else would change the carry structure.
+            if st:
+                raise ValueError(
+                    f"scan_layers got unexpected block state {list(st)}")
+            return y, None
+
+        if cfg.remat and training:
+            body = jax.checkpoint(body)
+        if rngs is None:
+            def body_no_rng(carry, lparams, _inner=body):
+                return _inner(carry, (lparams, None))
+            x, _ = jax.lax.scan(body_no_rng, x, stacked)
+        else:
+            x, _ = jax.lax.scan(body, x, (stacked, rngs))
+        return x, {}
+
+
+def stack_layer_params(params: dict, num_layers: int) -> dict:
+    """Unrolled GPT-2 params (``h0`` .. ``h{L-1}``) -> scan layout
+    (``h_scan`` with a leading layer dim). Non-trunk entries pass through."""
+    out = {k: v for k, v in params.items()
+           if not (k.startswith("h") and k[1:].isdigit())}
+    layers = [params[f"h{i}"] for i in range(num_layers)]
+    out["h_scan"] = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *layers)
+    return out
+
+
+def unstack_layer_params(params: dict, num_layers: int) -> dict:
+    """Scan-layout GPT-2 params -> unrolled ``h{i}`` layout (checkpoint/HF
+    interchange, tensor-parallel rule tables)."""
+    out = {k: v for k, v in params.items() if k != "h_scan"}
+    for i in range(num_layers):
+        out[f"h{i}"] = jax.tree_util.tree_map(
+            lambda x: x[i], params["h_scan"])
+    return out
+
+
 class GPT2(Module):
     """Returns LM logits [B, S, vocab]; weight-tied head.
 
@@ -217,10 +310,18 @@ class GPT2(Module):
                                 embedding_init=init_lib.normal(0.01),
                                 policy=policy)
         self.drop = nn.Dropout(cfg.dropout)
-        self.h = [Block(cfg, policy,
-                        use_moe=bool(cfg.moe_experts)
-                        and i % cfg.moe_every == cfg.moe_every - 1)
-                  for i in range(cfg.num_layers)]
+        if cfg.scan_layers:
+            if cfg.moe_experts:
+                raise ValueError(
+                    "scan_layers requires homogeneous blocks; "
+                    "incompatible with moe_experts")
+            self.h_scan = ScannedBlocks(cfg, policy)
+            self.h = []
+        else:
+            self.h = [Block(cfg, policy,
+                            use_moe=bool(cfg.moe_experts)
+                            and i % cfg.moe_every == cfg.moe_every - 1)
+                      for i in range(cfg.num_layers)]
         self.ln_f = nn.LayerNorm(cfg.hidden_size, policy=policy,
                           impl=cfg.ln_impl)
 
@@ -248,6 +349,30 @@ class GPT2(Module):
                           training=training)
         x = run_child(self.drop, "drop", variables, states, x,
                       training=training, rng=rng)
+        if self.cfg.scan_layers:
+            if cache is None:
+                # rng passed RAW (not via run_child): ScannedBlocks does
+                # the per-layer ``h{i}`` derivation itself so dropout keys
+                # match the unrolled trunk exactly.
+                x, _ = self.h_scan.apply(
+                    child_vars(variables, "h_scan"), x,
+                    training=training, rng=rng, pos=pos)
+            else:
+                # Decode: per-layer slices of the stacked params, states
+                # emitted under the unrolled ``h{i}`` names so the
+                # generate/KV-cache plumbing is layout-agnostic.
+                stacked = child_vars(variables, "h_scan")["params"]
+                for i in range(self.cfg.num_layers):
+                    lvars = {"params": jax.tree_util.tree_map(
+                        lambda p, i=i: p[i], stacked), "state": {}}
+                    x, st = self.h_scan.block.apply(
+                        lvars, x, training=training,
+                        rng=child_rng(rng, f"h{i}"), cache=cache[i],
+                        pos=pos)
+                    if st:
+                        states[f"h{i}"] = st
+        # (With scan_layers, self.h is empty — the loop below is a no-op
+        # and the shared ln_f/aux/head tail runs for both layouts.)
         remat = self.cfg.remat and training and cache is None
         for i, block in enumerate(self.h):
             if remat:

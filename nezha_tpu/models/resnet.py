@@ -103,7 +103,11 @@ class ResNet(Module):
     ``width_factor=2`` gives the Wide-ResNet variants (inner bottleneck
     width doubled, output channels unchanged). ``stem="s2d"`` routes the
     7x7/s2 stem through :func:`_space_to_depth_stem` (same parameters,
-    same math, ~3x faster stem on TPU); ``"conv7"`` keeps the plain conv.
+    same math, MXU-tileable layout): measured worth ~+3% e2e over
+    ``"conv7"`` on RN50 (2,212 vs 2,141 img/s, r4 — different windows,
+    tunnel-jitter caveat; the ~3x stem-in-isolation figure from the r3
+    probe arithmetic did NOT materialize e2e, the step is
+    bandwidth-bound elsewhere). ``"conv7"`` keeps the plain conv.
     """
 
     def __init__(self, stage_sizes: Sequence[int], num_classes: int = 1000,

@@ -902,3 +902,52 @@ def test_cli_bert_real_token_data(devices8, tmp_path):
                     "--steps", "2", "--batch-size", "8", "--log-every", "1",
                     "--data-dir", str(tmp_path)])
     assert np.isfinite(metrics["loss"])
+
+
+def test_cli_scan_layers(devices8):
+    """--scan-layers trains the stacked trunk (single + dp), and the
+    incompatible engines/modes reject loudly."""
+    import pytest
+    metrics = _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+                    "--steps", "2", "--batch-size", "2", "--scan-layers",
+                    "--parallel", "single", "--log-every", "1"])
+    assert np.isfinite(metrics["loss"])
+    metrics = _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+                    "--steps", "2", "--batch-size", "8", "--scan-layers",
+                    "--mesh", "dp=8", "--log-every", "1"])
+    assert np.isfinite(metrics["loss"])
+    with pytest.raises(SystemExit, match="scan-layers"):
+        _run(["--config", "bert_base_zero1", "--model-preset", "tiny",
+              "--steps", "1", "--batch-size", "2", "--scan-layers"])
+    with pytest.raises(SystemExit, match="scan-layers"):
+        _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--steps", "1", "--batch-size", "2", "--scan-layers",
+              "--parallel", "gspmd", "--mesh", "dp=4,tp=2"])
+    with pytest.raises(SystemExit, match="graph"):
+        _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--steps", "1", "--batch-size", "2", "--scan-layers",
+              "--engine", "graph"])
+
+
+def test_cli_bert_byte_corpus_requires_explicit_mask_token(tmp_path):
+    """A byte-packed corpus (all sampled ids < 256) with a defaulted MLM
+    mask token is refused — the default 103 is a real byte value there
+    (ADVICE r4); an explicit --mlm-mask-token proceeds."""
+    import pytest
+    try:
+        from nezha_tpu.data.native import load_library
+        load_library()
+    except Exception:
+        pytest.skip("native runtime not available")
+    rng = np.random.RandomState(0)
+    (tmp_path / "train.tokens.u16").write_bytes(
+        rng.randint(0, 256, 8192).astype(np.uint16).tobytes())
+    with pytest.raises(SystemExit, match="byte-packed"):
+        _run(["--config", "bert_base_zero1", "--model-preset", "tiny",
+              "--steps", "1", "--batch-size", "8",
+              "--data-dir", str(tmp_path)])
+    metrics = _run(["--config", "bert_base_zero1", "--model-preset", "tiny",
+                    "--steps", "2", "--batch-size", "8", "--log-every", "1",
+                    "--mlm-mask-token", "300",
+                    "--data-dir", str(tmp_path)])
+    assert np.isfinite(metrics["loss"])

@@ -244,3 +244,22 @@ def test_flash_varlen_jits_and_batches_lengths():
     o2 = f(q, k, v, jnp.asarray([32, 8], jnp.int32))
     assert o1.shape == o2.shape == q.shape
     assert not np.allclose(np.asarray(o1), np.asarray(o2))
+
+
+def test_flash_varlen_zero_length_clamps_to_one():
+    """Length 0 is clamped to 1 (fully-padded row attends to position 0
+    only) — finite output, identical to an explicit length-1 call, and no
+    silent uniform-attention over padding (ADVICE r4)."""
+    q, k, v, _, _, _ = _varlen_setup(s=32, lengths=(20, 32))
+    out0 = flash_attention(q, k, v, causal=False, block_q=8, block_k=8,
+                           kv_lengths=jnp.asarray([0, 32], jnp.int32))
+    out1 = flash_attention(q, k, v, causal=False, block_q=8, block_k=8,
+                           kv_lengths=jnp.asarray([1, 32], jnp.int32))
+    assert np.all(np.isfinite(np.asarray(out0)))
+    np.testing.assert_allclose(np.asarray(out0), np.asarray(out1),
+                               rtol=1e-6, atol=1e-7)
+    # NOT uniform attention over all positions (the pre-clamp failure
+    # mode): row 0 must equal attention restricted to key position 0.
+    only_pos0 = jnp.broadcast_to(v[0, :, :1, :], q[0].shape)
+    np.testing.assert_allclose(np.asarray(out0[0]), np.asarray(only_pos0),
+                               rtol=5e-4, atol=5e-5)
