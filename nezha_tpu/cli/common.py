@@ -67,3 +67,38 @@ def _is_graph_layout(ckpt_dir: str, ckpt) -> bool:
     path = os.path.join(ckpt_dir, f"step_{step:08d}.npz")
     with np.load(path) as z:
         return not any(k.startswith("variables/") for k in z.files)
+
+
+def ckpt_has_scan_trunk(ckpt_dir: str) -> bool:
+    """True when the newest checkpoint in ``ckpt_dir`` (either format)
+    stores GPT-2 trunk params in the scan layout (``h_scan`` — a
+    ``--scan-layers`` training run). Lets nezha-generate/nezha-export
+    rebuild the model with the matching layout instead of failing to
+    match ``h0..hN`` template leaves. Reads directory listings / zip
+    indexes only, never the arrays."""
+    import os
+    from pathlib import Path
+
+    import numpy as np
+
+    from nezha_tpu.train import checkpoint as ckpt
+
+    step = ckpt.latest_step(ckpt_dir)
+    if step is not None:
+        path = os.path.join(ckpt_dir, f"step_{step:08d}.npz")
+        with np.load(path) as z:
+            return any("/h_scan/" in k or k.startswith("h_scan/")
+                       for k in z.files)
+    # Sharded layout: leaf paths live in the meta_p*.json indexes.
+    steps = sorted(Path(ckpt_dir).glob("step_*"))
+    if not steps:
+        return False
+    for meta in steps[-1].glob("meta_p*.json"):
+        try:
+            text = meta.read_text()
+        except OSError:
+            continue
+        if "h_scan" in text:
+            return True
+        return False  # first meta names every leaf path prefix
+    return False

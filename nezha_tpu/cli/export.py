@@ -65,12 +65,20 @@ def run(args) -> dict:
     # Restore templates need the param SHAPES only (master params are fp32
     # under every policy), so default-policy models suffice.
     if args.config == "gpt2_124m":
+        # --scan-layers checkpoints store the trunk stacked under h_scan;
+        # restore with the matching template, then unstack to the h{i}
+        # layout the HF conversion names.
+        from nezha_tpu.cli.common import ckpt_has_scan_trunk
+        scan = ckpt_has_scan_trunk(args.ckpt_dir)
         if args.model_preset == "full":
-            model = GPT2(GPT2Config())
+            model = GPT2(GPT2Config(scan_layers=scan))
         else:
             from nezha_tpu.cli.train import TINY_GPT2_KW
-            model = GPT2(GPT2Config(**TINY_GPT2_KW))
+            model = GPT2(GPT2Config(**TINY_GPT2_KW, scan_layers=scan))
         params = _restore_params(args, model, optim.sgd(0.1))
+        if scan:
+            from nezha_tpu.models.gpt2 import unstack_layer_params
+            params = unstack_layer_params(params, model.cfg.num_layers)
         state_dict = convert.gpt2_params_to_hf(
             jax.device_get(params), model.cfg.num_layers)
     else:

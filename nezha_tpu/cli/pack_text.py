@@ -1,0 +1,101 @@
+"""``nezha-pack-text``: text files -> flat binary token files for
+`nezha-train --data-dir` (SURVEY.md §2 data loaders; LM configs 3-4).
+
+Byte-level by default (vocab 256, zero dependencies); with
+``--tokenizer DIR`` the corpus is encoded with the real GPT-2 BPE or BERT
+WordPiece vocabulary in that directory (``vocab.json``+``merges.txt`` or
+``vocab.txt`` — the files a Hugging Face checkpoint ships; network-free,
+see data/tokenizer.py). Usage::
+
+    nezha-pack-text docs/ --out /data/corpus/train.tokens.u16
+    nezha-pack-text book.txt --tokenizer /ckpts/gpt2 \
+        --out /data/corpus/train.tokens.u16
+    nezha-train --config gpt2_124m --data-dir /data/corpus
+
+The output dtype follows the vocab (uint16 when every id fits, else
+int32) and the filename must match what nezha-train probes for
+(train.tokens.u16 / train.tokens.i32).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="nezha-pack-text",
+        description="Pack text files/trees into a flat binary token file "
+                    "for nezha-train --data-dir.")
+    p.add_argument("src", nargs="+",
+                   help="text files and/or directories (directories are "
+                        "walked for --suffix files)")
+    p.add_argument("--out", required=True,
+                   help="output token file, e.g. corpus/train.tokens.u16")
+    p.add_argument("--tokenizer", default=None,
+                   help="tokenizer directory (vocab.json+merges.txt for "
+                        "GPT-2 BPE, vocab.txt for BERT WordPiece); "
+                        "default: byte-level vocab 256")
+    p.add_argument("--suffix", nargs="+", default=[".txt", ".md", ".py"],
+                   help="file suffixes picked up under directory sources")
+    return p
+
+
+def run(args) -> dict:
+    import numpy as np
+
+    from nezha_tpu.data import pack
+
+    paths = []
+    for s in args.src:
+        if os.path.isdir(s):
+            for dirpath, dirnames, filenames in os.walk(s):
+                dirnames[:] = [d for d in dirnames if d not in
+                               (".git", "__pycache__", ".pytest_cache")]
+                paths.extend(os.path.join(dirpath, f) for f in filenames
+                             if any(f.endswith(x) for x in args.suffix))
+        elif os.path.isfile(s):
+            paths.append(s)
+        else:
+            raise SystemExit(f"no such file or directory: {s}")
+    if not paths:
+        raise SystemExit("no input files matched")
+
+    out_dir = os.path.dirname(os.path.abspath(args.out))
+    os.makedirs(out_dir, exist_ok=True)
+    if args.tokenizer:
+        from nezha_tpu.data.tokenizer import load_tokenizer
+        tok = load_tokenizer(args.tokenizer)
+        dtype = pack.token_dtype(tok.vocab_size)
+        want = ".u16" if dtype == np.uint16 else ".i32"
+        if not args.out.endswith(want):
+            # nezha-train probes train.tokens.u16/.i32 by name; a mismatch
+            # here would silently misread every id at training time.
+            raise SystemExit(
+                f"--out must end in {want} for a vocab of "
+                f"{tok.vocab_size} (nezha-train infers dtype from the "
+                f"filename)")
+        n = pack.pack_text_files_tokenized(paths, args.out, tok,
+                                           dtype=dtype)
+        kind = type(tok).__name__
+    else:
+        if not args.out.endswith(".u16"):
+            raise SystemExit("--out must end in .u16 for byte-level "
+                             "packing (nezha-train infers dtype from the "
+                             "filename)")
+        n = pack.pack_text_files(paths, args.out)
+        kind = "byte-level"
+    print(f"packed {len(paths)} files -> {args.out}: {n} tokens ({kind})",
+          file=sys.stderr)
+    return {"files": len(paths), "tokens": int(n), "tokenizer": kind}
+
+
+def main(argv=None) -> int:
+    run(build_parser().parse_args(argv))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

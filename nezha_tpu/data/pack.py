@@ -1,9 +1,14 @@
 """Pack text files into flat binary token files for the native TokenLoader.
 
-Byte-level tokenization (vocab 256): no external vocab files needed (this
-image has no network egress for BPE downloads), ids are valid under any
-model vocab >= 256, and real text still yields a real next-token learning
-signal — the convergence evidence VERDICT round 1 item 10 asks for.
+Two encodings:
+
+- **Byte-level** (vocab 256, the zero-dependency default): ids are valid
+  under any model vocab >= 256 and real text still yields a real
+  next-token learning signal.
+- **Subword** via :mod:`nezha_tpu.data.tokenizer` (GPT-2 byte-level BPE
+  or BERT WordPiece over user-supplied vocab files — network-free): the
+  reference's actual GPT-2 124M / BERT-base data parity
+  (:func:`pack_text_files_tokenized`, ``nezha-pack-text --tokenizer``).
 """
 
 from __future__ import annotations
@@ -40,3 +45,39 @@ def pack_tree(root: str, out_path: str,
             if any(f.endswith(s) for s in suffixes):
                 paths.append(os.path.join(dirpath, f))
     return pack_text_files(paths, out_path, dtype=dtype)
+
+
+def token_dtype(vocab_size: int):
+    """The one dtype rule for packed token files: uint16 when every id
+    fits (GPT-2's 50257 and BERT's 30522 both do), else int32. Shared by
+    the packers and the `nezha-pack-text` filename check so they cannot
+    diverge."""
+    return np.uint16 if vocab_size <= 65536 else np.int32
+
+
+def pack_text_files_tokenized(paths: Iterable[str], out_path: str,
+                              tokenizer, dtype=None) -> int:
+    """Encode files with ``tokenizer`` (``encode(str) -> ids``; see
+    ``data.tokenizer``) -> flat token file; returns the token count.
+
+    ``dtype=None`` uses :func:`token_dtype`. Files are concatenated in
+    sorted order with a document boundary between them: the tokenizer's
+    ``[SEP]`` id when it has one (WordPiece — whose basic tokenizer
+    would drop a bare newline), else the encoded newline (BPE)."""
+    from nezha_tpu.data.tokenizer import encode_plain
+
+    sep_tok = getattr(tokenizer, "sep_token", None)
+    if sep_tok is not None and sep_tok in getattr(tokenizer, "vocab", {}):
+        boundary = [tokenizer.vocab[sep_tok]]
+    else:
+        boundary = encode_plain(tokenizer, "\n")
+    ids: list = []
+    for p in sorted(str(p) for p in paths):
+        ids.extend(encode_plain(tokenizer,
+                                Path(p).read_text(encoding="utf-8")))
+        ids.extend(boundary)
+    if dtype is None:
+        dtype = token_dtype(tokenizer.vocab_size)
+    tokens = np.asarray(ids, dtype=dtype)
+    tokens.tofile(out_path)
+    return tokens.size

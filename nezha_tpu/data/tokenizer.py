@@ -1,0 +1,349 @@
+"""Network-free subword tokenizers: GPT-2 byte-level BPE and BERT WordPiece.
+
+Closes the last data-parity gap against the reference's LM configs
+(SURVEY.md §2 models rows; VERDICT r4 missing item 2): the reference's
+GPT-2 124M / BERT-base workloads assume real BPE / WordPiece vocabularies,
+while this repo previously packed raw bytes only. These encoders load the
+STANDARD on-disk formats (``vocab.json``+``merges.txt`` for GPT-2,
+``vocab.txt`` for BERT) from user-supplied files or an offline Hugging
+Face checkpoint directory — no network egress, no `tokenizers` Rust
+dependency. Exact-match parity with the HF slow tokenizers is pinned in
+``tests/test_tokenizer.py``.
+
+TPU relevance: tokenization is host-side dataset prep (the device sees
+int32 ids either way), so the design goal is correctness + zero new deps,
+not throughput; `pack_text_files`-style corpus packing runs it once,
+offline.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import unicodedata
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+__all__ = ["GPT2BPETokenizer", "WordPieceTokenizer", "load_tokenizer"]
+
+
+# --------------------------------------------------------------- GPT-2 BPE
+@functools.lru_cache(maxsize=1)
+def _bytes_to_unicode() -> Dict[int, str]:
+    """The GPT-2 byte<->printable-unicode table: every byte maps to a
+    character that survives a round trip through text files (control and
+    whitespace bytes get remapped above U+0100)."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(ord("\xa1"), ord("\xac") + 1))
+          + list(range(ord("\xae"), ord("\xff") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, map(chr, cs)))
+
+
+def _get_pairs(word: Tuple[str, ...]):
+    return {(word[i], word[i + 1]) for i in range(len(word) - 1)}
+
+
+class GPT2BPETokenizer:
+    """Byte-level BPE over ``vocab.json`` / ``merges.txt`` (the GPT-2 /
+    RoBERTa on-disk format). Encoding: regex pre-tokenization (GPT-2's
+    pattern, via the ``regex`` module for \\p{L}/\\p{N} classes), byte ->
+    unicode mapping, then lowest-rank-first merges per word."""
+
+    def __init__(self, vocab: Dict[str, int],
+                 merges: Sequence[Tuple[str, str]]):
+        try:
+            # \p{L}/\p{N} classes; stdlib re has no unicode categories.
+            # Declared in the `prep` extra (pyproject.toml) — like PIL,
+            # only dataset prep needs it, never the training path.
+            import regex
+        except ImportError as e:
+            raise ImportError(
+                "GPT-2 BPE needs the `regex` package (pip install "
+                "nezha-tpu[prep] or pip install regex)") from e
+
+        self.encoder = dict(vocab)
+        self.decoder = {v: k for k, v in self.encoder.items()}
+        self.bpe_ranks = {tuple(m): i for i, m in enumerate(merges)}
+        self.byte_encoder = _bytes_to_unicode()
+        self.byte_decoder = {v: k for k, v in self.byte_encoder.items()}
+        self._pat = regex.compile(
+            r"""'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+|"""
+            r""" ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+""")
+        self._cache: Dict[str, List[str]] = {}
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_files(cls, vocab_json: str, merges_txt: str) -> "GPT2BPETokenizer":
+        with open(vocab_json, encoding="utf-8") as f:
+            vocab = json.load(f)
+        merges: List[Tuple[str, str]] = []
+        with open(merges_txt, encoding="utf-8") as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if not line or line.startswith("#version"):
+                    continue
+                a, _, b = line.partition(" ")
+                merges.append((a, b))
+        return cls(vocab, merges)
+
+    @classmethod
+    def from_dir(cls, path: str) -> "GPT2BPETokenizer":
+        """A Hugging Face GPT-2 checkpoint/tokenizer directory."""
+        return cls.from_files(os.path.join(path, "vocab.json"),
+                              os.path.join(path, "merges.txt"))
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.encoder)
+
+    # -- core --------------------------------------------------------------
+    def _bpe(self, token: str) -> List[str]:
+        cached = self._cache.get(token)
+        if cached is not None:
+            return cached
+        word: Tuple[str, ...] = tuple(token)
+        pairs = _get_pairs(word)
+        while pairs:
+            best = min(pairs, key=lambda p: self.bpe_ranks.get(p, 1 << 60))
+            if best not in self.bpe_ranks:
+                break
+            a, b = best
+            merged: List[str] = []
+            i = 0
+            while i < len(word):
+                if (word[i] == a and i < len(word) - 1
+                        and word[i + 1] == b):
+                    merged.append(a + b)
+                    i += 2
+                else:
+                    merged.append(word[i])
+                    i += 1
+            word = tuple(merged)
+            if len(word) == 1:
+                break
+            pairs = _get_pairs(word)
+        out = list(word)
+        if len(self._cache) < 65536:
+            self._cache[token] = out
+        return out
+
+    def encode(self, text: str) -> List[int]:
+        ids: List[int] = []
+        enc = self.encoder
+        benc = self.byte_encoder
+        for tok in self._pat.findall(text):
+            mapped = "".join(benc[b] for b in tok.encode("utf-8"))
+            ids.extend(enc[p] for p in self._bpe(mapped))
+        return ids
+
+    def decode(self, ids: Iterable[int]) -> str:
+        text = "".join(self.decoder[i] for i in ids if i in self.decoder)
+        return bytes(self.byte_decoder[c] for c in text).decode(
+            "utf-8", errors="replace")
+
+
+# ------------------------------------------------------------- WordPiece
+def _is_punctuation(ch: str) -> bool:
+    cp = ord(ch)
+    # ASCII symbol ranges count as punctuation (BERT convention: treat
+    # $, +, ~ etc. as splittable even though unicode classes them S*).
+    if (33 <= cp <= 47 or 58 <= cp <= 64 or 91 <= cp <= 96
+            or 123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def _is_cjk(cp: int) -> bool:
+    return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
+            or 0x20000 <= cp <= 0x2A6DF or 0x2A700 <= cp <= 0x2B73F
+            or 0x2B740 <= cp <= 0x2B81F or 0x2B820 <= cp <= 0x2CEAF
+            or 0xF900 <= cp <= 0xFAFF or 0x2F800 <= cp <= 0x2FA1F)
+
+
+class WordPieceTokenizer:
+    """BERT-style tokenizer: basic (clean / CJK-space / lowercase /
+    accent-strip / punct-split) + greedy longest-match WordPiece over a
+    ``vocab.txt`` (one token per line, ``##`` continuation prefix)."""
+
+    def __init__(self, vocab: Dict[str, int], lowercase: bool = True,
+                 unk_token: str = "[UNK]", cls_token: str = "[CLS]",
+                 sep_token: str = "[SEP]", mask_token: str = "[MASK]",
+                 pad_token: str = "[PAD]",
+                 max_chars_per_word: int = 100):
+        self.vocab = dict(vocab)
+        self.ids_to_tokens = {v: k for k, v in self.vocab.items()}
+        self.lowercase = lowercase
+        self.unk_token, self.cls_token = unk_token, cls_token
+        self.sep_token, self.mask_token = sep_token, mask_token
+        self.pad_token = pad_token
+        self.max_chars_per_word = max_chars_per_word
+
+    @classmethod
+    def from_files(cls, vocab_txt: str, lowercase: bool = True,
+                   **kw) -> "WordPieceTokenizer":
+        vocab: Dict[str, int] = {}
+        with open(vocab_txt, encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                tok = line.rstrip("\n")
+                if tok:
+                    vocab[tok] = i
+        return cls(vocab, lowercase=lowercase, **kw)
+
+    @classmethod
+    def from_dir(cls, path: str, **kw) -> "WordPieceTokenizer":
+        return cls.from_files(os.path.join(path, "vocab.txt"), **kw)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab)
+
+    @property
+    def mask_token_id(self) -> int:
+        return self.vocab[self.mask_token]
+
+    # -- basic tokenization ------------------------------------------------
+    def _basic(self, text: str) -> List[str]:
+        cleaned: List[str] = []
+        for ch in text:
+            cp = ord(ch)
+            if cp == 0 or cp == 0xFFFD or unicodedata.category(ch) in (
+                    "Cc", "Cf"):
+                if ch not in ("\t", "\n", "\r"):
+                    continue
+            if _is_cjk(cp):
+                cleaned.append(f" {ch} ")
+            elif ch.isspace():
+                cleaned.append(" ")
+            else:
+                cleaned.append(ch)
+        words: List[str] = []
+        for w in "".join(cleaned).split():
+            if self.lowercase:
+                w = w.lower()
+                w = "".join(c for c in unicodedata.normalize("NFD", w)
+                            if unicodedata.category(c) != "Mn")
+            # split on punctuation, keeping each mark as its own token
+            cur = ""
+            for ch in w:
+                if _is_punctuation(ch):
+                    if cur:
+                        words.append(cur)
+                        cur = ""
+                    words.append(ch)
+                else:
+                    cur += ch
+            if cur:
+                words.append(cur)
+        return words
+
+    def _wordpiece(self, word: str) -> List[str]:
+        if len(word) > self.max_chars_per_word:
+            return [self.unk_token]
+        pieces: List[str] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            piece = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    piece = sub
+                    break
+                end -= 1
+            if piece is None:
+                return [self.unk_token]
+            pieces.append(piece)
+            start = end
+        return pieces
+
+    def tokenize(self, text: str) -> List[str]:
+        out: List[str] = []
+        for w in self._basic(text):
+            out.extend(self._wordpiece(w))
+        return out
+
+    def encode(self, text: str, text_pair: str | None = None,
+               add_special_tokens: bool = True):
+        """-> ids (and, for pairs, BERT segment ids via
+        :meth:`encode_with_segments`)."""
+        ids = [self.vocab[t] for t in self.tokenize(text)]
+        if text_pair is None:
+            if add_special_tokens:
+                return ([self.vocab[self.cls_token]] + ids
+                        + [self.vocab[self.sep_token]])
+            return ids
+        ids2 = [self.vocab[t] for t in self.tokenize(text_pair)]
+        if not add_special_tokens:
+            return ids + ids2
+        return ([self.vocab[self.cls_token]] + ids
+                + [self.vocab[self.sep_token]] + ids2
+                + [self.vocab[self.sep_token]])
+
+    def encode_with_segments(self, text: str, text_pair: str):
+        """BERT NSP-style pair -> (ids, segment_ids)."""
+        a = [self.vocab[t] for t in self.tokenize(text)]
+        b = [self.vocab[t] for t in self.tokenize(text_pair)]
+        cls_, sep = self.vocab[self.cls_token], self.vocab[self.sep_token]
+        ids = [cls_] + a + [sep] + b + [sep]
+        segs = [0] * (len(a) + 2) + [1] * (len(b) + 1)
+        return ids, segs
+
+    def decode(self, ids: Iterable[int],
+               skip_special_tokens: bool = True) -> str:
+        specials = {self.cls_token, self.sep_token, self.pad_token,
+                    self.mask_token}
+        toks = [self.ids_to_tokens[i] for i in ids
+                if i in self.ids_to_tokens]
+        if skip_special_tokens:
+            toks = [t for t in toks if t not in specials]
+        out: List[str] = []
+        for t in toks:
+            if t.startswith("##") and out:
+                out[-1] += t[2:]
+            else:
+                out.append(t)
+        return " ".join(out)
+
+
+# ---------------------------------------------------------------- loader
+def load_tokenizer(path: str):
+    """Auto-detect the tokenizer format in ``path``: ``vocab.json`` +
+    ``merges.txt`` -> GPT-2 BPE; ``vocab.txt`` -> WordPiece. The same
+    directory layout a Hugging Face checkpoint ships, so
+    ``nezha-generate --hf-dir D --tokenizer D`` needs one path."""
+    if os.path.isfile(os.path.join(path, "vocab.json")) and \
+            os.path.isfile(os.path.join(path, "merges.txt")):
+        return GPT2BPETokenizer.from_dir(path)
+    if os.path.isfile(os.path.join(path, "vocab.txt")):
+        # Honor HF's do_lower_case if a tokenizer_config.json is present.
+        lower = True
+        cfgp = os.path.join(path, "tokenizer_config.json")
+        if os.path.isfile(cfgp):
+            try:
+                with open(cfgp, encoding="utf-8") as f:
+                    lower = bool(json.load(f).get("do_lower_case", True))
+            except (OSError, ValueError):
+                pass
+        return WordPieceTokenizer.from_dir(path, lowercase=lower)
+    raise FileNotFoundError(
+        f"no tokenizer files in {path}: expected vocab.json+merges.txt "
+        f"(GPT-2 BPE) or vocab.txt (BERT WordPiece)")
+
+
+def encode_plain(tokenizer, text: str) -> List[int]:
+    """Encode WITHOUT special tokens regardless of tokenizer kind — the
+    packed-LM-stream / generation-prompt contract (WordPiece would
+    otherwise wrap every call in [CLS]/[SEP]; BPE has no specials)."""
+    try:
+        return tokenizer.encode(text, add_special_tokens=False)
+    except TypeError:
+        return tokenizer.encode(text)

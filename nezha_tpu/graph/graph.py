@@ -25,6 +25,7 @@ OP_SET = (
     "reshape", "transpose", "broadcast_to", "sum", "mean", "max",
     "cast", "concat", "slice", "take", "take_along",
     "all_reduce", "reduce_scatter", "all_gather",  # collective graph ops
+    "flash_attention",  # fused-attention node -> Pallas kernel on TPU
 )
 
 
@@ -92,6 +93,22 @@ class Graph:
         return self._add("conv2d", [x, w],
                          {"stride": tuple(stride), "padding": padding,
                           "groups": groups})
+
+    def flash_attention(self, q, k, v, causal: bool = True, scale=None,
+                        impl: str = "auto"):
+        """Fused scaled-dot-product attention over [B, H, S, D] operands.
+
+        The one IR node that lowers to a custom kernel rather than
+        composed jnp ops: ``impl="auto"`` picks the Pallas flash kernel
+        on TPU backends (ops/pallas/flash_attention.py — fused fwd+bwd
+        with a custom VJP, no S x S score materialization) and the
+        composed softmax(QK^T)V elsewhere; "pallas"/"xla" force a path
+        (pallas runs the kernel in interpret mode off-TPU — the parity-
+        test hook)."""
+        if impl not in ("auto", "pallas", "xla"):
+            raise ValueError(f"unknown flash_attention impl {impl!r}")
+        return self._add("flash_attention", [q, k, v],
+                         {"causal": causal, "scale": scale, "impl": impl})
 
     def relu(self, x):
         return self._add("relu", [x])

@@ -111,6 +111,22 @@ def _eval_node(node, vals, feeds):
         axis = attrs["axis"]
         return jnp.take_along_axis(
             x[0], jnp.expand_dims(x[1], axis), axis=axis).squeeze(axis)
+    if op == "flash_attention":
+        impl = attrs.get("impl", "auto")
+        causal, scale = attrs["causal"], attrs.get("scale")
+        use_kernel = (impl == "pallas"
+                      or (impl == "auto"
+                          and jax.default_backend() == "tpu"))
+        if use_kernel:
+            from nezha_tpu.ops.pallas import flash_attention
+            return flash_attention(x[0], x[1], x[2], causal=causal,
+                                   scale=scale)
+        # Composed fallback — identical math, S x S scores materialized.
+        from nezha_tpu import ops as _ops
+        s_q, s_k = x[0].shape[2], x[1].shape[2]
+        mask = _ops.causal_mask(s_q, s_k) if causal else None
+        return _ops.dot_product_attention(x[0], x[1], x[2], mask=mask,
+                                          scale=scale)
     if op == "all_reduce":
         return lax.psum(x[0], attrs["axis_name"])
     if op == "reduce_scatter":

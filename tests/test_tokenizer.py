@@ -1,0 +1,189 @@
+"""Exact-match parity of the network-free tokenizers against the Hugging
+Face SLOW tokenizers (pure-python reference implementations) over locally
+constructed vocab files — no network, no pretrained downloads.
+
+The BPE vocab/merges are built from a training corpus with a miniature
+merge-learning loop so the merge table is realistic (ranks matter); the
+WordPiece vocab covers continuations, punctuation, accents, CJK, and
+unknown words.
+"""
+
+import json
+import os
+
+import pytest
+
+from nezha_tpu.data.tokenizer import (GPT2BPETokenizer, WordPieceTokenizer,
+                                      _bytes_to_unicode, load_tokenizer)
+
+transformers = pytest.importorskip("transformers")
+
+
+def _learn_bpe(corpus: str, n_merges: int):
+    """Tiny reference BPE learner (GPT-2 style, byte-level): returns
+    (vocab dict, merges list) in the on-disk format."""
+    import regex
+
+    from collections import Counter
+
+    benc = _bytes_to_unicode()
+    pat = regex.compile(
+        r"""'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+|"""
+        r""" ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+""")
+    words = Counter()
+    for tok in pat.findall(corpus):
+        words[tuple(benc[b] for b in tok.encode("utf-8"))] += 1
+    merges = []
+    for _ in range(n_merges):
+        pairs = Counter()
+        for w, c in words.items():
+            for i in range(len(w) - 1):
+                pairs[(w[i], w[i + 1])] += c
+        if not pairs:
+            break
+        (a, b), _c = pairs.most_common(1)[0]
+        merges.append((a, b))
+        new_words = Counter()
+        for w, c in words.items():
+            out, i = [], 0
+            while i < len(w):
+                if i < len(w) - 1 and w[i] == a and w[i + 1] == b:
+                    out.append(a + b)
+                    i += 2
+                else:
+                    out.append(w[i])
+                    i += 1
+            new_words[tuple(out)] += c
+        words = new_words
+    vocab = {ch: i for i, ch in enumerate(sorted(benc.values()))}
+    for a, b in merges:
+        vocab[a + b] = len(vocab)
+    return vocab, merges
+
+
+CORPUS = ("The quick brown fox jumps over the lazy dog. "
+          "the theatre of the absurd -- don't stop, it's 1234 times better! "
+          "  Multiple   spaces\tand\nnewlines. naive cafe RESUME "
+          "hello hello hello world world worlds")
+
+TEXTS = [
+    "The quick brown fox",
+    "don't stop, it's the theatre!",
+    "  leading spaces and   runs   ",
+    "numbers 1234 and 99 mix",
+    "unseen wordzzz qqq",
+    "trailing space ",
+    "tabs\tand\nnewlines",
+    "punct!!! ... (parens) [brackets]",
+    "",
+]
+
+
+@pytest.fixture(scope="module")
+def bpe_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("bpe")
+    vocab, merges = _learn_bpe(CORPUS, 60)
+    (d / "vocab.json").write_text(json.dumps(vocab), encoding="utf-8")
+    (d / "merges.txt").write_text(
+        "#version: 0.2\n" + "\n".join(f"{a} {b}" for a, b in merges) + "\n",
+        encoding="utf-8")
+    return str(d)
+
+
+def test_bpe_matches_hf_slow(bpe_dir):
+    ours = GPT2BPETokenizer.from_dir(bpe_dir)
+    theirs = transformers.GPT2Tokenizer(
+        os.path.join(bpe_dir, "vocab.json"),
+        os.path.join(bpe_dir, "merges.txt"))
+    for text in TEXTS:
+        assert ours.encode(text) == theirs.encode(text), text
+
+
+def test_bpe_roundtrip(bpe_dir):
+    tok = GPT2BPETokenizer.from_dir(bpe_dir)
+    for text in TEXTS:
+        assert tok.decode(tok.encode(text)) == text
+    # Unicode outside the corpus still round-trips (byte fallback).
+    text = "café 中文 emoji \U0001f600"
+    assert tok.decode(tok.encode(text)) == text
+
+
+def test_bpe_vocab_size_and_known_merge(bpe_dir):
+    tok = GPT2BPETokenizer.from_dir(bpe_dir)
+    assert tok.vocab_size >= 256
+    # "the" is frequent in CORPUS: must encode to few tokens, and fewer
+    # than the byte count (merges actually engaged).
+    ids = tok.encode(" the")
+    assert len(ids) < 4
+
+
+WP_VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+            "the", "quick", "brown", "fox", "jump", "##s", "##ed", "##ing",
+            "over", "lazy", "dog", "un", "##want", "##able", "!", ",", ".",
+            "?", "'", "naive", "cafe", "1234", "##9", "99", "hello", "world",
+            "resume", "中", "文"]
+
+
+@pytest.fixture(scope="module")
+def wp_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("wp")
+    (d / "vocab.txt").write_text("\n".join(WP_VOCAB) + "\n",
+                                 encoding="utf-8")
+    return str(d)
+
+
+WP_TEXTS = [
+    "The quick brown fox jumps over the lazy dog.",
+    "unwanted jumping, unwantable!",
+    "naïve café RÉSUMÉ",     # accents fold to vocab words
+    "hello 中文 world",                           # CJK chars split out
+    "completely unknownword here?",
+    "punct' , . !",
+    "99 1234",
+]
+
+
+def test_wordpiece_matches_hf_slow(wp_dir):
+    ours = WordPieceTokenizer.from_dir(wp_dir)
+    theirs = transformers.BertTokenizer(os.path.join(wp_dir, "vocab.txt"))
+    for text in WP_TEXTS:
+        assert ours.encode(text) == theirs.encode(text), text
+        assert ours.tokenize(text) == theirs.tokenize(text), text
+
+
+def test_wordpiece_pairs_and_segments(wp_dir):
+    ours = WordPieceTokenizer.from_dir(wp_dir)
+    theirs = transformers.BertTokenizer(os.path.join(wp_dir, "vocab.txt"))
+    a, b = "the quick fox", "hello world"
+    assert ours.encode(a, b) == theirs.encode(a, b)
+    ids, segs = ours.encode_with_segments(a, b)
+    enc = theirs(a, b)
+    assert ids == enc["input_ids"]
+    assert segs == enc["token_type_ids"]
+
+
+def test_wordpiece_decode_and_mask_id(wp_dir):
+    tok = WordPieceTokenizer.from_dir(wp_dir)
+    ids = tok.encode("unwanted jumping")
+    assert tok.decode(ids) == "unwanted jumping"
+    assert tok.mask_token_id == WP_VOCAB.index("[MASK]")
+
+
+def test_load_tokenizer_autodetect(bpe_dir, wp_dir, tmp_path):
+    assert isinstance(load_tokenizer(bpe_dir), GPT2BPETokenizer)
+    assert isinstance(load_tokenizer(wp_dir), WordPieceTokenizer)
+    with pytest.raises(FileNotFoundError, match="no tokenizer files"):
+        load_tokenizer(str(tmp_path))
+
+
+def test_load_tokenizer_honors_do_lower_case(wp_dir, tmp_path):
+    import shutil
+    d = tmp_path / "cased"
+    d.mkdir()
+    shutil.copy(os.path.join(wp_dir, "vocab.txt"), d / "vocab.txt")
+    (d / "tokenizer_config.json").write_text(
+        json.dumps({"do_lower_case": False}), encoding="utf-8")
+    tok = load_tokenizer(str(d))
+    assert tok.lowercase is False
+    # Cased: "The" is not in vocab -> [UNK]; lowercased version is.
+    assert tok.tokenize("The") == ["[UNK]"]
