@@ -1,0 +1,98 @@
+#!/bin/bash
+# Day-1 multi-chip recipe (VERDICT r4 item 9): the moment real multi-chip
+# hardware appears, ONE command produces (a) the all-reduce bus-bandwidth
+# metric of record (BASELINE.json `metric`) and (b) smoke runs of every
+# mesh-axis path (tp / pp / sp / ep / zero1 / int8-wire) on real ICI.
+#
+#   bash experiments/multichip_day1.sh             # real devices
+#   bash experiments/multichip_day1.sh --virtual 8 # CPU dry-run (no TPU)
+#
+# Outputs (committed by the operator or the wd committer):
+#   artifacts/collectives_ici.json  — one JSON object per line:
+#       {"collective": "all_reduce", "devices": N, "size_mb_per_dev": M,
+#        "time_ms": T, "bus_gbps": B}
+#     The metric of record is the LARGEST-size all_reduce row's bus_gbps.
+#   artifacts/multichip_smoke.log   — one line per mode: loss + steps/s.
+#
+# Every path here is the same code the dryrun (__graft_entry__.py) runs on
+# the virtual mesh every round — this script only exists so the first real
+# pod session is a paste, not a design exercise.
+set -eu
+cd "$(dirname "$0")/.."
+mkdir -p artifacts
+
+VIRT=""
+if [ "${1:-}" = "--virtual" ]; then
+  VIRT="${2:?--virtual needs a device count}"
+fi
+
+NDEV="${VIRT:-$(python - <<'EOF'
+import jax
+print(len(jax.devices()))
+EOF
+)}"
+if [ "$NDEV" -lt 2 ]; then
+  echo "need >= 2 devices (got $NDEV); nothing to measure" >&2
+  exit 1
+fi
+HALF=$((NDEV / 2))
+
+PLAT=()
+if [ -n "$VIRT" ]; then
+  # nezha-train's --platform flag pins the CPU backend after jax import —
+  # the env var alone cannot override the ambient axon site hook.
+  PLAT=(--platform cpu)
+  export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=$VIRT"
+fi
+
+echo "== collectives bus bandwidth ($NDEV devices) =="
+if [ -n "$VIRT" ]; then
+  python benchmarks/collectives.py --cpu-devices "$VIRT" \
+    --sizes-mb 1 16 64 --iters 10 | tee artifacts/collectives_ici.json
+else
+  python benchmarks/collectives.py --sizes-mb 1 4 16 64 128 --iters 20 \
+    | tee artifacts/collectives_ici.json
+fi
+
+echo "== mesh-axis smokes ==" | tee artifacts/multichip_smoke.log
+FAILED=0
+smoke() {  # $1 label, rest: nezha-train args
+  local label="$1"; shift
+  echo "-- $label" | tee -a artifacts/multichip_smoke.log
+  local tmp rc=0
+  tmp="$(mktemp)"
+  # Capture to a file first so a crashed mode is recorded as FAIL with
+  # its real traceback tail, not masked by the tee pipeline's status.
+  python -m nezha_tpu.cli.train "$@" ${PLAT[@]+"${PLAT[@]}"} \
+    --steps 3 --log-every 3 > "$tmp" 2>&1 || rc=$?
+  if [ "$rc" -eq 0 ]; then
+    tail -1 "$tmp" | tee -a artifacts/multichip_smoke.log
+  else
+    FAILED=1
+    { echo "FAIL (rc=$rc): $label"; tail -5 "$tmp"; } \
+      | tee -a artifacts/multichip_smoke.log
+  fi
+  rm -f "$tmp"
+}
+
+smoke "gspmd dp=${HALF} x tp=2"  --config gpt2_124m --model-preset tiny \
+  --parallel gspmd --mesh "dp=${HALF},tp=2" --batch-size "$NDEV"
+smoke "zero1 dp=${NDEV}"         --config bert_base_zero1 --model-preset tiny \
+  --parallel zero1 --mesh "dp=${NDEV}" --batch-size "$NDEV"
+smoke "zero1 int8 wire"          --config bert_base_zero1 --model-preset tiny \
+  --parallel zero1 --mesh "dp=${NDEV}" --grad-allreduce int8 \
+  --batch-size "$NDEV"
+smoke "pp dp=${HALF} x pp=2"     --config gpt2_124m --model-preset tiny \
+  --parallel pp --mesh "dp=${HALF},pp=2" --batch-size $((NDEV * 2)) \
+  --microbatches 2
+smoke "sp dp=${HALF} x sp=2"     --config gpt2_124m --model-preset tiny \
+  --parallel sp --mesh "dp=${HALF},sp=2" --batch-size "$HALF"
+smoke "moe ep dp=${HALF} x ep=2" --config gpt2_124m --model-preset tiny \
+  --parallel gspmd --mesh "dp=${HALF},tp=1,ep=2" --moe-experts 4 \
+  --batch-size "$NDEV"
+
+if [ "$FAILED" -ne 0 ]; then
+  echo "day-1 recipe: SOME SMOKES FAILED (see artifacts/multichip_smoke.log)"
+  exit 1
+fi
+echo "day-1 recipe complete: artifacts/collectives_ici.json + multichip_smoke.log"

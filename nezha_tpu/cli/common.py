@@ -89,16 +89,20 @@ def ckpt_has_scan_trunk(ckpt_dir: str) -> bool:
         with np.load(path) as z:
             return any("/h_scan/" in k or k.startswith("h_scan/")
                        for k in z.files)
-    # Sharded layout: leaf paths live in the meta_p*.json indexes.
-    steps = sorted(Path(ckpt_dir).glob("step_*"))
-    if not steps:
+    # Sharded layout: leaf paths live in the meta_p*.json indexes. Use
+    # the sharded latest_step (honors COMPLETE markers) so detection
+    # looks at the SAME checkpoint restore will read — a torn newer dir
+    # must not flip the layout decision.
+    from nezha_tpu.train import sharded_checkpoint as sckpt
+
+    sstep = sckpt.latest_step(ckpt_dir)
+    if sstep is None:
         return False
-    for meta in steps[-1].glob("meta_p*.json"):
+    sdir = Path(ckpt_dir) / f"step_{sstep:08d}.sharded"
+    for meta in sdir.glob("meta_p*.json"):
         try:
             text = meta.read_text()
         except OSError:
             continue
-        if "h_scan" in text:
-            return True
-        return False  # first meta names every leaf path prefix
+        return "h_scan" in text  # each meta names every leaf path prefix
     return False

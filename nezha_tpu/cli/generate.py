@@ -110,11 +110,19 @@ def _load_tokenizer(args):
 
     from nezha_tpu.data.tokenizer import load_tokenizer
     if args.tokenizer:
-        return load_tokenizer(args.tokenizer)
-    if args.hf_dir and (
-            os.path.isfile(os.path.join(args.hf_dir, "vocab.json"))
-            or os.path.isfile(os.path.join(args.hf_dir, "vocab.txt"))):
-        return load_tokenizer(args.hf_dir)
+        try:
+            return load_tokenizer(args.tokenizer)
+        except FileNotFoundError as e:
+            raise SystemExit(str(e))
+    if args.hf_dir:
+        # Same completeness rule as load_tokenizer itself (BPE needs BOTH
+        # files): a partial vocab copy falls back to byte-level instead
+        # of aborting a generation that used to work.
+        bpe = all(os.path.isfile(os.path.join(args.hf_dir, f))
+                  for f in ("vocab.json", "merges.txt"))
+        wp = os.path.isfile(os.path.join(args.hf_dir, "vocab.txt"))
+        if bpe or wp:
+            return load_tokenizer(args.hf_dir)
     return None
 
 
@@ -188,8 +196,20 @@ def run(args) -> dict:
     result = {"prompt_len": int(prompt.shape[1]), "tokens": new_tokens}
     if tokenizer is not None:
         # Real-vocabulary decode: HF GPT-2 weights + their shipped BPE
-        # files emit actual text (VERDICT r4 missing item 2).
+        # files emit actual text (VERDICT r4 missing item 2). decode()
+        # skips unknown ids, so count them loudly (mirror of the
+        # byte-level path's non_byte_tokens warning).
+        known = (tokenizer.decoder if hasattr(tokenizer, "decoder")
+                 else tokenizer.ids_to_tokens)
+        dropped = sum(t not in known for t in new_tokens)
         result["text"] = tokenizer.decode(new_tokens)
+        if dropped:
+            result["unknown_tokens"] = dropped
+            print(f"warning: {dropped}/{len(new_tokens)} generated ids "
+                  f"are outside this tokenizer's vocab "
+                  f"({tokenizer.vocab_size}) — wrong --tokenizer for "
+                  f"this checkpoint? \"text\" is partial",
+                  file=sys.stderr)
     elif args.prompt is not None:
         # Byte-level round trip (the encoding pack_text_files trains with).
         # A non-byte-trained checkpoint (e.g. BPE HF weights) emits ids

@@ -51,11 +51,7 @@ def run(args) -> dict:
     paths = []
     for s in args.src:
         if os.path.isdir(s):
-            for dirpath, dirnames, filenames in os.walk(s):
-                dirnames[:] = [d for d in dirnames if d not in
-                               (".git", "__pycache__", ".pytest_cache")]
-                paths.extend(os.path.join(dirpath, f) for f in filenames
-                             if any(f.endswith(x) for x in args.suffix))
+            paths.extend(pack.collect_paths(s, args.suffix))
         elif os.path.isfile(s):
             paths.append(s)
         else:

@@ -19,32 +19,40 @@ from typing import Iterable, Sequence
 
 import numpy as np
 
+# Directories no packer descends into (VCS/caches) — one list shared by
+# pack_tree and the nezha-pack-text CLI walk.
+PRUNE_DIRS = (".git", "__pycache__", ".pytest_cache")
+
+
+def collect_paths(root: str, suffixes: Sequence[str]) -> list:
+    """Every ``suffixes`` file under ``root``, pruning :data:`PRUNE_DIRS`."""
+    paths = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in PRUNE_DIRS]
+        for f in filenames:
+            if any(f.endswith(s) for s in suffixes):
+                paths.append(os.path.join(dirpath, f))
+    return paths
+
 
 def pack_text_files(paths: Iterable[str], out_path: str,
                     dtype=np.uint16) -> int:
     """Concatenate files as raw bytes -> ``out_path`` tokens; returns count."""
-    chunks = []
-    for p in sorted(str(p) for p in paths):
-        chunks.append(Path(p).read_bytes())
-        chunks.append(b"\n")
-    data = b"".join(chunks)
-    tokens = np.frombuffer(data, np.uint8).astype(dtype)
-    tokens.tofile(out_path)
-    return tokens.size
+    total = 0
+    with open(out_path, "wb") as out:
+        for p in sorted(str(p) for p in paths):
+            data = Path(p).read_bytes() + b"\n"
+            np.frombuffer(data, np.uint8).astype(dtype).tofile(out)
+            total += len(data)
+    return total
 
 
 def pack_tree(root: str, out_path: str,
               suffixes: Sequence[str] = (".py", ".md"),
               dtype=np.uint16) -> int:
     """Pack every ``suffixes`` file under ``root`` (skipping VCS dirs)."""
-    paths = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames
-                       if d not in (".git", "__pycache__", ".pytest_cache")]
-        for f in filenames:
-            if any(f.endswith(s) for s in suffixes):
-                paths.append(os.path.join(dirpath, f))
-    return pack_text_files(paths, out_path, dtype=dtype)
+    return pack_text_files(collect_paths(root, suffixes), out_path,
+                           dtype=dtype)
 
 
 def token_dtype(vocab_size: int):
@@ -63,7 +71,9 @@ def pack_text_files_tokenized(paths: Iterable[str], out_path: str,
     ``dtype=None`` uses :func:`token_dtype`. Files are concatenated in
     sorted order with a document boundary between them: the tokenizer's
     ``[SEP]`` id when it has one (WordPiece — whose basic tokenizer
-    would drop a bare newline), else the encoded newline (BPE)."""
+    would drop a bare newline), else the encoded newline (BPE).
+    Streams one file at a time, so peak memory is the largest document,
+    not the corpus."""
     from nezha_tpu.data.tokenizer import encode_plain
 
     sep_tok = getattr(tokenizer, "sep_token", None)
@@ -71,13 +81,14 @@ def pack_text_files_tokenized(paths: Iterable[str], out_path: str,
         boundary = [tokenizer.vocab[sep_tok]]
     else:
         boundary = encode_plain(tokenizer, "\n")
-    ids: list = []
-    for p in sorted(str(p) for p in paths):
-        ids.extend(encode_plain(tokenizer,
-                                Path(p).read_text(encoding="utf-8")))
-        ids.extend(boundary)
     if dtype is None:
         dtype = token_dtype(tokenizer.vocab_size)
-    tokens = np.asarray(ids, dtype=dtype)
-    tokens.tofile(out_path)
-    return tokens.size
+    total = 0
+    with open(out_path, "wb") as out:
+        for p in sorted(str(p) for p in paths):
+            ids = encode_plain(tokenizer,
+                               Path(p).read_text(encoding="utf-8"))
+            ids.extend(boundary)
+            np.asarray(ids, dtype=dtype).tofile(out)
+            total += len(ids)
+    return total

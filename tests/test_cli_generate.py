@@ -295,3 +295,19 @@ def test_generate_and_export_from_scan_layers_checkpoint(tmp_path, capsys):
     z = np.load(tmp_path / "hf.npz")
     assert any(k.startswith("transformer.h.1.") or "h.1." in k
                for k in z.files), list(z.files)[:5]
+
+
+def test_generate_scan_layers_sharded_zero1_checkpoint(tmp_path, devices8,
+                                                       capsys):
+    """Layout detection reads the sharded (zero1) checkpoint's meta index
+    too — the COMPLETE-marker-honoring path."""
+    ck = str(tmp_path / "ck")
+    train_run(train_parser().parse_args(
+        ["--config", "gpt2_124m", "--model-preset", "tiny", "--steps", "2",
+         "--batch-size", "8", "--scan-layers", "--parallel", "zero1",
+         "--mesh", "dp=8", "--ckpt-dir", ck]))
+    out = _gen(["--ckpt-dir", ck, "--model-preset", "tiny",
+                "--prompt-tokens", "5,17,3", "--max-new-tokens", "4",
+                "--temperature", "0"])
+    assert len(out["tokens"]) == 4
+    assert "restored step 2" in capsys.readouterr().err
