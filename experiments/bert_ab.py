@@ -29,6 +29,10 @@ VARIANTS = [
     # + non-causal flash attention (the new TPU default)
     {"name": "fused_flash", "cfg": {"fused_loss_chunk": -1,
                                     "attn_impl": "flash"}},
+    # + scan-over-layers encoder (r5 trunk lever; parity-tested)
+    {"name": "fused_flash_scan", "cfg": {"fused_loss_chunk": -1,
+                                         "attn_impl": "flash",
+                                         "scan_layers": True}},
 ]
 
 

@@ -71,11 +71,11 @@ def _is_graph_layout(ckpt_dir: str, ckpt) -> bool:
 
 def ckpt_has_scan_trunk(ckpt_dir: str) -> bool:
     """True when the newest checkpoint in ``ckpt_dir`` (either format)
-    stores GPT-2 trunk params in the scan layout (``h_scan`` — a
-    ``--scan-layers`` training run). Lets nezha-generate/nezha-export
-    rebuild the model with the matching layout instead of failing to
-    match ``h0..hN`` template leaves. Reads directory listings / zip
-    indexes only, never the arrays."""
+    stores trunk params in the scan layout (``h_scan`` for GPT-2,
+    ``layers_scan`` for BERT — a ``--scan-layers`` training run). Lets
+    nezha-generate/nezha-export rebuild the model with the matching
+    layout instead of failing to match ``h0..hN`` template leaves. Reads
+    directory listings / zip indexes only, never the arrays."""
     import os
     from pathlib import Path
 
@@ -83,12 +83,15 @@ def ckpt_has_scan_trunk(ckpt_dir: str) -> bool:
 
     from nezha_tpu.train import checkpoint as ckpt
 
+    def scan_key(k: str) -> bool:
+        return any(f"/{s}/" in k or k.startswith(f"{s}/")
+                   for s in ("h_scan", "layers_scan"))
+
     step = ckpt.latest_step(ckpt_dir)
     if step is not None:
         path = os.path.join(ckpt_dir, f"step_{step:08d}.npz")
         with np.load(path) as z:
-            return any("/h_scan/" in k or k.startswith("h_scan/")
-                       for k in z.files)
+            return any(scan_key(k) for k in z.files)
     # Sharded layout: leaf paths live in the meta_p*.json indexes. Use
     # the sharded latest_step (honors COMPLETE markers) so detection
     # looks at the SAME checkpoint restore will read — a torn newer dir
@@ -104,5 +107,6 @@ def ckpt_has_scan_trunk(ckpt_dir: str) -> bool:
             text = meta.read_text()
         except OSError:
             continue
-        return "h_scan" in text  # each meta names every leaf path prefix
+        # Each meta names every leaf path prefix.
+        return "h_scan" in text or "layers_scan" in text
     return False

@@ -64,12 +64,12 @@ def run(args) -> dict:
 
     # Restore templates need the param SHAPES only (master params are fp32
     # under every policy), so default-policy models suffice.
+    # --scan-layers checkpoints store the trunk stacked (h_scan /
+    # layers_scan); restore with the matching template, then unstack to
+    # the per-layer layout the HF conversions name.
+    from nezha_tpu.cli.common import ckpt_has_scan_trunk
+    scan = ckpt_has_scan_trunk(args.ckpt_dir)
     if args.config == "gpt2_124m":
-        # --scan-layers checkpoints store the trunk stacked under h_scan;
-        # restore with the matching template, then unstack to the h{i}
-        # layout the HF conversion names.
-        from nezha_tpu.cli.common import ckpt_has_scan_trunk
-        scan = ckpt_has_scan_trunk(args.ckpt_dir)
         if args.model_preset == "full":
             model = GPT2(GPT2Config(scan_layers=scan))
         else:
@@ -83,12 +83,16 @@ def run(args) -> dict:
             jax.device_get(params), model.cfg.num_layers)
     else:
         if args.model_preset == "full":
-            cfg = BertConfig()
+            cfg = BertConfig(scan_layers=scan)
         else:
             from nezha_tpu.cli.train import TINY_BERT_KW
-            cfg = BertConfig(**TINY_BERT_KW)
+            cfg = BertConfig(**TINY_BERT_KW, scan_layers=scan)
         model = Bert(cfg)
         params = _restore_params(args, model, optim.sgd(0.1))
+        if scan:
+            from nezha_tpu.nn.module import unstack_prefixed_params
+            params = unstack_prefixed_params(params, "layers",
+                                             cfg.num_layers, "layers_scan")
         state_dict = convert.bert_params_to_hf(
             jax.device_get(params), cfg.num_layers, cfg.hidden_size)
 

@@ -93,8 +93,10 @@ def _configs() -> Dict[str, Config]:
         kw.update(overrides)
         return models.GPT2(models.GPT2Config(**kw))
 
-    def tiny_bert():
-        return models.Bert(bert_mod.BertConfig(**TINY_BERT_KW))
+    def tiny_bert(**overrides):
+        kw = dict(TINY_BERT_KW)
+        kw.update(overrides)
+        return models.Bert(bert_mod.BertConfig(**kw))
 
     tiny_tokens = lambda bs, seq_len=64, **kw: data.synthetic_token_batches(
         bs, seq_len=seq_len, vocab_size=512, **kw)
@@ -163,7 +165,8 @@ def _configs() -> Dict[str, Config]:
         "bert_base_zero1": Config(
             # fused_loss_chunk=-1: bf16 MLM logits with the fp32 upcast
             # fused into logsumexp (same default as gpt2_124m's head).
-            build_model=lambda: models.bert_base(fused_loss_chunk=-1),
+            build_model=lambda **ov: models.bert_base(fused_loss_chunk=-1,
+                                                      **ov),
             loss_fn=bert_mod.mlm_loss,
             batches=lambda bs: data.synthetic_mlm_batches(bs, seq_len=512),
             build_optimizer=lambda steps: optim.adamw(
@@ -577,8 +580,9 @@ def run(args) -> Dict[str, float]:
         # so restrict to the paths whose param handling is layout-agnostic
         # and parity-tested; gspmd TP rules and the pipeline/sp builders
         # address h{i} names explicitly.
-        if args.config != "gpt2_124m":
-            raise SystemExit("--scan-layers applies to gpt2_124m")
+        if args.config not in ("gpt2_124m", "bert_base_zero1"):
+            raise SystemExit("--scan-layers applies to gpt2_124m / "
+                             "bert_base_zero1")
         if args.engine == "graph":
             raise SystemExit("--scan-layers is a module-engine knob; the "
                              "graph engine authors its own trunk IR")
@@ -1232,11 +1236,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "long-context memory knob (pairs with --seq-len "
                         "and --parallel sp)")
     p.add_argument("--scan-layers", action="store_true",
-                   help="gpt2_124m only (single/dp/zero1, module engine): "
-                        "layer-stacked trunk applied via lax.scan — one "
-                        "compiled block program instead of num_layers "
-                        "inlined copies (params live under h_scan with a "
-                        "leading layer dim; see GPT2Config.scan_layers)")
+                   help="gpt2_124m / bert_base_zero1 (single/dp/zero1, "
+                        "module engine): layer-stacked trunk applied via "
+                        "lax.scan — one compiled block program instead of "
+                        "num_layers inlined copies (params live under "
+                        "h_scan / layers_scan with a leading layer dim; "
+                        "see GPT2Config.scan_layers)")
     p.add_argument("--grad-allreduce", default="fp32",
                    choices=["fp32", "int8"],
                    help="dp/zero1 gradient wire format: exact fp32 or "

@@ -44,6 +44,12 @@ class BertConfig:
     # padding_mask falls back to composed XLA attention. Mirrors
     # GPT2Config.attn_impl (incl. the GSPMD auto-partitioner fallback).
     attn_impl: str = "auto"  # "xla" | "flash" | "auto"
+    # Layer-stacked encoder applied via lax.scan — one compiled layer
+    # program instead of num_layers inlined copies; params live under
+    # "layers_scan" with a leading [num_layers] dim. Mirrors
+    # GPT2Config.scan_layers (same parity contract, same converters via
+    # nn.module.stack_prefixed_params).
+    scan_layers: bool = False
 
 
 class EncoderLayer(Module):
@@ -114,6 +120,33 @@ class EncoderLayer(Module):
                          training=training), states
 
 
+class ScannedEncoder(Module):
+    """``num_layers`` homogeneous :class:`EncoderLayer`s with layer-stacked
+    params, applied via ``lax.scan`` (one compiled layer program; see
+    ``models.gpt2.ScannedBlocks`` for the full rationale). ``mask`` /
+    ``kv_lengths`` are layer-invariant broadcast inputs (closures), not
+    scan operands; per-layer dropout RNGs pre-split with the SAME
+    ``layers{i}`` derivation as the unrolled encoder."""
+
+    def __init__(self, cfg: BertConfig, policy: Policy):
+        self.cfg = cfg
+        self.layer = EncoderLayer(cfg, policy)  # structure template
+
+    def init(self, rng: jax.Array) -> Variables:
+        from nezha_tpu.nn.module import scan_stack_init
+        return scan_stack_init(self.layer, rng, self.cfg.num_layers,
+                               "layers")
+
+    def apply(self, variables: Variables, x, mask=None, training: bool = False,
+              rng=None, kv_lengths=None):
+        from nezha_tpu.nn.module import scan_stack_apply
+        x = scan_stack_apply(self.layer, variables["params"], x,
+                             self.cfg.num_layers, "layers", rng=rng,
+                             mask=mask, training=training,
+                             kv_lengths=kv_lengths)
+        return x, {}
+
+
 class Bert(Module):
     """Returns MLM logits [B, S, vocab] (decoder tied to token embeddings).
 
@@ -133,7 +166,12 @@ class Bert(Module):
         self.type_emb = nn.Embedding(cfg.type_vocab_size, h, policy=policy)
         self.emb_ln = nn.LayerNorm(h, eps=cfg.ln_eps, policy=policy)
         self.drop = nn.Dropout(cfg.dropout)
-        self.layers = [EncoderLayer(cfg, policy) for _ in range(cfg.num_layers)]
+        if cfg.scan_layers:
+            self.layers_scan = ScannedEncoder(cfg, policy)
+            self.layers = []
+        else:
+            self.layers = [EncoderLayer(cfg, policy)
+                           for _ in range(cfg.num_layers)]
         # MLM head: transform + LN, decoder tied to tok_emb with a free bias.
         self.mlm_dense = nn.Linear(h, h, kernel_init=init_lib.normal(0.02),
                                    policy=policy)
@@ -179,6 +217,12 @@ class Bert(Module):
                       training=training, rng=rng)
         mask = (ops.make_attention_mask(padding_mask)
                 if padding_mask is not None else None)
+        if self.cfg.scan_layers:
+            # rng passed RAW: ScannedEncoder derives per-layer layers{i}
+            # keys itself, matching the unrolled encoder exactly.
+            x, _ = self.layers_scan.apply(
+                child_vars(variables, "layers_scan"), x, mask=mask,
+                training=training, rng=rng, kv_lengths=kv_lengths)
         for i, layer in enumerate(self.layers):
             x = run_child(layer, f"layers{i}", variables, states, x,
                           mask=mask, training=training, rng=rng,

@@ -232,66 +232,31 @@ class ScannedBlocks(Module):
         self.block = Block(cfg, policy)
 
     def init(self, rng: jax.Array) -> Variables:
-        inits = [self.block.init(child_rng(rng, f"h{i}"))
-                 for i in range(self.cfg.num_layers)]
-        params = jax.tree_util.tree_map(
-            lambda *xs: jnp.stack(xs), *[v["params"] for v in inits])
-        if any(v["state"] for v in inits):
-            raise ValueError("scan_layers requires stateless blocks")
-        return {"params": params, "state": {}}
+        from nezha_tpu.nn.module import scan_stack_init
+        return scan_stack_init(self.block, rng, self.cfg.num_layers, "h")
 
     def apply(self, variables: Variables, x, training: bool = False,
               rng=None, pos=None):
-        cfg = self.cfg
-        L = cfg.num_layers
-        stacked = variables["params"]
-        if rng is not None:
-            rngs = jnp.stack([child_rng(rng, f"h{i}") for i in range(L)])
-        else:
-            rngs = None
-
-        def body(carry, layer):
-            lparams, lrng = layer
-            y, st = self.block.apply({"params": lparams, "state": {}},
-                                     carry, training=training, rng=lrng,
-                                     pos=pos)
-            # Homogeneous stateless blocks (MoE is rejected at config
-            # time); anything else would change the carry structure.
-            if st:
-                raise ValueError(
-                    f"scan_layers got unexpected block state {list(st)}")
-            return y, None
-
-        if cfg.remat and training:
-            body = jax.checkpoint(body)
-        if rngs is None:
-            def body_no_rng(carry, lparams, _inner=body):
-                return _inner(carry, (lparams, None))
-            x, _ = jax.lax.scan(body_no_rng, x, stacked)
-        else:
-            x, _ = jax.lax.scan(body, x, (stacked, rngs))
+        from nezha_tpu.nn.module import scan_stack_apply
+        x = scan_stack_apply(self.block, variables["params"], x,
+                             self.cfg.num_layers, "h", rng=rng,
+                             remat=self.cfg.remat and training,
+                             training=training, pos=pos)
         return x, {}
 
 
 def stack_layer_params(params: dict, num_layers: int) -> dict:
     """Unrolled GPT-2 params (``h0`` .. ``h{L-1}``) -> scan layout
     (``h_scan`` with a leading layer dim). Non-trunk entries pass through."""
-    out = {k: v for k, v in params.items()
-           if not (k.startswith("h") and k[1:].isdigit())}
-    layers = [params[f"h{i}"] for i in range(num_layers)]
-    out["h_scan"] = jax.tree_util.tree_map(
-        lambda *xs: jnp.stack(xs), *layers)
-    return out
+    from nezha_tpu.nn.module import stack_prefixed_params
+    return stack_prefixed_params(params, "h", num_layers, "h_scan")
 
 
 def unstack_layer_params(params: dict, num_layers: int) -> dict:
     """Scan-layout GPT-2 params -> unrolled ``h{i}`` layout (checkpoint/HF
     interchange, tensor-parallel rule tables)."""
-    out = {k: v for k, v in params.items() if k != "h_scan"}
-    for i in range(num_layers):
-        out[f"h{i}"] = jax.tree_util.tree_map(
-            lambda x: x[i], params["h_scan"])
-    return out
+    from nezha_tpu.nn.module import unstack_prefixed_params
+    return unstack_prefixed_params(params, "h", num_layers, "h_scan")
 
 
 class GPT2(Module):

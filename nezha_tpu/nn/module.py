@@ -153,3 +153,81 @@ class Sequential(Module):
             if st:
                 new_state[name] = st
         return x, new_state
+
+
+def stack_prefixed_params(params: dict, prefix: str, num_layers: int,
+                          stacked_key: str) -> dict:
+    """``{prefix}0 .. {prefix}{L-1}`` param subtrees -> one ``stacked_key``
+    subtree with a leading [L] dim on every leaf (the lax.scan-over-layers
+    layout). Non-matching entries pass through untouched."""
+    import jax.numpy as jnp
+
+    names = {f"{prefix}{i}" for i in range(num_layers)}
+    out = {k: v for k, v in params.items() if k not in names}
+    layers = [params[f"{prefix}{i}"] for i in range(num_layers)]
+    out[stacked_key] = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *layers)
+    return out
+
+
+def unstack_prefixed_params(params: dict, prefix: str, num_layers: int,
+                            stacked_key: str) -> dict:
+    """Inverse of :func:`stack_prefixed_params`."""
+    out = {k: v for k, v in params.items() if k != stacked_key}
+    for i in range(num_layers):
+        out[f"{prefix}{i}"] = jax.tree_util.tree_map(
+            lambda x, i=i: x[i], params[stacked_key])
+    return out
+
+
+def scan_stack_init(template: Module, rng: jax.Array, num_layers: int,
+                    prefix: str) -> Variables:
+    """Init for a lax.scan-over-layers stack: ``num_layers`` independent
+    inits of ``template`` (per-layer RNGs derived with the SAME
+    ``{prefix}{i}`` names the unrolled trunk uses), tree-stacked along a
+    new leading dim. Stateless layers only — running state would need a
+    per-layer carry the scan layout doesn't model."""
+    import jax.numpy as jnp
+
+    inits = [template.init(child_rng(rng, f"{prefix}{i}"))
+             for i in range(num_layers)]
+    if any(v["state"] for v in inits):
+        raise ValueError("scan_layers requires stateless layers")
+    params = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *[v["params"] for v in inits])
+    return make_variables(params, {})
+
+
+def scan_stack_apply(template: Module, stacked_params, x, num_layers: int,
+                     prefix: str, rng: Optional[jax.Array] = None,
+                     remat: bool = False, **layer_kwargs):
+    """Apply a layer-stacked trunk via ``lax.scan``: one traced/compiled
+    ``template`` program, params sliced per layer; ``layer_kwargs`` are
+    layer-invariant broadcast inputs (masks, position offsets). Per-layer
+    dropout RNGs are pre-split outside the scan with the unrolled
+    ``{prefix}{i}`` derivation, so both layouts replay identical keys.
+    ``remat=True`` wraps the body in ``jax.checkpoint`` (activation
+    memory O(1) per layer). The template must return ``(y, {})`` —
+    non-empty layer state raises."""
+    import jax.numpy as jnp
+
+    rngs = (jnp.stack([child_rng(rng, f"{prefix}{i}")
+                       for i in range(num_layers)])
+            if rng is not None else None)
+
+    def body(carry, layer):
+        lparams, lrng = layer
+        y, st = template.apply({"params": lparams, "state": {}}, carry,
+                               rng=lrng, **layer_kwargs)
+        if st:
+            raise ValueError(
+                f"scan_layers got unexpected layer state {list(st)}")
+        return y, None
+
+    if remat:
+        body = jax.checkpoint(body)
+    if rngs is None:
+        def body_no_rng(carry, lparams, _inner=body):
+            return _inner(carry, (lparams, None))
+        return jax.lax.scan(body_no_rng, x, stacked_params)[0]
+    return jax.lax.scan(body, x, (stacked_params, rngs))[0]

@@ -917,7 +917,7 @@ def test_cli_scan_layers(devices8):
                     "--mesh", "dp=8", "--log-every", "1"])
     assert np.isfinite(metrics["loss"])
     with pytest.raises(SystemExit, match="scan-layers"):
-        _run(["--config", "bert_base_zero1", "--model-preset", "tiny",
+        _run(["--config", "resnet50_imagenet", "--model-preset", "tiny",
               "--steps", "1", "--batch-size", "2", "--scan-layers"])
     with pytest.raises(SystemExit, match="scan-layers"):
         _run(["--config", "gpt2_124m", "--model-preset", "tiny",
@@ -951,3 +951,21 @@ def test_cli_bert_byte_corpus_requires_explicit_mask_token(tmp_path):
                     "--mlm-mask-token", "300",
                     "--data-dir", str(tmp_path)])
     assert np.isfinite(metrics["loss"])
+
+
+def test_cli_bert_scan_layers(devices8):
+    """--scan-layers trains BERT's stacked encoder under zero1."""
+    metrics = _run(["--config", "bert_base_zero1", "--model-preset", "tiny",
+                    "--steps", "2", "--batch-size", "16", "--scan-layers",
+                    "--mesh", "dp=8", "--log-every", "1"])
+    assert np.isfinite(metrics["loss"])
+
+
+def test_cli_scan_layers_full_preset_builders():
+    """Both full-preset builders accept the scan_layers override (the
+    tiny-only CLI tests would miss a zero-arg full-preset lambda)."""
+    from nezha_tpu.cli.train import _configs
+    cfgs = _configs()
+    for name in ("gpt2_124m", "bert_base_zero1"):
+        m = cfgs[name].build_model(scan_layers=True)
+        assert m.cfg.scan_layers

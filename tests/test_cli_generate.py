@@ -311,3 +311,23 @@ def test_generate_scan_layers_sharded_zero1_checkpoint(tmp_path, devices8,
                 "--temperature", "0"])
     assert len(out["tokens"]) == 4
     assert "restored step 2" in capsys.readouterr().err
+
+
+def test_export_bert_scan_layers_checkpoint(tmp_path, devices8):
+    """BERT --scan-layers (layers_scan stacked encoder) exports to the
+    layers.N-named HF state dict via detection + unstack."""
+    from nezha_tpu.cli.export import build_parser as export_parser
+    from nezha_tpu.cli.export import run as export_run
+
+    ck = str(tmp_path / "ck")
+    train_run(train_parser().parse_args(
+        ["--config", "bert_base_zero1", "--model-preset", "tiny",
+         "--steps", "2", "--batch-size", "16", "--scan-layers",
+         "--mesh", "dp=8", "--ckpt-dir", ck]))
+    export_run(export_parser().parse_args(
+        ["--config", "bert_base_zero1", "--model-preset", "tiny",
+         "--ckpt-dir", ck, "--format", "npz",
+         "--out", str(tmp_path / "hf.npz")]))
+    z = np.load(tmp_path / "hf.npz")
+    assert any("layer.1." in k or "layers.1." in k for k in z.files), \
+        list(z.files)[:6]

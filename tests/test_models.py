@@ -526,3 +526,41 @@ def test_gpt2_scan_layers_generate_matches():
 def test_gpt2_scan_layers_rejects_moe():
     with pytest.raises(ValueError, match="moe"):
         tiny_gpt2(scan_layers=True, moe_experts=4)
+
+
+def test_bert_scan_layers_matches_unrolled():
+    """BERT's scan encoder: same stacked params -> identical loss and
+    grads vs the unrolled encoder, incl. dropout key replay and the
+    kv_lengths broadcast input."""
+    from nezha_tpu.nn.module import stack_prefixed_params
+
+    m0 = tiny_bert(dropout=0.1, fused_loss_chunk=-1)
+    m1 = tiny_bert(dropout=0.1, fused_loss_chunk=-1, scan_layers=True)
+    v0 = m0.init(jax.random.PRNGKey(0))
+    p1 = stack_prefixed_params(v0["params"], "layers", m0.cfg.num_layers,
+                               "layers_scan")
+    rng = jax.random.PRNGKey(3)
+    rs = np.random.RandomState(0)
+    batch = {"tokens": jnp.asarray(rs.randint(0, 128, (2, 16)), jnp.int32),
+             "labels": jnp.asarray(
+                 np.where(rs.rand(2, 16) < 0.3,
+                          rs.randint(0, 128, (2, 16)), -100), jnp.int32),
+             "kv_lengths": jnp.asarray([12, 16], jnp.int32)}
+
+    def loss_grads(model, params):
+        def loss(p):
+            out, _ = model.apply({"params": p, "state": {}}, batch,
+                                 training=True, rng=rng)
+            return mlm_loss(out, batch)
+        return jax.value_and_grad(loss)(params)
+
+    l0, g0 = loss_grads(m0, v0["params"])
+    l1, g1 = loss_grads(m1, p1)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    from nezha_tpu.nn.module import unstack_prefixed_params
+    g1u = unstack_prefixed_params(g1, "layers", m0.cfg.num_layers,
+                                  "layers_scan")
+    flat1 = dict(jax.tree_util.tree_leaves_with_path(g1u))
+    for path, a in jax.tree_util.tree_leaves_with_path(g0):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(flat1[path]),
+                                   rtol=1e-5, atol=1e-6)
