@@ -31,7 +31,7 @@ set -u
 cd "$(dirname "$0")/.."
 mkdir -p artifacts/wd_done_r05
 
-STEPS=(rn50_stages bench_full gpt2_ab bert_ab rn50_s2d_b256 gpt2_scan
+STEPS=(rn50_stages bench_full gpt2_ab bert_ab rn50_s2d_b256 rn50_remat gpt2_scan
        gpt2_rest mlp_profile graph_gpt2 rn50_nodonate rn50_probe
        sp_smoke longctx)
 
@@ -46,6 +46,7 @@ step_cmd() {  # $1 step -> echoes "timeout_s|artifact|command..."
     gpt2_ab)       echo "1500|artifacts/gpt2_tune_r05.jsonl|python experiments/gpt2_tune.py --variants baseline ln_pallas" ;;
     bert_ab)       echo "1500|artifacts/bert_ab_r05.jsonl|python experiments/bert_ab.py" ;;
     rn50_s2d_b256) echo "1500|artifacts/rn50_variants_r05.jsonl|python experiments/rn50_probe.py --variants s2d b256" ;;
+    rn50_remat)    echo "1500|artifacts/rn50_variants_r05.jsonl|python experiments/rn50_probe.py --variants remat remat_b256" ;;
     gpt2_scan)     echo "1500|artifacts/gpt2_tune_r05.jsonl|python experiments/gpt2_tune.py --variants scan" ;;
     gpt2_rest)     echo "1800|artifacts/gpt2_tune_r05.jsonl|python experiments/gpt2_tune.py --variants attn_xla remat no_donate" ;;
     mlp_profile)   echo "900|artifacts/mlp_profile_r05.txt|python experiments/mlp_probe.py" ;;

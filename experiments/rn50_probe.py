@@ -14,6 +14,8 @@ Default mode prints one JSON line per variant (median-of-3 windows):
   no_donate  donation off (costs a full param+opt-state copy per step if
              XLA can't reuse; quantifies what donation buys)
   b256       s2d + batch 256 (amortizes fixed costs; bigger MXU tiles)
+  remat      per-bottleneck jax.checkpoint (trade saved-activation HBM
+             reads for recompute FLOPs — wins iff bandwidth-bound)
 
 ``--probe`` runs the r3 breakdown instead (fwd / fwd+bwd / stem-alone /
 XLA cost analysis) for roofline arithmetic.
@@ -34,7 +36,8 @@ PEAK_FLOPS = 197e12  # v5e bf16
 IMAGE_SIZE = 224  # overridable via --image-size for CPU smoke runs
 
 
-def _build(stem: str, batch: int, donate: bool):
+def _build(stem: str, batch: int, donate: bool,
+           remat: bool = False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -44,7 +47,7 @@ def _build(stem: str, batch: int, donate: bool):
     from nezha_tpu.tensor import bf16_policy
     from nezha_tpu.train.loop import init_train_state, make_train_step
 
-    model = resnet50(stem=stem, policy=bf16_policy())
+    model = resnet50(stem=stem, remat=remat, policy=bf16_policy())
     opt = optim.momentum(0.1, beta=0.9, weight_decay=1e-4)
     state = init_train_state(model, opt, jax.random.PRNGKey(0))
     ce = lambda logits, b_: ops.softmax_cross_entropy_with_integer_labels(
@@ -60,7 +63,8 @@ def _build(stem: str, batch: int, donate: bool):
 def measure(variant: dict, steps: int) -> dict:
     batch = variant.get("batch", 128)
     step, state, b = _build(variant.get("stem", "conv7"), batch,
-                            variant.get("donate", True))
+                            variant.get("donate", True),
+                            variant.get("remat", False))
     # ONE AOT compile serves both the timing loop and the cost analysis
     # (a second compile per geometry would double chip time and hold a
     # duplicate state in HBM alongside the donated one — b256 could OOM).
@@ -88,6 +92,12 @@ VARIANTS = [
     {"name": "s2d", "stem": "s2d"},
     {"name": "no_donate", "stem": "s2d", "donate": False},
     {"name": "b256", "stem": "s2d", "batch": 256},
+    # r5 bandwidth hypothesis: recompute each bottleneck in backward
+    # instead of reading saved intermediates — if the step is truly bound
+    # on saved-activation traffic (51 GB/step HLO vs 19.8 GB analytic
+    # floor), remat should WIN despite +~30% conv FLOPs.
+    {"name": "remat", "stem": "s2d", "remat": True},
+    {"name": "remat_b256", "stem": "s2d", "remat": True, "batch": 256},
 ]
 
 

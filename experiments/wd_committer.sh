@@ -16,7 +16,7 @@ ARTIFACTS=(artifacts/rn50_stages_r05.txt artifacts/bench_r05_live.json
            artifacts/rn50_variants_r05.jsonl artifacts/mlp_profile_r05.txt
            artifacts/graph_gpt2_r05.jsonl artifacts/rn50_breakdown_r05.txt
            artifacts/sp_smoke_r05.log artifacts/longctx_r05.log)
-STEPS=(rn50_stages bench_full gpt2_ab bert_ab rn50_s2d_b256 gpt2_scan
+STEPS=(rn50_stages bench_full gpt2_ab bert_ab rn50_s2d_b256 rn50_remat gpt2_scan
        gpt2_rest mlp_profile graph_gpt2 rn50_nodonate rn50_probe
        sp_smoke longctx)
 

@@ -123,8 +123,8 @@ def _configs() -> Dict[str, Config]:
             eval_stat=eval_mod.accuracy,
             tiny={}),  # already seconds-scale
         "resnet50_imagenet": Config(
-            build_model=lambda: models.resnet50(stem="s2d",
-                                                policy=bf16_policy()),
+            build_model=lambda **ov: models.resnet50(
+                stem="s2d", policy=bf16_policy(), **ov),
             loss_fn=ce,
             batches=lambda bs: data.synthetic_image_batches(bs),
             build_optimizer=lambda steps: optim.momentum(
@@ -132,8 +132,8 @@ def _configs() -> Dict[str, Config]:
                 beta=0.9, weight_decay=1e-4),
             default_batch=256,
             parallel_mode="dp",
-            tiny={"build_model": lambda: models.ResNet(
-                      (1, 1), num_classes=100, policy=bf16_policy()),
+            tiny={"build_model": lambda **ov: models.ResNet(
+                      (1, 1), num_classes=100, policy=bf16_policy(), **ov),
                   "batches": tiny_images}),
         "gpt2_124m": Config(
             # fused_loss_chunk=-1: CE never materializes fp32 [B,S,V]
@@ -179,8 +179,8 @@ def _configs() -> Dict[str, Config]:
             tp_rules=BERT_TP_RULES,
             graph_opt={"schedule": bert_sched, "weight_decay": 0.01}),
         "wrn101_large_batch": Config(
-            build_model=lambda: models.wide_resnet101(stem="s2d",
-                                                      policy=bf16_policy()),
+            build_model=lambda **ov: models.wide_resnet101(
+                stem="s2d", policy=bf16_policy(), **ov),
             loss_fn=ce,
             batches=lambda bs: data.synthetic_image_batches(bs),
             build_optimizer=lambda steps: optim.momentum(
@@ -188,9 +188,9 @@ def _configs() -> Dict[str, Config]:
                 beta=0.9, weight_decay=1e-4),
             default_batch=512,
             parallel_mode="dp",
-            tiny={"build_model": lambda: models.ResNet(
+            tiny={"build_model": lambda **ov: models.ResNet(
                       (1, 1), num_classes=100, width_factor=2,
-                      policy=bf16_policy()),
+                      policy=bf16_policy(), **ov),
                   "batches": tiny_images}),
     }
 
@@ -567,9 +567,11 @@ def run(args) -> Dict[str, float]:
 
     if args.remat:
         # Block rematerialization: the long-context/big-batch memory knob
-        # (jax.checkpoint per transformer block; see GPT2Config.remat).
-        if args.config != "gpt2_124m":
-            raise SystemExit("--remat applies to gpt2_124m")
+        # (jax.checkpoint per transformer block / ResNet bottleneck; see
+        # GPT2Config.remat, ResNet(remat=...)).
+        if args.config not in ("gpt2_124m",) + _IMAGE_CONFIGS:
+            raise SystemExit("--remat applies to gpt2_124m and the image "
+                             "configs")
         if args.engine == "graph":
             raise SystemExit("--remat is a jax.checkpoint knob; the graph "
                              "engine does not rematerialize")
@@ -1230,11 +1232,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "BERT-wordpiece convention; byte-packed text needs "
                         "an id >= 256 so masks are unambiguous)")
     p.add_argument("--remat", action="store_true",
-                   help="gpt2_124m only: rematerialize each block in "
-                        "backward (jax.checkpoint) — O(1) activation "
-                        "residuals per block for ~1/3 extra FLOPs; the "
-                        "long-context memory knob (pairs with --seq-len "
-                        "and --parallel sp)")
+                   help="gpt2_124m + image configs: rematerialize each "
+                        "block/bottleneck in backward (jax.checkpoint) — "
+                        "O(1) activation residuals per block for ~1/3 "
+                        "extra FLOPs; the long-context / big-batch memory "
+                        "knob (pairs with --seq-len and --parallel sp)")
     p.add_argument("--scan-layers", action="store_true",
                    help="gpt2_124m / bert_base_zero1 (single/dp/zero1, "
                         "module engine): layer-stacked trunk applied via "

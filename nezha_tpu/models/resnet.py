@@ -112,11 +112,18 @@ class ResNet(Module):
 
     def __init__(self, stage_sizes: Sequence[int], num_classes: int = 1000,
                  width_factor: int = 1, in_channels: int = 3,
-                 stem: str = "conv7", policy: Policy = DEFAULT_POLICY):
+                 stem: str = "conv7", remat: bool = False,
+                 policy: Policy = DEFAULT_POLICY):
         if stem not in ("conv7", "s2d"):
             raise ValueError(f"unknown stem {stem!r}")
         self.stage_sizes = tuple(stage_sizes)
         self.stem = stem
+        # Per-bottleneck jax.checkpoint: backward recomputes each block
+        # from its input instead of reading saved intermediates — the
+        # big-batch memory knob, and an A/B lever for the bandwidth-bound
+        # step (saved-activation reads traded for recompute FLOPs;
+        # rn50_probe --variants remat measures the sign on chip).
+        self.remat = remat
         self.policy = policy
         self.stem_conv = nn.Conv2d(in_channels, 64, 7, stride=2,
                                    use_bias=False, policy=policy)
@@ -151,9 +158,24 @@ class ResNet(Module):
                       training=training)
         x = jnp.maximum(x, 0)
         x = nn.max_pool(x, 3, 2, "SAME")
+        remat = self.remat and training
         for i, block in enumerate(self.blocks):
-            x = run_child(block, f"blocks{i}", variables, states, x,
-                          training=training)
+            if remat:
+                # Save only each bottleneck's input; recompute its convs/
+                # BNs in backward (running-stat state updates come from
+                # the forward pass as usual).
+                name = f"blocks{i}"
+
+                def block_fn(bvars, xx, block=block):
+                    return block.apply(bvars, xx, training=True)
+
+                x, st = jax.checkpoint(block_fn)(
+                    child_vars(variables, name), x)
+                if st:
+                    states[name] = st
+            else:
+                x = run_child(block, f"blocks{i}", variables, states, x,
+                              training=training)
         x = nn.global_avg_pool(x)
         logits = run_child(self.head, "head", variables, states, x,
                            training=training)
@@ -161,13 +183,15 @@ class ResNet(Module):
 
 
 def resnet50(num_classes: int = 1000, stem: str = "conv7",
+             remat: bool = False,
              policy: Policy = DEFAULT_POLICY) -> ResNet:
     return ResNet((3, 4, 6, 3), num_classes=num_classes, stem=stem,
-                  policy=policy)
+                  remat=remat, policy=policy)
 
 
 def wide_resnet101(num_classes: int = 1000, stem: str = "conv7",
+                   remat: bool = False,
                    policy: Policy = DEFAULT_POLICY) -> ResNet:
     """Wide-ResNet-101-2 (bottleneck width x2) — benchmark config 5."""
     return ResNet((3, 4, 23, 3), num_classes=num_classes, width_factor=2,
-                  stem=stem, policy=policy)
+                  stem=stem, remat=remat, policy=policy)

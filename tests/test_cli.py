@@ -969,3 +969,11 @@ def test_cli_scan_layers_full_preset_builders():
     for name in ("gpt2_124m", "bert_base_zero1"):
         m = cfgs[name].build_model(scan_layers=True)
         assert m.cfg.scan_layers
+
+
+def test_cli_resnet_remat(devices8):
+    """--remat now covers the image configs (per-bottleneck checkpoint)."""
+    metrics = _run(["--config", "resnet50_imagenet", "--model-preset", "tiny",
+                    "--steps", "2", "--batch-size", "16", "--remat",
+                    "--mesh", "dp=8", "--log-every", "1"])
+    assert np.isfinite(metrics["loss"])

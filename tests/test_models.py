@@ -564,3 +564,41 @@ def test_bert_scan_layers_matches_unrolled():
     for path, a in jax.tree_util.tree_leaves_with_path(g0):
         np.testing.assert_allclose(np.asarray(a), np.asarray(flat1[path]),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_resnet_remat_matches_exact_gradients():
+    """ResNet remat (per-bottleneck jax.checkpoint) changes memory
+    scheduling, not math: loss, grads, AND BatchNorm running-stat updates
+    match the non-remat model."""
+    def build(remat):
+        return ResNet((1, 1), num_classes=10, remat=remat)
+
+    rs = np.random.RandomState(0)
+    batch = {"image": jnp.asarray(rs.rand(2, 32, 32, 3).astype(np.float32)),
+             "label": jnp.asarray(rs.randint(0, 10, 2), jnp.int32)}
+
+    def loss_grads(model):
+        v = model.init(jax.random.PRNGKey(0))
+
+        def loss(params):
+            logits, st = model.apply({"params": params,
+                                      "state": v["state"]},
+                                     batch, training=True)
+            l = ops.softmax_cross_entropy_with_integer_labels(
+                logits, batch["label"]).mean()
+            return l, st
+
+        (l, st), g = jax.value_and_grad(loss, has_aux=True)(v["params"])
+        return l, g, st
+
+    l0, g0, st0 = loss_grads(build(False))
+    l1, g1, st1 = loss_grads(build(True))
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(st0),
+                    jax.tree_util.tree_leaves(st1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
