@@ -590,10 +590,10 @@ def run(args) -> Dict[str, float]:
                              "graph engine authors its own trunk IR")
         eff = cfg.parallel_mode if args.parallel == "config" \
             else args.parallel
-        if eff not in ("single", "dp", "zero1"):
+        if eff not in ("single", "dp", "zero1", "gspmd"):
             raise SystemExit("--scan-layers supports --parallel "
-                             "single/dp/zero1 (gspmd TP rules and the "
-                             "pp/sp builders address unrolled h{i} names)")
+                             "single/dp/zero1/gspmd (the pp/sp builders "
+                             "address unrolled h{i} names)")
         _wrap_model_overrides(cfg, scan_layers=True)
 
     if args.seq_len:
@@ -903,8 +903,18 @@ def run(args) -> Dict[str, float]:
             if args.moe_experts:
                 from nezha_tpu.parallel.expert import gpt2_moe_gspmd_rules
                 rules = gpt2_moe_gspmd_rules(cfg.tp_rules)
-            specs = parallel.param_specs_from_rules(
-                state["variables"]["params"], rules, strict=True)
+            if args.scan_layers:
+                # Stacked-trunk layout: same rule table, specs computed on
+                # the unrolled view with a leading layer dim (the
+                # canonical scan-over-layers + GSPMD TP shape).
+                prefix, key = (("h", "h_scan") if args.config == "gpt2_124m"
+                               else ("layers", "layers_scan"))
+                specs = parallel.scan_param_specs(
+                    state["variables"]["params"], rules,
+                    model.cfg.num_layers, prefix, key, strict=True)
+            else:
+                specs = parallel.param_specs_from_rules(
+                    state["variables"]["params"], rules, strict=True)
             state = parallel.shard_train_state(state, mesh, specs)
             save_fn = sckpt.save_sharded
             step_fn = parallel.make_gspmd_train_step(
@@ -1238,8 +1248,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "extra FLOPs; the long-context / big-batch memory "
                         "knob (pairs with --seq-len and --parallel sp)")
     p.add_argument("--scan-layers", action="store_true",
-                   help="gpt2_124m / bert_base_zero1 (single/dp/zero1, "
-                        "module engine): layer-stacked trunk applied via "
+                   help="gpt2_124m / bert_base_zero1 (single/dp/zero1/"
+                        "gspmd, module engine): layer-stacked trunk via "
                         "lax.scan — one compiled block program instead of "
                         "num_layers inlined copies (params live under "
                         "h_scan / layers_scan with a leading layer dim; "

@@ -128,6 +128,8 @@ class ScannedEncoder(Module):
     scan operands; per-layer dropout RNGs pre-split with the SAME
     ``layers{i}`` derivation as the unrolled encoder."""
 
+    _init_with_parent_rng = True  # layer keys derive from Bert's rng
+
     def __init__(self, cfg: BertConfig, policy: Policy):
         self.cfg = cfg
         self.layer = EncoderLayer(cfg, policy)  # structure template

@@ -224,6 +224,8 @@ class ScannedBlocks(Module):
     two layouts are bit-identical in expectation and in tests.
     """
 
+    _init_with_parent_rng = True  # layer keys derive from GPT2's rng
+
     def __init__(self, cfg: GPT2Config, policy: Policy):
         self.cfg = cfg
         self.policy = policy

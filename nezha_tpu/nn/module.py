@@ -89,7 +89,13 @@ class Module:
                 f"{type(self).__name__} has no child modules; implement init()")
         params, state = {}, {}
         for name, child in children.items():
-            v = child.init(child_rng(rng, name))
+            # _init_with_parent_rng (scan-over-layers stacks): the child
+            # derives its own per-layer names from the PARENT's rng, so a
+            # scan layout initializes bit-identically to the unrolled one
+            # under the same seed.
+            crng = (rng if getattr(child, "_init_with_parent_rng", False)
+                    else child_rng(rng, name))
+            v = child.init(crng)
             if v["params"]:
                 params[name] = v["params"]
             if v["state"]:

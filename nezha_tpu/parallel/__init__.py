@@ -29,6 +29,7 @@ from nezha_tpu.parallel.gspmd import (
     GPT2_TP_RULES,
     BERT_TP_RULES,
     param_specs_from_rules,
+    scan_param_specs,
     shard_train_state,
     make_gspmd_train_step,
 )
@@ -41,6 +42,7 @@ __all__ = [
     "replicate", "sync_batch_stats",
     "make_zero1_train_step", "zero1_init_opt_state",
     "GPT2_TP_RULES", "BERT_TP_RULES", "param_specs_from_rules",
+    "scan_param_specs",
     "shard_train_state", "make_gspmd_train_step",
 ]
 

@@ -140,6 +140,48 @@ def param_specs_from_rules(params: Any, rules: Rules,
     return specs
 
 
+def scan_param_specs(params: Any, rules: Rules, num_layers: int,
+                     prefix: str, stacked_key: str,
+                     strict: bool = False) -> Any:
+    """Partition specs for a scan-over-layers params layout, from the SAME
+    rule table the unrolled layout uses (no second table to drift).
+
+    The stacked subtree (``stacked_key``, leading [num_layers] dim on
+    every leaf) is unstacked to the ``{prefix}{i}`` view, the rules are
+    applied there (strict coverage checks included), and layer 0's trunk
+    specs get a leading ``None`` (layers replicate along their own stack
+    dim; TP shards the per-layer dims exactly as unrolled). This is the
+    canonical TPU LLM sharding shape: lax.scan over stacked layers with
+    GSPMD partitioning the scan body.
+    """
+    # Shape-only view: specs need leaf.ndim, not data — a real unstack
+    # would transiently duplicate the whole trunk on device right before
+    # sharding, the worst possible moment.
+    drop_lead = lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype)
+    unrolled = {k: jax.tree_util.tree_map(
+                    lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), v)
+                for k, v in params.items() if k != stacked_key}
+    for i in range(num_layers):
+        unrolled[f"{prefix}{i}"] = jax.tree_util.tree_map(
+            drop_lead, params[stacked_key])
+    specs = param_specs_from_rules(unrolled, rules, strict=strict)
+    names = {f"{prefix}{i}" for i in range(num_layers)}
+    trunk0 = specs[f"{prefix}0"]
+    for i in range(1, num_layers):
+        if specs[f"{prefix}{i}"] != trunk0:
+            # A layer-anchored rule (e.g. "^h0/...") would otherwise be
+            # silently flattened to layer 0's spec — fail loudly instead,
+            # matching strict mode's contract.
+            raise ValueError(
+                f"scan_param_specs requires layer-uniform rules; layer {i} "
+                f"resolved different specs than layer 0")
+    out = {k: v for k, v in specs.items() if k not in names}
+    out[stacked_key] = jax.tree_util.tree_map(
+        lambda s: P(None, *s), trunk0,
+        is_leaf=lambda x: isinstance(x, P))
+    return out
+
+
 def opt_state_specs(opt_state: Any, param_specs: Any) -> Any:
     """Optimizer stats inherit their parameter's spec; scalars replicate.
 

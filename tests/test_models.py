@@ -602,3 +602,24 @@ def test_resnet_remat_matches_exact_gradients():
                     jax.tree_util.tree_leaves(st1)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-6, atol=1e-7)
+
+
+def test_scan_layers_init_matches_unrolled_init():
+    """Same seed -> the scan layout initializes to EXACTLY the stacked
+    unrolled init, for both models (the _init_with_parent_rng contract:
+    layer keys derive from the model's rng, not the stack child's name)."""
+    from nezha_tpu.nn.module import stack_prefixed_params
+
+    for build, prefix, key in (
+            (tiny_gpt2, "h", "h_scan"),
+            (lambda **kw: tiny_bert(**kw), "layers", "layers_scan")):
+        m0 = build()
+        m1 = build(scan_layers=True)
+        v0 = m0.init(jax.random.PRNGKey(7))
+        v1 = m1.init(jax.random.PRNGKey(7))
+        expect = stack_prefixed_params(v0["params"], prefix,
+                                       m0.cfg.num_layers, key)
+        flat1 = dict(jax.tree_util.tree_leaves_with_path(v1["params"]))
+        for path, a in jax.tree_util.tree_leaves_with_path(expect):
+            np.testing.assert_array_equal(np.asarray(a),
+                                          np.asarray(flat1[path]), err_msg=str(path))
