@@ -33,6 +33,10 @@ VARIANTS = [
     {"name": "fused_flash_scan", "cfg": {"fused_loss_chunk": -1,
                                          "attn_impl": "flash",
                                          "scan_layers": True}},
+    # + fused Pallas layer norms (26 norms/step at BERT-base geometry)
+    {"name": "fused_flash_ln", "cfg": {"fused_loss_chunk": -1,
+                                       "attn_impl": "flash",
+                                       "ln_impl": "pallas"}},
 ]
 
 

@@ -143,8 +143,8 @@ def _configs() -> Dict[str, Config]:
             loss_fn=gpt2_mod.lm_loss,
             batches=lambda bs, seq_len=1024: data.synthetic_token_batches(
                 bs, seq_len=seq_len),
-            build_optimizer=lambda steps: optim.adamw(
-                gpt2_sched(steps), weight_decay=0.1),
+            build_optimizer=lambda steps, **kw: optim.adamw(
+                gpt2_sched(steps), weight_decay=0.1, **kw),
             default_batch=8,
             parallel_mode="dp",
             eval_batches=lambda bs, seq_len=1024: itertools.islice(
@@ -169,8 +169,8 @@ def _configs() -> Dict[str, Config]:
                                                       **ov),
             loss_fn=bert_mod.mlm_loss,
             batches=lambda bs: data.synthetic_mlm_batches(bs, seq_len=512),
-            build_optimizer=lambda steps: optim.adamw(
-                bert_sched(steps), weight_decay=0.01),
+            build_optimizer=lambda steps, **kw: optim.adamw(
+                bert_sched(steps), weight_decay=0.01, **kw),
             default_batch=16,
             parallel_mode="zero1",
             tiny={"build_model": tiny_bert,
@@ -511,15 +511,40 @@ def run(args) -> Dict[str, float]:
             "sgd": optim_mod.sgd,
             "momentum": lambda lr: optim_mod.momentum(
                 lr, beta=0.9, weight_decay=1e-4),
-            "adamw": lambda lr: optim_mod.adamw(lr, weight_decay=0.1),
+            "adamw": lambda lr, **kw: optim_mod.adamw(lr,
+                                                      weight_decay=0.1,
+                                                      **kw),
             "lars": lambda lr: optim_mod.lars(lr, weight_decay=1e-4),
-            "lamb": lambda lr: optim_mod.lamb(lr, weight_decay=0.01),
+            "lamb": lambda lr, **kw: optim_mod.lamb(lr, weight_decay=0.01,
+                                                    **kw),
             "adafactor": optim_mod.adafactor,
         }
         factory = factories[args.optimizer]
-        cfg.build_optimizer = lambda steps: factory(
+        cfg.build_optimizer = lambda steps, **kw: factory(
             optim_mod.warmup_cosine_schedule(
-                args.lr, min(100, max(1, steps // 10)), max(steps, 200)))
+                args.lr, min(100, max(1, steps // 10)), max(steps, 200)),
+            **kw)
+
+    if args.wd_exclude_1d:
+        # The standard GPT-2/BERT recipe: no decoupled weight decay on
+        # norm scales/biases (any leaf with ndim < 2). Composes with the
+        # default AdamW schedules and with --optimizer adamw/lamb.
+        if args.engine == "graph":
+            raise SystemExit("--wd-exclude-1d: the graph engine's "
+                             "IR-authored update decays every leaf")
+        if args.optimizer and args.optimizer not in ("adamw", "lamb"):
+            raise SystemExit(f"--wd-exclude-1d needs a masked-decay "
+                             f"optimizer (adamw/lamb), not "
+                             f"{args.optimizer}")
+        if not args.optimizer and args.config not in ("gpt2_124m",
+                                                      "bert_base_zero1"):
+            raise SystemExit("--wd-exclude-1d applies to the AdamW "
+                             "configs (gpt2_124m, bert_base_zero1) or "
+                             "with --optimizer adamw/lamb")
+        from nezha_tpu import optim as optim_mod
+        _build_opt0 = cfg.build_optimizer
+        cfg.build_optimizer = lambda steps: _build_opt0(
+            steps, mask=optim_mod.matrix_decay_mask)
 
     if args.grad_accum is not None:
         if args.grad_accum < 1:
@@ -786,6 +811,11 @@ def run(args) -> Dict[str, float]:
                              f"layerwise trust ratios, which ZeRO-1's flat "
                              f"per-rank chunks cannot preserve; use "
                              f"--parallel dp (or adamw/momentum with zero1)")
+        if args.wd_exclude_1d and mode in ("zero1", "pp"):
+            raise SystemExit("--wd-exclude-1d: this mode's flat/stacked "
+                             "param layout (zero1 chunks, pp stage slabs) "
+                             "erases the leaf shapes the ndim-based decay "
+                             "mask keys on; use --parallel dp/single/gspmd")
 
         # Mesh axes are validated against the chosen mode: an axis the mode
         # cannot consume is an error, never silently ignored — and every
@@ -1247,6 +1277,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "O(1) activation residuals per block for ~1/3 "
                         "extra FLOPs; the long-context / big-batch memory "
                         "knob (pairs with --seq-len and --parallel sp)")
+    p.add_argument("--wd-exclude-1d", action="store_true",
+                   help="AdamW/LAMB configs: exclude ndim<2 leaves (norm "
+                        "scales, biases) from decoupled weight decay — "
+                        "the standard GPT-2/BERT recipe (module engine; "
+                        "not under zero1's flat chunking)")
     p.add_argument("--scan-layers", action="store_true",
                    help="gpt2_124m / bert_base_zero1 (single/dp/zero1/"
                         "gspmd, module engine): layer-stacked trunk via "

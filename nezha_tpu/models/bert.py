@@ -50,6 +50,10 @@ class BertConfig:
     # GPT2Config.scan_layers (same parity contract, same converters via
     # nn.module.stack_prefixed_params).
     scan_layers: bool = False
+    # "pallas" opts the 2/layer + emb + mlm layer norms into the fused
+    # kernel on TPU (mirrors GPT2Config.ln_impl; default flips only on a
+    # measured A/B win).
+    ln_impl: str = "xla"
 
 
 class EncoderLayer(Module):
@@ -62,12 +66,14 @@ class EncoderLayer(Module):
                              policy=policy)
         self.attn_out = nn.Linear(h, h, kernel_init=init_lib.normal(0.02),
                                   policy=policy)
-        self.attn_ln = nn.LayerNorm(h, eps=cfg.ln_eps, policy=policy)
+        self.attn_ln = nn.LayerNorm(h, eps=cfg.ln_eps, policy=policy,
+                                    impl=cfg.ln_impl)
         self.fc = nn.Linear(h, h * cfg.mlp_ratio,
                             kernel_init=init_lib.normal(0.02), policy=policy)
         self.fc_out = nn.Linear(h * cfg.mlp_ratio, h,
                                 kernel_init=init_lib.normal(0.02), policy=policy)
-        self.out_ln = nn.LayerNorm(h, eps=cfg.ln_eps, policy=policy)
+        self.out_ln = nn.LayerNorm(h, eps=cfg.ln_eps, policy=policy,
+                                   impl=cfg.ln_impl)
         self.drop = nn.Dropout(cfg.dropout)
 
     def apply(self, variables: Variables, x, mask=None, training: bool = False,
@@ -166,7 +172,8 @@ class Bert(Module):
                                     embedding_init=init_lib.normal(0.02),
                                     policy=policy)
         self.type_emb = nn.Embedding(cfg.type_vocab_size, h, policy=policy)
-        self.emb_ln = nn.LayerNorm(h, eps=cfg.ln_eps, policy=policy)
+        self.emb_ln = nn.LayerNorm(h, eps=cfg.ln_eps, policy=policy,
+                                   impl=cfg.ln_impl)
         self.drop = nn.Dropout(cfg.dropout)
         if cfg.scan_layers:
             self.layers_scan = ScannedEncoder(cfg, policy)
@@ -177,7 +184,8 @@ class Bert(Module):
         # MLM head: transform + LN, decoder tied to tok_emb with a free bias.
         self.mlm_dense = nn.Linear(h, h, kernel_init=init_lib.normal(0.02),
                                    policy=policy)
-        self.mlm_ln = nn.LayerNorm(h, eps=cfg.ln_eps, policy=policy)
+        self.mlm_ln = nn.LayerNorm(h, eps=cfg.ln_eps, policy=policy,
+                                   impl=cfg.ln_impl)
 
     def init(self, rng: jax.Array) -> Variables:
         v = super().init(rng)

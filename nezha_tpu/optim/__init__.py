@@ -18,6 +18,7 @@ from nezha_tpu.optim.optimizers import (
     clip_by_global_norm,
     lars,
     lamb,
+    matrix_decay_mask,
     adafactor,
     with_grad_clipping,
     accumulate_gradients,
@@ -32,7 +33,7 @@ from nezha_tpu.optim.schedules import (
 __all__ = [
     "Optimizer", "sgd", "momentum", "adam", "adamw", "apply_updates",
     "global_norm", "clip_by_global_norm",
-    "lars", "lamb", "adafactor", "with_grad_clipping", "accumulate_gradients",
+    "lars", "lamb", "matrix_decay_mask", "adafactor", "with_grad_clipping", "accumulate_gradients",
     "constant_schedule", "cosine_decay_schedule", "warmup_cosine_schedule",
     "linear_warmup_schedule",
 ]

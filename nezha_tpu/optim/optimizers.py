@@ -371,3 +371,20 @@ def accumulate_gradients(opt: Optimizer, every: int) -> Optimizer:
         return lax.cond(count >= every, flush, hold, None)
 
     return Optimizer(init, update)
+
+
+def matrix_decay_mask(params):
+    """The standard GPT-2/BERT weight-decay exclusion: decay only leaves
+    with ndim >= 2 (kernels, embeddings); norm scales, biases, and other
+    1-D/scalar leaves get none. Pass as ``adamw(..., mask=...)`` /
+    ``lamb(..., mask=...)`` (CLI: ``--wd-exclude-1d``).
+
+    Scan-over-layers trunks (``h_scan`` / ``layers_scan`` subtrees) carry
+    a leading [num_layers] dim on every leaf, so the threshold there is
+    ndim >= 3 — a stacked LN scale [L, H] still gets no decay."""
+    def leaf_mask(path, p):
+        keys = {getattr(k, "key", None) for k in path}
+        stacked = "h_scan" in keys or "layers_scan" in keys
+        return jnp.ndim(p) >= (3 if stacked else 2)
+
+    return jax.tree_util.tree_map_with_path(leaf_mask, params)

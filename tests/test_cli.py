@@ -992,3 +992,53 @@ def test_cli_scan_layers_gspmd_matches_single(devices8):
     # And the unrolled single matches the scan single (layout-invariant).
     ref_unrolled = _final_losses("gpt2_124m", 3, 8, ["--parallel", "single"])
     np.testing.assert_allclose(ref, ref_unrolled, rtol=1e-4)
+
+
+def test_cli_wd_exclude_1d(devices8):
+    """--wd-exclude-1d masks weight decay off 1-D leaves; invalid combos
+    reject loudly."""
+    import pytest
+    m = _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--steps", "2", "--batch-size", "8", "--wd-exclude-1d",
+              "--mesh", "dp=8", "--log-every", "1"])
+    assert np.isfinite(m["loss"])
+    # Composes with the stacked trunk (the mask is layout-aware).
+    m = _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--steps", "2", "--batch-size", "8", "--wd-exclude-1d",
+              "--scan-layers", "--mesh", "dp=8", "--log-every", "1"])
+    assert np.isfinite(m["loss"])
+    with pytest.raises(SystemExit, match="wd-exclude-1d"):
+        _run(["--config", "bert_base_zero1", "--model-preset", "tiny",
+              "--steps", "1", "--batch-size", "8", "--wd-exclude-1d",
+              "--parallel", "zero1", "--mesh", "dp=8"])
+    with pytest.raises(SystemExit, match="wd-exclude-1d"):
+        _run(["--config", "mlp_mnist", "--steps", "1", "--batch-size", "8",
+              "--wd-exclude-1d"])
+    with pytest.raises(SystemExit, match="graph"):
+        _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--steps", "1", "--batch-size", "4", "--wd-exclude-1d",
+              "--engine", "graph"])
+
+
+def test_cli_wd_exclude_1d_changes_decay_not_masked_leaves():
+    """The mask really turns decay off for 1-D leaves: with lr frozen and
+    zero gradients, decayed leaves shrink and masked leaves don't."""
+    import jax
+    from nezha_tpu import optim
+    from nezha_tpu.models.gpt2 import GPT2, GPT2Config
+
+    model = GPT2(GPT2Config(vocab_size=64, max_positions=16, num_layers=1,
+                            num_heads=2, hidden_size=16))
+    params = model.init(jax.random.PRNGKey(0))["params"]
+    opt = optim.adamw(1e-2, weight_decay=0.5,
+                      mask=optim.matrix_decay_mask)
+    state = opt.init(params)
+    zeros = jax.tree_util.tree_map(lambda p: np.zeros_like(p), params)
+    upd, _ = opt.update(zeros, state, params)
+    flat = dict(jax.tree_util.tree_leaves_with_path(upd))
+    for path, u in flat.items():
+        nd = np.asarray(u).ndim
+        if nd >= 2:
+            assert np.any(np.asarray(u) != 0.0), path  # decay applied
+        else:
+            np.testing.assert_array_equal(np.asarray(u), 0.0, err_msg=str(path))

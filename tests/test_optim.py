@@ -185,3 +185,23 @@ class TestLargeBatchOptimizers:
         # Bias: no wd -> zero update. Weight: wd decays it.
         np.testing.assert_allclose(np.asarray(updates["b"]), 0.0)
         assert float(jnp.abs(updates["w"]).sum()) > 0
+
+
+def test_matrix_decay_mask_scan_layout():
+    """The decay mask is layout-aware: a stacked trunk's [L, H] norm
+    scale is still excluded (threshold ndim>=3 under h_scan/layers_scan),
+    while a real stacked kernel [L, H, 4H] is decayed."""
+    import jax
+    params = {
+        "wte": {"embedding": np.zeros((8, 4))},
+        "ln_f": {"scale": np.zeros((4,))},
+        "h_scan": {"mlp": {"fc": {"w": np.zeros((2, 4, 16)),
+                                  "b": np.zeros((2, 16))}},
+                   "ln_1": {"scale": np.zeros((2, 4))}},
+    }
+    m = optim.matrix_decay_mask(params)
+    assert m["wte"]["embedding"] is True or m["wte"]["embedding"] == True
+    assert not m["ln_f"]["scale"]
+    assert m["h_scan"]["mlp"]["fc"]["w"]
+    assert not m["h_scan"]["mlp"]["fc"]["b"]
+    assert not m["h_scan"]["ln_1"]["scale"]
