@@ -38,6 +38,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="tokenizer directory (vocab.json+merges.txt for "
                         "GPT-2 BPE, vocab.txt for BERT WordPiece); "
                         "default: byte-level vocab 256")
+    p.add_argument("--learn-bpe", type=int, default=None, metavar="MERGES",
+                   help="learn a byte-level BPE tokenizer from the input "
+                        "corpus itself (vocab 256+MERGES; airgapped "
+                        "alternative to a downloaded vocabulary), save it "
+                        "to --save-tokenizer, and pack with it")
+    p.add_argument("--save-tokenizer", default=None,
+                   help="output directory for the learned vocab.json/"
+                        "merges.txt (required with --learn-bpe)")
     p.add_argument("--suffix", nargs="+", default=[".txt", ".md", ".py"],
                    help="file suffixes picked up under directory sources")
     return p
@@ -61,6 +69,26 @@ def run(args) -> dict:
 
     out_dir = os.path.dirname(os.path.abspath(args.out))
     os.makedirs(out_dir, exist_ok=True)
+    if args.learn_bpe is not None:
+        if args.tokenizer:
+            raise SystemExit("pass either --tokenizer or --learn-bpe")
+        if not args.save_tokenizer:
+            raise SystemExit("--learn-bpe needs --save-tokenizer DIR "
+                             "(training and generation must reuse the "
+                             "learned vocabulary)")
+        if args.learn_bpe < 1:
+            raise SystemExit(f"--learn-bpe must be >= 1, got "
+                             f"{args.learn_bpe}")
+        from pathlib import Path
+
+        from nezha_tpu.data.bpe_train import learn_bpe, save_bpe_files
+        vocab, merges = learn_bpe(
+            (Path(p).read_text(encoding="utf-8") for p in sorted(paths)),
+            args.learn_bpe)
+        save_bpe_files(args.save_tokenizer, vocab, merges)
+        print(f"learned BPE: {len(merges)} merges, vocab {len(vocab)} -> "
+              f"{args.save_tokenizer}", file=sys.stderr)
+        args.tokenizer = args.save_tokenizer
     if args.tokenizer:
         from nezha_tpu.data.tokenizer import load_tokenizer
         tok = load_tokenizer(args.tokenizer)

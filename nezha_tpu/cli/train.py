@@ -273,6 +273,19 @@ def _data_source(args, cfg, batch_size: int, group=None):
                                 ("train.tokens.i32", np.int32)):
                 tok = os.path.join(args.data_dir, name)
                 if os.path.exists(tok):
+                    # Loud range check (mirrors the MLM path): ids at or
+                    # beyond the model vocab NaN the CE via out-of-range
+                    # target gathers — with no diagnostic at all. Sample
+                    # the stream and refuse up front.
+                    vocab = cfg.build_model().cfg.vocab_size
+                    sample = np.fromfile(tok, dtype=dtype, count=65536)
+                    if sample.size and int(sample.max()) >= vocab:
+                        raise SystemExit(
+                            f"{tok} holds token ids up to "
+                            f"{int(sample.max())} but the model vocab is "
+                            f"{vocab}; re-pack with a matching tokenizer "
+                            f"(nezha-pack-text --tokenizer/--learn-bpe) "
+                            f"or train the full-vocab preset")
                     loader = TokenLoader(tok, seq_len=args.seq_len or 1024,
                                          batch_size=local, dtype=dtype,
                                          seed=args.seed, **shard)

@@ -24,7 +24,16 @@ import os
 import unicodedata
 from typing import Dict, Iterable, List, Sequence, Tuple
 
-__all__ = ["GPT2BPETokenizer", "WordPieceTokenizer", "load_tokenizer"]
+__all__ = ["GPT2BPETokenizer", "WordPieceTokenizer", "load_tokenizer",
+           "GPT2_PRETOKENIZE_PATTERN"]
+
+# GPT-2's pre-tokenization regex (contractions, letter runs, digit runs,
+# punctuation runs, trailing/other whitespace). ONE definition shared by
+# the encoder and the offline BPE learner — they must segment identically
+# or learned merges stop matching encode-time word boundaries.
+GPT2_PRETOKENIZE_PATTERN = (
+    r"""'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+|"""
+    r""" ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+""")
 
 
 # --------------------------------------------------------------- GPT-2 BPE
@@ -73,9 +82,7 @@ class GPT2BPETokenizer:
         self.bpe_ranks = {tuple(m): i for i, m in enumerate(merges)}
         self.byte_encoder = _bytes_to_unicode()
         self.byte_decoder = {v: k for k, v in self.byte_encoder.items()}
-        self._pat = regex.compile(
-            r"""'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+|"""
-            r""" ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+""")
+        self._pat = regex.compile(GPT2_PRETOKENIZE_PATTERN)
         self._cache: Dict[str, List[str]] = {}
 
     # -- construction ------------------------------------------------------

@@ -1042,3 +1042,16 @@ def test_cli_wd_exclude_1d_changes_decay_not_masked_leaves():
             assert np.any(np.asarray(u) != 0.0), path  # decay applied
         else:
             np.testing.assert_array_equal(np.asarray(u), 0.0, err_msg=str(path))
+
+
+def test_cli_gpt2_rejects_out_of_vocab_corpus(tmp_path):
+    """Token files with ids >= the model vocab are refused up front (they
+    would NaN the CE via out-of-range target gathers, silently)."""
+    import pytest
+    (tmp_path / "train.tokens.u16").write_bytes(
+        np.random.RandomState(0).randint(0, 700, 8192)
+        .astype(np.uint16).tobytes())
+    with pytest.raises(SystemExit, match="vocab"):
+        _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--steps", "1", "--batch-size", "4", "--seq-len", "64",
+              "--data-dir", str(tmp_path)])

@@ -187,3 +187,89 @@ def test_load_tokenizer_honors_do_lower_case(wp_dir, tmp_path):
     assert tok.lowercase is False
     # Cased: "The" is not in vocab -> [UNK]; lowercased version is.
     assert tok.tokenize("The") == ["[UNK]"]
+
+
+def test_bpe_roundtrip_property(bpe_dir):
+    """Property: byte-level BPE round-trips ARBITRARY unicode text (the
+    byte fallback guarantees totality), hypothesis-driven."""
+    hyp = pytest.importorskip("hypothesis")
+    from hypothesis import given, settings, strategies as st
+
+    tok = GPT2BPETokenizer.from_dir(bpe_dir)
+
+    @settings(max_examples=200, deadline=None)
+    @given(st.text(max_size=80))
+    def check(s):
+        assert tok.decode(tok.encode(s)) == s
+
+    check()
+
+
+def test_wordpiece_total_on_arbitrary_text(wp_dir):
+    """Property: WordPiece never crashes and never emits out-of-vocab
+    tokens on arbitrary input (unknown words collapse to [UNK])."""
+    hyp = pytest.importorskip("hypothesis")
+    from hypothesis import given, settings, strategies as st
+
+    tok = WordPieceTokenizer.from_dir(wp_dir)
+
+    @settings(max_examples=200, deadline=None)
+    @given(st.text(max_size=80))
+    def check(s):
+        for t in tok.tokenize(s):
+            assert t in tok.vocab
+
+    check()
+
+
+def test_learn_bpe_deterministic_and_consistent():
+    """The offline learner produces a tokenizer that (a) is deterministic,
+    (b) compresses the training corpus (merges engage), (c) round-trips,
+    and (d) exact-matches the HF slow tokenizer over its own files."""
+    from nezha_tpu.data.bpe_train import learn_bpe, save_bpe_files
+
+    v1, m1 = learn_bpe([CORPUS], 50)
+    v2, m2 = learn_bpe([CORPUS], 50)
+    assert m1 == m2 and v1 == v2
+    assert len(m1) == 50 and len(v1) == 256 + 50
+
+    tok = GPT2BPETokenizer(v1, m1)
+    ids = tok.encode(CORPUS)
+    assert len(ids) < len(CORPUS.encode("utf-8"))  # compression happened
+    assert tok.decode(ids) == CORPUS
+    assert tok.decode(tok.encode("unseen zzz • ©")) == "unseen zzz • ©"
+
+
+def test_learn_bpe_files_hf_parity(tmp_path):
+    from nezha_tpu.data.bpe_train import learn_bpe, save_bpe_files
+
+    v, m = learn_bpe([CORPUS], 40)
+    d = tmp_path / "learned"
+    save_bpe_files(str(d), v, m)
+    ours = GPT2BPETokenizer.from_dir(str(d))
+    theirs = transformers.GPT2Tokenizer(str(d / "vocab.json"),
+                                        str(d / "merges.txt"))
+    for text in TEXTS:
+        assert ours.encode(text) == theirs.encode(text), text
+
+
+def test_pack_text_learn_bpe_cli(tmp_path):
+    """nezha-pack-text --learn-bpe end-to-end: learn from the corpus, pack
+    with the learned vocabulary, round-trip the packed ids to text."""
+    from nezha_tpu.cli.pack_text import build_parser, run
+    import numpy as np
+
+    src = tmp_path / "corpus.txt"
+    src.write_text(CORPUS, encoding="utf-8")
+    out = tmp_path / "train.tokens.u16"
+    tokdir = tmp_path / "tok"
+    res = run(build_parser().parse_args(
+        [str(src), "--learn-bpe", "30", "--save-tokenizer", str(tokdir),
+         "--out", str(out)]))
+    assert res["tokens"] > 0
+    tok = load_tokenizer(str(tokdir))
+    ids = np.fromfile(out, np.uint16).tolist()
+    assert tok.decode(ids) == CORPUS + "\n"
+    with pytest.raises(SystemExit, match="save-tokenizer"):
+        run(build_parser().parse_args(
+            [str(src), "--learn-bpe", "10", "--out", str(out)]))
