@@ -628,10 +628,10 @@ def run(args) -> Dict[str, float]:
                              "graph engine authors its own trunk IR")
         eff = cfg.parallel_mode if args.parallel == "config" \
             else args.parallel
-        if eff not in ("single", "dp", "zero1", "gspmd"):
+        if eff not in ("single", "dp", "zero1", "gspmd", "sp"):
             raise SystemExit("--scan-layers supports --parallel "
-                             "single/dp/zero1/gspmd (the pp/sp builders "
-                             "address unrolled h{i} names)")
+                             "single/dp/zero1/gspmd/sp (the pp builder "
+                             "addresses unrolled h{i} names)")
         _wrap_model_overrides(cfg, scan_layers=True)
 
     if args.seq_len:
@@ -1297,7 +1297,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "not under zero1's flat chunking)")
     p.add_argument("--scan-layers", action="store_true",
                    help="gpt2_124m / bert_base_zero1 (single/dp/zero1/"
-                        "gspmd, module engine): layer-stacked trunk via "
+                        "gspmd/sp, module engine): layer-stacked trunk via "
                         "lax.scan — one compiled block program instead of "
                         "num_layers inlined copies (params live under "
                         "h_scan / layers_scan with a leading layer dim; "

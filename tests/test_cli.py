@@ -921,8 +921,9 @@ def test_cli_scan_layers(devices8):
               "--steps", "1", "--batch-size", "2", "--scan-layers"])
     with pytest.raises(SystemExit, match="scan-layers"):
         _run(["--config", "gpt2_124m", "--model-preset", "tiny",
-              "--steps", "1", "--batch-size", "2", "--scan-layers",
-              "--parallel", "sp", "--mesh", "dp=4,sp=2"])
+              "--steps", "1", "--batch-size", "4", "--scan-layers",
+              "--parallel", "pp", "--mesh", "dp=4,pp=2",
+              "--microbatches", "2"])
     with pytest.raises(SystemExit, match="graph"):
         _run(["--config", "gpt2_124m", "--model-preset", "tiny",
               "--steps", "1", "--batch-size", "2", "--scan-layers",
@@ -1055,3 +1056,15 @@ def test_cli_gpt2_rejects_out_of_vocab_corpus(tmp_path):
         _run(["--config", "gpt2_124m", "--model-preset", "tiny",
               "--steps", "1", "--batch-size", "4", "--seq-len", "64",
               "--data-dir", str(tmp_path)])
+
+
+def test_cli_scan_layers_sp_matches_single(devices8):
+    """--scan-layers composes with ring-attention sequence parallelism:
+    the per-hop collectives run inside the lax.scan body under shard_map,
+    matching single-device numerics step-for-step."""
+    ref = _final_losses("gpt2_124m", 3, 8,
+                        ["--parallel", "single", "--scan-layers"])
+    sp = _final_losses("gpt2_124m", 3, 8,
+                       ["--parallel", "sp", "--mesh", "dp=2,sp=4",
+                        "--attn-impl", "ring", "--scan-layers"])
+    np.testing.assert_allclose(sp, ref, rtol=1e-3)
