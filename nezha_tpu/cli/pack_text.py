@@ -43,9 +43,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "corpus itself (vocab 256+MERGES; airgapped "
                         "alternative to a downloaded vocabulary), save it "
                         "to --save-tokenizer, and pack with it")
+    p.add_argument("--learn-wordpiece", type=int, default=None,
+                   metavar="VOCAB",
+                   help="learn a BERT-style WordPiece vocab.txt of this "
+                        "size from the input corpus (likelihood-scored "
+                        "merges, ## continuations; airgapped BERT data "
+                        "prep), save to --save-tokenizer, pack with it")
     p.add_argument("--save-tokenizer", default=None,
-                   help="output directory for the learned vocab.json/"
-                        "merges.txt (required with --learn-bpe)")
+                   help="output directory for the learned tokenizer files "
+                        "(required with --learn-bpe/--learn-wordpiece)")
     p.add_argument("--suffix", nargs="+", default=[".txt", ".md", ".py"],
                    help="file suffixes picked up under directory sources")
     return p
@@ -69,25 +75,38 @@ def run(args) -> dict:
 
     out_dir = os.path.dirname(os.path.abspath(args.out))
     os.makedirs(out_dir, exist_ok=True)
-    if args.learn_bpe is not None:
-        if args.tokenizer:
-            raise SystemExit("pass either --tokenizer or --learn-bpe")
+    learning = [x for x in (args.learn_bpe, args.learn_wordpiece)
+                if x is not None]
+    if learning:
+        if args.tokenizer or len(learning) > 1:
+            raise SystemExit("pass ONE of --tokenizer / --learn-bpe / "
+                             "--learn-wordpiece")
         if not args.save_tokenizer:
-            raise SystemExit("--learn-bpe needs --save-tokenizer DIR "
-                             "(training and generation must reuse the "
-                             "learned vocabulary)")
-        if args.learn_bpe < 1:
-            raise SystemExit(f"--learn-bpe must be >= 1, got "
-                             f"{args.learn_bpe}")
+            raise SystemExit("--learn-bpe/--learn-wordpiece need "
+                             "--save-tokenizer DIR (training and "
+                             "generation must reuse the learned "
+                             "vocabulary)")
+        if learning[0] < 1:
+            raise SystemExit(f"learned vocab/merge count must be >= 1, "
+                             f"got {learning[0]}")
         from pathlib import Path
 
-        from nezha_tpu.data.bpe_train import learn_bpe, save_bpe_files
-        vocab, merges = learn_bpe(
-            (Path(p).read_text(encoding="utf-8") for p in sorted(paths)),
-            args.learn_bpe)
-        save_bpe_files(args.save_tokenizer, vocab, merges)
-        print(f"learned BPE: {len(merges)} merges, vocab {len(vocab)} -> "
-              f"{args.save_tokenizer}", file=sys.stderr)
+        texts = (Path(p).read_text(encoding="utf-8")
+                 for p in sorted(paths))
+        if args.learn_bpe is not None:
+            from nezha_tpu.data.bpe_train import learn_bpe, save_bpe_files
+            vocab, merges = learn_bpe(texts, args.learn_bpe)
+            save_bpe_files(args.save_tokenizer, vocab, merges)
+            print(f"learned BPE: {len(merges)} merges, vocab "
+                  f"{len(vocab)} -> {args.save_tokenizer}",
+                  file=sys.stderr)
+        else:
+            from nezha_tpu.data.bpe_train import (learn_wordpiece,
+                                                  save_wordpiece_vocab)
+            wvocab = learn_wordpiece(texts, args.learn_wordpiece)
+            save_wordpiece_vocab(args.save_tokenizer, wvocab)
+            print(f"learned WordPiece: vocab {len(wvocab)} -> "
+                  f"{args.save_tokenizer}", file=sys.stderr)
         args.tokenizer = args.save_tokenizer
     if args.tokenizer:
         from nezha_tpu.data.tokenizer import load_tokenizer

@@ -273,3 +273,58 @@ def test_pack_text_learn_bpe_cli(tmp_path):
     with pytest.raises(SystemExit, match="save-tokenizer"):
         run(build_parser().parse_args(
             [str(src), "--learn-bpe", "10", "--out", str(out)]))
+
+
+def test_learn_wordpiece_total_and_deterministic():
+    """The learned WordPiece vocab tokenizes its own training corpus with
+    ZERO [UNK] (char fallback guarantees totality), merges engage, and
+    the output is deterministic."""
+    from nezha_tpu.data.bpe_train import learn_wordpiece
+
+    v1 = learn_wordpiece([CORPUS], 160)
+    v2 = learn_wordpiece([CORPUS], 160)
+    assert v1 == v2
+    assert v1[:5] == ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+    # The corpus may exhaust its merges before the target size.
+    assert 50 < len(v1) <= 160
+    assert any(t.startswith("##") and len(t) > 3 for t in v1)  # merges
+
+    tok = WordPieceTokenizer({t: i for i, t in enumerate(v1)})
+    pieces = tok.tokenize(CORPUS)
+    assert "[UNK]" not in pieces
+    # Compression vs pure chars: words collapse into multi-char pieces.
+    n_chars = sum(len(w) for w in tok._basic(CORPUS))
+    assert len(pieces) < n_chars
+
+
+def test_pack_text_learn_wordpiece_cli_and_mlm_train(tmp_path):
+    """Airgapped BERT data prep end-to-end: learn WordPiece -> pack ->
+    dynamic-MLM train through the real CLI (mask id 4 = [MASK] passed
+    explicitly; ids are real subwords, not bytes)."""
+    from nezha_tpu.cli.pack_text import build_parser as pp, run as pack_run
+    from nezha_tpu.cli.train import build_parser as tp, run as train_run
+    import pytest
+
+    try:
+        from nezha_tpu.data.native import load_library
+        load_library()
+    except Exception:
+        pytest.skip("native runtime not available")
+
+    src = tmp_path / "corpus.txt"
+    src.write_text(CORPUS * 30, encoding="utf-8")
+    out = tmp_path / "train.tokens.u16"
+    tokdir = tmp_path / "tok"
+    res = pack_run(pp().parse_args(
+        [str(src), "--learn-wordpiece", "200", "--save-tokenizer",
+         str(tokdir), "--out", str(out)]))
+    assert res["tokens"] > 500
+    tok = load_tokenizer(str(tokdir))
+    assert tok.mask_token_id == 4
+    m = train_run(tp().parse_args(
+        ["--config", "bert_base_zero1", "--model-preset", "tiny",
+         "--steps", "2", "--batch-size", "8", "--log-every", "1",
+         "--mlm-mask-token", str(tok.mask_token_id),
+         "--data-dir", str(tmp_path)]))
+    import numpy as np
+    assert np.isfinite(m["loss"])
