@@ -103,7 +103,10 @@ def run(args) -> dict:
         else:
             from nezha_tpu.data.bpe_train import (learn_wordpiece,
                                                   save_wordpiece_vocab)
-            wvocab = learn_wordpiece(texts, args.learn_wordpiece)
+            try:
+                wvocab = learn_wordpiece(texts, args.learn_wordpiece)
+            except ValueError as e:
+                raise SystemExit(str(e))
             save_wordpiece_vocab(args.save_tokenizer, wvocab)
             print(f"learned WordPiece: vocab {len(wvocab)} -> "
                   f"{args.save_tokenizer}", file=sys.stderr)

@@ -173,9 +173,16 @@ def _configs() -> Dict[str, Config]:
                 bert_sched(steps), weight_decay=0.01, **kw),
             default_batch=16,
             parallel_mode="zero1",
+            eval_batches=lambda bs: itertools.islice(
+                data.synthetic_mlm_batches(bs, seq_len=512, seed=1), 8),
+            eval_stat=eval_mod.mlm_token_stats,
             tiny={"build_model": tiny_bert,
                   "batches": lambda bs: data.synthetic_mlm_batches(
-                      bs, seq_len=64, vocab_size=512, mask_token=1)},
+                      bs, seq_len=64, vocab_size=512, mask_token=1),
+                  "eval_batches": lambda bs: itertools.islice(
+                      data.synthetic_mlm_batches(bs, seq_len=64,
+                                                 vocab_size=512,
+                                                 mask_token=1, seed=1), 4)},
             tp_rules=BERT_TP_RULES,
             graph_opt={"schedule": bert_sched, "weight_decay": 0.01}),
         "wrn101_large_batch": Config(
@@ -303,30 +310,13 @@ def _data_source(args, cfg, batch_size: int, group=None):
             # edits can't drift the data path out from under the model.
             mcfg = cfg.build_model().cfg
             seq, vocab = mcfg.max_positions, mcfg.vocab_size
-            # 103 is [MASK] for BERT-wordpiece-tokenized data; byte-packed
-            # text (data.pack: ids 0-255) needs an id real data can't
-            # produce — pass --mlm-mask-token (e.g. 256+) there.
-            mask_token = (args.mlm_mask_token if args.mlm_mask_token
-                          is not None else min(103, vocab - 1))
             for name, dtype in (("train.tokens.u16", np.uint16),
                                 ("train.tokens.i32", np.int32)):
                 tok = os.path.join(args.data_dir, name)
                 if os.path.exists(tok):
-                    if args.mlm_mask_token is None:
-                        # Byte-packed corpora (data.pack: ids 0-255) make
-                        # the defaulted mask id 103 a REAL byte — genuine
-                        # 0x67 tokens would be indistinguishable from
-                        # [MASK]. Sample the stream and refuse rather than
-                        # train on ambiguous symbols (ADVICE r4).
-                        sample = np.fromfile(tok, dtype=dtype, count=32768)
-                        if sample.size and int(sample.max()) < 256:
-                            raise SystemExit(
-                                f"{tok} looks byte-packed (sampled ids all "
-                                f"< 256), so the default mask_token "
-                                f"{mask_token} is a real byte value; pass "
-                                f"an explicit --mlm-mask-token (>= 256 "
-                                f"reserves an id byte data cannot produce) "
-                                f"or use a WordPiece-tokenized corpus")
+                    mask_token = _resolve_mlm_mask_token(
+                        args, mcfg, tok,
+                        np.fromfile(tok, dtype=dtype, count=32768))
                     loader = TokenLoader(tok, seq_len=seq, batch_size=local,
                                          dtype=dtype, seed=args.seed,
                                          **shard)
@@ -352,6 +342,28 @@ def _data_source(args, cfg, batch_size: int, group=None):
               f"using synthetic data", file=sys.stderr)
     it = cfg.batches(batch_size)
     return (_slice_rows(it, rank, local) if world > 1 else it), None
+
+
+def _resolve_mlm_mask_token(args, mcfg, tok_path: str, sample_ids) -> int:
+    """MLM mask id for a packed-token file: the explicit flag, else the
+    BERT-wordpiece default 103 — refused when the corpus looks byte-packed
+    (every sampled id < 256), where 103 is a REAL byte value and genuine
+    0x67 tokens would be indistinguishable from [MASK] (ADVICE r4). ONE
+    resolution shared by the train and held-out-eval paths."""
+    import numpy as np
+
+    if args.mlm_mask_token is not None:
+        return args.mlm_mask_token
+    mask_token = min(103, mcfg.vocab_size - 1)
+    sample = np.asarray(sample_ids).ravel()
+    if sample.size and int(sample.max()) < 256:
+        raise SystemExit(
+            f"{tok_path} looks byte-packed (sampled ids all < 256), so "
+            f"the default mask_token {mask_token} is a real byte value; "
+            f"pass an explicit --mlm-mask-token (>= 256 reserves an id "
+            f"byte data cannot produce) or use a WordPiece-tokenized "
+            f"corpus")
+    return mask_token
 
 
 def _eval_source(args, cfg, batch_size: int):
@@ -380,6 +392,61 @@ def _eval_source(args, cfg, batch_size: int):
                                        train_augment=False, epochs=1)
             print(f"eval: {n} val records from {rec}", file=sys.stderr)
             return iter(loader), loader.close, eval_mod.accuracy
+    if args.data_dir and args.config in ("gpt2_124m", "bert_base_zero1"):
+        import numpy as np
+        for name, dtype in (("val.tokens.u16", np.uint16),
+                            ("val.tokens.i32", np.int32)):
+            tok = os.path.join(args.data_dir, name)
+            if os.path.exists(tok):
+                # Held-out LM eval: deterministic SEQUENTIAL [B, S+1]
+                # windows over the whole file, one epoch — exhaustive and
+                # reproducible, unlike the training loader's sampled
+                # windows. Geometry mirrors the train path.
+                mcfg = cfg.build_model().cfg
+                if args.config == "gpt2_124m":
+                    seq = args.seq_len or 1024
+                else:
+                    seq = mcfg.max_positions
+                ids = np.fromfile(tok, dtype=dtype).astype(np.int32)
+                if ids.size and int(ids.max()) >= mcfg.vocab_size:
+                    # Same loud refusal as the train path: out-of-range
+                    # ids clip under jit and yield a finite, meaningless
+                    # perplexity with no diagnostic.
+                    raise SystemExit(
+                        f"{tok} holds token ids up to {int(ids.max())} "
+                        f"but the model vocab is {mcfg.vocab_size}; "
+                        f"re-pack the val split with the matching "
+                        f"tokenizer")
+                win = seq + 1
+                n_win = ids.size // win
+                if n_win < 1:
+                    raise SystemExit(f"{tok}: {ids.size} tokens is fewer "
+                                     f"than one {win}-token eval window")
+                ids = ids[:n_win * win].reshape(n_win, win)
+                bs = min(batch_size, n_win)
+
+                def batches(ids=ids, bs=bs):
+                    # Full batches, then the remainder as a smaller final
+                    # batch (one extra jit trace) — exhaustive coverage,
+                    # as the log line claims.
+                    full = (ids.shape[0] // bs) * bs
+                    for i in range(0, full, bs):
+                        yield {"tokens": ids[i:i + bs]}
+                    if full < ids.shape[0]:
+                        yield {"tokens": ids[full:]}
+
+                print(f"eval: {n_win} held-out windows from {tok}",
+                      file=sys.stderr)
+                it = batches()
+                if args.config == "bert_base_zero1":
+                    from nezha_tpu.data.mlm import mlm_batches_from_tokens
+                    mask_token = _resolve_mlm_mask_token(args, mcfg, tok,
+                                                         ids)
+                    it = mlm_batches_from_tokens(
+                        ({"tokens": b["tokens"][:, :-1]} for b in it),
+                        vocab_size=mcfg.vocab_size,
+                        mask_token=mask_token, seed=args.seed)
+                return it, None, cfg.eval_stat
     if cfg.eval_batches is not None:
         return cfg.eval_batches(batch_size), None, cfg.eval_stat
     return None, None, None

@@ -66,14 +66,17 @@ def learn_bpe(texts: Iterable[str], num_merges: int
     # pair -> count, and pair -> set of words containing it (for
     # incremental updates); first_seen breaks count ties deterministically.
     pair_counts: Counter = Counter()
-    pair_words: Dict[Tuple[str, str], set] = {}
+    # insertion-ORDERED dict-as-set: iteration order must not depend on
+    # PYTHONHASHSEED, or first_seen tie-break ranks (assigned while
+    # re-adding affected words) differ across interpreter runs.
+    pair_words: Dict[Tuple[str, str], dict] = {}
     first_seen: Dict[Tuple[str, str], int] = {}
 
     def add_word(w: Tuple[str, ...], c: int) -> None:
         for i in range(len(w) - 1):
             p = (w[i], w[i + 1])
             pair_counts[p] += c
-            pair_words.setdefault(p, set()).add(w)
+            pair_words.setdefault(p, {})[w] = None
             if p not in first_seen:
                 first_seen[p] = len(first_seen)
 
@@ -87,7 +90,7 @@ def learn_bpe(texts: Iterable[str], num_merges: int
             else:
                 s = pair_words.get(p)
                 if s is not None:
-                    s.discard(w)
+                    s.pop(w, None)
 
     for w, c in words.items():
         add_word(w, c)
@@ -185,7 +188,8 @@ def learn_wordpiece(texts: Iterable[str], vocab_size: int,
     vocab_set = set(vocab)
 
     pair_counts: Counter = Counter()
-    pair_seqs: Dict[Tuple[str, str], set] = {}
+    # ordered dict-as-set; see learn_bpe's note on PYTHONHASHSEED.
+    pair_seqs: Dict[Tuple[str, str], dict] = {}
     first_seen: Dict[Tuple[str, str], int] = {}
     sym_counts: Counter = Counter()
 
@@ -195,7 +199,7 @@ def learn_wordpiece(texts: Iterable[str], vocab_size: int,
         for i in range(len(seq) - 1):
             p = (seq[i], seq[i + 1])
             pair_counts[p] += c
-            pair_seqs.setdefault(p, set()).add(seq)
+            pair_seqs.setdefault(p, {})[seq] = None
             if p not in first_seen:
                 first_seen[p] = len(first_seen)
 
@@ -211,7 +215,7 @@ def learn_wordpiece(texts: Iterable[str], vocab_size: int,
             else:
                 ss = pair_seqs.get(p)
                 if ss is not None:
-                    ss.discard(seq)
+                    ss.pop(seq, None)
 
     for seq, c in seqs.items():
         add_seq(seq, c)

@@ -93,3 +93,22 @@ def evaluate(model: Module, variables: dict, batches: Iterator[dict],
         out["perplexity"] = math.exp(out["nll_sum"] / out["count"])
     out["batches"] = n
     return out
+
+
+def mlm_token_stats(out, batch) -> Dict[str, jax.Array]:
+    """Masked-LM NLL sums over the predicted positions (labels != -100) —
+    yields masked perplexity. ``out``: dense logits (the eval-mode BERT
+    path; the fused head is training-only) or the fused-head dict."""
+    labels = batch["labels"]
+    valid = labels != -100
+    count = valid.sum()
+    if isinstance(out, dict) and "logits" not in out:
+        from nezha_tpu.ops.losses import lm_ce_from_fused
+        mean_nll = lm_ce_from_fused(out, labels, ignore_index=-100)
+        return {"nll_sum": mean_nll * count, "count": count}
+    if isinstance(out, dict):
+        out = out["logits"]
+    logp = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
+    safe = jnp.where(valid, labels, 0)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return {"nll_sum": jnp.where(valid, nll, 0.0).sum(), "count": count}
