@@ -11,7 +11,6 @@ from the same IR.
 
 from __future__ import annotations
 
-import math
 from typing import Callable, List, Sequence
 
 import jax

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 
 from nezha_tpu import nn
 from nezha_tpu.nn import initializers as init_lib
-from nezha_tpu.nn.module import Module, Variables, child_rng, child_vars, run_child
+from nezha_tpu.nn.module import Module, Variables, child_vars, run_child
 from nezha_tpu.tensor.policy import DEFAULT_POLICY, Policy
 
 

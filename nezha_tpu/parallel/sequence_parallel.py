@@ -17,7 +17,6 @@ O(S/sp) — the long-context scaling axis.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable, Dict, Optional
 
 import jax
