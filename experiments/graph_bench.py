@@ -2,19 +2,26 @@
 measurement: is the StableHLO-lowered IR path within 5% of the module
 path, or is it a correctness/portability engine with a quantified gap?
 
-Three points, one JSON line each (bench.py timing discipline):
+Four points, one JSON line each (bench.py timing discipline):
 
   - module_bf16:  the production module config (bf16 policy, Pallas flash
                   attention, fused logsumexp head) — the number of record.
   - module_fp32_xla: module engine configured like today's IR program
                   (fp32 policy, composed XLA attention, dense fp32-logit
                   CE) — isolates ENGINE overhead from FEATURE gap.
-  - graph_ir:     gpt2_loss_graph + IR-authored AdamW update
+  - graph_ir_float32:  gpt2_loss_graph + IR-authored AdamW update
                   (graph/programs.py), StableHLO via graph/lower.py.
+  - graph_ir_bfloat16: the same program with the bf16 compute policy
+                  authored as IR cast nodes (both IR points emit the
+                  flash_attention node; the remaining feature delta vs
+                  module_bf16 is the fused logsumexp head, which the IR
+                  program does not express — it materializes fp32
+                  [B,S,V] logits).
 
-If graph_ir ~= module_fp32_xla, the IR engine itself is sound and the gap
-to module_bf16 is feature coverage (bf16 policy + flash node + fused
-head in the IR — the written-down backlog). The conclusion goes to
+If graph_ir_float32 ~= module_fp32_xla, the IR engine itself is sound;
+graph_ir_bfloat16 then shows how much of module_bf16's lead the IR
+recovers with the policy authored in casts, and the residual gap is the
+fused head (+ any engine overhead). The conclusion goes to
 BENCH_NOTES.md and docs/DESIGN.md.
 
 Usage: python experiments/graph_bench.py [--steps 12] [--batch 8] [--seq 1024]
@@ -69,7 +76,8 @@ def measure_module(name: str, batch: int, seq: int, steps: int, tiny: bool,
             "spread": round(spread, 4)}
 
 
-def measure_graph(batch: int, seq: int, steps: int, tiny: bool) -> dict:
+def measure_graph(batch: int, seq: int, steps: int, tiny: bool,
+                  compute_dtype: str = "float32") -> dict:
     import jax
     import numpy as np
 
@@ -82,7 +90,8 @@ def measure_graph(batch: int, seq: int, steps: int, tiny: bool) -> dict:
     model = GPT2(cfg)  # fp32 default policy — what the IR program mirrors
     state = programs.init_graph_gpt2_state(model, jax.random.PRNGKey(0))
     step = programs.make_gpt2_graph_train_step(model, lambda t: 6e-4,
-                                               weight_decay=0.1)
+                                               weight_decay=0.1,
+                                               compute_dtype=compute_dtype)
     shard = programs.lm_shard_fn()
     tokens = np.random.RandomState(0).randint(
         0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32)
@@ -92,7 +101,7 @@ def measure_graph(batch: int, seq: int, steps: int, tiny: bool) -> dict:
     sps, spread = _time_steps(step, state, b, steps, 120.0)
     n_params = sum(np.size(x) for x in jax.tree_util.tree_leaves(
         state["params"]))
-    return {"engine": "graph_ir",
+    return {"engine": f"graph_ir_{compute_dtype}",
             "tokens_per_sec": round(batch * seq * sps, 1),
             "mfu": round(_flops(cfg, n_params, batch, seq) * sps / 197e12, 4),
             "spread": round(spread, 4)}
@@ -117,7 +126,10 @@ def main() -> int:
                                       args.seq, args.steps, args.tiny,
                                       bf16=False),
                lambda: measure_graph(args.batch, args.seq, args.steps,
-                                     args.tiny)):
+                                     args.tiny),
+               lambda: measure_graph(args.batch, args.seq, args.steps,
+                                     args.tiny,
+                                     compute_dtype="bfloat16")):
         print(json.dumps(fn()), flush=True)
     return 0
 

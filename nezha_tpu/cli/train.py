@@ -605,6 +605,13 @@ def run(args) -> Dict[str, float]:
                 args.lr, min(100, max(1, steps // 10)), max(steps, 200)),
             **kw)
 
+    if args.graph_bf16:
+        if args.engine != "graph" or args.config != "gpt2_124m":
+            raise SystemExit("--graph-bf16 applies to --engine graph with "
+                             "gpt2_124m (the bf16 policy authored in the "
+                             "IR; the module engine's presets carry their "
+                             "own policies)")
+
     if args.wd_exclude_1d:
         # The standard GPT-2/BERT recipe: no decoupled weight decay on
         # norm scales/biases (any leaf with ndim < 2). Composes with the
@@ -827,7 +834,9 @@ def run(args) -> Dict[str, float]:
                 model, lambda t: float(sched(_np.int32(t))),
                 weight_decay=cfg.graph_opt["weight_decay"],
                 clip_norm=args.clip_norm,
-                mesh=mesh if mode == "dp" else None)
+                mesh=mesh if mode == "dp" else None,
+                compute_dtype="bfloat16" if args.graph_bf16
+                else "float32")
             shard = programs.lm_shard_fn()
         if mode == "dp":
             # One placement composition for every graph-dp config:
@@ -1357,6 +1366,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "O(1) activation residuals per block for ~1/3 "
                         "extra FLOPs; the long-context / big-batch memory "
                         "knob (pairs with --seq-len and --parallel sp)")
+    p.add_argument("--graph-bf16", action="store_true",
+                   help="--engine graph, gpt2_124m: author the bf16 "
+                        "compute policy in the IR (fp32 master params, "
+                        "bf16 GEMMs/activations, fp32 softmax stats and "
+                        "logits) — the module policy, in graph form")
     p.add_argument("--wd-exclude-1d", action="store_true",
                    help="AdamW/LAMB configs: exclude ndim<2 leaves (norm "
                         "scales, biases) from decoupled weight decay — "

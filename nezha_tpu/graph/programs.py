@@ -490,15 +490,22 @@ def make_mlp_graph_train_step(dims: Sequence[int], batch: int, lr: float,
 # IR's pow op on a step placeholder.
 
 
-def gpt2_loss_graph(cfg, param_template, batch: int, seq: int) -> Graph:
+def gpt2_loss_graph(cfg, param_template, batch: int, seq: int,
+                    compute_dtype: str = "float32") -> Graph:
     """IR graph: (*flat_params, inputs[B,S] i32, targets[B,S] i32) -> loss.
 
     ``flat_params`` follows ``jax.tree_util.tree_flatten`` order of the
     module's param tree, so module-initialized params feed straight in.
-    Mirrors ``models.gpt2.GPT2.apply`` (fp32 policy, dropout=0).
+    Mirrors ``models.gpt2.GPT2.apply`` (dropout=0).
     ``cfg.attn_impl`` auto/flash emits the fused ``flash_attention`` IR
     node (Pallas kernel on TPU — the same production attention as the
     module engine); "xla" keeps attention fully composed in the IR.
+    ``compute_dtype="bfloat16"`` authors the module bf16 policy in the
+    IR: fp32 master params cast to bf16 at each use, activations bf16,
+    layernorm statistics fp32 (the ``layernorm`` node upcasts
+    internally), logits fp32 for the CE — gradients flow back to the
+    fp32 placeholders through the cast nodes, exactly like jax.grad
+    through a policy cast.
     """
     if cfg.dropout:
         raise ValueError("graph GPT-2 has no dropout path; build with "
@@ -519,10 +526,13 @@ def gpt2_loss_graph(cfg, param_template, batch: int, seq: int) -> Graph:
     inputs = g.placeholder((batch, seq), "int32", name="inputs")
     targets = g.placeholder((batch, seq), "int32", name="targets")
 
+    bf16 = compute_dtype == "bfloat16"
+    cc = (lambda t: g.cast(t, compute_dtype)) if bf16 else (lambda t: t)
+
     h_dim, nh = cfg.hidden_size, cfg.num_heads
     hd = h_dim // nh
-    x = g.take(p["wte"]["embedding"], inputs, axis=0)          # [B,S,H]
-    x = x + g.take(p["wpe"]["embedding"],
+    x = g.take(cc(p["wte"]["embedding"]), inputs, axis=0)      # [B,S,H]
+    x = x + g.take(cc(p["wpe"]["embedding"]),
                    g.constant(np.arange(seq)), axis=0)          # + [S,H]
     # Attention: the fused node (cfg.attn_impl auto/flash — lowers to the
     # Pallas kernel on TPU, composed elsewhere; the IR path's production
@@ -538,8 +548,9 @@ def gpt2_loss_graph(cfg, param_template, batch: int, seq: int) -> Graph:
 
     for i in range(cfg.num_layers):
         blk = p[f"h{i}"]
-        y = g.layernorm(x, blk["ln_1"]["scale"], blk["ln_1"]["bias"])
-        qkv = (y @ blk["attn"]["qkv"]["w"]) + blk["attn"]["qkv"]["b"]
+        y = g.layernorm(x, cc(blk["ln_1"]["scale"]),
+                        cc(blk["ln_1"]["bias"]))
+        qkv = (y @ cc(blk["attn"]["qkv"]["w"])) + cc(blk["attn"]["qkv"]["b"])
         q = heads(g.slice(qkv, (0, 0, 0), (batch, seq, h_dim)))
         k = heads(g.slice(qkv, (0, 0, h_dim), (batch, seq, 2 * h_dim)))
         v = heads(g.slice(qkv, (0, 0, 2 * h_dim), (batch, seq, 3 * h_dim)))
@@ -549,16 +560,29 @@ def gpt2_loss_graph(cfg, param_template, batch: int, seq: int) -> Graph:
                 impl="auto" if cfg.attn_impl == "auto" else "pallas")
         else:
             scores = (q @ g.transpose(k, (0, 1, 3, 2))) * (1.0 / hd ** 0.5)
-            att = g.softmax(scores + mask, axis=-1) @ v
+            if bf16:
+                # fp32 softmax stats, bf16 P·V — the module policy.
+                att = g.cast(g.softmax(g.cast(scores, "float32") + mask,
+                                       axis=-1), compute_dtype) @ v
+            else:
+                att = g.softmax(scores + mask, axis=-1) @ v
         o = g.reshape(g.transpose(att, (0, 2, 1, 3)),
                       (batch, seq, h_dim))
-        x = x + (o @ blk["attn"]["proj"]["w"]) + blk["attn"]["proj"]["b"]
-        y = g.layernorm(x, blk["ln_2"]["scale"], blk["ln_2"]["bias"])
-        y = g.gelu((y @ blk["mlp"]["fc"]["w"]) + blk["mlp"]["fc"]["b"])
-        x = x + (y @ blk["mlp"]["proj"]["w"]) + blk["mlp"]["proj"]["b"]
+        x = x + (o @ cc(blk["attn"]["proj"]["w"])) \
+            + cc(blk["attn"]["proj"]["b"])
+        y = g.layernorm(x, cc(blk["ln_2"]["scale"]),
+                        cc(blk["ln_2"]["bias"]))
+        y = g.gelu((y @ cc(blk["mlp"]["fc"]["w"]))
+                   + cc(blk["mlp"]["fc"]["b"]))
+        x = x + (y @ cc(blk["mlp"]["proj"]["w"])) \
+            + cc(blk["mlp"]["proj"]["b"])
 
-    x = g.layernorm(x, p["ln_f"]["scale"], p["ln_f"]["bias"])
-    logits = x @ g.transpose(p["wte"]["embedding"], (1, 0))  # tied head
+    x = g.layernorm(x, cc(p["ln_f"]["scale"]), cc(p["ln_f"]["bias"]))
+    logits = x @ g.transpose(cc(p["wte"]["embedding"]), (1, 0))  # tied head
+    if bf16:
+        # The module's fused-head discipline: bf16 logit GEMM, fp32
+        # upcast only inside the softmax statistics.
+        logits = g.cast(logits, "float32")
     logp = g.log_softmax(logits, axis=-1)
     nll = -g.mean(g.take_along(logp, targets, axis=2))
     g.output(nll)
@@ -719,14 +743,18 @@ def _make_adamw_ir_step(build_loss_graph, feed_keys: Tuple[str, ...],
 
 def make_gpt2_graph_train_step(model, lr_schedule, weight_decay: float = 0.1,
                                clip_norm: float = None, mesh=None,
-                               executor: Executor = None):
+                               executor: Executor = None,
+                               compute_dtype: str = "float32"):
     """Trainer-compatible step over ``init_graph_gpt2_state`` state; batches
     are {"inputs": [B,S] i32, "targets": [B,S] i32} (see
     :func:`lm_shard_fn`). Graphs are built per batch shape on first use.
-    ``mesh``: dp over the mesh's "dp" axis (IR all_reduce)."""
+    ``mesh``: dp over the mesh's "dp" axis (IR all_reduce).
+    ``compute_dtype="bfloat16"``: the module bf16 policy authored in the
+    IR (fp32 master params; see :func:`gpt2_loss_graph`)."""
     cfg = model.cfg
     return _make_adamw_ir_step(
-        lambda tmpl, batch, seq: gpt2_loss_graph(cfg, tmpl, batch, seq),
+        lambda tmpl, batch, seq: gpt2_loss_graph(
+            cfg, tmpl, batch, seq, compute_dtype=compute_dtype),
         feed_keys=("inputs", "targets"), shape_key="inputs",
         lr_schedule=lr_schedule, weight_decay=weight_decay,
         clip_norm=clip_norm, mesh=mesh, executor=executor)

@@ -1101,3 +1101,24 @@ def test_cli_bert_eval_and_lm_heldout_eval(tmp_path):
                "--eval", "--log-every", "1"])
     k3 = [x for x in m3 if "perplexity" in x][0]
     assert np.isfinite(m3[k3])
+
+
+def test_cli_graph_bf16(devices8):
+    """--graph-bf16 trains the IR-authored bf16 policy through the CLI
+    (single and graph-dp); non-graph engines reject."""
+    import pytest
+    m = _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--steps", "2", "--batch-size", "4", "--engine", "graph",
+              "--parallel", "single", "--graph-bf16", "--log-every", "1"])
+    assert np.isfinite(m["loss"])
+    m = _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--steps", "2", "--batch-size", "8", "--engine", "graph",
+              "--parallel", "dp", "--mesh", "dp=8", "--graph-bf16",
+              "--log-every", "1"])
+    assert np.isfinite(m["loss"])
+    with pytest.raises(SystemExit, match="graph-bf16"):
+        _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--steps", "1", "--batch-size", "4", "--graph-bf16"])
+    with pytest.raises(SystemExit, match="graph-bf16"):
+        _run(["--config", "mlp_mnist", "--steps", "1", "--batch-size", "4",
+              "--engine", "graph", "--graph-bf16"])
