@@ -12,11 +12,10 @@ Four points, one JSON line each (bench.py timing discipline):
   - graph_ir_float32:  gpt2_loss_graph + IR-authored AdamW update
                   (graph/programs.py), StableHLO via graph/lower.py.
   - graph_ir_bfloat16: the same program with the bf16 compute policy
-                  authored as IR cast nodes (both IR points emit the
-                  flash_attention node; the remaining feature delta vs
-                  module_bf16 is the fused logsumexp head, which the IR
-                  program does not express — it materializes fp32
-                  [B,S,V] logits).
+                  authored as IR cast nodes AND the fused logsumexp head
+                  (bf16 logits, fp32 upcast fused into the reductions) —
+                  feature-matched to module_bf16; both IR points emit
+                  the flash_attention node.
 
 If graph_ir_float32 ~= module_fp32_xla, the IR engine itself is sound;
 graph_ir_bfloat16 then shows how much of module_bf16's lead the IR

@@ -168,6 +168,15 @@ class Graph:
     def mean(self, x, axis=None, keepdims=False):
         return self._add("mean", [x], {"axis": axis, "keepdims": keepdims})
 
+    def max(self, x, axis=None, keepdims=False):
+        return self._add("max", [x], {"axis": axis, "keepdims": keepdims})
+
+    def exp(self, x):
+        return self._add("exp", [x])
+
+    def log(self, x):
+        return self._add("log", [x])
+
     def cast(self, x, dtype: str):
         return self._add("cast", [x], {"dtype": dtype})
 

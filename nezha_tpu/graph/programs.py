@@ -580,11 +580,19 @@ def gpt2_loss_graph(cfg, param_template, batch: int, seq: int,
     x = g.layernorm(x, cc(p["ln_f"]["scale"]), cc(p["ln_f"]["bias"]))
     logits = x @ g.transpose(cc(p["wte"]["embedding"]), (1, 0))  # tied head
     if bf16:
-        # The module's fused-head discipline: bf16 logit GEMM, fp32
-        # upcast only inside the softmax statistics.
-        logits = g.cast(logits, "float32")
-    logp = g.log_softmax(logits, axis=-1)
-    nll = -g.mean(g.take_along(logp, targets, axis=2))
+        # The module's fused-head discipline (ops.losses fused CE): the
+        # logit GEMM stays bf16 and the fp32 upcast feeds ONLY the
+        # logsumexp reductions + target gather — XLA fuses the cast into
+        # both consumers, so fp32 [B,S,V] never materializes in HBM.
+        xf = g.cast(logits, "float32")
+        m = g.max(xf, axis=-1, keepdims=True)              # [B,S,1]
+        lse = g.log(g.sum(g.exp(xf - m), axis=-1,
+                          keepdims=True)) + m              # [B,S,1]
+        tgt = g.take_along(xf, targets, axis=2)            # [B,S]
+        nll = g.mean(g.reshape(lse, (batch, seq)) - tgt)
+    else:
+        logp = g.log_softmax(logits, axis=-1)
+        nll = -g.mean(g.take_along(logp, targets, axis=2))
     g.output(nll)
     return g
 
