@@ -95,7 +95,7 @@ def _time_steps(step, state, batch, steps_target: int, budget_s: float,
     return median, spread
 
 
-def bench_gpt2(on_tpu: bool, peak):
+def bench_gpt2(on_tpu: bool, peak, **cfg_overrides):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -109,8 +109,9 @@ def bench_gpt2(on_tpu: bool, peak):
     steps_target = 20 if on_tpu else 3
     # fused_loss_chunk=-1: bf16 logits with the fp32 upcast fused into the
     # CE's logsumexp — never materializes fp32 [B,S,V] (+3% measured).
-    cfg = (GPT2Config(fused_loss_chunk=-1) if on_tpu
-           else GPT2Config(num_layers=4, fused_loss_chunk=-1))
+    cfg = (GPT2Config(fused_loss_chunk=-1, **cfg_overrides) if on_tpu
+           else GPT2Config(num_layers=4, fused_loss_chunk=-1,
+                           **cfg_overrides))
 
     model = GPT2(cfg, policy=bf16_policy())
     opt = optim.adamw(6e-4, weight_decay=0.1)
@@ -294,6 +295,23 @@ def main() -> int:
     peak = _peak_flops(platform)
 
     tokens_per_sec, gpt2_mfu, gpt2_spread = bench_gpt2(on_tpu, peak)
+    # r5 trunk-lever A/B points, captured even when the ONLY tunnel
+    # window of the round is this driver-run bench (the watchdog queue
+    # measures them properly when it gets a window; these are the
+    # fallback evidence). Guarded: a variant failure must not cost the
+    # headline numbers.
+    gpt2_scan_tps = gpt2_ln_tps = None
+    if on_tpu:
+        try:
+            gpt2_scan_tps, _, _ = bench_gpt2(on_tpu, peak,
+                                             scan_layers=True)
+        except Exception as e:
+            print(f"scan variant failed: {e}", file=sys.stderr)
+        try:
+            gpt2_ln_tps, _, _ = bench_gpt2(on_tpu, peak,
+                                           ln_impl="pallas")
+        except Exception as e:
+            print(f"ln_pallas variant failed: {e}", file=sys.stderr)
     images_per_sec, rn50_mfu, rn50_spread = bench_resnet50(on_tpu, peak)
     bert_tps, bert_mfu, _ = bench_bert(on_tpu, peak)
     wrn_ips, wrn_mfu, _ = bench_wrn101(on_tpu, peak)
@@ -352,6 +370,10 @@ def main() -> int:
         extras["bert_base_mfu"] = round(bert_mfu, 4)
     if wrn_mfu is not None:
         extras["wrn101_mfu"] = round(wrn_mfu, 4)
+    if gpt2_scan_tps is not None:
+        extras["gpt2_scan_tokens_per_sec"] = round(gpt2_scan_tps, 2)
+    if gpt2_ln_tps is not None:
+        extras["gpt2_ln_pallas_tokens_per_sec"] = round(gpt2_ln_tps, 2)
 
     out = {
         "metric": "gpt2_124m_tokens_per_sec_per_chip",
