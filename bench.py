@@ -294,6 +294,21 @@ def main() -> int:
     on_tpu = platform in ("tpu", "axon")
     peak = _peak_flops(platform)
 
+    # Dispatch round-trip: one trivial op + host fetch per call. Under
+    # the axon tunnel every dispatch crosses a network hop, and the CLI
+    # MLP number (~9 steps/s in r4) is hypothesized to be exactly this
+    # latency (BENCH_NOTES r5); recording it beside the configs makes the
+    # attribution mechanical.
+    import jax.numpy as jnp
+    _x = jnp.zeros((), jnp.float32)
+    _add = jax.jit(lambda v: v + 1.0)
+    _add(_x).block_until_ready()
+    _t0 = time.perf_counter()
+    for _ in range(20):
+        _x = _add(_x)
+        _x.block_until_ready()
+    ping_ms = (time.perf_counter() - _t0) / 20 * 1e3
+
     tokens_per_sec, gpt2_mfu, gpt2_spread = bench_gpt2(on_tpu, peak)
     # r5 trunk-lever A/B points, captured even when the ONLY tunnel
     # window of the round is this driver-run bench (the watchdog queue
@@ -361,6 +376,7 @@ def main() -> int:
         "bert_base_tokens_per_sec_per_chip": round(bert_tps, 2),
         "wrn101_images_per_sec_per_chip": round(wrn_ips, 2),
         "mlp_examples_per_sec": round(mlp_eps, 2),
+        "ping_ms": round(ping_ms, 3),
     }
     if isinstance(rn50_base, (int, float)) and rn50_base > 0:
         extras["resnet50_vs_baseline"] = round(images_per_sec / rn50_base, 4)
