@@ -147,9 +147,11 @@ def run(args) -> dict:
         # tiny trains fp32 (DEFAULT_POLICY) — greedy decode must run the
         # same compute numerics as the checkpoint's training run.
         # --scan-layers checkpoints store the trunk under h_scan with a
-        # leading layer dim; build the model with the matching layout so
-        # the restore template names the right leaves (decode itself is
-        # layout-agnostic — GPT2.apply slices per layer under a cache).
+        # leading layer dim; restore with the matching template, then
+        # unstack ONCE to the unrolled layout for decode — the scan
+        # model's cache path would otherwise slice every stacked param
+        # per decode step (doubling param traffic in the latency-bound
+        # loop).
         scan = False
         if args.ckpt_dir:
             from nezha_tpu.cli.common import ckpt_has_scan_trunk
@@ -168,6 +170,16 @@ def run(args) -> dict:
             from nezha_tpu.cli.common import restore_variables_any
             variables = restore_variables_any(args.ckpt_dir, model,
                                               optim.sgd(0.1))
+            if scan:
+                import dataclasses as _dc
+
+                from nezha_tpu.models.gpt2 import unstack_layer_params
+                variables = {
+                    "params": unstack_layer_params(
+                        variables["params"], model.cfg.num_layers),
+                    "state": variables.get("state", {})}
+                model = GPT2(_dc.replace(model.cfg, scan_layers=False),
+                             policy=model.policy)
         else:
             variables = model.init(jax.random.PRNGKey(args.seed))
 
