@@ -1068,6 +1068,12 @@ def test_cli_scan_layers_sp_matches_single(devices8):
                        ["--parallel", "sp", "--mesh", "dp=2,sp=4",
                         "--attn-impl", "ring", "--scan-layers"])
     np.testing.assert_allclose(sp, ref, rtol=1e-3)
+    # Ulysses all-to-all + scan + remat compose too (memory-knob stack).
+    uly = _final_losses("gpt2_124m", 3, 8,
+                        ["--parallel", "sp", "--mesh", "dp=2,sp=4",
+                         "--attn-impl", "ulysses", "--scan-layers",
+                         "--remat"])
+    np.testing.assert_allclose(uly, ref, rtol=1e-3)
 
 
 def test_cli_bert_eval_and_lm_heldout_eval(tmp_path):
