@@ -86,12 +86,8 @@ class EncoderLayer(Module):
         qkv = qkv.reshape(b, s, 3, cfg.num_heads, d).transpose(2, 0, 3, 1, 4)
         impl = cfg.attn_impl
         if impl == "auto":
-            import jax
-
-            from nezha_tpu.parallel.gspmd import under_auto_partitioner
-            impl = ("flash" if mask is None
-                    and jax.default_backend() == "tpu"
-                    and not under_auto_partitioner() else "xla")
+            from nezha_tpu.models.gpt2 import _flash_auto_ok
+            impl = "flash" if mask is None and _flash_auto_ok() else "xla"
         if impl == "flash":
             if mask is not None:
                 raise ValueError("attn_impl='flash' cannot apply an "

@@ -71,7 +71,8 @@ def _prefill_fn(model: GPT2):
     @jax.jit
     def prefill(variables, prompt, cache):
         logits, states = model.apply(variables, prompt, training=False,
-                                     cache=cache, pos=jnp.int32(0))
+                                     cache=cache, pos=jnp.int32(0),
+                                     prefill=True)
         return logits[:, -1, :], _caches_from_states(model, states, cache)
 
     return prefill

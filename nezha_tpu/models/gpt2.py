@@ -79,6 +79,17 @@ class GPT2Config:
     scan_layers: bool = False
 
 
+def _flash_auto_ok() -> bool:
+    """ONE backend policy for every attn_impl='auto' site (train, prefill,
+    BERT): compiled flash on TPU, and never under the GSPMD
+    auto-partitioner (jit-with-shardings cannot partition a Mosaic custom
+    call; shard_map paths see per-device blocks and are fine)."""
+    import jax
+
+    from nezha_tpu.parallel.gspmd import under_auto_partitioner
+    return jax.default_backend() == "tpu" and not under_auto_partitioner()
+
+
 class Attention(Module):
     def __init__(self, cfg: GPT2Config, policy: Policy):
         h = cfg.hidden_size
@@ -91,7 +102,7 @@ class Attention(Module):
         self.drop = nn.Dropout(cfg.dropout)
 
     def apply(self, variables: Variables, x, training: bool = False, rng=None,
-              cache=None, pos=None):
+              cache=None, pos=None, prefill: bool = False):
         cfg = self.cfg
         b, s, h = x.shape
         d = h // cfg.num_heads
@@ -113,12 +124,41 @@ class Attention(Module):
             v_all = lax.dynamic_update_slice(
                 cache["v"], v.astype(cache["v"].dtype),
                 (zero, zero, pos, zero))
-            L = k_all.shape[2]
-            abs_q = pos + jnp.arange(s)[:, None]       # absolute positions
-            attendable = jnp.arange(L)[None, :] <= abs_q
-            mask = jnp.where(attendable, 0.0, -jnp.inf).astype(jnp.float32)
-            out = ops.dot_product_attention(q, k_all.astype(q.dtype),
-                                            v_all.astype(q.dtype), mask=mask)
+            use_flash_prefill = False
+            if prefill and s > 1:
+                # Prefill (STATIC hint from generate.py: pos is always 0
+                # there): nothing precedes the prompt, so attention is
+                # exactly causal flash over the chunk itself — no
+                # [B,H,S,L] score matrix against the padded cache. Same
+                # backend policy as the training path (shared helper).
+                impl = cfg.attn_impl
+                if impl == "auto":
+                    impl = "flash" if _flash_auto_ok() else "xla"
+                use_flash_prefill = impl == "flash"
+            if use_flash_prefill:
+                from nezha_tpu.ops.pallas import flash_attention
+                # Arbitrary prompt lengths: pad to a lane multiple so the
+                # kernel gets real block sizes (a prime S would degrade
+                # _pick_block to 1-wide blocks); padded keys are masked
+                # via kv_lengths, padded query rows sliced off.
+                pad = (-s) % 128
+                if pad:
+                    pq, pk, pv = (jnp.pad(t, ((0, 0), (0, 0), (0, pad),
+                                              (0, 0)))
+                                  for t in (q, k, v))
+                    lens = jnp.full((b,), s, jnp.int32)
+                    out = flash_attention(pq, pk, pv, causal=True,
+                                          kv_lengths=lens)[:, :, :s, :]
+                else:
+                    out = flash_attention(q, k, v, causal=True)
+            else:
+                L = k_all.shape[2]
+                abs_q = pos + jnp.arange(s)[:, None]   # absolute positions
+                attendable = jnp.arange(L)[None, :] <= abs_q
+                mask = jnp.where(attendable, 0.0, -jnp.inf).astype(jnp.float32)
+                out = ops.dot_product_attention(q, k_all.astype(q.dtype),
+                                                v_all.astype(q.dtype),
+                                                mask=mask)
             states["cache"] = {"k": k_all, "v": v_all}
             out = out.transpose(0, 2, 1, 3).reshape(b, s, h)
             out = run_child(self.proj, "proj", variables, states, out,
@@ -135,11 +175,7 @@ class Attention(Module):
             # flash under the GSPMD auto-partitioner (jit-with-shardings
             # cannot partition a Mosaic custom call; shard_map paths like
             # ZeRO-1/pipeline see per-device blocks and are fine).
-            import jax
-
-            from nezha_tpu.parallel.gspmd import under_auto_partitioner
-            impl = ("flash" if jax.default_backend() == "tpu"
-                    and not under_auto_partitioner() else "xla")
+            impl = "flash" if _flash_auto_ok() else "xla"
         if impl == "ring":
             from nezha_tpu.parallel.ring import ring_attention
             out = ring_attention(q, k, v, cfg.sp_axis, causal=True,
@@ -199,11 +235,12 @@ class Block(Module):
             self.mlp = MLPBlock(cfg, policy)
 
     def apply(self, variables: Variables, x, training: bool = False, rng=None,
-              cache=None, pos=None):
+              cache=None, pos=None, prefill: bool = False):
         states: dict = {}
         y = run_child(self.ln_1, "ln_1", variables, states, x, training=training)
         y = run_child(self.attn, "attn", variables, states, y,
-                      training=training, rng=rng, cache=cache, pos=pos)
+                      training=training, rng=rng, cache=cache, pos=pos,
+                      prefill=prefill)
         x = x + y
         y = run_child(self.ln_2, "ln_2", variables, states, x, training=training)
         y = run_child(self.mlp, "mlp", variables, states, y,
@@ -293,7 +330,7 @@ class GPT2(Module):
                           impl=cfg.ln_impl)
 
     def apply(self, variables: Variables, batch, training: bool = False,
-              rng=None, cache=None, pos=None):
+              rng=None, cache=None, pos=None, prefill: bool = False):
         if isinstance(batch, dict):
             tokens = batch["tokens"][:, :-1]
         else:
@@ -335,7 +372,7 @@ class GPT2(Module):
                     x, st = self.h_scan.block.apply(
                         lvars, x, training=training,
                         rng=child_rng(rng, f"h{i}"), cache=cache[i],
-                        pos=pos)
+                        pos=pos, prefill=prefill)
                     if st:
                         states[f"h{i}"] = st
         # (With scan_layers, self.h is empty — the loop below is a no-op
@@ -360,7 +397,7 @@ class GPT2(Module):
                 x = run_child(block, f"h{i}", variables, states, x,
                               training=training, rng=rng,
                               cache=None if cache is None else cache[i],
-                              pos=pos)
+                              pos=pos, prefill=prefill)
         x = run_child(self.ln_f, "ln_f", variables, states, x,
                       training=training)
         # MoE blocks report their load-balance losses through child state;
