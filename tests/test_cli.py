@@ -1128,3 +1128,25 @@ def test_cli_graph_bf16(devices8):
     with pytest.raises(SystemExit, match="graph-bf16"):
         _run(["--config", "mlp_mnist", "--steps", "1", "--batch-size", "4",
               "--engine", "graph", "--graph-bf16"])
+
+
+def test_cli_scan_layers_resume_and_knob_compositions(tmp_path, devices8):
+    """scan-layers composes with checkpoint resume, --grad-accum,
+    --clip-norm, and --wd-exclude-1d; MoE composes with the decay mask."""
+    ck = str(tmp_path / "ck")
+    _run(["--config", "gpt2_124m", "--model-preset", "tiny", "--steps", "3",
+          "--batch-size", "8", "--scan-layers", "--mesh", "dp=8",
+          "--ckpt-dir", ck])
+    m = _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--steps", "2", "--batch-size", "8", "--scan-layers",
+              "--mesh", "dp=8", "--ckpt-dir", ck, "--log-every", "1"])
+    assert m["step"] == 5  # resumed 3 -> 5
+    m = _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--steps", "2", "--batch-size", "8", "--scan-layers",
+              "--grad-accum", "2", "--clip-norm", "1.0", "--wd-exclude-1d",
+              "--mesh", "dp=8", "--log-every", "1"])
+    assert np.isfinite(m["loss"])
+    m = _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--steps", "2", "--batch-size", "8", "--moe-experts", "4",
+              "--wd-exclude-1d", "--mesh", "dp=8", "--log-every", "1"])
+    assert np.isfinite(m["loss"])
