@@ -310,27 +310,39 @@ def main() -> int:
     ping_ms = (time.perf_counter() - _t0) / 20 * 1e3
 
     tokens_per_sec, gpt2_mfu, gpt2_spread = bench_gpt2(on_tpu, peak)
-    # r5 trunk-lever A/B points, captured even when the ONLY tunnel
-    # window of the round is this driver-run bench (the watchdog queue
-    # measures them properly when it gets a window; these are the
-    # fallback evidence). Guarded: a variant failure must not cost the
-    # headline numbers.
-    gpt2_scan_tps = gpt2_ln_tps = None
-    if on_tpu:
-        try:
-            gpt2_scan_tps, _, _ = bench_gpt2(on_tpu, peak,
-                                             scan_layers=True)
-        except Exception as e:
-            print(f"scan variant failed: {e}", file=sys.stderr)
-        try:
-            gpt2_ln_tps, _, _ = bench_gpt2(on_tpu, peak,
-                                           ln_impl="pallas")
-        except Exception as e:
-            print(f"ln_pallas variant failed: {e}", file=sys.stderr)
     images_per_sec, rn50_mfu, rn50_spread = bench_resnet50(on_tpu, peak)
     bert_tps, bert_mfu, _ = bench_bert(on_tpu, peak)
     wrn_ips, wrn_mfu, _ = bench_wrn101(on_tpu, peak)
     mlp_eps = bench_mlp(on_tpu)
+
+    # r5 trunk-lever A/B points, captured even when the ONLY tunnel
+    # window of the round is this driver-run bench (the watchdog queue
+    # measures them properly when it gets a window; these are fallback
+    # evidence). They run LAST — after every headline config — and each
+    # is bounded by an alarm, so a hung variant on a dying tunnel cannot
+    # cost the numbers of record.
+    gpt2_scan_tps = gpt2_ln_tps = None
+    if on_tpu:
+        import signal
+
+        def _bounded(fn, seconds=240):
+            def _alarm(signum, frame):
+                raise TimeoutError("variant timed out")
+            old = signal.signal(signal.SIGALRM, _alarm)
+            signal.alarm(seconds)
+            try:
+                return fn()
+            except Exception as e:
+                print(f"variant failed: {e}", file=sys.stderr)
+                return None
+            finally:
+                signal.alarm(0)
+                signal.signal(signal.SIGALRM, old)
+
+        r = _bounded(lambda: bench_gpt2(on_tpu, peak, scan_layers=True))
+        gpt2_scan_tps = r[0] if r else None
+        r = _bounded(lambda: bench_gpt2(on_tpu, peak, ln_impl="pallas"))
+        gpt2_ln_tps = r[0] if r else None
 
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "bench_baseline.json")
