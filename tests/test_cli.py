@@ -1150,3 +1150,13 @@ def test_cli_scan_layers_resume_and_knob_compositions(tmp_path, devices8):
               "--steps", "2", "--batch-size", "8", "--moe-experts", "4",
               "--wd-exclude-1d", "--mesh", "dp=8", "--log-every", "1"])
     assert np.isfinite(m["loss"])
+    # BERT stacked encoder under GSPMD TP; scan + int8 gradient wire.
+    m = _run(["--config", "bert_base_zero1", "--model-preset", "tiny",
+              "--steps", "2", "--batch-size", "8", "--parallel", "gspmd",
+              "--mesh", "dp=4,tp=2", "--scan-layers", "--log-every", "1"])
+    assert np.isfinite(m["loss"])
+    m = _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--steps", "2", "--batch-size", "8", "--parallel", "dp",
+              "--mesh", "dp=8", "--scan-layers", "--grad-allreduce",
+              "int8", "--log-every", "1"])
+    assert np.isfinite(m["loss"])
