@@ -1160,3 +1160,13 @@ def test_cli_scan_layers_resume_and_knob_compositions(tmp_path, devices8):
               "--mesh", "dp=8", "--scan-layers", "--grad-allreduce",
               "int8", "--log-every", "1"])
     assert np.isfinite(m["loss"])
+    # Sharded (gspmd) checkpoint resume with the stacked trunk.
+    ck2 = str(tmp_path / "ck2")
+    _run(["--config", "gpt2_124m", "--model-preset", "tiny", "--steps", "2",
+          "--batch-size", "8", "--parallel", "gspmd", "--mesh", "dp=4,tp=2",
+          "--scan-layers", "--ckpt-dir", ck2])
+    m = _run(["--config", "gpt2_124m", "--model-preset", "tiny",
+              "--steps", "2", "--batch-size", "8", "--parallel", "gspmd",
+              "--mesh", "dp=4,tp=2", "--scan-layers", "--ckpt-dir", ck2,
+              "--log-every", "1"])
+    assert m["step"] == 4 and np.isfinite(m["loss"])
