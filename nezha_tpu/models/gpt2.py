@@ -36,7 +36,10 @@ class GPT2Config:
     # GPT-2 124M B=8 S=1024): flash 102.0k tok/s vs xla 87.0k (+17%) once
     # the kernel dots run in bf16 with tuned blocks; flash also removes the
     # S x S score buffers, so B=32 trains where the xla path OOMs.
-    attn_impl: str = "auto"  # "xla" | "flash" | "auto" | "ring" | "ulysses"
+    # "auto" | "xla" | "flash" | "flash_shmap" (flash via nested
+    # shard_map over tp-sharded heads inside a gspmd trace — auto picks
+    # it on TPU when tp divides the heads) | "ring" | "ulysses"
+    attn_impl: str = "auto"
     sp_axis: str = "sp"
     # ring/ulysses flash policy: None = auto (flash kernels on TPU,
     # composed elsewhere); True/False force it — the escape hatch back to
@@ -77,6 +80,55 @@ class GPT2Config:
     # (incompatible with moe_experts). Decode still runs per-layer so the
     # KV-cache/generate path is unchanged.
     scan_layers: bool = False
+
+
+def _tp_sharded_flash(q, k, v, mesh, causal: bool = True):
+    """Per-device flash attention over head-sharded blocks inside a GSPMD
+    trace: heads are embarrassingly parallel over ``tp`` (the Megatron
+    qkv column-parallel layout shards [B, H, S, D] on H), so a NESTED
+    shard_map runs the Mosaic kernel device-locally — the auto-
+    partitioner never sees the custom call, and TP training keeps the
+    flash kernel instead of falling back to composed S x S attention."""
+    from jax.sharding import PartitionSpec as P
+
+    from nezha_tpu.ops.pallas import flash_attention
+    from nezha_tpu.parallel._compat import shard_map
+
+    # Batch over dp (matching the enclosing data-parallel sharding — a
+    # None there would make jit all-gather the batch and compute every
+    # dp shard redundantly), heads over tp.
+    bspec = "dp" if "dp" in mesh.axis_names else None
+    spec = P(bspec, "tp", None, None)
+    f = shard_map(
+        lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return f(q, k, v)
+
+
+def _tp_flash_mesh(num_heads: int):
+    """The enclosing gspmd mesh when the nested-shard_map flash path is
+    usable for ``num_heads`` (TPU backend, a ``tp`` axis that divides the
+    heads); None otherwise."""
+    import jax
+
+    from nezha_tpu.parallel.gspmd import auto_partitioner_mesh
+    mesh = auto_partitioner_mesh()
+    if (mesh is not None and "tp" in mesh.axis_names
+            and num_heads % mesh.shape["tp"] == 0
+            and jax.default_backend() == "tpu"):
+        return mesh
+    return None
+
+
+def _resolve_auto_impl(cfg) -> str:
+    """THE attn_impl='auto' policy, shared by training and prefill:
+    compiled flash on TPU; under a mesh-carrying GSPMD trace, the nested
+    shard_map kernel when tp divides the heads; composed XLA otherwise."""
+    if _flash_auto_ok():
+        return "flash"
+    if _tp_flash_mesh(cfg.num_heads) is not None:
+        return "flash_shmap"
+    return "xla"
 
 
 def _flash_auto_ok() -> bool:
@@ -133,7 +185,10 @@ class Attention(Module):
                 # backend policy as the training path (shared helper).
                 impl = cfg.attn_impl
                 if impl == "auto":
-                    impl = "flash" if _flash_auto_ok() else "xla"
+                    impl = _resolve_auto_impl(cfg)
+                # (flash_shmap applies to the training path; prefill runs
+                # outside the gspmd trace, where auto resolves to plain
+                # flash/xla.)
                 use_flash_prefill = impl == "flash"
             if use_flash_prefill:
                 from nezha_tpu.ops.pallas import flash_attention
@@ -171,11 +226,12 @@ class Attention(Module):
             # (S=1024: +10% over xla attention-only, +17% end-to-end;
             # S=2048: +25% attention-only) and is the only path at S>=32k
             # where the S x S score matrix exhausts HBM. Interpret-mode
-            # flash (non-TPU backends) is never auto-chosen, and neither is
-            # flash under the GSPMD auto-partitioner (jit-with-shardings
-            # cannot partition a Mosaic custom call; shard_map paths like
-            # ZeRO-1/pipeline see per-device blocks and are fine).
-            impl = "flash" if _flash_auto_ok() else "xla"
+            # flash (non-TPU backends) is never auto-chosen. Under the
+            # GSPMD auto-partitioner (which cannot partition a Mosaic
+            # custom call) the kernel still runs when the trace carries
+            # its mesh and tp divides the heads — via a nested shard_map
+            # over the head axis (_tp_sharded_flash); otherwise composed.
+            impl = _resolve_auto_impl(cfg)
         if impl == "ring":
             from nezha_tpu.parallel.ring import ring_attention
             out = ring_attention(q, k, v, cfg.sp_axis, causal=True,
@@ -184,6 +240,19 @@ class Attention(Module):
             from nezha_tpu.parallel.sequence_parallel import ulysses_attention
             out = ulysses_attention(q, k, v, cfg.sp_axis, causal=True,
                                     use_flash=cfg.sp_use_flash)
+        elif impl == "flash_shmap":
+            from nezha_tpu.parallel.gspmd import auto_partitioner_mesh
+            mesh = auto_partitioner_mesh()
+            if mesh is None or "tp" not in mesh.axis_names \
+                    or cfg.num_heads % mesh.shape["tp"]:
+                raise ValueError(
+                    f"attn_impl='flash_shmap' needs an enclosing gspmd "
+                    f"trace carrying a mesh with a 'tp' axis dividing "
+                    f"num_heads={cfg.num_heads} "
+                    f"(make_gspmd_train_step or "
+                    f"auto_partitioner_scope(mesh=...)); got "
+                    f"{mesh and dict(mesh.shape)}")
+            out = _tp_sharded_flash(q, k, v, mesh, causal=True)
         elif impl == "flash":
             from nezha_tpu.ops.pallas import flash_attention
             out = flash_attention(q, k, v, causal=True)

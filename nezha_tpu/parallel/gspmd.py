@@ -33,26 +33,39 @@ Rules = List[Tuple[str, P]]
 # calls, so models consult this to avoid auto-choosing custom kernels.
 _AUTO_PARTITIONED: contextvars.ContextVar[bool] = contextvars.ContextVar(
     "nezha_gspmd_auto_partitioned", default=False)
+_AUTO_MESH: contextvars.ContextVar = contextvars.ContextVar(
+    "nezha_gspmd_auto_mesh", default=None)
 
 
 def under_auto_partitioner() -> bool:
     return _AUTO_PARTITIONED.get()
 
 
-def auto_partitioner_scope():
+def auto_partitioner_mesh():
+    """The Mesh of the enclosing gspmd trace (None outside one). Lets
+    model code open a NESTED shard_map region for ops XLA cannot
+    auto-partition — e.g. per-device flash attention over tp-sharded
+    heads (models.gpt2)."""
+    return _AUTO_MESH.get()
+
+
+def auto_partitioner_scope(mesh=None):
     """Public scope: trace model code as if under the GSPMD auto-
     partitioner, so ``attn_impl='auto'`` avoids Mosaic kernels that XLA
     cannot partition. Needed anywhere sharded params meet a fresh trace —
-    e.g. eval over a gspmd/pipeline-laid-out state."""
-    return _auto_partitioner_scope()
+    e.g. eval over a gspmd/pipeline-laid-out state. Pass ``mesh`` to also
+    enable nested-shard_map kernel regions (auto_partitioner_mesh)."""
+    return _auto_partitioner_scope(mesh)
 
 
 @contextlib.contextmanager
-def _auto_partitioner_scope():
+def _auto_partitioner_scope(mesh=None):
     token = _AUTO_PARTITIONED.set(True)
+    mtoken = _AUTO_MESH.set(mesh)
     try:
         yield
     finally:
+        _AUTO_MESH.reset(mtoken)
         _AUTO_PARTITIONED.reset(token)
 
 # Megatron-style GPT-2 sharding: column-parallel qkv/fc (shard the output
@@ -237,7 +250,7 @@ def make_gspmd_train_step(model: Module, optimizer: Optimizer,
         rng, next_rng = jax.random.split(state["rng"])
 
         def compute_loss(params):
-            with _auto_partitioner_scope():  # trace-time flag, see above
+            with _auto_partitioner_scope(mesh):  # trace-time flag + mesh
                 out, new_state = model.apply(
                     {"params": params, "state": variables["state"]},
                     batch, training=True, rng=rng)
