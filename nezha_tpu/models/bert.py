@@ -86,9 +86,25 @@ class EncoderLayer(Module):
         qkv = qkv.reshape(b, s, 3, cfg.num_heads, d).transpose(2, 0, 3, 1, 4)
         impl = cfg.attn_impl
         if impl == "auto":
-            from nezha_tpu.models.gpt2 import _flash_auto_ok
-            impl = "flash" if mask is None and _flash_auto_ok() else "xla"
-        if impl == "flash":
+            from nezha_tpu.models.gpt2 import _resolve_auto_impl
+            impl = _resolve_auto_impl(cfg) if mask is None else "xla"
+        if impl == "flash_shmap":
+            if mask is not None:
+                raise ValueError("attn_impl='flash_shmap' cannot apply an "
+                                 "arbitrary padding mask; use right-padded "
+                                 "batches with kv_lengths, or 'xla'")
+            from nezha_tpu.models.gpt2 import _tp_sharded_flash
+            from nezha_tpu.parallel.gspmd import auto_partitioner_mesh
+            mesh = auto_partitioner_mesh()
+            if mesh is None or "tp" not in mesh.axis_names \
+                    or cfg.num_heads % mesh.shape["tp"]:
+                raise ValueError(
+                    f"attn_impl='flash_shmap' needs an enclosing gspmd "
+                    f"trace carrying a mesh with a 'tp' axis dividing "
+                    f"num_heads={cfg.num_heads}")
+            att = _tp_sharded_flash(qkv[0], qkv[1], qkv[2], mesh,
+                                    causal=False, kv_lengths=kv_lengths)
+        elif impl == "flash":
             if mask is not None:
                 raise ValueError("attn_impl='flash' cannot apply an "
                                  "arbitrary padding mask; use right-padded "

@@ -82,13 +82,16 @@ class GPT2Config:
     scan_layers: bool = False
 
 
-def _tp_sharded_flash(q, k, v, mesh, causal: bool = True):
+def _tp_sharded_flash(q, k, v, mesh, causal: bool = True,
+                      kv_lengths=None):
     """Per-device flash attention over head-sharded blocks inside a GSPMD
     trace: heads are embarrassingly parallel over ``tp`` (the Megatron
     qkv column-parallel layout shards [B, H, S, D] on H), so a NESTED
     shard_map runs the Mosaic kernel device-locally — the auto-
     partitioner never sees the custom call, and TP training keeps the
-    flash kernel instead of falling back to composed S x S attention."""
+    flash kernel instead of falling back to composed S x S attention.
+    ``kv_lengths`` ([B] int32, BERT right-padding) shards with the
+    batch."""
     from jax.sharding import PartitionSpec as P
 
     from nezha_tpu.ops.pallas import flash_attention
@@ -99,10 +102,16 @@ def _tp_sharded_flash(q, k, v, mesh, causal: bool = True):
     # dp shard redundantly), heads over tp.
     bspec = "dp" if "dp" in mesh.axis_names else None
     spec = P(bspec, "tp", None, None)
+    if kv_lengths is None:
+        f = shard_map(
+            lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=causal),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        return f(q, k, v)
     f = shard_map(
-        lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    return f(q, k, v)
+        lambda q_, k_, v_, l_: flash_attention(q_, k_, v_, causal=causal,
+                                               kv_lengths=l_),
+        mesh=mesh, in_specs=(spec, spec, spec, P(bspec)), out_specs=spec)
+    return f(q, k, v, kv_lengths)
 
 
 def _tp_flash_mesh(num_heads: int):
