@@ -16,6 +16,7 @@ workloads (SURVEY.md §2: matmul, conv, norms, embedding, dropout, pooling).
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence, Tuple, Union
 
 import jax
@@ -169,9 +170,10 @@ class LayerNorm(Module):
 
     ``impl="pallas"`` opts into the fused Pallas kernel (fwd + custom-VJP
     bwd, `ops.pallas.fused_layer_norm`) on TPU backends; requires both
-    scale and bias. Falls back to the XLA composition under the GSPMD
-    auto-partitioner (Mosaic calls cannot be auto-partitioned) and on
-    non-TPU backends."""
+    scale and bias. Under the GSPMD auto-partitioner (which cannot
+    partition Mosaic calls) the kernel still runs device-locally via a
+    nested shard_map when the trace carries its mesh (rows independent,
+    batch over dp); composed XLA otherwise and on non-TPU backends."""
 
     def __init__(self, dim: int, eps: float = 1e-5, use_bias: bool = True,
                  use_scale: bool = True, policy: Policy = DEFAULT_POLICY,
@@ -199,14 +201,37 @@ class LayerNorm(Module):
     def apply(self, variables: Variables, x, training: bool = False, rng=None):
         del training, rng
         p = variables["params"]
-        if self.impl == "pallas" and jax.default_backend() == "tpu":
-            from nezha_tpu.parallel.gspmd import under_auto_partitioner
+        force = os.environ.get("NEZHA_LN_INTERPRET")  # CPU test hook
+        if self.impl == "pallas" and (jax.default_backend() == "tpu"
+                                      or force):
+            from nezha_tpu.parallel.gspmd import (auto_partitioner_mesh,
+                                                  under_auto_partitioner)
             if not under_auto_partitioner():
                 from nezha_tpu.ops.pallas import fused_layer_norm
                 y = fused_layer_norm(
                     self.policy.cast_to_compute(x),
                     jnp.asarray(p["scale"], jnp.float32),
                     jnp.asarray(p["bias"], jnp.float32), eps=self.eps)
+                return self.policy.cast_output(y), {}
+            mesh = auto_partitioner_mesh()
+            if mesh is not None and "dp" in mesh.axis_names and x.ndim >= 2:
+                # Under the GSPMD auto-partitioner (which cannot partition
+                # a Mosaic call) the kernel still runs device-locally via
+                # a nested shard_map: rows are independent, activations
+                # between blocks are tp-replicated, batch shards over dp
+                # (same pattern as models.gpt2._tp_sharded_flash).
+                from jax.sharding import PartitionSpec as P
+
+                from nezha_tpu.ops.pallas import fused_layer_norm
+                from nezha_tpu.parallel._compat import shard_map
+                spec = P(*(("dp",) + (None,) * (x.ndim - 1)))
+                f = shard_map(
+                    lambda x_, s_, b_: fused_layer_norm(x_, s_, b_,
+                                                        eps=self.eps),
+                    mesh=mesh, in_specs=(spec, P(), P()), out_specs=spec)
+                y = f(self.policy.cast_to_compute(x),
+                      jnp.asarray(p["scale"], jnp.float32),
+                      jnp.asarray(p["bias"], jnp.float32))
                 return self.policy.cast_output(y), {}
         xf = jnp.asarray(x, jnp.float32)
         mean = jnp.mean(xf, axis=-1, keepdims=True)

@@ -243,3 +243,32 @@ def test_gspmd_bert_tp_flash_shmap_varlen_matches_single(devices8):
         s1, met = step1(s1, b1)
         l1.append(float(met["loss"]))
     np.testing.assert_allclose(l1, l0, rtol=1e-3)
+
+
+def test_gspmd_pallas_ln_nested_shmap_matches_xla(devices8, monkeypatch):
+    """Under the auto-partitioner with a mesh, the fused Pallas LN runs
+    device-locally via a nested shard_map (NEZHA_LN_INTERPRET exercises
+    the kernel in interpret mode off-TPU) — numerics match the composed
+    LN."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from nezha_tpu import nn, parallel
+    from nezha_tpu.parallel.gspmd import auto_partitioner_scope
+
+    monkeypatch.setenv("NEZHA_LN_INTERPRET", "1")
+    mesh = parallel.make_mesh({"dp": 2, "tp": 4})
+    ln_p = nn.LayerNorm(32, impl="pallas")
+    ln_x = nn.LayerNorm(32, impl="xla")
+    v = ln_x.init(jax.random.PRNGKey(0))
+    v["params"]["scale"] = jnp.asarray(
+        np.random.RandomState(1).rand(32).astype(np.float32))
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 16, 32)
+                    .astype(np.float32))
+
+    with auto_partitioner_scope(mesh):
+        y_p, _ = ln_p.apply(v, x)
+    y_x, _ = ln_x.apply(v, x)
+    np.testing.assert_allclose(np.asarray(y_p), np.asarray(y_x),
+                               rtol=2e-5, atol=2e-6)
