@@ -117,10 +117,16 @@ def _tp_sharded_flash(q, k, v, mesh, causal: bool = True,
 def _tp_flash_mesh(num_heads: int):
     """The enclosing gspmd mesh when the nested-shard_map flash path is
     usable for ``num_heads`` (TPU backend, a ``tp`` axis that divides the
-    heads); None otherwise."""
+    heads); None otherwise. ``NEZHA_NO_NESTED_KERNELS=1`` disables it —
+    the day-1 escape hatch if Mosaic-inside-shard_map misbehaves on real
+    hardware (parity is virtual-mesh-proven; real-ICI compile is not)."""
+    import os
+
     import jax
 
     from nezha_tpu.parallel.gspmd import auto_partitioner_mesh
+    if os.environ.get("NEZHA_NO_NESTED_KERNELS"):
+        return None
     mesh = auto_partitioner_mesh()
     if (mesh is not None and "tp" in mesh.axis_names
             and num_heads % mesh.shape["tp"] == 0

@@ -214,6 +214,8 @@ class LayerNorm(Module):
                     jnp.asarray(p["bias"], jnp.float32), eps=self.eps)
                 return self.policy.cast_output(y), {}
             mesh = auto_partitioner_mesh()
+            if os.environ.get("NEZHA_NO_NESTED_KERNELS"):
+                mesh = None  # day-1 escape hatch; see gpt2._tp_flash_mesh
             if mesh is not None and "dp" in mesh.axis_names and x.ndim >= 2:
                 # Under the GSPMD auto-partitioner (which cannot partition
                 # a Mosaic call) the kernel still runs device-locally via
