@@ -100,6 +100,8 @@ def run(sizes_mb, iters: int = 20) -> list:
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from nezha_tpu import obs
+
     mesh = _mesh()
     n = mesh.devices.size
     results = []
@@ -116,7 +118,14 @@ def run(sizes_mb, iters: int = 20) -> list:
                 out = fn(x)
             np.asarray(jax.tree_util.tree_leaves(out)[0][:1])  # sync
             dt = (time.perf_counter() - t0) / iters
-            bus = bus_bytes(per_dev * 4) / dt
+            payload = per_dev * 4
+            bus = bus_bytes(payload) / dt
+            # Telemetry (with --run-dir): the MEASURED per-collective
+            # bandwidth — the benchmark is the authoritative source for
+            # the report's bus GB/s column (train-step call sites only
+            # count payload bytes).
+            obs.record_collective(name, payload, seconds=dt,
+                                  bus_bytes=bus_bytes(payload))
             results.append({
                 "collective": name, "devices": n, "size_mb_per_dev": mb,
                 "time_ms": round(dt * 1e3, 3),
@@ -132,11 +141,27 @@ def main(argv=None) -> int:
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="force an N-device virtual CPU mesh")
+    ap.add_argument("--run-dir", default=None,
+                    help="also record results as a telemetry run "
+                         "(metrics.jsonl + summary.json with the "
+                         "per-collective bandwidth table; read with "
+                         "nezha-telemetry RUN_DIR)")
     args = ap.parse_args(argv)
     if args.cpu_devices:
         _force_cpu(args.cpu_devices)
-    for rec in run(args.sizes_mb, args.iters):
-        print(json.dumps(rec))
+    # After _force_cpu: importing nezha_tpu pulls in jax, which must not
+    # happen before the virtual-device flags are set.
+    from nezha_tpu import obs
+    if args.run_dir:
+        obs.start_run(args.run_dir, meta={"tool": "benchmarks/collectives",
+                                          "iters": args.iters})
+    try:
+        for i, rec in enumerate(run(args.sizes_mb, args.iters)):
+            obs.record_metrics(i, rec)  # no-op without --run-dir
+            print(json.dumps(rec))
+    finally:
+        if args.run_dir:
+            obs.end_run()
     return 0
 
 

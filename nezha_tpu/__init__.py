@@ -24,7 +24,7 @@ which were derived from BASELINE.json.
 __version__ = "0.1.0"
 
 from nezha_tpu import nn, ops, optim, parallel, models, data, train, graph, runtime
-from nezha_tpu import dist, utils
+from nezha_tpu import dist, obs, utils
 
 __all__ = [
     "nn",
@@ -37,6 +37,7 @@ __all__ = [
     "graph",
     "runtime",
     "dist",
+    "obs",
     "utils",
     "__version__",
 ]

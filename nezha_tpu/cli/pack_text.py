@@ -126,6 +126,19 @@ def run(args) -> dict:
         n = pack.pack_text_files_tokenized(paths, args.out, tok,
                                            dtype=dtype)
         kind = type(tok).__name__
+        # Meta sidecar (ADVICE r5): record which tokenizer packed this
+        # corpus so nezha-train can resolve the TRUE [MASK] id (a learned
+        # WordPiece vocab puts it at id 4, not the BERT convention's 103)
+        # without the user re-supplying the tokenizer path.
+        import json
+        mask_id = getattr(tok, "vocab", {}).get(
+            getattr(tok, "mask_token", "[MASK]")) \
+            if hasattr(tok, "vocab") else None
+        with open(args.out + ".meta.json", "w", encoding="utf-8") as f:
+            json.dump({"tokenizer_kind": kind,
+                       "tokenizer_dir": os.path.abspath(args.tokenizer),
+                       "vocab_size": tok.vocab_size,
+                       "mask_token_id": mask_id}, f)
     else:
         if not args.out.endswith(".u16"):
             raise SystemExit("--out must end in .u16 for byte-level "

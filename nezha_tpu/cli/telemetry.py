@@ -1,0 +1,75 @@
+"""``nezha-telemetry``: render the report for a ``--run-dir`` telemetry
+capture.
+
+    nezha-train --config mlp_mnist --steps 100 --run-dir /tmp/run
+    python -m nezha_tpu.cli.telemetry /tmp/run
+
+Reads the artifacts the run sink wrote (``metrics.jsonl``,
+``spans.jsonl``, ``summary.json`` — a crashed run may have only the
+streams) and prints step-rate percentiles, per-chip throughput, the
+per-collective payload/bandwidth table, compile-cache hit ratio, and the
+slowest spans. ``--json`` dumps the raw summary instead, for scripting.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="nezha-telemetry",
+        description="Render the telemetry report for a nezha-train "
+                    "--run-dir capture.")
+    p.add_argument("run_dir", help="run directory (holds metrics.jsonl / "
+                                   "spans.jsonl / summary.json)")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw summary.json (recomputed from the "
+                        "streams when the file is missing) instead of the "
+                        "rendered report")
+    p.add_argument("--check", action="store_true",
+                   help="also validate the artifacts against the frozen "
+                        "telemetry schema (exit 1 on drift)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if not os.path.isdir(args.run_dir):
+        print(f"no such run directory: {args.run_dir}", file=sys.stderr)
+        return 2
+    # Deferred so `--help` stays instant (repo convention for CLI entries).
+    from nezha_tpu.obs.report import load_run, render_report, summarize_streams
+
+    if args.json:
+        run = load_run(args.run_dir)
+        summary = run["summary"]
+        if summary is None:
+            summary = summarize_streams(run["metrics"], run["spans"])
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    else:
+        print(render_report(args.run_dir))
+    if args.check:
+        root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        sys.path.insert(0, os.path.join(root, "tools"))
+        try:
+            from check_telemetry_schema import check_run_dir
+        except ImportError:
+            print("schema checker (tools/check_telemetry_schema.py) not "
+                  "found; skipping --check", file=sys.stderr)
+            return 0
+        errors = check_run_dir(args.run_dir)
+        if errors:
+            for e in errors:
+                print(f"schema: {e}", file=sys.stderr)
+            return 1
+        print("schema: OK", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -344,16 +344,57 @@ def _data_source(args, cfg, batch_size: int, group=None):
     return (_slice_rows(it, rank, local) if world > 1 else it), None
 
 
+def _mask_token_from_corpus_sidecar(tok_path: str) -> Optional[int]:
+    """The packed corpus's OWN [MASK] id, when discoverable: the
+    ``<tokens>.meta.json`` sidecar nezha-pack-text writes (carries the
+    packing tokenizer's mask id), else a ``vocab.txt`` sitting next to the
+    tokens file (the `--save-tokenizer <data-dir>` layout). None when
+    neither exists."""
+    import os
+
+    meta_path = tok_path + ".meta.json"
+    if os.path.isfile(meta_path):
+        try:
+            with open(meta_path, encoding="utf-8") as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            meta = {}
+        if meta.get("mask_token_id") is not None:
+            return int(meta["mask_token_id"])
+    vocab_txt = os.path.join(os.path.dirname(os.path.abspath(tok_path)),
+                             "vocab.txt")
+    if os.path.isfile(vocab_txt):
+        with open(vocab_txt, encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                if line.rstrip("\n") == "[MASK]":
+                    return i
+    return None
+
+
 def _resolve_mlm_mask_token(args, mcfg, tok_path: str, sample_ids) -> int:
-    """MLM mask id for a packed-token file: the explicit flag, else the
-    BERT-wordpiece default 103 — refused when the corpus looks byte-packed
-    (every sampled id < 256), where 103 is a REAL byte value and genuine
-    0x67 tokens would be indistinguishable from [MASK] (ADVICE r4). ONE
-    resolution shared by the train and held-out-eval paths."""
+    """MLM mask id for a packed-token file: the explicit flag; else the
+    corpus's own tokenizer metadata (pack-text meta sidecar or an adjacent
+    vocab.txt — a --learn-wordpiece vocab puts [MASK] at id 4, where the
+    103 convention would silently collide with a real subword, ADVICE r5);
+    else the BERT-wordpiece default 103 — refused when the corpus looks
+    byte-packed (every sampled id < 256), where 103 is a REAL byte value
+    and genuine 0x67 tokens would be indistinguishable from [MASK]
+    (ADVICE r4). ONE resolution shared by the train and held-out-eval
+    paths."""
     import numpy as np
 
     if args.mlm_mask_token is not None:
         return args.mlm_mask_token
+    resolved = _mask_token_from_corpus_sidecar(tok_path)
+    if resolved is not None:
+        if resolved >= mcfg.vocab_size:
+            raise SystemExit(
+                f"{tok_path}: the corpus tokenizer's [MASK] id {resolved} "
+                f"is outside the model vocab ({mcfg.vocab_size}); the "
+                f"corpus and model vocabularies do not match")
+        print(f"mlm: [MASK] id {resolved} resolved from the corpus "
+              f"tokenizer metadata next to {tok_path}", file=sys.stderr)
+        return resolved
     mask_token = min(103, mcfg.vocab_size - 1)
     sample = np.asarray(sample_ids).ravel()
     if sample.size and int(sample.max()) < 256:
@@ -488,6 +529,44 @@ def _parse_profile_steps(spec: str):
 
 
 def run(args) -> Dict[str, float]:
+    """Argv-validated entry. With ``--run-dir`` the whole run executes
+    inside a telemetry run scope: the registry turns on, per-window
+    metrics/spans stream into the directory, and ``summary.json`` lands on
+    every exit path (success or raise) — `nezha-telemetry RUN_DIR` renders
+    the report."""
+    if args.trace_dir:
+        # --trace-dir is the observability-workflow spelling of
+        # --profile-dir (XProf/XLA trace window; see docs/RUNBOOK.md §7).
+        if args.profile_dir and args.profile_dir != args.trace_dir:
+            raise SystemExit("--trace-dir is an alias for --profile-dir; "
+                             "pass one of them")
+        args.profile_dir = args.trace_dir
+    if not args.run_dir:
+        return _run_traced(args)
+    import os
+
+    from nezha_tpu import obs
+    run_dir = args.run_dir
+    if args.coordinator:
+        # Multi-process launch: every process captures into its own
+        # subdirectory — the sink truncates its streams on open, so two
+        # ranks sharing one dir would destroy each other's capture. Rank
+        # is only assigned at the rendezvous (inside the run scope), so
+        # the pre-join identity is the rank hint, else the PID.
+        sub = (f"rank{args.rank_hint}" if args.rank_hint >= 0
+               else f"pid{os.getpid()}")
+        run_dir = os.path.join(run_dir, sub)
+    obs.start_run(run_dir, meta={
+        "config": args.config, "steps": args.steps,
+        "engine": args.engine, "parallel": args.parallel,
+        "model_preset": args.model_preset})
+    try:
+        return _run_traced(args)
+    finally:
+        obs.end_run()
+
+
+def _run_traced(args) -> Dict[str, float]:
     if args.ckpt_keep is not None and args.ckpt_keep <= 0:
         raise SystemExit(f"--ckpt-keep must be >= 1 (got {args.ckpt_keep}); "
                          f"omit it to keep all checkpoints")
@@ -1402,6 +1481,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "default keeps all")
     p.add_argument("--metrics-file", default=None,
                    help="append JSONL metrics here")
+    p.add_argument("--run-dir", default=None,
+                   help="telemetry run directory: stream metrics.jsonl + "
+                        "spans.jsonl and write a final summary.json "
+                        "(step-rate percentiles, per-collective payload "
+                        "bytes, compile-cache stats); read it back with "
+                        "nezha-telemetry RUN_DIR. With --coordinator each "
+                        "process captures into its own rank<K>/ (or "
+                        "pid<P>/) subdirectory")
+    p.add_argument("--trace-dir", default=None,
+                   help="XProf/XLA profiler trace directory (alias for "
+                        "--profile-dir; bound the window with "
+                        "--profile-steps)")
     p.add_argument("--data-dir", default=None,
                    help="directory with real datasets (train.nzr image "
                         "records / train.tokens.* / mnist IDX); synthetic "

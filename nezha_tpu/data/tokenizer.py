@@ -98,6 +98,17 @@ class GPT2BPETokenizer:
                     continue
                 a, _, b = line.partition(" ")
                 merges.append((a, b))
+        # Mismatched pair detection (ADVICE r5): a merge whose output is
+        # not a vocab entry means vocab.json and merges.txt come from
+        # different tokenizers — without this, encode() dies mid-corpus
+        # with a bare KeyError on the first affected word.
+        missing = [a + b for a, b in merges if a + b not in vocab]
+        if missing:
+            raise ValueError(
+                f"{merges_txt} does not match {vocab_json}: "
+                f"{len(missing)} merge output(s) missing from the vocab "
+                f"(first: {missing[0]!r}) — the two files must come from "
+                f"the same tokenizer")
         return cls(vocab, merges)
 
     @classmethod
@@ -201,7 +212,20 @@ class WordPieceTokenizer:
                 tok = line.rstrip("\n")
                 if tok:
                     vocab[tok] = i
-        return cls(vocab, lowercase=lowercase, **kw)
+        self = cls(vocab, lowercase=lowercase, **kw)
+        # Construction-time validation (ADVICE r5): a vocab without the
+        # BERT specials (e.g. a --learn-bpe vocab pointed at by a BERT
+        # flow) would otherwise surface as a bare KeyError mid-encode.
+        # [MASK] is checked lazily by mask_token_id — non-MLM flows don't
+        # need it.
+        missing = [t for t in (self.unk_token, self.cls_token,
+                               self.sep_token) if t not in vocab]
+        if missing:
+            raise ValueError(
+                f"{vocab_txt} is not a usable WordPiece vocab: missing "
+                f"special token(s) {missing} — is this really a BERT "
+                f"vocab.txt?")
+        return self
 
     @classmethod
     def from_dir(cls, path: str, **kw) -> "WordPieceTokenizer":
@@ -213,7 +237,14 @@ class WordPieceTokenizer:
 
     @property
     def mask_token_id(self) -> int:
-        return self.vocab[self.mask_token]
+        try:
+            return self.vocab[self.mask_token]
+        except KeyError:
+            raise ValueError(
+                f"this WordPiece vocab has no {self.mask_token!r} token, "
+                f"so it cannot drive MLM masking — re-learn/re-download a "
+                f"vocab with the BERT specials or pass an explicit mask "
+                f"id") from None
 
     # -- basic tokenization ------------------------------------------------
     def _basic(self, text: str) -> List[str]:

@@ -13,6 +13,7 @@ from __future__ import annotations
 import ctypes
 from typing import List, Optional
 
+from nezha_tpu import obs
 from nezha_tpu.runtime.native import load_library
 
 
@@ -62,6 +63,7 @@ class ProcessGroup:
         self._lib = lib
         self.rank = lib.nz_client_rank(handle)
         self.world_size = lib.nz_client_world(handle)
+        self._last_failed: List[int] = []  # dedup for failure-event spans
 
     def _round(self, tag: str) -> int:
         """This rank's collective round for ``tag``. KV keys are never
@@ -103,8 +105,9 @@ class ProcessGroup:
     # ----------------------------------------------------------- control
     def barrier(self, timeout_s: Optional[float] = None) -> None:
         timeout_ms = -1 if timeout_s is None else int(timeout_s * 1000)
-        if self._lib.nz_client_barrier(self._h, timeout_ms) != 0:
-            raise CoordinatorError(self._lib.nz_last_error().decode())
+        with obs.span("dist.barrier", rank=self.rank):
+            if self._lib.nz_client_barrier(self._h, timeout_ms) != 0:
+                raise CoordinatorError(self._lib.nz_last_error().decode())
 
     def broadcast(self, value: Optional[bytes], root: int = 0,
                   timeout_s: Optional[float] = None,
@@ -135,14 +138,24 @@ class ProcessGroup:
         n = self._lib.nz_client_failed(self._h, arr, cap)
         if n < 0:
             raise CoordinatorError(self._lib.nz_last_error().decode())
-        return sorted(arr[i] for i in range(min(n, cap)))
+        failed = sorted(arr[i] for i in range(min(n, cap)))
+        if obs.enabled() and failed != self._last_failed:
+            # Heartbeat-failure EVENT (zero-duration span), recorded once
+            # per transition — the poll itself runs every few steps.
+            self._last_failed = failed
+            if failed:
+                with obs.span("dist.failure", rank=self.rank,
+                              failed=failed):
+                    pass
+        return failed
 
     # ---------------------------------------------------------- lifecycle
     def leave(self) -> None:
         """Graceful departure — not counted as a failure."""
         if self._h:
-            self._lib.nz_client_leave(self._h)
-            self._lib.nz_client_close(self._h)
+            with obs.span("dist.leave", rank=self.rank):
+                self._lib.nz_client_leave(self._h)
+                self._lib.nz_client_close(self._h)
             self._h = None
 
     def close(self) -> None:
@@ -170,10 +183,13 @@ def join(host: str, port: int, rank_hint: int = -1,
     """Join the coordinator at host:port; returns a ProcessGroup with an
     assigned rank. Retries until the coordinator is up (launch skew)."""
     lib = load_library()
-    h = lib.nz_client_connect(
-        host.encode(), int(port), int(rank_hint), int(timeout_s * 1000),
-        int(heartbeat_interval_s * 1000))
-    if not h:
-        raise CoordinatorError(
-            lib.nz_last_error().decode() or "join failed")
-    return ProcessGroup(h, lib)
+    with obs.span("dist.join", host=host, port=port) as sp:
+        h = lib.nz_client_connect(
+            host.encode(), int(port), int(rank_hint), int(timeout_s * 1000),
+            int(heartbeat_interval_s * 1000))
+        if not h:
+            raise CoordinatorError(
+                lib.nz_last_error().decode() or "join failed")
+        group = ProcessGroup(h, lib)
+        sp.set(rank=group.rank, world=group.world_size)
+    return group

@@ -70,8 +70,11 @@ def _sample(logits, rng, temperature: float, top_k: Optional[int],
 def _prefill_fn(model: GPT2):
     @jax.jit
     def prefill(variables, prompt, cache):
+        # pos as a STATIC Python 0 (not jnp.int32(0), which traces to a
+        # Tracer under jit): Attention.apply's flash-prefill guard only
+        # fires when the cache position is statically known to be zero.
         logits, states = model.apply(variables, prompt, training=False,
-                                     cache=cache, pos=jnp.int32(0),
+                                     cache=cache, pos=0,
                                      prefill=True)
         return logits[:, -1, :], _caches_from_states(model, states, cache)
 

@@ -193,18 +193,33 @@ class Attention(Module):
                 (zero, zero, pos, zero))
             use_flash_prefill = False
             if prefill and s > 1:
-                # Prefill (STATIC hint from generate.py: pos is always 0
-                # there): nothing precedes the prompt, so attention is
-                # exactly causal flash over the chunk itself — no
-                # [B,H,S,L] score matrix against the padded cache. Same
-                # backend policy as the training path (shared helper).
-                impl = cfg.attn_impl
-                if impl == "auto":
-                    impl = _resolve_auto_impl(cfg)
-                # (flash_shmap applies to the training path; prefill runs
-                # outside the gspmd trace, where auto resolves to plain
-                # flash/xla.)
-                use_flash_prefill = impl == "flash"
+                # Prefill contract (ADVICE r5): ``prefill=True`` promises
+                # the chunk IS the whole cache prefix — flash attends
+                # within the chunk only, so a nonzero cache position would
+                # silently drop attention to the cached prefix. Honor it
+                # statically: only a pos known to be 0 at trace time (a
+                # Python/numpy int or a concrete array, as generate.py
+                # passes) takes the flash path; a traced or nonzero pos
+                # falls back to masked attention over the cache, which is
+                # correct at any position.
+                from jax.core import Tracer as _Tracer
+                try:
+                    pos_is_zero = (not isinstance(pos, _Tracer)
+                                   and int(pos) == 0)
+                except TypeError:  # non-scalar / unconvertible pos
+                    pos_is_zero = False
+                if pos_is_zero:
+                    # Nothing precedes the prompt, so attention is exactly
+                    # causal flash over the chunk itself — no [B,H,S,L]
+                    # score matrix against the padded cache. Same backend
+                    # policy as the training path (shared helper).
+                    impl = cfg.attn_impl
+                    if impl == "auto":
+                        impl = _resolve_auto_impl(cfg)
+                    # (flash_shmap applies to the training path; prefill
+                    # runs outside the gspmd trace, where auto resolves to
+                    # plain flash/xla.)
+                    use_flash_prefill = impl == "flash"
             if use_flash_prefill:
                 from nezha_tpu.ops.pallas import flash_attention
                 # Arbitrary prompt lengths: pad to a lane multiple so the

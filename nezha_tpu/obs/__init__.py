@@ -1,0 +1,58 @@
+"""Telemetry subsystem: process-wide registry + run-scoped sinks.
+
+The stack self-reports its metrics of record (PAPER.md §0: steps/sec,
+examples- and tokens-per-sec-per-chip, per-collective payload bytes and
+bus bandwidth, compile-cache behavior) instead of leaving them to ad-hoc
+computation in bench.py. Three pieces:
+
+- ``registry``: counters / gauges / histograms / wall-clock spans with
+  branch-only no-op fast paths while disabled (see registry.py docstring
+  for the exact contract).
+- ``sink``: ``start_run(run_dir)`` streams ``metrics.jsonl`` +
+  ``spans.jsonl`` and writes a final ``summary.json`` —
+  ``nezha-train --run-dir`` wires it up; ``nezha-telemetry <run-dir>``
+  renders the report (obs/report.py).
+- ``metrics`` / ``trace``: the JSONL logger, async-dispatch-aware
+  StepTimer, and jax.profiler wrappers absorbed from ``utils/metrics.py``
+  and ``utils/profiling.py`` (those modules remain as thin re-exports).
+"""
+
+from nezha_tpu.obs.metrics import MetricsLogger, StepTimer, read_metrics
+from nezha_tpu.obs.registry import (
+    NULL_SPAN,
+    Counter,
+    Gauge,
+    Histogram,
+    REGISTRY,
+    Registry,
+    Span,
+    counter,
+    disable,
+    enable,
+    enabled,
+    gauge,
+    histogram,
+    record_collective,
+    record_metrics,
+    span,
+)
+from nezha_tpu.obs.sink import (
+    METRICS_FILE,
+    SPANS_FILE,
+    SUMMARY_FILE,
+    RunSink,
+    current_sink,
+    end_run,
+    start_run,
+)
+from nezha_tpu.obs.trace import Tracer, annotate, profile_trace
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "Span", "REGISTRY",
+    "NULL_SPAN", "counter", "gauge", "histogram", "span", "enabled",
+    "enable", "disable", "record_metrics", "record_collective",
+    "RunSink", "start_run", "end_run", "current_sink",
+    "METRICS_FILE", "SPANS_FILE", "SUMMARY_FILE",
+    "MetricsLogger", "StepTimer", "read_metrics",
+    "Tracer", "annotate", "profile_trace",
+]

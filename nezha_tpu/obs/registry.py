@@ -1,0 +1,384 @@
+"""Process-wide telemetry registry: counters, gauges, histograms, spans.
+
+The observability layer the metrics of record hang off (PAPER.md §0:
+images/sec/chip, tokens/sec/chip, all-reduce bus bandwidth): call sites
+across train/, parallel/, runtime/, and dist/ stay permanently
+instrumented, and the whole layer collapses to near-zero cost when no run
+is active. The fast-path contract is explicit: with telemetry disabled,
+``counter().inc()`` / ``gauge().set()`` / ``histogram().observe()`` are a
+single attribute check and ``span()`` returns one shared no-op singleton —
+no per-call host allocation, no I/O (pinned by tests/test_obs.py).
+
+Instruments are process-wide and keyed by name (get-or-create), so
+independent subsystems accumulate into one snapshot without plumbing a
+registry handle through every constructor. A run-scoped sink
+(``obs.sink.start_run``) enables the registry and streams spans/metrics to
+a ``--run-dir``; ``snapshot()`` renders everything into the
+``summary.json`` schema (tools/check_telemetry_schema.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+# One mutable cell shared by every instrument: ``enabled`` is THE fast-path
+# check. Instruments cache a reference to this object, so toggling it flips
+# every existing counter/gauge/span site at once.
+
+
+class _State:
+    __slots__ = ("enabled",)
+
+    def __init__(self):
+        self.enabled = False
+
+
+_state = _State()
+
+
+def enabled() -> bool:
+    return _state.enabled
+
+
+def percentile_of(sorted_values: List[float], q: float) -> float:
+    """Index percentile over an ascending list (0.0 when empty) — the one
+    percentile convention every telemetry surface shares (Histogram
+    summaries, the report renderer, recomputed-stream summaries)."""
+    if not sorted_values:
+        return 0.0
+    idx = min(int(q / 100.0 * len(sorted_values)), len(sorted_values) - 1)
+    return sorted_values[idx]
+
+
+def values_summary(values: List[float]) -> dict:
+    """``Histogram.summary()``-shaped dict computed exactly from a full
+    list of values (the recomputed-from-stream path, where no reservoir
+    decimation is involved)."""
+    s = sorted(values)
+    total = sum(s)
+    return {"count": len(s), "sum": total,
+            "min": s[0] if s else 0.0, "max": s[-1] if s else 0.0,
+            "mean": total / len(s) if s else 0.0,
+            "p50": percentile_of(s, 50), "p90": percentile_of(s, 90),
+            "p99": percentile_of(s, 99)}
+
+
+class Counter:
+    """Monotonic counter. ``inc`` is a no-op while telemetry is disabled."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        if _state.enabled:
+            self.value += n
+
+
+class Gauge:
+    """Last-value-wins instrument (queue depths, cache sizes)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0.0
+
+    def set(self, v) -> None:
+        if _state.enabled:
+            self.value = float(v)
+
+
+class Histogram:
+    """Value distribution with streaming min/max/sum and a bounded sample
+    reservoir for percentiles (run-scale cardinality: decimate by 2 when
+    the reservoir fills, keeping a uniform stride over the stream)."""
+
+    __slots__ = ("name", "count", "total", "min", "max", "_samples",
+                 "_stride", "_skip", "_cap", "_lock")
+
+    def __init__(self, name: str, cap: int = 4096):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self._samples: List[float] = []
+        self._stride = 1   # keep every _stride'th observation
+        self._skip = 0
+        self._cap = cap
+        # Per-instrument lock: observe() is a multi-field read-modify-write
+        # (count/total/reservoir decimation) that concurrent recorders
+        # (e.g. two Executor threads timing compiles) would corrupt.
+        self._lock = threading.Lock()
+
+    def observe(self, v) -> None:
+        if not _state.enabled:
+            return
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.total += v
+            if self.min is None or v < self.min:
+                self.min = v
+            if self.max is None or v > self.max:
+                self.max = v
+            self._skip += 1
+            if self._skip >= self._stride:
+                self._skip = 0
+                self._samples.append(v)
+                if len(self._samples) >= self._cap:
+                    self._samples = self._samples[::2]
+                    self._stride *= 2
+
+    def percentile(self, q: float) -> Optional[float]:
+        with self._lock:
+            s = sorted(self._samples)
+        return percentile_of(s, q) if s else None
+
+    def summary(self) -> dict:
+        # count/sum/min/max are exact streaming stats; only the percentiles
+        # come from the (possibly decimated) reservoir.
+        with self._lock:
+            count, total = self.count, self.total
+            mn, mx = self.min, self.max
+            s = sorted(self._samples)
+        return {
+            "count": count,
+            "sum": total,
+            "min": mn if mn is not None else 0.0,
+            "max": mx if mx is not None else 0.0,
+            "mean": total / count if count else 0.0,
+            "p50": percentile_of(s, 50),
+            "p90": percentile_of(s, 90),
+            "p99": percentile_of(s, 99),
+        }
+
+
+class _NullSpan:
+    """The disabled-mode span: one shared instance, every method a no-op —
+    ``with obs.span("x"):`` costs a dict-free call and two no-op methods."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def set(self, **attrs) -> "_NullSpan":
+        return self
+
+
+NULL_SPAN = _NullSpan()
+
+# Bookkeeping fields whose distributions mean nothing (the step counter,
+# the logger's wall-clock stamp): streamed to metrics.jsonl as-is but never
+# folded into metric.<key> histograms. Shared with the recomputed-stream
+# path (obs/report.py summarize_streams).
+UNFOLDED_METRIC_KEYS = frozenset({"step", "ts"})
+
+
+class Span:
+    """Live wall-clock span; records itself into the registry on exit."""
+
+    __slots__ = ("name", "attrs", "t0", "t1", "_registry")
+
+    def __init__(self, name: str, registry: "Registry", attrs: dict):
+        self.name = name
+        self.attrs = attrs
+        self.t0 = time.time()
+        self.t1: Optional[float] = None
+        self._registry = registry
+
+    def set(self, **attrs) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, *exc) -> bool:
+        self.t1 = time.time()
+        if exc_type is not None:
+            self.attrs["error"] = exc_type.__name__
+        self._registry.record_span(self.to_record())
+        return False
+
+    def to_record(self) -> dict:
+        t1 = self.t1 if self.t1 is not None else time.time()
+        return {"name": self.name, "t0": self.t0, "t1": t1,
+                "dur_s": t1 - self.t0, "attrs": self.attrs}
+
+
+class Registry:
+    """Named-instrument store + bounded span log. Thread-safe for
+    get-or-create (instrument mutation itself is GIL-atomic enough for
+    counters/gauges; histograms carry their own lock, spans take the
+    registry's)."""
+
+    def __init__(self, max_spans: int = 10000):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self.spans: List[dict] = []
+        self._max_spans = max_spans
+        self._sink = None  # RunSink streaming spans/metrics, when attached
+
+    # -------------------------------------------------- instrument access
+    def counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(name, Counter(name))
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            with self._lock:
+                g = self._gauges.setdefault(name, Gauge(name))
+        return g
+
+    def histogram(self, name: str) -> Histogram:
+        h = self._histograms.get(name)
+        if h is None:
+            with self._lock:
+                h = self._histograms.setdefault(name, Histogram(name))
+        return h
+
+    def span(self, name: str, **attrs):
+        if not _state.enabled:
+            return NULL_SPAN
+        return Span(name, self, attrs)
+
+    def record_span(self, rec: dict) -> None:
+        if not _state.enabled:
+            return
+        with self._lock:
+            if len(self.spans) < self._max_spans:
+                self.spans.append(rec)
+            sink = self._sink
+        if sink is not None:
+            sink.write_span(rec)
+
+    # --------------------------------------------------------- aggregates
+    def record_metrics(self, step: int, metrics: Dict[str, Any]) -> None:
+        """Route a per-step metrics dict to the attached sink and fold
+        every numeric value into a ``metric.<key>`` histogram, so the
+        summary carries percentiles (step-rate p50/p90/...) for free."""
+        if not _state.enabled:
+            return
+        for k, v in metrics.items():
+            if (k not in UNFOLDED_METRIC_KEYS
+                    and isinstance(v, (int, float))
+                    and not isinstance(v, bool)):
+                self.histogram(f"metric.{k}").observe(v)
+        sink = self._sink
+        if sink is not None:
+            sink.write_metrics(step, metrics)
+
+    def record_collective(self, op: str, payload_bytes: int,
+                          seconds: Optional[float] = None,
+                          bus_bytes: Optional[float] = None) -> None:
+        """Per-collective accounting (EQuARX's first-class metric): call
+        count + payload bytes always; achieved bus bandwidth when the
+        caller timed the op (benchmarks). Trace-time call sites (the
+        collectives emitted inside jit) count bytes per traced program —
+        the payload a compiled step moves per execution."""
+        if not _state.enabled:
+            return
+        self.counter(f"collective.{op}.calls").inc()
+        self.counter(f"collective.{op}.payload_bytes").inc(
+            int(payload_bytes))
+        if seconds is not None and seconds > 0 and bus_bytes is not None:
+            self.histogram(f"collective.{op}.bus_gbps").observe(
+                bus_bytes / seconds / 1e9)
+
+    def snapshot(self) -> dict:
+        """Everything, in the frozen summary.json shape (schema v1)."""
+        with self._lock:
+            counters = {k: c.value for k, c in self._counters.items()}
+            gauges = {k: g.value for k, g in self._gauges.items()}
+            hists = {k: h.summary() for k, h in self._histograms.items()}
+            spans = list(self.spans)
+        collectives: Dict[str, dict] = {}
+        for name, value in counters.items():
+            if not name.startswith("collective."):
+                continue
+            _, op, field = name.split(".", 2)
+            collectives.setdefault(op, {})[field] = value
+        for name, h in hists.items():
+            if name.startswith("collective.") and name.endswith(".bus_gbps"):
+                op = name.split(".", 2)[1]
+                collectives.setdefault(op, {})["bus_gbps"] = h
+        slowest = sorted(spans, key=lambda s: -s["dur_s"])[:10]
+        return {
+            "schema_version": 1,
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": hists,
+            "collectives": collectives,
+            "compile_cache": {
+                "hits": counters.get("compile_cache.hits", 0),
+                "misses": counters.get("compile_cache.misses", 0),
+                "compile_seconds": hists.get(
+                    "compile_cache.compile_seconds",
+                    {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
+                     "mean": 0.0, "p50": 0.0, "p90": 0.0, "p99": 0.0}),
+            },
+            "num_spans": len(spans),
+            "slowest_spans": slowest,
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+            self.spans.clear()
+
+
+# The process-wide default registry and its module-level shorthands: the
+# form instrumented call sites use (``obs.counter("x").inc()``).
+REGISTRY = Registry()
+
+
+def counter(name: str) -> Counter:
+    return REGISTRY.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return REGISTRY.gauge(name)
+
+
+def histogram(name: str) -> Histogram:
+    return REGISTRY.histogram(name)
+
+
+def span(name: str, **attrs):
+    return REGISTRY.span(name, **attrs)
+
+
+def record_metrics(step: int, metrics: Dict[str, Any]) -> None:
+    REGISTRY.record_metrics(step, metrics)
+
+
+def record_collective(op: str, payload_bytes: int,
+                      seconds: Optional[float] = None,
+                      bus_bytes: Optional[float] = None) -> None:
+    REGISTRY.record_collective(op, payload_bytes, seconds, bus_bytes)
+
+
+def enable() -> None:
+    _state.enabled = True
+
+
+def disable() -> None:
+    _state.enabled = False

@@ -1,0 +1,161 @@
+"""Run-report rendering for ``nezha-telemetry``.
+
+Reads the three run-dir artifacts the sink writes (metrics.jsonl,
+spans.jsonl, summary.json — any subset may be missing for a crashed run)
+and renders the operator's first-read view: step-rate percentiles,
+per-chip throughput, the per-collective payload/bandwidth table, compile-
+cache behavior, and the slowest spans. Pure stdlib + the JSONL reader, so
+the report works on any machine the run dir is copied to.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from nezha_tpu.obs.metrics import read_metrics
+from nezha_tpu.obs.registry import (UNFOLDED_METRIC_KEYS, percentile_of,
+                                    values_summary)
+from nezha_tpu.obs.sink import METRICS_FILE, SPANS_FILE, SUMMARY_FILE
+
+
+def load_run(run_dir: str) -> dict:
+    """-> {"metrics": [...], "spans": [...], "summary": dict|None}."""
+    out: Dict[str, Any] = {"metrics": [], "spans": [], "summary": None}
+    mpath = os.path.join(run_dir, METRICS_FILE)
+    if os.path.isfile(mpath):
+        out["metrics"] = read_metrics(mpath)
+    spath = os.path.join(run_dir, SPANS_FILE)
+    if os.path.isfile(spath):
+        out["spans"] = read_metrics(spath)  # same JSONL shape
+    jpath = os.path.join(run_dir, SUMMARY_FILE)
+    if os.path.isfile(jpath):
+        with open(jpath) as f:
+            out["summary"] = json.load(f)
+    return out
+
+
+def summarize_streams(metrics: List[dict], spans: List[dict]) -> dict:
+    """Best-effort summary for a run that died before ``end_run()`` wrote
+    summary.json: numeric metric histograms and span aggregates recomputed
+    from the JSONL streams. Counter-backed sections (collectives, compile
+    cache) lived only in the process registry and cannot be recovered, so
+    they are absent; ``recomputed`` marks the dict as this partial form."""
+    series: Dict[str, List[float]] = {}
+    for m in metrics:
+        for k, v in m.items():
+            if (k not in UNFOLDED_METRIC_KEYS
+                    and isinstance(v, (int, float))
+                    and not isinstance(v, bool)):
+                series.setdefault(f"metric.{k}", []).append(float(v))
+
+    slowest = sorted(spans, key=lambda sp: -sp.get("dur_s", 0.0))[:10]
+    return {"schema_version": 1, "recomputed": True,
+            "histograms": {k: values_summary(v)
+                           for k, v in series.items()},
+            "num_spans": len(spans), "slowest_spans": slowest}
+
+
+def _percentiles(values: List[float]) -> Optional[dict]:
+    if not values:
+        return None
+    s = sorted(values)
+    return {"n": len(s), "mean": sum(s) / len(s), "min": s[0],
+            "p10": percentile_of(s, 10), "p50": percentile_of(s, 50),
+            "p90": percentile_of(s, 90), "max": s[-1]}
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} TiB"
+
+
+def render_report(run_dir: str) -> str:
+    """The full plain-text report for a run directory."""
+    run = load_run(run_dir)
+    metrics, spans, summary = run["metrics"], run["spans"], run["summary"]
+    lines: List[str] = [f"telemetry report: {os.path.abspath(run_dir)}"]
+
+    if summary and "run" in summary:
+        meta = summary["run"]
+        parts = [f"{k}={meta[k]}" for k in sorted(meta)
+                 if k not in ("run_dir", "started_at")]
+        if parts:
+            lines.append("run: " + " ".join(parts))
+    if not (metrics or spans or summary):
+        lines.append("(no telemetry artifacts found — was the run started "
+                     "with --run-dir?)")
+        return "\n".join(lines)
+
+    # ------------------------------------------------------- step rates
+    rates = [m["steps_per_sec"] for m in metrics
+             if isinstance(m.get("steps_per_sec"), (int, float))]
+    p = _percentiles(rates)
+    lines.append("")
+    if p is not None:
+        lines.append(f"step rate (steps/sec over {p['n']} windows): "
+                     f"mean {p['mean']:.3f}  p10 {p['p10']:.3f}  "
+                     f"p50 {p['p50']:.3f}  p90 {p['p90']:.3f}")
+    else:
+        lines.append("step rate: no steps_per_sec records")
+    for key in ("examples_per_sec_per_chip", "tokens_per_sec_per_chip"):
+        vals = [m[key] for m in metrics
+                if isinstance(m.get(key), (int, float))]
+        pk = _percentiles(vals)
+        if pk is not None:
+            lines.append(f"{key}: mean {pk['mean']:.1f}  "
+                         f"p50 {pk['p50']:.1f}  p90 {pk['p90']:.1f}")
+    losses = [m["loss"] for m in metrics
+              if isinstance(m.get("loss"), (int, float))]
+    if losses:
+        lines.append(f"loss: first {losses[0]:.4f} -> last {losses[-1]:.4f} "
+                     f"({len(losses)} records)")
+
+    # ------------------------------------------------------ collectives
+    coll = (summary or {}).get("collectives", {})
+    lines.append("")
+    if coll:
+        lines.append("collectives:")
+        lines.append(f"  {'op':<22}{'calls':>8}{'payload':>12}"
+                     f"{'bus GB/s (p50)':>16}")
+        for op in sorted(coll):
+            row = coll[op]
+            bw = row.get("bus_gbps")
+            bw_s = f"{bw['p50']:.2f}" if isinstance(bw, dict) else "-"
+            lines.append(f"  {op:<22}{row.get('calls', 0):>8}"
+                         f"{_fmt_bytes(row.get('payload_bytes', 0)):>12}"
+                         f"{bw_s:>16}")
+    else:
+        lines.append("collectives: none recorded")
+
+    # ---------------------------------------------------- compile cache
+    cc = (summary or {}).get("compile_cache")
+    if cc is not None:
+        hits, misses = cc.get("hits", 0), cc.get("misses", 0)
+        total = hits + misses
+        ratio = f"{hits / total:.1%}" if total else "n/a"
+        secs = cc.get("compile_seconds", {})
+        lines.append(f"compile cache: {hits} hits / {misses} misses "
+                     f"(hit ratio {ratio}; "
+                     f"{secs.get('sum', 0.0):.2f}s compiling)")
+
+    # ------------------------------------------------------------ spans
+    slowest = (summary or {}).get("slowest_spans")
+    if slowest is None:
+        slowest = sorted(spans, key=lambda s: -s.get("dur_s", 0.0))[:10]
+    lines.append("")
+    if slowest:
+        lines.append("slowest spans:")
+        for s in slowest[:10]:
+            attrs = s.get("attrs") or {}
+            a = (" " + " ".join(f"{k}={v}" for k, v in sorted(
+                attrs.items()))) if attrs else ""
+            lines.append(f"  {s.get('dur_s', 0.0):>9.4f}s  "
+                         f"{s.get('name', '?')}{a}")
+    else:
+        lines.append("spans: none recorded")
+    return "\n".join(lines)

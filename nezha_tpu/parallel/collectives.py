@@ -5,6 +5,16 @@ Each wrapper emits the XLA collective HLO; XLA's collective scheduler picks
 the ring/tree algorithm and overlaps it with compute — nothing is
 hand-scheduled. Bus-bandwidth accounting helpers mirror the reference's
 "all-reduce bus bw" metric of record (BASELINE.json `metric`).
+
+Telemetry: every wrapper (and the dp/zero1 train-step collectives) reports
+its op + payload bytes to the process-wide registry — the wrappers via
+:func:`record_traced_collective`, the int8-wire train-step paths directly
+at their actual wire width (int8 + scales; see parallel/quantized.py
+``wire_payload_bytes``). Shapes are static under tracing, so the
+recording happens at TRACE time — the counters measure the bytes one
+execution of each compiled program moves, not bytes x steps (the run
+report states the convention). Zero cost while telemetry is disabled: the
+guard is one flag check before any tree traversal.
 """
 
 from __future__ import annotations
@@ -15,23 +25,41 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from nezha_tpu import obs
+
+
+def record_traced_collective(op: str, tree: Any) -> None:
+    """Account a collective emitted during tracing: per-device payload
+    bytes of ``tree`` (leaf shapes are static on tracers). No-op when
+    telemetry is disabled."""
+    if not obs.enabled():
+        return
+    payload = sum(x.size * x.dtype.itemsize
+                  for x in jax.tree_util.tree_leaves(tree)
+                  if hasattr(x, "size") and hasattr(x, "dtype"))
+    obs.record_collective(op, payload)
+
 
 def all_reduce_sum(tree: Any, axis_name: str) -> Any:
+    record_traced_collective("all_reduce", tree)
     return jax.tree_util.tree_map(lambda x: lax.psum(x, axis_name), tree)
 
 
 def all_reduce_mean(tree: Any, axis_name: str) -> Any:
+    record_traced_collective("all_reduce", tree)
     return jax.tree_util.tree_map(lambda x: lax.pmean(x, axis_name), tree)
 
 
 def all_gather(tree: Any, axis_name: str, axis: int = 0, tiled: bool = True) -> Any:
     """Gather shards along ``axis`` from every rank (concatenated if tiled)."""
+    record_traced_collective("all_gather", tree)
     return jax.tree_util.tree_map(
         lambda x: lax.all_gather(x, axis_name, axis=axis, tiled=tiled), tree)
 
 
 def reduce_scatter(tree: Any, axis_name: str, axis: int = 0) -> Any:
     """Sum-reduce then scatter shards along ``axis`` (ZeRO-1 gradient path)."""
+    record_traced_collective("reduce_scatter", tree)
     return jax.tree_util.tree_map(
         lambda x: lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True),
         tree)
@@ -39,6 +67,7 @@ def reduce_scatter(tree: Any, axis_name: str, axis: int = 0) -> Any:
 
 def ring_permute(x, axis_name: str, shift: int = 1):
     """Send to the next rank on the ring (ring attention / pipeline edges)."""
+    record_traced_collective("ppermute", x)
     n = lax.axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm)

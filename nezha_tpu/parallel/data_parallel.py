@@ -88,10 +88,27 @@ def make_dp_train_step(model: Module, optimizer: Optimizer,
 
         # The DP collective: mean over the dp axis (reference: NCCL ring
         # all-reduce). XLA overlaps this with the tail of backward.
+        from nezha_tpu.parallel.collectives import record_traced_collective
         if grad_reduce == "int8":
-            from nezha_tpu.parallel.quantized import quantized_all_reduce_mean
+            from nezha_tpu import obs
+            from nezha_tpu.parallel.quantized import (
+                DEFAULT_MIN_NUMEL, quantized_all_reduce_mean,
+                split_quantized_leaves, wire_payload_bytes)
+            if obs.enabled():
+                # Payload at actual wire width: quantized leaves count
+                # int8+scale bytes, sub-cutoff leaves the exact pmean width.
+                quant, exact = split_quantized_leaves(grads, DEFAULT_MIN_NUMEL)
+                if quant:
+                    obs.record_collective(
+                        "all_reduce_int8",
+                        sum(wire_payload_bytes(g.size) for g in quant))
+                if exact:
+                    obs.record_collective(
+                        "all_reduce",
+                        sum(g.size * g.dtype.itemsize for g in exact))
             grads = quantized_all_reduce_mean(grads, axis)
         else:
+            record_traced_collective("all_reduce", grads)
             grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, axis), grads)
         loss = lax.pmean(loss, axis)
         new_state = jax.tree_util.tree_map(lambda s: lax.pmean(s, axis), new_state)

@@ -38,6 +38,10 @@ from jax import lax
 
 _QMAX = 127.0
 
+# Leaves below this ride the exact path (EQuARX-style size cutoff); shared
+# default for quantized_all_reduce_mean and its telemetry accounting.
+DEFAULT_MIN_NUMEL = 4096
+
 
 def _quantize_blocks(x: jax.Array, block: int):
     """Symmetric per-block int8 quantization of ``x`` [..., k*block] ->
@@ -72,6 +76,17 @@ def should_quantize(leaf: jax.Array, min_numel: int) -> bool:
                 and leaf.size >= min_numel)
 
 
+def split_quantized_leaves(tree: Any, min_numel: int):
+    """Partition ``tree``'s leaves by the wire cutoff: ``(quantized,
+    exact)`` — the one classification the dp/zero1 collectives AND their
+    telemetry accounting share, so payload tables can never disagree with
+    what actually rides the int8 wire."""
+    quant, exact = [], []
+    for leaf in jax.tree_util.tree_leaves(tree):
+        (quant if should_quantize(leaf, min_numel) else exact).append(leaf)
+    return quant, exact
+
+
 def _qar_mean(x: jax.Array, axis_name: str, block: int) -> jax.Array:
     """int8-wire all-reduce-mean of one array (inside shard_map): the ring
     decomposition reduce_scatter + all_gather, each phase quantized."""
@@ -85,7 +100,7 @@ def _qar_mean(x: jax.Array, axis_name: str, block: int) -> jax.Array:
 
 
 def quantized_all_reduce_mean(tree: Any, axis_name: str, block: int = 512,
-                              min_numel: int = 4096) -> Any:
+                              min_numel: int = DEFAULT_MIN_NUMEL) -> Any:
     """Tree-wide gradient mean over ``axis_name`` with int8 payloads for
     every float leaf of at least ``min_numel`` elements; small or integer
     leaves take the exact ``pmean`` path."""
@@ -126,6 +141,15 @@ def quantized_all_gather(chunk_arr: jax.Array, axis_name: str,
     qg = lax.all_gather(q, axis_name, axis=0, tiled=True)
     sg = lax.all_gather(s, axis_name, axis=0, tiled=True)
     return _dequantize(qg, sg).reshape(n, -1)[:, :chunk].reshape(-1)
+
+
+def wire_payload_bytes(numel: int, block: int = 512) -> int:
+    """Bytes of the int8 wire form of ``numel`` fp32 elements for ONE
+    quantized phase: block-padded int8 data plus one fp32 scale per block
+    — the telemetry payload accounting (vs 4 bytes/element exact). See
+    ``quantized_wire_bytes`` for the full two-phase ring-bus total."""
+    padded = -(-numel // block) * block
+    return padded + (padded // block) * 4
 
 
 def quantized_wire_bytes(numel: int, block: int = 512, world: int = 8) -> int:

@@ -110,7 +110,31 @@ def make_zero1_train_step(model: Module, optimizer: Optimizer,
         # Both wire phases gate on the same shared predicate over the SAME
         # leaf size (params and their grads are shaped alike), so a leaf is
         # either quantized in both phases or neither.
+        from nezha_tpu import obs
         from nezha_tpu.parallel.quantized import should_quantize
+
+        if obs.enabled():
+            # One record per op per traced program (the dp/wrapper
+            # convention — not per leaf), at actual wire width: int8 leaves
+            # count int8+scale bytes, exact leaves fp32. Chunk sizes mirror
+            # _flat_pad (world-padded) and the block padding inside the
+            # quantized collectives.
+            from nezha_tpu.parallel.quantized import (split_quantized_leaves,
+                                                      wire_payload_bytes)
+            quant, exact = (split_quantized_leaves(grads, quant_min_numel)
+                            if grad_reduce == "int8"
+                            else ([], jax.tree_util.tree_leaves(grads)))
+            chunks_q = [-(-g.size // world) for g in quant]
+            chunks_e = [-(-g.size // world) for g in exact]
+            for op, payload in (
+                    ("reduce_scatter", sum(c * world * 4 for c in chunks_e)),
+                    ("reduce_scatter_int8",
+                     sum(world * wire_payload_bytes(c) for c in chunks_q)),
+                    ("all_gather", sum(c * 4 for c in chunks_e)),
+                    ("all_gather_int8",
+                     sum(wire_payload_bytes(c) for c in chunks_q))):
+                if payload:
+                    obs.record_collective(op, payload)
 
         def to_chunk(g):
             flat = _flat_pad(g.astype(jnp.float32), world)

@@ -10,10 +10,12 @@ capture + executable cache).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, Hashable, Optional, Tuple
 
 import jax
 
+from nezha_tpu import obs
 from nezha_tpu.graph.graph import Graph
 from nezha_tpu.graph.lower import to_callable
 
@@ -56,7 +58,11 @@ def _signature(args: Tuple, kwargs: Dict) -> Hashable:
 
 
 class CompileCache:
-    """Thread-safe (signature -> compiled executable) cache with stats."""
+    """Thread-safe (signature -> compiled executable) cache with stats.
+
+    Hit/miss/build-time telemetry flows to the process-wide registry
+    (``compile_cache.*`` — the GC3-motivated compiler-cache view in a
+    ``--run-dir`` summary) alongside the local attributes."""
 
     def __init__(self):
         self._cache: Dict[Hashable, Any] = {}
@@ -65,15 +71,24 @@ class CompileCache:
         self.misses = 0
 
     def get_or_build(self, key: Hashable, build: Callable[[], Any]) -> Any:
+        value, _ = self.get_or_build2(key, build)
+        return value
+
+    def get_or_build2(self, key: Hashable,
+                      build: Callable[[], Any]) -> "Tuple[Any, bool]":
+        """-> ``(value, built)`` where ``built`` says whether THIS call
+        populated the entry — a per-call miss signal (the shared ``misses``
+        counter can move concurrently under other keys)."""
         with self._lock:
             if key in self._cache:
                 self.hits += 1
-                return self._cache[key]
+                obs.counter("compile_cache.hits").inc()
+                return self._cache[key], False
         built = build()  # compile outside the lock; dup compiles are benign
         with self._lock:
-            self._cache.setdefault(key, built)
             self.misses += 1
-            return self._cache[key]
+            obs.counter("compile_cache.misses").inc()
+            return self._cache.setdefault(key, built), True
 
     def __len__(self):
         return len(self._cache)
@@ -101,8 +116,17 @@ class Executor:
             # entry keeps it alive so ids can't be recycled.
             base_key = ("fn", fn_or_graph)
         key = (base_key, _signature(args, kwargs))
-        jitted = self.cache.get_or_build(
+        jitted, built = self.cache.get_or_build2(
             key, lambda: jax.jit(fn, donate_argnums=self.donate_argnums))
+        if built and obs.enabled():
+            # jax.jit is lazy — the FIRST dispatch pays trace+compile, so
+            # that call is the executable's compile-time record.
+            with obs.span("executor.compile", kind=base_key[0]):
+                t0 = time.perf_counter()
+                out = jitted(*args, **kwargs)
+                obs.histogram("compile_cache.compile_seconds").observe(
+                    time.perf_counter() - t0)
+            return out
         return jitted(*args, **kwargs)
 
     def stats(self) -> dict:

@@ -18,9 +18,17 @@ from typing import Any, Iterator, Optional
 
 import jax
 
+from nezha_tpu import obs
+
 
 class Prefetcher:
-    """Bounded-depth background prefetcher; iterate to get device batches."""
+    """Bounded-depth background prefetcher; iterate to get device batches.
+
+    Telemetry (when a run is active): a ``prefetch.queue_depth`` gauge
+    sampled at every consumer read, and a ``prefetch.stalls`` counter with
+    ``prefetch.stall_seconds`` for reads that found the queue empty — the
+    input-bound signal (a healthy pipeline keeps depth > 0, so the device
+    never waits on the host)."""
 
     _DONE = object()
 
@@ -74,7 +82,23 @@ class Prefetcher:
 
     def __next__(self):
         while True:
-            item = self._q.get()
+            if obs.enabled():
+                # Guarded so the disabled path stays exactly `q.get()`.
+                obs.gauge("prefetch.queue_depth").set(self._q.qsize())
+                if self._q.empty():
+                    t0 = time.perf_counter()
+                    item = self._q.get()
+                    # A wait that yields a worker-exit sentinel is shutdown
+                    # bookkeeping, not host-input starvation — don't let
+                    # end-of-stream drains read as an input-bound signal.
+                    if item is not self._DONE:
+                        obs.counter("prefetch.stalls").inc()
+                        obs.histogram("prefetch.stall_seconds").observe(
+                            time.perf_counter() - t0)
+                else:
+                    item = self._q.get()
+            else:
+                item = self._q.get()
             if item is self._DONE:
                 self._done_seen += 1
                 if self._done_seen >= len(self._threads):

@@ -16,7 +16,9 @@ from typing import Any, Callable, Dict, Iterator, Optional
 import jax
 import jax.numpy as jnp
 
+from nezha_tpu import obs
 from nezha_tpu.nn.module import Module, Variables
+from nezha_tpu.obs.metrics import StepTimer
 from nezha_tpu.optim.optimizers import Optimizer, apply_updates
 
 TrainState = Dict[str, Any]  # {"variables": Variables, "opt_state": Any, "rng": key}
@@ -102,7 +104,8 @@ class Trainer:
                  save_fn: Optional[Callable[[str, Any, int], Any]] = None,
                  save_wait: Optional[Callable[[], None]] = None,
                  checkpoint_keep: Optional[int] = None,
-                 examples_per_step: int = 0):
+                 examples_per_step: int = 0,
+                 tokens_per_step: int = 0):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -161,10 +164,21 @@ class Trainer:
         # Custom save_fns handle their own pruning (the CLI wraps them).
         self.checkpoint_keep = checkpoint_keep
         self.examples_per_step = examples_per_step
+        # Tokens consumed per optimizer step (LM configs: batch x seq) —
+        # feeds the tokens/sec-per-chip metric of record (PAPER.md §0).
+        self.tokens_per_step = tokens_per_step
+        # Rate windows close on the loop's own log boundaries (a resume
+        # can land mid-window), so the timer runs in explicit-lap mode.
+        self._timer = StepTimer(window=max(log_every, 1))
+        self._first_step = True  # next dispatch pays trace+compile
         self.state: Optional[TrainState] = None
         self.global_step = 0
 
     def _save(self, step: int) -> None:
+        with obs.span("checkpoint.save", step=step):
+            self._save_checkpoint(step)
+
+    def _save_checkpoint(self, step: int) -> None:
         if self._save_fn is not None:
             if self.checkpoint_keep:
                 # Every built-in save_fn (save_checkpoint, save_sharded,
@@ -230,14 +244,30 @@ class Trainer:
         if self.state is None:
             self.initialize()
         last_metrics: Dict[str, float] = {}
-        t0 = time.perf_counter()
+        n_chips = max(jax.device_count(), 1)
+        self._timer.start()
         window_steps = 0  # actual steps this logging window (a resume can
         # land mid-window, so log_every would overstate the first rate)
         for _ in range(steps):
             batch = next(batches)
             if self.shard_fn is not None:
                 batch = self.shard_fn(batch)
-            self.state, metrics = self.step_fn(self.state, batch)
+            if self._first_step:
+                # The first dispatch carries trace+compile; as a span it
+                # is the run's compile-time record (jit compiles
+                # synchronously, so the call returns after the build).
+                self._first_step = False
+                if (not self.tokens_per_step and isinstance(batch, dict)
+                        and hasattr(batch.get("tokens"), "size")):
+                    # LM batches: global tokens consumed per step, for the
+                    # tokens/sec-per-chip metric (shape is static, so one
+                    # read here covers the run).
+                    self.tokens_per_step = int(batch["tokens"].size)
+                with obs.span("train.first_step",
+                              step=self.global_step + 1):
+                    self.state, metrics = self.step_fn(self.state, batch)
+            else:
+                self.state, metrics = self.step_fn(self.state, batch)
             self.global_step += 1
             window_steps += 1
             if self.tracer is not None:
@@ -251,9 +281,10 @@ class Trainer:
                         if self._save_wait is not None:
                             self._save_wait()  # commit before raising
                     if self.failure_mode == "rejoin":  # ckpt_dir guaranteed
-                        self._rejoin_and_reload(failed)
+                        with obs.span("train.rejoin", failed=failed):
+                            self._rejoin_and_reload(failed)
                         # Rate windows must not count the heal wait.
-                        t0 = time.perf_counter()
+                        self._timer.start()
                         window_steps = 0
                         continue
                     if self.on_failure is not None:
@@ -263,14 +294,28 @@ class Trainer:
                             f"peer rank(s) {failed} failed at step "
                             f"{self.global_step}")
             if self.log_every and self.global_step % self.log_every == 0:
+                # The float() fetches are the window's device barrier (the
+                # StepTimer contract): every dispatched step has finished
+                # before the lap closes.
                 last_metrics = {k: float(v) for k, v in metrics.items()}
-                dt = max(time.perf_counter() - t0, 1e-9)
-                last_metrics["steps_per_sec"] = window_steps / dt
+                rate = self._timer.lap(last_metrics.get("loss", 0.0),
+                                       window_steps)
+                last_metrics["steps_per_sec"] = rate if rate is not None \
+                    else 0.0
                 if self.examples_per_step:
-                    last_metrics["examples_per_sec"] = (
-                        window_steps * self.examples_per_step / dt)
+                    eps = last_metrics["steps_per_sec"] \
+                        * self.examples_per_step
+                    last_metrics["examples_per_sec"] = eps
+                    last_metrics["examples_per_sec_per_chip"] = \
+                        eps / n_chips
+                if self.tokens_per_step:
+                    tps = last_metrics["steps_per_sec"] \
+                        * self.tokens_per_step
+                    last_metrics["tokens_per_sec"] = tps
+                    last_metrics["tokens_per_sec_per_chip"] = tps / n_chips
                 last_metrics["step"] = self.global_step
-                t0 = time.perf_counter()
+                obs.counter("train.steps").inc(window_steps)
+                obs.record_metrics(self.global_step, last_metrics)
                 window_steps = 0
                 if self.metric_logger:
                     self.metric_logger(self.global_step, last_metrics)

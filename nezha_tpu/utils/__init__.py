@@ -2,7 +2,12 @@
 (SURVEY.md §5 — the reference's evidence here was thin, so this package is
 sized to what a training framework needs on TPU: XLA-aware profiling via
 jax.profiler, JSONL metrics with async-dispatch-aware step timing, and a
-rank-tagged logger)."""
+rank-tagged logger).
+
+The metrics/profiling primitives now live in the unified telemetry
+subsystem (``nezha_tpu.obs`` — registry, run-scoped sinks, and the
+``nezha-telemetry`` report CLI); this package re-exports them under their
+long-standing names."""
 
 from nezha_tpu.utils.compile_cache import enable_persistent_compile_cache
 from nezha_tpu.utils.logging import get_logger, set_rank

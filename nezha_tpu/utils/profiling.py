@@ -1,92 +1,11 @@
-"""Tracing and profiling on top of jax.profiler.
+"""Thin re-export: the jax.profiler wrappers moved into the telemetry
+subsystem (``nezha_tpu.obs.trace``). Import from here (or
+``nezha_tpu.utils``) keeps working."""
 
-The reference had no attested profiler subsystem (SURVEY.md §5); on TPU
-the platform tool is the XLA profiler — ``jax.profiler`` captures device
-traces (MXU occupancy, HBM traffic, per-op timing) viewable in
-TensorBoard/XProf. This module wraps it with context managers that are
-no-ops when disabled, so call sites can stay annotated permanently.
-"""
+from nezha_tpu.obs.trace import (  # noqa: F401
+    Tracer,
+    annotate,
+    profile_trace,
+)
 
-from __future__ import annotations
-
-import contextlib
-import os
-from typing import Iterator, Optional
-
-import jax
-
-
-@contextlib.contextmanager
-def profile_trace(log_dir: str,
-                  create_perfetto_link: bool = False) -> Iterator[None]:
-    """Capture a device trace for the enclosed block into ``log_dir``.
-
-    Wrap a handful of steady-state steps (skip step 0 — it contains the
-    compile). View with TensorBoard's profile plugin or Perfetto.
-    """
-    os.makedirs(log_dir, exist_ok=True)
-    jax.profiler.start_trace(log_dir,
-                             create_perfetto_link=create_perfetto_link)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
-
-
-@contextlib.contextmanager
-def annotate(name: str) -> Iterator[None]:
-    """Named region in the trace timeline (host and device rows).
-
-    Usable inside jit: becomes an XLA op annotation via TraceAnnotation.
-    """
-    with jax.profiler.TraceAnnotation(name):
-        yield
-
-
-class Tracer:
-    """Start/stop trace control for long-running loops.
-
-    A Trainer can hold one and call ``maybe_trace(step)``: the trace turns
-    on at ``start_step`` and off after ``num_steps`` — the standard
-    "profile steps 10..13" workflow without restructuring the loop.
-    """
-
-    def __init__(self, log_dir: Optional[str] = None, start_step: int = 10,
-                 num_steps: int = 3):
-        self.log_dir = log_dir
-        self.start_step = start_step
-        self.num_steps = num_steps
-        self.stop_step = start_step + num_steps
-        self._active = False
-        self._done = False
-
-    @property
-    def enabled(self) -> bool:
-        return self.log_dir is not None
-
-    def maybe_trace(self, step: int) -> None:
-        if not self.enabled:
-            return
-        # A resumed run's counter may start anywhere past start_step (e.g.
-        # restored global_step=5000 with start_step=10): rebase the window
-        # onto the first step actually observed at/after start_step, so a
-        # full num_steps window is always captured exactly once.
-        if not self._active and not self._done and step >= self.start_step:
-            self.stop_step = step + self.num_steps
-            os.makedirs(self.log_dir, exist_ok=True)
-            jax.profiler.start_trace(self.log_dir)
-            self._active = True
-        elif self._active and step >= self.stop_step:
-            self.stop()
-
-    def stop(self) -> None:
-        if self._active:
-            jax.profiler.stop_trace()
-            self._active = False
-            self._done = True  # one window per Tracer
-
-    def __del__(self):
-        try:
-            self.stop()
-        except Exception:
-            pass
+__all__ = ["Tracer", "annotate", "profile_trace"]

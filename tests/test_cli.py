@@ -1170,3 +1170,93 @@ def test_cli_scan_layers_resume_and_knob_compositions(tmp_path, devices8):
               "--mesh", "dp=4,tp=2", "--scan-layers", "--ckpt-dir", ck2,
               "--log-every", "1"])
     assert m["step"] == 4 and np.isfinite(m["loss"])
+
+
+def test_cli_run_dir_telemetry(devices8, tmp_path):
+    """--run-dir captures the run: metrics.jsonl with step rates,
+    spans.jsonl, and a summary.json carrying per-collective payload bytes
+    and compile-cache counts — all matching the frozen telemetry schema —
+    and nezha-telemetry renders a report from it. Telemetry is OFF again
+    after the run (the disabled fast path is the default state)."""
+    import os
+    import sys
+
+    from nezha_tpu import obs
+    from nezha_tpu.cli.telemetry import main as telemetry_main
+
+    run_dir = str(tmp_path / "run")
+    metrics = _run(["--config", "mlp_mnist", "--steps", "6",
+                    "--batch-size", "16", "--parallel", "dp",
+                    "--mesh", "dp=8", "--log-every", "2",
+                    "--run-dir", run_dir])
+    assert np.isfinite(metrics["loss"])
+    assert not obs.enabled()  # run scope closed on exit
+
+    recs = obs.read_metrics(os.path.join(run_dir, "metrics.jsonl"))
+    assert recs and all("steps_per_sec" in r for r in recs)
+    assert recs[-1]["step"] == 6
+    spans = obs.read_metrics(os.path.join(run_dir, "spans.jsonl"))
+    assert any(s["name"] == "train.first_step" for s in spans)
+    with open(os.path.join(run_dir, "summary.json")) as f:
+        summary = json.load(f)
+    # The dp gradient collective was accounted (trace-time payload bytes).
+    ar = summary["collectives"]["all_reduce"]
+    assert ar["calls"] >= 1 and ar["payload_bytes"] > 0
+    assert summary["compile_cache"]["hits"] >= 0  # section always present
+    assert summary["histograms"]["metric.steps_per_sec"]["count"] == 3
+
+    # Frozen schema (tools/check_telemetry_schema.py): drift fails here.
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "tools"))
+    from check_telemetry_schema import check_run_dir
+    assert check_run_dir(run_dir) == []
+
+    # The report CLI renders the capture.
+    from contextlib import redirect_stdout
+    import io
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert telemetry_main([run_dir]) == 0
+    out = buf.getvalue()
+    assert "step rate" in out and "all_reduce" in out
+    assert "compile cache" in out
+
+
+def test_cli_bert_mask_token_resolved_from_corpus_tokenizer(devices8,
+                                                            tmp_path,
+                                                            capsys):
+    """No --mlm-mask-token and a non-byte-level corpus: the TRUE [MASK]
+    id comes from the tokenizer metadata next to the tokens file — the
+    vocab.txt layout and the nezha-pack-text meta sidecar — instead of
+    silently defaulting to 103 (ADVICE r5: a learned WordPiece vocab puts
+    [MASK] at id 4, where 103 is a real subword)."""
+    import pytest
+    try:
+        from nezha_tpu.data.native import load_library
+        load_library()
+    except Exception:
+        pytest.skip("native runtime not available")
+    rng = np.random.RandomState(0)
+    (tmp_path / "train.tokens.u16").write_bytes(
+        rng.randint(5, 200, 8192).astype(np.uint16).tobytes())
+    # Layout 1: the packing tokenizer's vocab.txt sits next to the tokens
+    # (--save-tokenizer into the data dir): [MASK] at id 4.
+    (tmp_path / "vocab.txt").write_text(
+        "[PAD]\n[UNK]\n[CLS]\n[SEP]\n[MASK]\n" +
+        "\n".join(f"tok{i}" for i in range(500)) + "\n", encoding="utf-8")
+    m = _run(["--config", "bert_base_zero1", "--model-preset", "tiny",
+              "--steps", "2", "--batch-size", "8", "--log-every", "1",
+              "--data-dir", str(tmp_path)])
+    assert np.isfinite(m["loss"])
+    assert "[MASK] id 4 resolved" in capsys.readouterr().err
+    # Layout 2: the meta sidecar wins even without an adjacent vocab.
+    (tmp_path / "vocab.txt").unlink()
+    (tmp_path / "train.tokens.u16.meta.json").write_text(
+        json.dumps({"tokenizer_kind": "WordPieceTokenizer",
+                    "vocab_size": 505, "mask_token_id": 7}),
+        encoding="utf-8")
+    m = _run(["--config", "bert_base_zero1", "--model-preset", "tiny",
+              "--steps", "2", "--batch-size", "8", "--log-every", "1",
+              "--data-dir", str(tmp_path)])
+    assert np.isfinite(m["loss"])
+    assert "[MASK] id 7 resolved" in capsys.readouterr().err

@@ -149,3 +149,32 @@ def test_generate_flash_prefill_matches_composed():
     b = generate(m_xla, v, prompt, max_new_tokens=4, temperature=0.0,
                  cache_dtype=jnp.float32)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_prefill_nonzero_pos_falls_back_to_masked(model_and_vars):
+    """The flash-prefill contract (ADVICE r5): ``prefill=True`` with a
+    cache position that is not statically zero must NOT take the
+    chunk-local flash path (it would drop attention to the cached
+    prefix). A forced-flash model fed prefill=True at pos=4 must match
+    the plain masked-cache path exactly."""
+    kw = dict(vocab_size=97, max_positions=64, num_layers=2, num_heads=4,
+              hidden_size=64)
+    m_flash = GPT2(GPT2Config(attn_impl="flash", **kw))
+    m_xla = GPT2(GPT2Config(attn_impl="xla", **kw))
+    variables = m_xla.init(jax.random.PRNGKey(1))
+    prefix = jnp.asarray([[5, 17, 3, 42]], jnp.int32)
+    chunk = jnp.asarray([[8, 30, 2, 9]], jnp.int32)
+    from nezha_tpu.models.generate import _caches_from_states
+
+    cache = init_cache(m_xla, 1, 16, jnp.float32)
+    _, st = m_xla.apply(variables, prefix, training=False, cache=cache,
+                        pos=0)
+    warm = _caches_from_states(m_xla, st, cache)
+    # Reference: continue WITHOUT the prefill hint (masked path).
+    ref, _ = m_xla.apply(variables, chunk, training=False,
+                         cache=warm, pos=4)
+    # prefill=True at pos=4: the guard must fall back, not mis-attend.
+    out, _ = m_flash.apply(variables, chunk, training=False,
+                           cache=warm, pos=4, prefill=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)

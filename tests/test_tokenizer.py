@@ -328,3 +328,41 @@ def test_pack_text_learn_wordpiece_cli_and_mlm_train(tmp_path):
          "--data-dir", str(tmp_path)]))
     import numpy as np
     assert np.isfinite(m["loss"])
+
+
+def test_wordpiece_missing_specials_rejected_at_construction(tmp_path):
+    """A vocab.txt without the BERT specials (e.g. a --learn-bpe vocab
+    pointed at by a BERT flow) is refused at load time with the filename,
+    not a bare KeyError mid-encode (ADVICE r5)."""
+    bad = tmp_path / "vocab.txt"
+    bad.write_text("hello\nworld\n##ld\n", encoding="utf-8")
+    with pytest.raises(ValueError, match=r"vocab\.txt.*\[UNK\]"):
+        WordPieceTokenizer.from_files(str(bad))
+    # [MASK] is lazy: a GPT-style flow without it loads fine, but the MLM
+    # accessor diagnoses instead of KeyError-ing.
+    ok = tmp_path / "ok"
+    ok.mkdir()
+    (ok / "vocab.txt").write_text(
+        "[PAD]\n[UNK]\n[CLS]\n[SEP]\nhello\n##world\n", encoding="utf-8")
+    tok = WordPieceTokenizer.from_dir(str(ok))
+    assert tok.encode("hello", add_special_tokens=False) == [4]
+    with pytest.raises(ValueError, match=r"\[MASK\]"):
+        _ = tok.mask_token_id
+
+
+def test_bpe_mismatched_vocab_merges_rejected(tmp_path):
+    """A merges.txt whose outputs are missing from vocab.json (files from
+    two different tokenizers) is refused at construction naming both
+    files, instead of a bare KeyError mid-encode (ADVICE r5)."""
+    vocab, merges = _learn_bpe(CORPUS, 20)
+    d = tmp_path / "tok"
+    d.mkdir()
+    # Drop every merged token from the vocab: chars only = a vocab that
+    # never saw these merges.
+    chars_only = {k: v for k, v in vocab.items()
+                  if all(k != a + b for a, b in merges)}
+    (d / "vocab.json").write_text(json.dumps(chars_only), encoding="utf-8")
+    (d / "merges.txt").write_text(
+        "\n".join(f"{a} {b}" for a, b in merges) + "\n", encoding="utf-8")
+    with pytest.raises(ValueError, match=r"merges\.txt does not match"):
+        GPT2BPETokenizer.from_dir(str(d))

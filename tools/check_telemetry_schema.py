@@ -1,0 +1,193 @@
+#!/usr/bin/env python3
+"""Frozen-schema validator for telemetry run directories.
+
+The run-dir file contract (obs/sink.py) is an interface other tooling
+reads — dashboards, the `nezha-telemetry` report, downstream analysis —
+so drift must fail fast. This validator pins schema v1:
+
+    metrics.jsonl   one JSON object per line; "step" int >= 0, "ts"
+                    float; other values JSON scalars
+    spans.jsonl     one JSON object per line; "name" str, "t0"/"t1"
+                    floats with t1 >= t0, "dur_s" float, "attrs" object
+    summary.json    schema_version == 1; counters/gauges/histograms/
+                    collectives objects; compile_cache with int
+                    hits/misses; slowest_spans list of span records
+
+Stdlib-only (no jsonschema dependency, nothing to install). Run from a
+tier-1 test (tests/test_telemetry_schema.py) against a real `nezha-train
+--run-dir` capture, or standalone:
+
+    python tools/check_telemetry_schema.py /tmp/run
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import List
+
+SCHEMA_VERSION = 1
+_HIST_KEYS = {"count", "sum", "min", "max", "mean", "p50", "p90", "p99"}
+_SUMMARY_KEYS = {"schema_version", "counters", "gauges", "histograms",
+                 "collectives", "compile_cache", "num_spans",
+                 "slowest_spans"}
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _check_span(rec, where: str, errors: List[str]) -> None:
+    if not isinstance(rec, dict):
+        errors.append(f"{where}: span record is not an object")
+        return
+    if not isinstance(rec.get("name"), str):
+        errors.append(f"{where}: span 'name' must be a string")
+    for k in ("t0", "t1", "dur_s"):
+        if not _is_num(rec.get(k)):
+            errors.append(f"{where}: span '{k}' must be a number")
+    if (_is_num(rec.get("t0")) and _is_num(rec.get("t1"))
+            and rec["t1"] < rec["t0"]):
+        errors.append(f"{where}: span t1 < t0")
+    if not isinstance(rec.get("attrs"), dict):
+        errors.append(f"{where}: span 'attrs' must be an object")
+
+
+def check_metrics_jsonl(path: str, errors: List[str]) -> None:
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                errors.append(f"metrics.jsonl:{i}: not valid JSON")
+                continue
+            if not isinstance(rec, dict):
+                errors.append(f"metrics.jsonl:{i}: not an object")
+                continue
+            step = rec.get("step")
+            if not (isinstance(step, int) and not isinstance(step, bool)
+                    and step >= 0):
+                errors.append(f"metrics.jsonl:{i}: 'step' must be an int "
+                              f">= 0, got {step!r}")
+            if not _is_num(rec.get("ts")):
+                errors.append(f"metrics.jsonl:{i}: 'ts' must be a number")
+            for k, v in rec.items():
+                if not isinstance(v, (int, float, str, bool, type(None))):
+                    errors.append(f"metrics.jsonl:{i}: value for {k!r} is "
+                                  f"not a JSON scalar")
+
+
+def check_spans_jsonl(path: str, errors: List[str]) -> None:
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                errors.append(f"spans.jsonl:{i}: not valid JSON")
+                continue
+            _check_span(rec, f"spans.jsonl:{i}", errors)
+
+
+def check_summary_json(path: str, errors: List[str]) -> None:
+    try:
+        with open(path) as f:
+            summary = json.load(f)
+    except ValueError:
+        errors.append("summary.json: not valid JSON")
+        return
+    if not isinstance(summary, dict):
+        errors.append("summary.json: not an object")
+        return
+    if summary.get("schema_version") != SCHEMA_VERSION:
+        errors.append(f"summary.json: schema_version must be "
+                      f"{SCHEMA_VERSION}, got "
+                      f"{summary.get('schema_version')!r}")
+    missing = _SUMMARY_KEYS - set(summary)
+    if missing:
+        errors.append(f"summary.json: missing key(s) {sorted(missing)}")
+    for section in ("counters", "gauges"):
+        vals = summary.get(section)
+        if not isinstance(vals, dict):
+            errors.append(f"summary.json: '{section}' must be an object")
+            continue
+        for k, v in vals.items():
+            if not _is_num(v):
+                errors.append(f"summary.json: {section}[{k!r}] must be a "
+                              f"number")
+    hists = summary.get("histograms")
+    if isinstance(hists, dict):
+        for k, h in hists.items():
+            if not isinstance(h, dict) or not _HIST_KEYS <= set(h):
+                errors.append(f"summary.json: histograms[{k!r}] must "
+                              f"carry {sorted(_HIST_KEYS)}")
+    else:
+        errors.append("summary.json: 'histograms' must be an object")
+    coll = summary.get("collectives")
+    if isinstance(coll, dict):
+        for op, row in coll.items():
+            if not isinstance(row, dict):
+                errors.append(f"summary.json: collectives[{op!r}] must be "
+                              f"an object")
+                continue
+            for field in ("calls", "payload_bytes"):
+                if field in row and not _is_num(row[field]):
+                    errors.append(f"summary.json: collectives[{op!r}]"
+                                  f".{field} must be a number")
+    else:
+        errors.append("summary.json: 'collectives' must be an object")
+    cc = summary.get("compile_cache")
+    if isinstance(cc, dict):
+        for field in ("hits", "misses"):
+            v = cc.get(field)
+            if not (isinstance(v, int) and not isinstance(v, bool)):
+                errors.append(f"summary.json: compile_cache.{field} must "
+                              f"be an int")
+    else:
+        errors.append("summary.json: 'compile_cache' must be an object")
+    slowest = summary.get("slowest_spans")
+    if isinstance(slowest, list):
+        for j, rec in enumerate(slowest):
+            _check_span(rec, f"summary.json: slowest_spans[{j}]", errors)
+    else:
+        errors.append("summary.json: 'slowest_spans' must be a list")
+
+
+def check_run_dir(run_dir: str) -> List[str]:
+    """-> list of schema violations (empty = valid). All three artifacts
+    are required — a run dir missing one is itself a violation."""
+    errors: List[str] = []
+    for name, checker in (("metrics.jsonl", check_metrics_jsonl),
+                          ("spans.jsonl", check_spans_jsonl),
+                          ("summary.json", check_summary_json)):
+        path = os.path.join(run_dir, name)
+        if not os.path.isfile(path):
+            errors.append(f"{name}: missing from {run_dir}")
+            continue
+        checker(path, errors)
+    return errors
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print(__doc__, file=sys.stderr)
+        return 2
+    errors = check_run_dir(argv[0])
+    for e in errors:
+        print(e, file=sys.stderr)
+    if errors:
+        print(f"FAIL: {len(errors)} schema violation(s)", file=sys.stderr)
+        return 1
+    print("OK: telemetry artifacts match schema v1")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
