@@ -1,0 +1,211 @@
+"""Serving load generator: offered load vs TTFT/TPOT percentiles.
+
+Drives the in-process continuous-batching stack (`nezha_tpu.serve`) the
+way EQuARX-style training benchmarks drive collectives: measure the REAL
+hot path (admission -> slot prefill -> batched decode) rather than a
+proxy, and write the same run-dir telemetry artifacts `nezha-train`
+produces, so `nezha-telemetry RUN_DIR` renders the serving report and
+`tools/check_telemetry_schema.py` validates it.
+
+Two load models:
+
+- **closed** loop (--concurrency N): N requests always outstanding —
+  measures capacity (tokens/sec at full batch occupancy).
+- **open** loop (--rate R): Poisson arrivals at R req/s wall-clock —
+  measures latency under offered load; queue-full arrivals are DROPPED
+  and counted (that is the backpressure behaving, not an error).
+
+Usage::
+
+    python benchmarks/serving.py --requests 32 --concurrency 4 \
+        --run-dir /tmp/serve_bench --json
+    python benchmarks/serving.py --mode open --rate 20 --requests 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--mode", choices=["closed", "open"], default="closed")
+    p.add_argument("--requests", type=int, default=16,
+                   help="total requests to issue")
+    p.add_argument("--concurrency", type=int, default=4,
+                   help="closed loop: requests kept outstanding")
+    p.add_argument("--rate", type=float, default=8.0,
+                   help="open loop: offered arrivals per second")
+    p.add_argument("--prompt-len", type=int, default=8)
+    p.add_argument("--max-new-tokens", type=int, default=16)
+    p.add_argument("--sample-fraction", type=float, default=0.5,
+                   help="fraction of requests that sample at temperature "
+                        "0.8 / top-k 40 (rest decode greedy) — a mixed "
+                        "batch exercises the per-row sampling path")
+    p.add_argument("--max-batch-size", type=int, default=4)
+    p.add_argument("--max-len", type=int, default=64)
+    p.add_argument("--max-prefill-len", type=int, default=16)
+    p.add_argument("--queue-capacity", type=int, default=16)
+    p.add_argument("--model-preset", choices=["tiny", "full"],
+                   default="tiny")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--run-dir", default=None,
+                   help="write telemetry artifacts here")
+    p.add_argument("--json", action="store_true",
+                   help="print the result record as JSON")
+    p.add_argument("--platform", default=None)
+    return p
+
+
+def _percentiles(values):
+    from nezha_tpu.obs.registry import percentile_of
+    s = sorted(values)
+    return {"p50": percentile_of(s, 50), "p90": percentile_of(s, 90),
+            "p99": percentile_of(s, 99)}
+
+
+def run(args) -> dict:
+    from nezha_tpu.cli.common import setup_jax
+    setup_jax(args)
+
+    import jax
+    import jax.numpy as jnp
+
+    from nezha_tpu import obs
+    from nezha_tpu.serve import (Engine, QueueFull, Request, Scheduler,
+                                 ServeConfig)
+
+    if args.model_preset == "tiny":
+        from nezha_tpu.cli.train import TINY_GPT2_KW
+        from nezha_tpu.models.gpt2 import GPT2, GPT2Config
+        model = GPT2(GPT2Config(**TINY_GPT2_KW))
+    else:
+        from nezha_tpu.models.gpt2 import gpt2_124m
+        model = gpt2_124m()
+    variables = model.init(jax.random.PRNGKey(args.seed))
+    cfg = ServeConfig(
+        max_batch_size=args.max_batch_size, max_len=args.max_len,
+        max_prefill_len=args.max_prefill_len,
+        queue_capacity=args.queue_capacity, cache_dtype=jnp.bfloat16)
+    engine = Engine(model, variables, cfg)
+    sched = Scheduler(engine)
+    rng = random.Random(args.seed)
+    vocab = engine.vocab
+
+    def make_request(i: int) -> Request:
+        sampled = rng.random() < args.sample_fraction
+        return Request(
+            prompt=[rng.randrange(vocab)
+                    for _ in range(args.prompt_len)],
+            max_new_tokens=args.max_new_tokens,
+            temperature=0.8 if sampled else 0.0,
+            top_k=40 if sampled else None,
+            seed=i, request_id=f"bench-{i}")
+
+    # Warm both programs off the clock — serving steady state never pays
+    # trace+compile, and neither should the measurement. The telemetry
+    # run starts AFTER warmup so the artifacts hold steady-state
+    # percentiles only (no multi-second compile spike in ttft p99).
+    sched.submit(Request(prompt=[0], max_new_tokens=1,
+                         request_id="warmup"))
+    sched.run_until_idle()
+
+    sink = None
+    if args.run_dir:
+        from nezha_tpu.serve.scheduler import register_serve_instruments
+        sink = obs.start_run(args.run_dir, meta={
+            "kind": "serve_bench", "mode": args.mode,
+            "requests": args.requests,
+            "offered": (args.concurrency if args.mode == "closed"
+                        else args.rate)})
+        register_serve_instruments()
+
+    # (Occupancy percentiles come from the scheduler itself — it folds
+    # per-decode occupancy into the metric.batch_occupancy histogram.)
+    t0 = time.monotonic()
+    issued = finished = dropped = 0
+    if args.mode == "closed":
+        while finished < args.requests:
+            # Pace by queue room: a closed-loop client waits, it does
+            # not shed — hammering submit would inflate rejected_total.
+            while (issued < args.requests
+                   and issued - finished < args.concurrency
+                   and sched.queue_depth < sched.queue_capacity):
+                sched.submit(make_request(issued))
+                issued += 1
+            sched.step()
+            finished = issued - sched.queue_depth - len(sched._live)
+    else:
+        # Poisson arrivals: exponential inter-arrival gaps at --rate.
+        # Arrivals hitting a full queue are DROPPED (open-loop clients
+        # don't wait) — the genuine load-shed rejected_total measures.
+        arrivals = []
+        t = 0.0
+        for _ in range(args.requests):
+            t += rng.expovariate(args.rate)
+            arrivals.append(t)
+        while finished + dropped < args.requests:
+            now = time.monotonic() - t0
+            while issued + dropped < args.requests \
+                    and arrivals[issued + dropped] <= now:
+                try:
+                    sched.submit(make_request(issued + dropped))
+                    issued += 1
+                except QueueFull:
+                    dropped += 1
+            if sched.has_work():
+                sched.step()
+            else:
+                time.sleep(0.001)
+            finished = issued - sched.queue_depth - len(sched._live)
+    wall = time.monotonic() - t0
+
+    results = [r for rid, r in sched.results.items() if rid != "warmup"]
+    ttfts = [r.ttft_s for r in results if r.ttft_s is not None]
+    lats = [r.latency_s for r in results]
+    total_tokens = sum(len(r.tokens) for r in results)
+    tpots = [(r.latency_s - r.ttft_s) / max(len(r.tokens) - 1, 1)
+             for r in results if r.ttft_s is not None]
+    record = {
+        "mode": args.mode,
+        "offered": (args.concurrency if args.mode == "closed"
+                    else args.rate),
+        "requests": args.requests, "finished": len(results),
+        "dropped_queue_full": dropped,
+        "wall_s": wall,
+        "tokens": total_tokens,
+        "tokens_per_sec": total_tokens / wall if wall else 0.0,
+        "ttft_s": _percentiles(ttfts),
+        "tpot_s": _percentiles(tpots),
+        "latency_s": _percentiles(lats),
+        "compile_cache": engine.compile_stats(),
+    }
+    if sink is not None:
+        obs.end_run()
+    if args.json:
+        print(json.dumps(record, indent=2, sort_keys=True))
+    else:
+        print(f"{args.mode} load: {record['offered']} -> "
+              f"{record['tokens_per_sec']:.1f} tok/s, "
+              f"ttft p50 {record['ttft_s']['p50'] * 1e3:.1f} ms, "
+              f"tpot p50 {record['tpot_s']['p50'] * 1e3:.1f} ms, "
+              f"{dropped} dropped")
+    return record
+
+
+def main(argv=None) -> int:
+    run(build_parser().parse_args(argv))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

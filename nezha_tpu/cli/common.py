@@ -110,3 +110,83 @@ def ckpt_has_scan_trunk(ckpt_dir: str) -> bool:
         # Each meta names every leaf path prefix.
         return "h_scan" in text or "layers_scan" in text
     return False
+
+
+def load_gpt2_for_inference(args):
+    """(model, variables) for the inference CLIs (`nezha-generate`,
+    `nezha-serve`) from any of their three weight sources: --hf-dir
+    (transformers checkpoint), --ckpt-dir (either nezha-train format,
+    scan-layers auto-detected and unstacked ONCE to the unrolled decode
+    layout), or --random-init. Policies mirror nezha-train's presets:
+    full decodes bf16, tiny fp32 — greedy decode must run the same
+    compute numerics as the checkpoint's training run."""
+    import jax
+
+    from nezha_tpu.models.gpt2 import GPT2, GPT2Config
+    from nezha_tpu.tensor import bf16_policy
+
+    if getattr(args, "hf_dir", None):
+        import transformers
+
+        hf = transformers.GPT2LMHeadModel.from_pretrained(args.hf_dir)
+        from nezha_tpu.models.convert import gpt2_from_hf
+        return gpt2_from_hf(hf)
+
+    # --scan-layers checkpoints store the trunk under h_scan with a
+    # leading layer dim; restore with the matching template, then
+    # unstack ONCE to the unrolled layout for decode — the scan model's
+    # cache path would otherwise slice every stacked param per decode
+    # step (doubling param traffic in the latency-bound loop).
+    scan = False
+    if getattr(args, "ckpt_dir", None):
+        scan = ckpt_has_scan_trunk(args.ckpt_dir)
+    if args.model_preset == "full":
+        model = GPT2(GPT2Config(scan_layers=scan), policy=bf16_policy())
+    else:
+        from nezha_tpu.cli.train import TINY_GPT2_KW
+        model = GPT2(GPT2Config(**TINY_GPT2_KW, scan_layers=scan))
+    if getattr(args, "ckpt_dir", None):
+        # Either checkpoint format: dense npz OR the per-shard layout
+        # that zero1/gspmd/pp training writes. Generation needs the
+        # variables leaf only (optimizer state is ignored); no point
+        # materializing a random init just to overwrite it.
+        from nezha_tpu import optim
+        variables = restore_variables_any(args.ckpt_dir, model,
+                                          optim.sgd(0.1))
+        if scan:
+            import dataclasses as _dc
+
+            from nezha_tpu.models.gpt2 import unstack_layer_params
+            variables = {
+                "params": unstack_layer_params(
+                    variables["params"], model.cfg.num_layers),
+                "state": variables.get("state", {})}
+            model = GPT2(_dc.replace(model.cfg, scan_layers=False),
+                         policy=model.policy)
+    else:
+        variables = model.init(jax.random.PRNGKey(args.seed))
+    return model, variables
+
+
+def resolve_eos_id(explicit, tokenizer, vocab: int, flag: str = "--eos-id"):
+    """ONE EOS policy for the inference CLIs (generate + serve): an
+    explicit flag wins and is validated hard (out-of-vocab = user
+    error); otherwise the loaded tokenizer's natural EOS, which quietly
+    disables (stderr note) when it falls outside the model vocab — a
+    big-vocab tokenizer on a small model must not break decoding that
+    worked before EOS support. Negative values force-disable."""
+    if explicit is not None and explicit >= vocab:
+        raise SystemExit(f"{flag} {explicit} outside the model vocab "
+                         f"[0, {vocab})")
+    eos_id = explicit
+    if eos_id is None and tokenizer is not None:
+        from nezha_tpu.data.tokenizer import default_eos_id
+        eos_id = default_eos_id(tokenizer)
+        if eos_id is not None and eos_id >= vocab:
+            print(f"note: tokenizer EOS id {eos_id} is outside this "
+                  f"model's vocab [0, {vocab}); EOS stopping disabled",
+                  file=sys.stderr)
+            eos_id = None
+    if eos_id is not None and eos_id < 0:
+        eos_id = None
+    return eos_id

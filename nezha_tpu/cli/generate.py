@@ -66,6 +66,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--top-p", type=float, default=None,
                    help="nucleus sampling: keep the smallest prefix of "
                         "descending-prob tokens with mass >= p")
+    p.add_argument("--eos-id", type=int, default=None,
+                   help="stop rows that emit this token (later positions "
+                        "pad with it); defaults to the tokenizer's EOS "
+                        "(<|endoftext|> / [SEP]) when one is loaded, "
+                        "-1 disables even then")
+    p.add_argument("--num-samples", type=int, default=1,
+                   help="decode N sampled continuations of ONE prompt in "
+                        "a single batch (temperature > 0; output gains a "
+                        "\"samples\" list)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. cpu)")
@@ -129,59 +138,12 @@ def _load_tokenizer(args):
 def run(args) -> dict:
     import jax
 
-    from nezha_tpu.cli.common import setup_jax
+    from nezha_tpu.cli.common import load_gpt2_for_inference, setup_jax
     setup_jax(args)
 
     from nezha_tpu.models.generate import generate
-    from nezha_tpu.models.gpt2 import GPT2, GPT2Config
-    from nezha_tpu.tensor import bf16_policy
 
-    if args.hf_dir:
-        import transformers
-
-        hf = transformers.GPT2LMHeadModel.from_pretrained(args.hf_dir)
-        from nezha_tpu.models.convert import gpt2_from_hf
-        model, variables = gpt2_from_hf(hf)
-    else:
-        # Policies mirror nezha-train's presets exactly: full trains bf16,
-        # tiny trains fp32 (DEFAULT_POLICY) — greedy decode must run the
-        # same compute numerics as the checkpoint's training run.
-        # --scan-layers checkpoints store the trunk under h_scan with a
-        # leading layer dim; restore with the matching template, then
-        # unstack ONCE to the unrolled layout for decode — the scan
-        # model's cache path would otherwise slice every stacked param
-        # per decode step (doubling param traffic in the latency-bound
-        # loop).
-        scan = False
-        if args.ckpt_dir:
-            from nezha_tpu.cli.common import ckpt_has_scan_trunk
-            scan = ckpt_has_scan_trunk(args.ckpt_dir)
-        if args.model_preset == "full":
-            model = GPT2(GPT2Config(scan_layers=scan), policy=bf16_policy())
-        else:
-            from nezha_tpu.cli.train import TINY_GPT2_KW
-            model = GPT2(GPT2Config(**TINY_GPT2_KW, scan_layers=scan))
-        if args.ckpt_dir:
-            # Either checkpoint format: dense npz OR the per-shard layout
-            # that zero1/gspmd/pp training writes. Generation needs the
-            # variables leaf only (optimizer state is ignored); no point
-            # materializing a random init just to overwrite it.
-            from nezha_tpu import optim
-            from nezha_tpu.cli.common import restore_variables_any
-            variables = restore_variables_any(args.ckpt_dir, model,
-                                              optim.sgd(0.1))
-            if scan:
-                import dataclasses as _dc
-
-                from nezha_tpu.models.gpt2 import unstack_layer_params
-                variables = {
-                    "params": unstack_layer_params(
-                        variables["params"], model.cfg.num_layers),
-                    "state": variables.get("state", {})}
-                model = GPT2(_dc.replace(model.cfg, scan_layers=False),
-                             policy=model.policy)
-        else:
-            variables = model.init(jax.random.PRNGKey(args.seed))
+    model, variables = load_gpt2_for_inference(args)
 
     tokenizer = _load_tokenizer(args)
     prompt = _prompt_ids(args, tokenizer)
@@ -198,43 +160,77 @@ def run(args) -> dict:
         raise SystemExit(f"prompt ({prompt.shape[1]} tokens) + "
                          f"--max-new-tokens {args.max_new_tokens} exceeds "
                          f"max_positions {model.cfg.max_positions}")
+    if args.top_k is not None and not 1 <= args.top_k <= vocab:
+        raise SystemExit(f"--top-k must be in [1, {vocab}] for this "
+                         f"model's vocab, got {args.top_k}")
+    if args.num_samples < 1:
+        raise SystemExit(f"--num-samples must be >= 1, got "
+                         f"{args.num_samples}")
+    if args.num_samples > 1 and args.temperature == 0.0:
+        raise SystemExit("--num-samples > 1 needs sampling (greedy "
+                         "decoding is deterministic — every sample would "
+                         "be identical); pass --temperature > 0")
 
+    # EOS: explicit flag wins (validated hard); else the tokenizer's
+    # natural EOS, auto-disabled when outside the model vocab; -1
+    # force-disables. Shared policy with nezha-serve.
+    from nezha_tpu.cli.common import resolve_eos_id
+    eos_id = resolve_eos_id(args.eos_id, tokenizer, vocab)
+
+    if args.num_samples > 1:
+        # N sampled continuations of ONE prompt as a single batched
+        # decode — the same batched single-token program serving uses.
+        prompt = np.repeat(prompt, args.num_samples, axis=0)
     out = generate(model, variables, prompt,
                    max_new_tokens=args.max_new_tokens,
                    temperature=args.temperature, top_k=args.top_k,
                    top_p=args.top_p,
-                   rng=jax.random.PRNGKey(args.seed))
-    new_tokens = np.asarray(out)[0, prompt.shape[1]:].tolist()
-    result = {"prompt_len": int(prompt.shape[1]), "tokens": new_tokens}
-    if tokenizer is not None:
-        # Real-vocabulary decode: HF GPT-2 weights + their shipped BPE
-        # files emit actual text (VERDICT r4 missing item 2). decode()
-        # skips unknown ids, so count them loudly (mirror of the
-        # byte-level path's non_byte_tokens warning).
-        known = (tokenizer.decoder if hasattr(tokenizer, "decoder")
-                 else tokenizer.ids_to_tokens)
-        dropped = sum(t not in known for t in new_tokens)
-        result["text"] = tokenizer.decode(new_tokens)
-        if dropped:
-            result["unknown_tokens"] = dropped
-            print(f"warning: {dropped}/{len(new_tokens)} generated ids "
-                  f"are outside this tokenizer's vocab "
-                  f"({tokenizer.vocab_size}) — wrong --tokenizer for "
-                  f"this checkpoint? \"text\" is partial",
-                  file=sys.stderr)
-    elif args.prompt is not None:
-        # Byte-level round trip (the encoding pack_text_files trains with).
-        # A non-byte-trained checkpoint (e.g. BPE HF weights) emits ids
-        # >= 256 — count them loudly rather than silently shrinking "text".
-        dropped = sum(t >= 256 for t in new_tokens)
-        result["text"] = bytes(t for t in new_tokens if t < 256).decode(
-            "utf-8", errors="replace")
-        if dropped:
-            result["non_byte_tokens"] = dropped
-            print(f"warning: {dropped}/{len(new_tokens)} generated ids are "
-                  f">= 256 — this checkpoint is not byte-level-trained; "
-                  f"\"text\" is partial (pass --tokenizer DIR with the "
-                  f"model's vocab files for real text)", file=sys.stderr)
+                   rng=jax.random.PRNGKey(args.seed),
+                   eos_id=eos_id)
+    rows = np.asarray(out)[:, prompt.shape[1]:]
+
+    def row_result(new_tokens: list) -> dict:
+        result = {"tokens": new_tokens}
+        if tokenizer is not None:
+            # Real-vocabulary decode: HF GPT-2 weights + their shipped BPE
+            # files emit actual text (VERDICT r4 missing item 2). decode()
+            # skips unknown ids, so count them loudly (mirror of the
+            # byte-level path's non_byte_tokens warning).
+            known = (tokenizer.decoder if hasattr(tokenizer, "decoder")
+                     else tokenizer.ids_to_tokens)
+            dropped = sum(t not in known for t in new_tokens)
+            result["text"] = tokenizer.decode(new_tokens)
+            if dropped:
+                result["unknown_tokens"] = dropped
+                print(f"warning: {dropped}/{len(new_tokens)} generated ids "
+                      f"are outside this tokenizer's vocab "
+                      f"({tokenizer.vocab_size}) — wrong --tokenizer for "
+                      f"this checkpoint? \"text\" is partial",
+                      file=sys.stderr)
+        elif args.prompt is not None:
+            # Byte-level round trip (the encoding pack_text_files trains
+            # with). A non-byte-trained checkpoint (e.g. BPE HF weights)
+            # emits ids >= 256 — count them loudly rather than silently
+            # shrinking "text".
+            dropped = sum(t >= 256 for t in new_tokens)
+            result["text"] = bytes(t for t in new_tokens if t < 256).decode(
+                "utf-8", errors="replace")
+            if dropped:
+                result["non_byte_tokens"] = dropped
+                print(f"warning: {dropped}/{len(new_tokens)} generated ids "
+                      f"are >= 256 — this checkpoint is not byte-level-"
+                      f"trained; \"text\" is partial (pass --tokenizer DIR "
+                      f"with the model's vocab files for real text)",
+                      file=sys.stderr)
+        return result
+
+    samples = [row_result(r.tolist()) for r in rows]
+    result = {"prompt_len": int(prompt.shape[1]), **samples[0]}
+    if eos_id is not None:
+        result["eos_id"] = eos_id
+    if args.num_samples > 1:
+        result["num_samples"] = args.num_samples
+        result["samples"] = samples
     print(json.dumps(result))
     return result
 

@@ -1,0 +1,408 @@
+"""`nezha-serve` — continuous-batching inference server.
+
+The serving counterpart of `nezha-generate`: same three weight sources
+(--ckpt-dir / --hf-dir / --random-init), but requests are admitted and
+retired individually against the slot-pooled engine
+(`nezha_tpu.serve`) — a late request joins the running batch instead of
+waiting for it. Two front ends, zero new dependencies:
+
+stdio JSONL (default) — one request object per stdin line, streamed
+events per stdout line::
+
+    {"id": "a", "prompt_tokens": [5, 17, 3], "max_new_tokens": 8}
+    {"id": "b", "prompt": "hello", "temperature": 0.8, "top_p": 0.9}
+
+    -> {"id": "a", "event": "token", "token": 42}
+       ...
+       {"id": "a", "event": "done", "tokens": [...], "finish_reason":
+        "length", "ttft_s": ..., "latency_s": ...}
+
+HTTP (--http PORT, stdlib http.server) — POST /generate with the same
+request object (response once finished; queue-full = 503), GET /healthz
+for liveness + occupancy.
+
+With --run-dir the run writes the standard telemetry artifacts;
+`nezha-telemetry RUN_DIR` then renders the serving section (TTFT/TPOT
+percentiles, tokens/sec, batch occupancy).
+
+    nezha-serve --ckpt-dir runs/gpt2 --model-preset tiny \
+        --max-batch-size 8 --max-len 96 --run-dir /tmp/serve
+    nezha-serve --hf-dir /ckpts/gpt2 --http 8000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="nezha-serve", description=__doc__)
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--ckpt-dir",
+                     help="checkpoint dir written by nezha-train")
+    src.add_argument("--hf-dir",
+                     help="Hugging Face GPT2LMHeadModel directory")
+    src.add_argument("--random-init", action="store_true",
+                     help="fresh random weights (smoke/benchmark runs)")
+    p.add_argument("--model-preset", choices=["full", "tiny"],
+                   default="full")
+    p.add_argument("--tokenizer", default=None,
+                   help="tokenizer dir for text prompts/output (defaults "
+                        "to --hf-dir's shipped tokenizer; else text "
+                        "prompts use byte-level ids)")
+    p.add_argument("--max-batch-size", type=int, default=4,
+                   help="decode slots (concurrent in-flight requests)")
+    p.add_argument("--max-len", type=int, default=96,
+                   help="per-slot KV capacity: prompt + generated tokens")
+    p.add_argument("--max-prefill-len", type=int, default=32,
+                   help="static prompt pad width; longer prompts are "
+                        "rejected at admission")
+    p.add_argument("--k-max", type=int, default=64,
+                   help="static top-k cap; per-request top_k is clamped "
+                        "to it")
+    p.add_argument("--queue-capacity", type=int, default=16,
+                   help="admission queue bound (backpressure past it)")
+    p.add_argument("--max-new-tokens", type=int, default=32,
+                   help="default for requests that don't set "
+                        "max_new_tokens, and the cap for those that do")
+    p.add_argument("--eos-id", type=int, default=None,
+                   help="default EOS for requests that don't set one; "
+                        "defaults to the tokenizer's EOS when loaded, "
+                        "-1 disables even then")
+    p.add_argument("--cache-dtype", choices=["bf16", "f32"], default="bf16",
+                   help="KV pool dtype (f32 for bit-exact parity checks)")
+    p.add_argument("--http", type=int, default=None, metavar="PORT",
+                   help="serve HTTP on PORT instead of stdio JSONL")
+    p.add_argument("--run-dir", default=None,
+                   help="write telemetry artifacts (metrics.jsonl / "
+                        "spans.jsonl / summary.json) here")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--platform", default=None,
+                   help="force a jax platform (e.g. cpu)")
+    return p
+
+
+def _build_stack(args):
+    """(scheduler, tokenizer, eos_id) from parsed args."""
+    import jax.numpy as jnp
+
+    from nezha_tpu.cli.common import load_gpt2_for_inference
+    from nezha_tpu.cli.generate import _load_tokenizer
+    from nezha_tpu.serve import Engine, ServeConfig, Scheduler
+
+    model, variables = load_gpt2_for_inference(args)
+    tokenizer = _load_tokenizer(args)
+    from nezha_tpu.cli.common import resolve_eos_id
+    eos_id = resolve_eos_id(args.eos_id, tokenizer, model.cfg.vocab_size)
+    max_len = min(args.max_len, model.cfg.max_positions)
+    cfg = ServeConfig(
+        max_batch_size=args.max_batch_size, max_len=max_len,
+        max_prefill_len=args.max_prefill_len, k_max=args.k_max,
+        queue_capacity=args.queue_capacity,
+        cache_dtype=jnp.float32 if args.cache_dtype == "f32"
+        else jnp.bfloat16)
+    engine = Engine(model, variables, cfg)
+    return Scheduler(engine), tokenizer, eos_id
+
+
+def _parse_request(obj: dict, args, tokenizer, eos_id, vocab: int):
+    """One wire object -> serve.Request. Raises ValueError on bad input."""
+    from nezha_tpu.serve import Request
+    if not isinstance(obj, dict):
+        raise ValueError("request must be a JSON object")
+    if ("prompt_tokens" in obj) == ("prompt" in obj):
+        raise ValueError("pass exactly one of prompt_tokens / prompt")
+    if "prompt_tokens" in obj:
+        prompt = [int(t) for t in obj["prompt_tokens"]]
+    else:
+        text = obj["prompt"]
+        if not isinstance(text, str) or not text:
+            raise ValueError("prompt must be a non-empty string")
+        if tokenizer is not None:
+            from nezha_tpu.data.tokenizer import encode_plain
+            prompt = encode_plain(tokenizer, text)
+        else:
+            prompt = list(text.encode("utf-8"))
+    if not prompt:
+        raise ValueError("prompt encoded to zero tokens")
+    if max(prompt) >= vocab or min(prompt) < 0:
+        raise ValueError(f"prompt ids must be in [0, {vocab})")
+    def num(key, cast, default=None):
+        # Coerce HERE so a malformed field is a per-request error (400 /
+        # error event), never an exception inside the decode loop.
+        v = obj.get(key, default)
+        if v is None:
+            return None
+        try:
+            return cast(v)
+        except (TypeError, ValueError):
+            raise ValueError(f"{key} must be a number, got {v!r}")
+
+    # --max-new-tokens is both the default and the per-request CAP: the
+    # operator's bound on how long one request may monopolize a slot.
+    max_new = min(num("max_new_tokens", int, args.max_new_tokens),
+                  args.max_new_tokens)
+    return Request(
+        prompt=prompt, max_new_tokens=max_new,
+        temperature=num("temperature", float, 0.0),
+        top_k=num("top_k", int), top_p=num("top_p", float),
+        eos_id=num("eos_id", int, eos_id),
+        seed=num("seed", int, args.seed),
+        deadline_s=num("deadline_s", float),
+        request_id=obj.get("id"))
+
+
+def _decode_text(tokens, tokenizer):
+    if tokenizer is not None:
+        return tokenizer.decode(tokens)
+    return bytes(t for t in tokens if t < 256).decode(
+        "utf-8", errors="replace")
+
+
+def _result_obj(res, tokenizer) -> dict:
+    return {"id": res.request_id, "event": "done", "tokens": res.tokens,
+            "text": _decode_text(res.tokens, tokenizer),
+            "finish_reason": res.finish_reason, "ttft_s": res.ttft_s,
+            "latency_s": res.latency_s}
+
+
+# ------------------------------------------------------------- stdio mode
+def run_stdio(scheduler, args, tokenizer, eos_id,
+              stdin=None, stdout=None) -> int:
+    """JSONL in, JSONL events out. A reader thread feeds the admission
+    queue as lines arrive (QueueFull = wait: stdin IS the backpressure
+    channel); the caller's thread drives the decode loop."""
+    stdin = stdin if stdin is not None else sys.stdin
+    stdout = stdout if stdout is not None else sys.stdout
+    vocab = scheduler.engine.vocab
+    out_lock = threading.Lock()
+
+    def emit(obj):
+        with out_lock:
+            stdout.write(json.dumps(obj) + "\n")
+            stdout.flush()
+
+    scheduler.on_token = lambda rid, tok: emit(
+        {"id": rid, "event": "token", "token": tok})
+
+    def on_finish(res):
+        emit(_result_obj(res, tokenizer))
+        # The done event IS the delivery — drop the stored result, or a
+        # long-lived server leaks every retired request's token list.
+        scheduler.results.pop(res.request_id, None)
+
+    scheduler.on_finish = on_finish
+
+    from nezha_tpu.serve import QueueFull
+    done_reading = threading.Event()
+
+    def reader():
+        try:
+            for line in stdin:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    emit({"id": None, "event": "error",
+                          "error": "line is not valid JSON"})
+                    continue
+                try:
+                    req = _parse_request(obj, args, tokenizer, eos_id,
+                                         vocab)
+                except ValueError as e:
+                    emit({"id": obj.get("id")
+                          if isinstance(obj, dict) else None,
+                          "event": "error", "error": str(e)})
+                    continue
+                while True:
+                    # Wait for queue room rather than spamming submit:
+                    # stdin is the backpressure channel, and QueueFull
+                    # increments the rejected_total SHED metric.
+                    if scheduler.queue_depth >= scheduler.queue_capacity:
+                        time.sleep(0.005)
+                        continue
+                    try:
+                        scheduler.submit(req)
+                        break
+                    except QueueFull:   # raced a burst; keep waiting
+                        time.sleep(0.005)
+                    except ValueError as e:
+                        emit({"id": req.request_id, "event": "error",
+                              "error": str(e)})
+                        break
+        finally:
+            done_reading.set()
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    while not done_reading.is_set() or scheduler.has_work():
+        if not scheduler.step():
+            time.sleep(0.002)
+    return 0
+
+
+# -------------------------------------------------------------- http mode
+def run_http(scheduler, args, tokenizer, eos_id, port: int,
+             ready_cb=None) -> int:
+    """Stdlib http.server front end: POST /generate (blocks until the
+    request retires; 503 on queue-full backpressure), GET /healthz.
+    Handlers run on server threads; one daemon thread drives decode."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from nezha_tpu.serve import QueueFull
+
+    vocab = scheduler.engine.vocab
+    events = {}
+    events_lock = threading.Lock()
+
+    def on_finish(res):
+        with events_lock:
+            ev = events.get(res.request_id)
+        if ev is not None:
+            ev.set()
+
+    scheduler.on_finish = on_finish
+    stop = threading.Event()
+
+    def loop():
+        # Fail LOUD and release every waiter: a dead decode thread with
+        # handlers parked on ev.wait() would hang the server silently
+        # (healthz keeps answering) — instead surface 500s/503s.
+        try:
+            while not stop.is_set():
+                if not scheduler.step():
+                    time.sleep(0.002)
+        except Exception:
+            import traceback
+            traceback.print_exc()
+            stop.set()
+            with events_lock:
+                for ev in events.values():
+                    ev.set()
+
+    decode_thread = threading.Thread(target=loop, daemon=True)
+    decode_thread.start()
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # stderr noise off the request path
+            pass
+
+        def _send(self, code: int, obj: dict):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path != "/healthz":
+                return self._send(404, {"error": "unknown path"})
+            pool = scheduler.engine.pool
+            code = 503 if stop.is_set() else 200
+            self._send(code, {
+                "status": "decode loop stopped" if stop.is_set()
+                else "ok",
+                "active": pool.num_active,
+                "capacity": pool.capacity,
+                "queued": scheduler.queue_depth,
+                "occupancy": pool.occupancy})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                return self._send(404, {"error": "unknown path"})
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = _parse_request(json.loads(self.rfile.read(n)),
+                                     args, tokenizer, eos_id, vocab)
+            except (ValueError, json.JSONDecodeError) as e:
+                return self._send(400, {"error": str(e)})
+            if stop.is_set():
+                return self._send(503, {"error": "decode loop stopped"})
+            # Register the event BEFORE submit (the decode thread could
+            # retire a short request between submit and a later
+            # registration), and never hold events_lock across submit —
+            # on_finish runs under the scheduler lock and takes
+            # events_lock, so holding both here in the opposite order
+            # would deadlock.
+            import uuid
+            rid = req.request_id or f"http-{uuid.uuid4().hex[:12]}"
+            req.request_id = rid
+            ev = threading.Event()
+            with events_lock:
+                if rid in events:
+                    # A duplicate would overwrite the first waiter's
+                    # event and strand it forever on ev.wait().
+                    return self._send(409, {
+                        "error": f"request id {rid!r} already in flight"})
+                events[rid] = ev
+            try:
+                scheduler.submit(req)
+            except QueueFull as e:
+                with events_lock:
+                    events.pop(rid, None)
+                return self._send(503, {"error": str(e)})
+            except ValueError as e:
+                with events_lock:
+                    events.pop(rid, None)
+                return self._send(400, {"error": str(e)})
+            ev.wait()
+            with events_lock:
+                events.pop(rid, None)
+            res = scheduler.results.pop(rid, None)
+            if res is None:   # decode loop died before retiring us
+                return self._send(500, {"error": "decode loop failed"})
+            out = _result_obj(res, tokenizer)
+            out.pop("event")
+            self._send(200, out)
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    if ready_cb is not None:
+        ready_cb(server)
+    print(f"nezha-serve listening on http://127.0.0.1:"
+          f"{server.server_address[1]} (POST /generate, GET /healthz)",
+          file=sys.stderr)
+    try:
+        server.serve_forever(poll_interval=0.1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        stop.set()
+        server.server_close()
+    return 0
+
+
+def run(args, stdin=None, stdout=None, ready_cb=None) -> int:
+    from nezha_tpu.cli.common import setup_jax
+    setup_jax(args)
+
+    sink = None
+    if args.run_dir:
+        from nezha_tpu import obs
+        sink = obs.start_run(args.run_dir, meta={
+            "kind": "serve", "mode": "http" if args.http else "stdio"})
+    try:
+        scheduler, tokenizer, eos_id = _build_stack(args)
+        if args.http is not None:
+            return run_http(scheduler, args, tokenizer, eos_id, args.http,
+                            ready_cb=ready_cb)
+        return run_stdio(scheduler, args, tokenizer, eos_id,
+                         stdin=stdin, stdout=stdout)
+    finally:
+        if sink is not None:
+            from nezha_tpu import obs
+            obs.end_run()
+
+
+def main(argv=None) -> int:
+    return run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

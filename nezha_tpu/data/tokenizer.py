@@ -385,3 +385,18 @@ def encode_plain(tokenizer, text: str) -> List[int]:
         return tokenizer.encode(text, add_special_tokens=False)
     except TypeError:
         return tokenizer.encode(text)
+
+
+def default_eos_id(tokenizer) -> "int | None":
+    """The vocabulary's end-of-sequence id, when it has one: GPT-2 BPE's
+    ``<|endoftext|>``, WordPiece's ``[SEP]``. None otherwise (e.g. a
+    corpus-learned vocab with no specials) — callers fall back to
+    no-EOS decoding. Generation-side counterpart of the MLM mask-id
+    resolution."""
+    encoder = getattr(tokenizer, "encoder", None)
+    if encoder is not None:                       # GPT-2 BPE
+        return encoder.get("<|endoftext|>")
+    vocab = getattr(tokenizer, "vocab", None)
+    if vocab is not None:                         # WordPiece
+        return vocab.get(getattr(tokenizer, "sep_token", "[SEP]"))
+    return None

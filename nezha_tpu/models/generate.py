@@ -42,6 +42,11 @@ def _sample(logits, rng, temperature: float, top_k: Optional[int],
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / jnp.maximum(temperature, 1e-6)
     if top_k is not None:
+        # Clamp to [1, vocab]: lax.top_k rejects k < 1 and k > axis size
+        # with an opaque error, and callers (CLI, serving) may hand
+        # through user-supplied values. top_k is static, so this is a
+        # trace-time Python clamp — no runtime cost.
+        top_k = max(1, min(int(top_k), logits.shape[-1]))
         kth = lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p is not None and top_p < 1.0:
@@ -83,26 +88,43 @@ def _prefill_fn(model: GPT2):
 
 @functools.lru_cache(maxsize=64)
 def _decode_fn(model: GPT2, temperature: float, top_k: Optional[int],
-               top_p: Optional[float], max_new_tokens: int):
+               top_p: Optional[float], max_new_tokens: int,
+               eos_id: Optional[int] = None,
+               pad_id: Optional[int] = None):
+    # EOS early-stop keeps static shapes: a finished row keeps decoding
+    # (its cache position advances over the pads it feeds itself) but its
+    # SAMPLED tokens are masked to pad_id — so the program is the same
+    # two compiled pieces whether rows finish early or not.
+    pad = eos_id if pad_id is None else pad_id
+
+    def mask_done(tok, done):
+        if eos_id is None:
+            return tok, done
+        tok = jnp.where(done, jnp.int32(pad), tok)
+        return tok, done | (tok == eos_id)
+
     @jax.jit
     def decode(variables, last_logits, cache, pos0, rng):
         def step(carry, _):
-            logits, cache, pos, rng = carry
+            logits, cache, pos, rng, done = carry
             rng, sub = jax.random.split(rng)
             tok = _sample(logits, sub, temperature, top_k, top_p)
+            tok, done = mask_done(tok, done)
             out, states = model.apply(variables, tok[:, None],
                                       training=False, cache=cache, pos=pos)
             new_cache = _caches_from_states(model, states, cache)
-            return (out[:, -1, :], new_cache, pos + 1, rng), tok
+            return (out[:, -1, :], new_cache, pos + 1, rng, done), tok
 
         # The last sampled token needs no forward pass (nothing consumes
         # its logits), so scan N-1 steps and sample the final token from
         # the carried logits — N-1 forwards for N tokens.
-        init = (last_logits, cache, pos0, rng)
-        (logits, _, _, rng), tokens = lax.scan(
+        done0 = jnp.zeros(last_logits.shape[:1], bool)
+        init = (last_logits, cache, pos0, rng, done0)
+        (logits, _, _, rng, done), tokens = lax.scan(
             step, init, None, length=max_new_tokens - 1)
         _, sub = jax.random.split(rng)
         final = _sample(logits, sub, temperature, top_k, top_p)
+        final, _ = mask_done(final, done)
         tokens = jnp.concatenate([tokens, final[None, :]], axis=0)
         return tokens.T  # [steps, B] -> [B, steps]
 
@@ -114,13 +136,20 @@ def generate(model: GPT2, variables: dict, prompt: jax.Array,
              top_k: Optional[int] = None,
              top_p: Optional[float] = None,
              rng: Optional[jax.Array] = None,
-             cache_dtype=jnp.bfloat16) -> jax.Array:
+             cache_dtype=jnp.bfloat16,
+             eos_id: Optional[int] = None,
+             pad_id: Optional[int] = None) -> jax.Array:
     """Generate ``[B, prompt_len + max_new_tokens]`` token ids.
 
     ``temperature=0`` is greedy decoding; otherwise categorical sampling
     (optionally top-k truncated and/or top-p nucleus-filtered). Compiles exactly two programs per
     (model, sampling config, shapes) — prefill and the scanned
     single-token step — reused across calls.
+
+    ``eos_id``: rows that emit it stop — their cache position keeps
+    advancing (static shapes) but every subsequent sampled token is
+    masked to ``pad_id`` (defaults to ``eos_id``), so output rows read
+    ``... eos pad pad``.
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     b, s = prompt.shape
@@ -135,6 +164,6 @@ def generate(model: GPT2, variables: dict, prompt: jax.Array,
     cache = init_cache(model, b, max_len, cache_dtype)
     last_logits, cache = _prefill_fn(model)(variables, prompt, cache)
     new_tokens = _decode_fn(model, temperature, top_k, top_p,
-                            max_new_tokens)(
+                            max_new_tokens, eos_id, pad_id)(
         variables, last_logits, cache, jnp.int32(s), rng)
     return jnp.concatenate([prompt, new_tokens], axis=1)

@@ -182,15 +182,29 @@ class Attention(Module):
             # Incremental decoding: append this chunk's K/V at `pos` in the
             # fixed-size cache and attend causally over everything written
             # so far. Static shapes throughout — `pos` is a traced scalar,
-            # so one compiled program serves every decode step.
+            # so one compiled program serves every decode step. A [B]
+            # position VECTOR means per-row positions (the serve engine's
+            # slot pool: every row is an independent request at its own
+            # depth) — writes become a vmapped per-row update and the
+            # causal mask gains a batch dim.
             import jax.lax as lax
+            per_row = getattr(pos, "ndim", 0) == 1
             zero = jnp.zeros((), jnp.int32)
-            k_all = lax.dynamic_update_slice(
-                cache["k"], k.astype(cache["k"].dtype),
-                (zero, zero, pos, zero))
-            v_all = lax.dynamic_update_slice(
-                cache["v"], v.astype(cache["v"].dtype),
-                (zero, zero, pos, zero))
+            if per_row:
+                def _row_update(c, new, p):
+                    return lax.dynamic_update_slice(c, new, (zero, p, zero))
+
+                k_all = jax.vmap(_row_update)(
+                    cache["k"], k.astype(cache["k"].dtype), pos)
+                v_all = jax.vmap(_row_update)(
+                    cache["v"], v.astype(cache["v"].dtype), pos)
+            else:
+                k_all = lax.dynamic_update_slice(
+                    cache["k"], k.astype(cache["k"].dtype),
+                    (zero, zero, pos, zero))
+                v_all = lax.dynamic_update_slice(
+                    cache["v"], v.astype(cache["v"].dtype),
+                    (zero, zero, pos, zero))
             use_flash_prefill = False
             if prefill and s > 1:
                 # Prefill contract (ADVICE r5): ``prefill=True`` promises
@@ -238,8 +252,14 @@ class Attention(Module):
                     out = flash_attention(q, k, v, causal=True)
             else:
                 L = k_all.shape[2]
-                abs_q = pos + jnp.arange(s)[:, None]   # absolute positions
-                attendable = jnp.arange(L)[None, :] <= abs_q
+                if per_row:
+                    # [B, 1, S, L]: each row masks against its own depth.
+                    abs_q = pos[:, None] + jnp.arange(s)[None, :]
+                    attendable = (jnp.arange(L)[None, None, :]
+                                  <= abs_q[:, :, None])[:, None, :, :]
+                else:
+                    abs_q = pos + jnp.arange(s)[:, None]  # absolute positions
+                    attendable = jnp.arange(L)[None, :] <= abs_q
                 mask = jnp.where(attendable, 0.0, -jnp.inf).astype(jnp.float32)
                 out = ops.dot_product_attention(q, k_all.astype(q.dtype),
                                                 v_all.astype(q.dtype),
@@ -444,8 +464,12 @@ class GPT2(Module):
         # ``pos`` without a cache = a global position offset: the sequence-
         # parallel train step passes each shard's offset so position
         # embeddings (and ring attention's causal mask) see global positions.
+        # A [B] pos vector (serve decode) offsets each row independently.
         offset = 0 if pos is None else pos
-        positions = offset + jnp.arange(s)[None, :]
+        if getattr(pos, "ndim", 0) == 1:
+            positions = pos[:, None] + jnp.arange(s)[None, :]
+        else:
+            positions = offset + jnp.arange(s)[None, :]
         x = run_child(self.wte, "wte", variables, states, tokens,
                       training=training)
         x = x + run_child(self.wpe, "wpe", variables, states, positions,

@@ -74,6 +74,49 @@ def _fmt_bytes(n: float) -> str:
     return f"{n:.1f} TiB"
 
 
+def render_serving_section(summary: Optional[dict]) -> List[str]:
+    """The serving block (present only for serve/benchmark runs —
+    detected by the pre-registered ``serve.*`` instruments): request
+    counters, TTFT/TPOT percentiles, throughput, batch occupancy."""
+    if not summary:
+        return []
+    counters = summary.get("counters", {})
+    if "serve.admitted_total" not in counters:
+        return []
+    gauges = summary.get("gauges", {})
+    hists = summary.get("histograms", {})
+    lines = ["serving:"]
+    lines.append(
+        "  requests: "
+        f"{counters.get('serve.admitted_total', 0)} admitted  "
+        f"{counters.get('serve.rejected_total', 0)} rejected  "
+        f"{counters.get('serve.expired_total', 0)} expired  "
+        f"{counters.get('serve.retired_total', 0)} retired")
+    for key, label in (("serve.ttft_s", "ttft"), ("serve.tpot_s", "tpot")):
+        h = hists.get(key)
+        if h and h.get("count"):
+            lines.append(
+                f"  {label}: p50 {h['p50'] * 1e3:.1f} ms  "
+                f"p90 {h['p90'] * 1e3:.1f} ms  "
+                f"p99 {h['p99'] * 1e3:.1f} ms  (n={h['count']})")
+    tokens = counters.get("serve.tokens_total", 0)
+    wall = (summary.get("run") or {}).get("wall_seconds")
+    if tokens and wall:
+        lines.append(f"  throughput: {tokens} tokens in {wall:.1f}s "
+                     f"({tokens / wall:.1f} tok/s)")
+    elif tokens:
+        lines.append(f"  throughput: {tokens} tokens")
+    occ = gauges.get("serve.batch_occupancy")
+    occ_h = hists.get("metric.batch_occupancy")
+    if occ_h and occ_h.get("count"):
+        lines.append(f"  batch occupancy: mean {occ_h['mean']:.2f}  "
+                     f"p50 {occ_h['p50']:.2f}  max {occ_h['max']:.2f}")
+    elif occ is not None:
+        lines.append(f"  batch occupancy: {occ:.2f} (final)  "
+                     f"queue depth: {gauges.get('serve.queue_depth', 0):.0f}")
+    return lines
+
+
 def render_report(run_dir: str) -> str:
     """The full plain-text report for a run directory."""
     run = load_run(run_dir)
@@ -131,6 +174,12 @@ def render_report(run_dir: str) -> str:
                          f"{bw_s:>16}")
     else:
         lines.append("collectives: none recorded")
+
+    # ---------------------------------------------------------- serving
+    serving = render_serving_section(summary)
+    if serving:
+        lines.append("")
+        lines.extend(serving)
 
     # ---------------------------------------------------- compile cache
     cc = (summary or {}).get("compile_cache")

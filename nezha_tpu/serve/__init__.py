@@ -1,0 +1,45 @@
+"""Continuous-batching inference: the serving layer on top of
+``models/generate.py``'s compiled decode.
+
+One-shot ``generate()`` decodes a whole batch in lockstep: every request
+shares sampling params, and nothing can join or leave mid-flight. The
+serve stack replaces the batch lifecycle with a slot lifecycle:
+
+- ``slots``: a fixed-capacity KV slot pool — pooled per-layer caches
+  ``[B_max, H, L_max, D]`` with per-slot positions, host-side alloc/free,
+  prefill writes into a slot's rows via ``dynamic_update_slice``.
+- ``sampling``: per-row temperature / top-k / top-p as traced arrays, so
+  one compiled program serves every mix of requests (top-k masks by
+  per-row k under a static ``k_max`` cap — ``lax.top_k``'s k is static).
+- ``engine``: exactly two jitted programs, reused forever — prefill (one
+  request into one slot) and the batched single-token decode step over
+  all ``B_max`` rows (active-row mask, per-row traced positions). Both
+  route through the runtime ``CompileCache``, so the two-program steady
+  state is provable from the ``compile_cache.*`` obs counters.
+- ``scheduler``: bounded FIFO admission with backpressure, per-request
+  deadlines, and the iteration loop (admit -> decode one token for all
+  active rows -> retire on EOS / max-new-tokens / deadline, freeing
+  slots for waiters). Fully instrumented through ``nezha_tpu.obs``
+  (serve.ttft_s / serve.tpot_s histograms, queue-depth and
+  batch-occupancy gauges, admitted/rejected/retired counters).
+
+``nezha-serve`` (cli/serve.py) fronts the scheduler with stdio-JSONL and
+stdlib-http modes; ``benchmarks/serving.py`` load-tests it into the same
+run-dir telemetry artifacts training writes.
+"""
+
+from nezha_tpu.serve.engine import Engine, ServeConfig
+from nezha_tpu.serve.sampling import sample_tokens
+from nezha_tpu.serve.scheduler import (
+    FinishReason,
+    QueueFull,
+    Request,
+    RequestResult,
+    Scheduler,
+)
+from nezha_tpu.serve.slots import SlotPool
+
+__all__ = [
+    "Engine", "ServeConfig", "SlotPool", "sample_tokens",
+    "Scheduler", "Request", "RequestResult", "QueueFull", "FinishReason",
+]

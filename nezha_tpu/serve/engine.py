@@ -1,0 +1,236 @@
+"""The continuous-batching engine: two compiled programs, reused forever.
+
+Steady-state serving is exactly TWO XLA programs regardless of request
+mix — the property that keeps TPU serving latency flat:
+
+- **prefill** — one request's prompt (padded to the static
+  ``max_prefill_len``) runs through the model against a scratch cache,
+  and its K/V rows, position, PRNG key, and sampling params are written
+  into one SLOT of the pooled batch state via ``dynamic_update_slice``.
+  Pad positions beyond the prompt write garbage K/V that is never
+  attended (the decode mask stops at ``pos``, and every position below
+  ``pos`` is rewritten by a decode step before the mask reaches it).
+- **step** — one batched single-token decode over all ``B_max`` rows:
+  sample per row from the carried last-logits (per-row traced
+  temperature / top-k / top-p — serve/sampling.py), forward through the
+  model with PER-ROW cache positions (models/gpt2.py per-row pos path),
+  advance active rows. Inactive rows compute garbage that is masked out
+  host-side; their state is frozen by ``where(active, ...)``.
+
+Both programs route through the runtime ``Executor`` (compile-cache
+keyed on function identity + full arg shape signature), so the
+two-program claim is enforced by the ``compile_cache.*`` obs counters:
+a shape drift would show up as a third miss, and tests pin it.
+
+All per-request scalars cross into the programs as 0-d ARRAYS, never
+Python numbers — the executor's signature (and jax.jit's) would
+otherwise key on the literal value and recompile per request.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from nezha_tpu.models.generate import _caches_from_states
+from nezha_tpu.runtime.executor import Executor
+from nezha_tpu.serve.sampling import sample_tokens
+from nezha_tpu.serve.slots import SlotPool, write_slot
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Static serving shapes — everything a compiled program is keyed on.
+
+    ``max_batch_size`` is the slot count (rows decoded per step),
+    ``max_len`` the per-slot KV capacity (prompt + generated),
+    ``max_prefill_len`` the static prompt pad width (prompts longer than
+    this are rejected at admission), ``k_max`` the static top-k cap
+    per-row ks are clamped to. ``queue_capacity`` bounds the scheduler's
+    FIFO (backpressure); ``pad_id`` is the token fed for inactive rows.
+    """
+
+    max_batch_size: int = 4
+    max_len: int = 128
+    max_prefill_len: int = 32
+    k_max: int = 64
+    queue_capacity: int = 16
+    pad_id: int = 0
+    cache_dtype: Any = jnp.bfloat16
+
+    def __post_init__(self):
+        if self.max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        if not 1 <= self.max_prefill_len <= self.max_len:
+            raise ValueError(
+                f"need 1 <= max_prefill_len <= max_len, got "
+                f"{self.max_prefill_len} / {self.max_len}")
+        if self.k_max < 1:
+            raise ValueError("k_max must be >= 1")
+        if self.queue_capacity < 1:
+            raise ValueError("queue_capacity must be >= 1")
+
+
+class Engine:
+    """Device-side serving state + the two compiled programs.
+
+    The engine is deliberately request-blind: it knows slots, not
+    requests. Admission policy, deadlines, retirement, and telemetry
+    live in the scheduler; the engine's contract is ``prefill(slot, ...)``
+    to load one slot and ``step(active)`` to decode one token for every
+    row and hand the batch back to the host.
+    """
+
+    def __init__(self, model, variables, cfg: ServeConfig = ServeConfig()):
+        if cfg.max_len > model.cfg.max_positions:
+            raise ValueError(
+                f"max_len {cfg.max_len} exceeds the model's max_positions "
+                f"{model.cfg.max_positions}")
+        self.model = model
+        self.variables = variables
+        self.cfg = cfg
+        self.vocab = model.cfg.vocab_size
+        self.k_max = min(cfg.k_max, self.vocab)
+        self.pool = SlotPool(model, cfg.max_batch_size, cfg.max_len,
+                             cfg.cache_dtype)
+        b = cfg.max_batch_size
+        self.last_logits = jnp.zeros((b, self.vocab), jnp.float32)
+        self.positions = jnp.zeros((b,), jnp.int32)
+        self.keys = jnp.zeros((b, 2), jnp.uint32)
+        self.temps = jnp.zeros((b,), jnp.float32)
+        self.top_ks = jnp.zeros((b,), jnp.int32)
+        self.top_ps = jnp.ones((b,), jnp.float32)
+        # Donate the pooled caches (positional arg 1 in BOTH programs):
+        # without donation every decoded token would copy the whole
+        # [B_max, H, L_max, D] K/V pool per layer just to write one row —
+        # double the KV memory and a full-pool bandwidth tax on the
+        # latency-bound loop. The engine rebinds the returned buffers
+        # immediately, so the invalidated inputs are never reused.
+        self.executor = Executor(donate_argnums=(1,))
+        self._prefill_fn = _build_prefill(model, cfg)
+        self._step_fn = _build_step(model, self.k_max, cfg.pad_id)
+
+    # -------------------------------------------------------- host API
+    def prefill(self, slot: int, tokens: Sequence[int], *, seed: int = 0,
+                temperature: float = 0.0, top_k: Optional[int] = None,
+                top_p: Optional[float] = None) -> None:
+        """Load one request into ``slot``: prompt K/V, position, PRNG
+        key, and sampling params. ``tokens`` must fit
+        ``max_prefill_len``; the first generated token comes from the
+        next :meth:`step`."""
+        n = len(tokens)
+        p_max = self.cfg.max_prefill_len
+        if not 1 <= n <= p_max:
+            raise ValueError(
+                f"prompt length {n} not in [1, max_prefill_len={p_max}]")
+        padded = np.zeros((1, p_max), np.int32)
+        padded[0, :n] = np.asarray(tokens, np.int32)
+        if padded.max() >= self.vocab or padded.min() < 0:
+            raise ValueError(f"prompt ids must be in [0, {self.vocab})")
+        out = self.executor.run(
+            self._prefill_fn, self.variables, self.pool.caches,
+            jnp.asarray(padded),
+            np.int32(n), np.int32(slot), np.int32(seed),
+            np.float32(temperature),
+            np.int32(0 if top_k is None else top_k),
+            np.float32(1.0 if top_p is None else top_p),
+            self.last_logits, self.positions, self.keys,
+            self.temps, self.top_ks, self.top_ps)
+        (self.pool.caches, self.last_logits, self.positions, self.keys,
+         self.temps, self.top_ks, self.top_ps) = out
+
+    def step(self, active: np.ndarray) -> np.ndarray:
+        """Decode one token for every row; ``active`` is a ``[B_max]``
+        bool mask. Returns the sampled tokens as a host array — entries
+        for inactive rows are garbage and must be ignored."""
+        tok, caches, last, pos, keys = self.executor.run(
+            self._step_fn, self.variables, self.pool.caches,
+            self.last_logits, self.positions,
+            jnp.asarray(active, bool), self.keys,
+            self.temps, self.top_ks, self.top_ps)
+        self.pool.caches = caches
+        self.last_logits, self.positions, self.keys = last, pos, keys
+        return np.asarray(tok)
+
+    def compile_stats(self) -> dict:
+        """Executor cache stats — steady state is ``entries == 2``
+        (prefill + step), misses frozen at 2 while hits grow."""
+        return self.executor.stats()
+
+
+def _scratch_cache(model, p_max: int, dtype) -> List[dict]:
+    cfg = model.cfg
+    d = cfg.hidden_size // cfg.num_heads
+    shape = (1, cfg.num_heads, p_max, d)
+    return [{"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+            for _ in range(cfg.num_layers)]
+
+
+def _build_prefill(model, cfg: ServeConfig):
+    p_max = cfg.max_prefill_len
+
+    def prefill(variables, caches, tokens, length, slot, seed,
+                temperature, top_k, top_p,
+                last_logits, positions, keys, temps, top_ks, top_ps):
+        # The prompt runs against a scratch cache at STATIC pos=0 (the
+        # flash-prefill fast path on TPU), then its K/V rows land in the
+        # pooled slot. tokens is [1, p_max]; rows past `length` are pad.
+        scratch = _scratch_cache(model, p_max, caches[0]["k"].dtype)
+        logits, states = model.apply(variables, tokens, training=False,
+                                     cache=scratch, pos=0, prefill=True)
+        chunk = _caches_from_states(model, states, scratch)
+        new_caches = [
+            {"k": write_slot(pool["k"], ck["k"], slot),
+             "v": write_slot(pool["v"], ck["v"], slot)}
+            for pool, ck in zip(caches, chunk)]
+        row = lax.dynamic_slice(
+            logits, (0, length - 1, jnp.zeros((), jnp.int32)),
+            (1, 1, logits.shape[-1]))[:, 0, :]          # [1, V] last REAL row
+        key = jax.random.PRNGKey(seed).astype(keys.dtype)
+
+        def set_row(buf, val):
+            return lax.dynamic_update_slice(
+                buf, jnp.asarray(val, buf.dtype).reshape(
+                    (1,) + buf.shape[1:]),
+                (slot,) + (jnp.zeros((), jnp.int32),) * (buf.ndim - 1))
+
+        return (new_caches,
+                set_row(last_logits, row),
+                set_row(positions, length),
+                set_row(keys, key),
+                set_row(temps, temperature),
+                set_row(top_ks, top_k),
+                set_row(top_ps, top_p))
+
+    return prefill
+
+
+def _build_step(model, k_max: int, pad_id: int):
+    def step(variables, caches, last_logits, positions, active, keys,
+             temps, top_ks, top_ps):
+        # One key split per row per step: a request's RNG stream depends
+        # only on its seed and step count, never on its batch neighbors.
+        splits = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+        next_keys, subs = splits[:, 0], splits[:, 1]
+        tok = sample_tokens(last_logits, subs, temps, top_ks, top_ps,
+                            k_max)
+        tok = jnp.where(active, tok, pad_id)
+        logits, states = model.apply(variables, tok[:, None],
+                                     training=False, cache=caches,
+                                     pos=positions)
+        new_caches = _caches_from_states(model, states, caches)
+        row_logits = logits[:, -1, :]
+        act = active[:, None]
+        return (tok,
+                new_caches,
+                jnp.where(act, row_logits, last_logits),
+                jnp.where(active, positions + 1, positions),
+                jnp.where(act, next_keys, keys))
+
+    return step

@@ -1,0 +1,64 @@
+"""Per-row sampling for the batched decode step.
+
+``models/generate._sample`` keys its compiled program on PYTHON-level
+sampling params — fine for one-shot batch decode, fatal for serving,
+where every recompile stalls the whole batch. Here temperature / top-k /
+top-p arrive as TRACED ``[B]`` arrays, so one compiled step serves every
+mix of requests:
+
+- temperature ``<= 0`` selects greedy argmax for that row (no RNG
+  consumed — a greedy row's tokens are bit-identical whatever its batch
+  neighbors sample);
+- top-k cannot be traced through ``lax.top_k`` (its k is static), so the
+  step always extracts the static ``k_max`` largest logits (config cap)
+  and masks by PER-ROW k via rank comparison against the row's k-th
+  value; ``top_k <= 0`` disables truncation for the row, and per-row k is
+  clamped to ``[1, k_max]``;
+- top-p is the same exclusive-cumsum nucleus as ``_sample`` with p
+  broadcast per row (``p >= 1`` keeps everything, ``p <= 0`` degrades to
+  argmax via the rank-0 term — never an empty nucleus);
+- rows draw from their OWN PRNG key (vmapped categorical), so sampling
+  rows are also isolated: a request's token sequence depends only on its
+  seed and its step count, never on who shares the batch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def sample_tokens(logits, keys, temperature, top_k, top_p,
+                  k_max: int) -> jax.Array:
+    """logits ``[B, V]``, keys ``[B, 2]`` (one PRNG key per row),
+    temperature/top_p ``[B]`` float, top_k ``[B]`` int (``<= 0`` = off),
+    ``k_max`` static int (``1 <= k_max <= V``) -> token ids ``[B]``.
+    """
+    b, v = logits.shape
+    if not 1 <= k_max <= v:
+        raise ValueError(f"k_max must be in [1, {v}], got {k_max}")
+    greedy = temperature <= 0.0
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+
+    # Per-row top-k under the static cap: the k_max'th-largest values are
+    # computed once; each row thresholds at its own (clamped) k-th value.
+    kth_vals = lax.top_k(scaled, k_max)[0]                    # [B, k_max]
+    k_eff = jnp.clip(top_k, 1, k_max)
+    kth = jnp.take_along_axis(kth_vals, (k_eff - 1)[:, None], axis=1)
+    apply_k = (top_k > 0)[:, None]
+    scaled = jnp.where(apply_k & (scaled < kth), -jnp.inf, scaled)
+
+    # Per-row nucleus (same construction as generate._sample, p per row).
+    sorted_logits = jnp.flip(jnp.sort(scaled, axis=-1), axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    exclusive_cum = jnp.cumsum(probs, axis=-1) - probs
+    rank = lax.broadcasted_iota(jnp.int32, sorted_logits.shape, 1)
+    keep = (exclusive_cum < top_p[:, None]) | (rank == 0)
+    threshold = jnp.min(
+        jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+    scaled = jnp.where(scaled < threshold, -jnp.inf, scaled)
+
+    sampled = jax.vmap(jax.random.categorical)(keys, scaled)
+    return jnp.where(greedy, jnp.argmax(logits, axis=-1),
+                     sampled).astype(jnp.int32)

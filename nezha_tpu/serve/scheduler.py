@@ -1,0 +1,283 @@
+"""Admission, retirement, and the serving iteration loop.
+
+The scheduler owns everything request-shaped: a BOUNDED FIFO admission
+queue (submit past capacity fails fast — backpressure, not unbounded
+memory), per-request deadlines, and the continuous-batching iteration:
+
+    admit waiters into free slots -> decode one token for all active
+    rows -> retire rows on EOS / max-new-tokens / deadline -> admit
+    again (a slot freed by retirement is refilled in the SAME iteration,
+    so capacity never idles while work is queued).
+
+Telemetry flows through ``nezha_tpu.obs`` at the serving layer's
+metrics of record: ``serve.ttft_s`` (submit -> first token) and
+``serve.tpot_s`` (per decoded token) histograms, ``serve.queue_depth``
+and ``serve.batch_occupancy`` gauges, and
+``serve.{admitted,rejected,expired,retired,tokens}_total`` counters —
+the names tools/check_telemetry_schema.py pins. With no run active
+every call site is the registry's branch-only no-op.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import threading
+import time
+from typing import Callable, Deque, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from nezha_tpu import obs
+from nezha_tpu.serve.engine import Engine
+
+
+class QueueFull(Exception):
+    """Admission queue at capacity — the backpressure signal. Callers
+    should shed load or retry later (HTTP mode maps this to 503)."""
+
+
+class FinishReason:
+    EOS = "eos"
+    LENGTH = "length"          # max_new_tokens reached
+    DEADLINE = "deadline"      # expired (queued or mid-decode)
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. ``deadline_s`` is a wall-clock budget in
+    seconds from submit; expired requests are retired with whatever
+    tokens they have (possibly none, if still queued)."""
+
+    prompt: Sequence[int]
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    eos_id: Optional[int] = None
+    seed: int = 0
+    deadline_s: Optional[float] = None
+    request_id: Optional[str] = None
+
+
+@dataclasses.dataclass
+class RequestResult:
+    request_id: str
+    tokens: List[int]
+    finish_reason: str
+    ttft_s: Optional[float]    # None when expired before the first token
+    latency_s: float
+
+
+@dataclasses.dataclass
+class _Live:
+    """Host bookkeeping for one occupied slot."""
+
+    req: Request
+    request_id: str
+    submit_t: float
+    deadline_t: Optional[float]
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    ttft_s: Optional[float] = None
+
+
+def register_serve_instruments() -> None:
+    """Pre-register (get-or-create) the full serving instrument set so
+    every serving run's summary carries it — a run with zero rejections
+    still reports ``rejected_total = 0`` (the stable schema
+    tools/check_telemetry_schema.py pins). Called at scheduler
+    construction; call again after a registry reset (e.g. a benchmark
+    that starts its run AFTER warmup)."""
+    for c in ("admitted", "rejected", "expired", "retired", "tokens"):
+        obs.counter(f"serve.{c}_total")
+    obs.gauge("serve.queue_depth")
+    obs.gauge("serve.batch_occupancy")
+    obs.histogram("serve.ttft_s")
+    obs.histogram("serve.tpot_s")
+
+
+class Scheduler:
+    """Bounded-FIFO continuous-batching scheduler over an :class:`Engine`.
+
+    ``on_token(request_id, token)`` streams each decoded token;
+    ``on_finish(result)`` fires at retirement. Both run on the thread
+    driving :meth:`step`. ``submit`` is thread-safe (HTTP handlers call
+    it concurrently with the decode loop).
+    """
+
+    def __init__(self, engine: Engine,
+                 on_token: Optional[Callable[[str, int], None]] = None,
+                 on_finish: Optional[Callable[[RequestResult], None]] = None):
+        self.engine = engine
+        self.on_token = on_token
+        self.on_finish = on_finish
+        self.queue_capacity = engine.cfg.queue_capacity
+        self._queue: Deque[_Live] = collections.deque()
+        self._live: Dict[int, _Live] = {}          # slot -> request state
+        self._lock = threading.RLock()
+        self._ids = itertools.count()
+        self.results: Dict[str, RequestResult] = {}
+        register_serve_instruments()
+
+    # ------------------------------------------------------- admission
+    def submit(self, req: Request) -> str:
+        """Enqueue; returns the request id. Raises :class:`QueueFull`
+        past capacity and ``ValueError`` for requests that can never be
+        served (prompt too long for the static prefill width, or
+        prompt + max_new_tokens past the slot's KV capacity)."""
+        cfg = self.engine.cfg
+        n = len(req.prompt)
+        if not 1 <= n <= cfg.max_prefill_len:
+            raise ValueError(
+                f"prompt length {n} not in [1, max_prefill_len="
+                f"{cfg.max_prefill_len}]")
+        if req.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if n + req.max_new_tokens > cfg.max_len:
+            raise ValueError(
+                f"prompt ({n}) + max_new_tokens ({req.max_new_tokens}) "
+                f"exceeds max_len {cfg.max_len}")
+        vocab = self.engine.vocab
+        if not all(0 <= t < vocab for t in req.prompt):
+            # Validate HERE, not in prefill: a bad id surfacing inside
+            # step() would kill the decode loop with other requests in
+            # flight instead of bouncing this submit.
+            raise ValueError(f"prompt ids must be in [0, {vocab})")
+        with self._lock:
+            if len(self._queue) >= self.queue_capacity:
+                obs.counter("serve.rejected_total").inc()
+                raise QueueFull(
+                    f"admission queue at capacity {self.queue_capacity}")
+            rid = req.request_id or f"req-{next(self._ids)}"
+            now = time.monotonic()
+            self._queue.append(_Live(
+                req=req, request_id=rid, submit_t=now,
+                deadline_t=None if req.deadline_s is None
+                else now + req.deadline_s))
+            obs.gauge("serve.queue_depth").set(len(self._queue))
+        return rid
+
+    # ------------------------------------------------------- iteration
+    def step(self) -> int:
+        """One serving iteration. Returns the number of tokens decoded
+        (0 when fully idle)."""
+        with self._lock:
+            self._expire_queued()
+            self._admit()
+            emitted = self._decode() if self._live else 0
+            self._admit()          # refill slots freed by retirement
+            obs.gauge("serve.queue_depth").set(len(self._queue))
+            obs.gauge("serve.batch_occupancy").set(
+                self.engine.pool.occupancy)
+            return emitted
+
+    def run_until_idle(self, max_iters: Optional[int] = None) -> int:
+        """Drive :meth:`step` until queue and slots are empty; returns
+        the iteration count."""
+        iters = 0
+        while self.has_work():
+            self.step()
+            iters += 1
+            if max_iters is not None and iters >= max_iters:
+                break
+        return iters
+
+    def has_work(self) -> bool:
+        with self._lock:
+            return bool(self._queue or self._live)
+
+    @property
+    def queue_depth(self) -> int:
+        """Current admission-queue length. Pacing clients (the stdio
+        reader, closed-loop benchmarks) should wait for room here
+        instead of hammering submit() — every QueueFull counts into
+        ``serve.rejected_total``, which must mean SHED REQUESTS, not
+        retry polls."""
+        with self._lock:
+            return len(self._queue)
+
+    # -------------------------------------------------------- internals
+    def _expire_queued(self) -> None:
+        now = time.monotonic()
+        kept: Deque[_Live] = collections.deque()
+        for live in self._queue:
+            if live.deadline_t is not None and now >= live.deadline_t:
+                obs.counter("serve.expired_total").inc()
+                self._finish(live, FinishReason.DEADLINE)
+            else:
+                kept.append(live)
+        self._queue = kept
+
+    def _admit(self) -> None:
+        pool = self.engine.pool
+        while self._queue and pool.num_free:
+            live = self._queue.popleft()
+            slot = pool.alloc()
+            req = live.req
+            try:
+                with obs.span("serve.prefill", request_id=live.request_id,
+                              prompt_len=len(req.prompt)):
+                    self.engine.prefill(
+                        slot, req.prompt, seed=req.seed,
+                        temperature=req.temperature, top_k=req.top_k,
+                        top_p=req.top_p)
+            except Exception:   # submit() pre-validates; never leak a slot
+                pool.free(slot)
+                raise
+            self._live[slot] = live
+            obs.counter("serve.admitted_total").inc()
+
+    def _decode(self) -> int:
+        active = np.zeros((self.engine.cfg.max_batch_size,), bool)
+        for slot in self._live:
+            active[slot] = True
+        # Occupancy OF THIS DECODE, folded into the metric.* histogram
+        # the report renders percentiles from (the same name a
+        # record_metrics stream would fold into) — the gauge alone only
+        # keeps the final value, which is 0 for any drained server.
+        obs.histogram("metric.batch_occupancy").observe(
+            len(self._live) / self.engine.cfg.max_batch_size)
+        t0 = time.monotonic()
+        tokens = self.engine.step(active)
+        dt = time.monotonic() - t0
+        now = time.monotonic()
+        emitted = 0
+        for slot in list(self._live):
+            live = self._live[slot]
+            tok = int(tokens[slot])
+            live.tokens.append(tok)
+            emitted += 1
+            if live.ttft_s is None:
+                live.ttft_s = now - live.submit_t
+                obs.histogram("serve.ttft_s").observe(live.ttft_s)
+            obs.histogram("serve.tpot_s").observe(dt)
+            if self.on_token is not None:
+                self.on_token(live.request_id, tok)
+            reason = None
+            if live.req.eos_id is not None and tok == live.req.eos_id:
+                reason = FinishReason.EOS
+            elif len(live.tokens) >= live.req.max_new_tokens:
+                reason = FinishReason.LENGTH
+            elif live.deadline_t is not None and now >= live.deadline_t:
+                reason = FinishReason.DEADLINE
+            if reason is not None:
+                del self._live[slot]
+                self.engine.pool.free(slot)
+                obs.counter("serve.retired_total").inc()
+                if reason == FinishReason.DEADLINE:
+                    # expired_total counts EVERY deadline miss, queued
+                    # or mid-decode (FinishReason's documented contract).
+                    obs.counter("serve.expired_total").inc()
+                self._finish(live, reason)
+        obs.counter("serve.tokens_total").inc(emitted)
+        return emitted
+
+    def _finish(self, live: _Live, reason: str) -> None:
+        result = RequestResult(
+            request_id=live.request_id, tokens=live.tokens,
+            finish_reason=reason, ttft_s=live.ttft_s,
+            latency_s=time.monotonic() - live.submit_t)
+        self.results[live.request_id] = result
+        if self.on_finish is not None:
+            self.on_finish(result)

@@ -1,0 +1,90 @@
+"""Fixed-capacity KV slot pool.
+
+The pool owns the serving layer's only large buffers: per-layer K/V
+caches shaped ``[B_max, H, L_max, D]`` (the same layout
+``models/generate.init_cache`` builds, with the batch dim reinterpreted
+as SLOTS). A slot is one in-flight request's cache rows; slots are
+allocated host-side (plain free list — allocation must not touch the
+device) and their contents are written device-side:
+
+- prefill writes a request's prompt K/V into its slot's rows via
+  ``lax.dynamic_update_slice`` at ``(slot, 0, 0, 0)`` (engine.py builds
+  the jitted program; :func:`write_slot` is the update it uses),
+- decode steps append one position per ACTIVE row via the model's
+  per-row-position cache path (models/gpt2.py).
+
+Freeing a slot is bookkeeping only — stale K/V stays in the buffers.
+That is safe by construction: a new occupant's prefill overwrites rows
+``[0, P_max)``, and its decode mask only ever attends positions
+``<= pos``, each of which the request itself has written first (prefill
+pads beyond the prompt are likewise never attended: the first decode
+write lands at ``pos = prompt_len`` before the mask reaches it).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+
+class SlotPool:
+    """Host-side slot bookkeeping + the pooled device cache buffers.
+
+    ``caches`` is the per-layer list of ``{"k", "v"}`` dicts the model's
+    cache path consumes. The pool hands out slot INDICES; the engine
+    threads the cache pytree through its jitted programs (functional
+    updates — the pool re-binds ``caches`` to each program's output).
+    """
+
+    def __init__(self, model, capacity: int, max_len: int,
+                 dtype=jnp.bfloat16):
+        from nezha_tpu.models.generate import init_cache
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if max_len < 1:
+            raise ValueError(f"max_len must be >= 1, got {max_len}")
+        self.capacity = capacity
+        self.max_len = max_len
+        self.dtype = dtype
+        self.caches = init_cache(model, capacity, max_len, dtype)
+        # LIFO free list: the most-recently-freed slot is re-used first,
+        # keeping the active rows clustered low (cheap occupancy reads).
+        self._free: List[int] = list(range(capacity - 1, -1, -1))
+
+    # ----------------------------------------------------------- alloc
+    def alloc(self) -> Optional[int]:
+        """-> a free slot index, or None when the pool is fully occupied."""
+        return self._free.pop() if self._free else None
+
+    def free(self, slot: int) -> None:
+        if not 0 <= slot < self.capacity:
+            raise ValueError(f"slot {slot} out of range [0, {self.capacity})")
+        if slot in self._free:
+            raise ValueError(f"slot {slot} is already free (double free)")
+        self._free.append(slot)
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_active(self) -> int:
+        return self.capacity - len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        """Active fraction in [0, 1] — the batch-occupancy gauge value."""
+        return self.num_active / self.capacity
+
+
+def write_slot(pool_leaf, chunk_leaf, slot):
+    """Write one request's prefill rows into a slot of a pooled cache
+    leaf: ``pool_leaf [B_max, H, L_max, D]``, ``chunk_leaf [1, H, P, D]``
+    (P <= L_max), ``slot`` a traced int32 scalar. Pure — returns the
+    updated leaf; call under jit (engine prefill program)."""
+    zero = jnp.zeros((), jnp.int32)
+    return lax.dynamic_update_slice(
+        pool_leaf, chunk_leaf.astype(pool_leaf.dtype),
+        (slot, zero, zero, zero))

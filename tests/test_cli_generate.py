@@ -331,3 +331,28 @@ def test_export_bert_scan_layers_checkpoint(tmp_path, devices8):
     z = np.load(tmp_path / "hf.npz")
     assert any("layer.1." in k or "layers.1." in k for k in z.files), \
         list(z.files)[:6]
+
+
+def test_generate_num_samples_and_eos_flags(tmp_path):
+    """--num-samples batches N continuations of one prompt; greedy
+    requires N=1; bad --top-k / --eos-id reject with clear errors."""
+    out = _gen(["--random-init", "--model-preset", "tiny",
+                "--prompt-tokens", "5,17", "--max-new-tokens", "3",
+                "--temperature", "0.9", "--num-samples", "2",
+                "--seed", "1"])
+    assert out["num_samples"] == 2 and len(out["samples"]) == 2
+    assert out["samples"][0]["tokens"] == out["tokens"]
+    assert all(len(s["tokens"]) == 3 for s in out["samples"])
+    with pytest.raises(SystemExit, match="num-samples"):
+        _gen(["--random-init", "--model-preset", "tiny",
+              "--prompt-tokens", "1", "--num-samples", "0"])
+    with pytest.raises(SystemExit, match="greedy"):
+        _gen(["--random-init", "--model-preset", "tiny",
+              "--prompt-tokens", "1", "--temperature", "0",
+              "--num-samples", "2"])
+    with pytest.raises(SystemExit, match=r"top-k must be in \[1, 512\]"):
+        _gen(["--random-init", "--model-preset", "tiny",
+              "--prompt-tokens", "1", "--top-k", "0"])
+    with pytest.raises(SystemExit, match="eos-id"):
+        _gen(["--random-init", "--model-preset", "tiny",
+              "--prompt-tokens", "1", "--eos-id", "9999"])

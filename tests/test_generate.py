@@ -178,3 +178,41 @@ def test_prefill_nonzero_pos_falls_back_to_masked(model_and_vars):
                            cache=warm, pos=4, prefill=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=1e-5, rtol=1e-5)
+
+
+def test_generate_eos_early_stop(model_and_vars):
+    """Rows that emit eos_id keep decoding (static shapes) but their
+    later tokens are masked to the pad (default: eos itself); other rows
+    are bit-identical to the no-eos run."""
+    model, variables = model_and_vars
+    prompt = np.array([[5, 17, 3, 42], [7, 7, 23, 1]], np.int32)
+    kw = dict(max_new_tokens=10, temperature=0.8, top_k=20,
+              cache_dtype=jnp.float32, rng=jax.random.PRNGKey(5))
+    base = np.asarray(generate(model, variables, prompt, **kw))[:, 4:]
+    # Plant row 0's first non-repeated token as EOS; row 1 untouched.
+    row = base[0].tolist()
+    stop = next(i for i in range(1, len(row)) if row[i] not in row[:i])
+    eos = row[stop]
+    out = np.asarray(generate(model, variables, prompt, **kw,
+                              eos_id=eos))[:, 4:]
+    assert out[0, :stop + 1].tolist() == row[:stop + 1]
+    assert all(t == eos for t in out[0, stop:].tolist())
+    np.testing.assert_array_equal(out[1], base[1])
+    # Explicit pad_id: tail pads with it instead of eos.
+    out2 = np.asarray(generate(model, variables, prompt, **kw,
+                               eos_id=eos, pad_id=0))[:, 4:]
+    assert out2[0, stop] == eos
+    assert all(t == 0 for t in out2[0, stop + 1:].tolist())
+
+
+def test_sample_top_k_clamped():
+    """_sample no longer reaches lax.top_k with k outside [1, vocab]."""
+    from nezha_tpu.models.generate import _sample
+    logits = jnp.asarray([[5.0, 4.0, 3.0, 2.0, 1.0]], jnp.float32)
+    for bad_k in (0, -3, 99):
+        tok = _sample(logits, jax.random.PRNGKey(0), 1.0, bad_k, None)
+        assert 0 <= int(tok[0]) < 5
+    # k<=0 clamps to 1 == argmax regardless of rng
+    for i in range(10):
+        assert int(_sample(logits, jax.random.PRNGKey(i), 1.0, 0,
+                           None)[0]) == 0

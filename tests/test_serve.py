@@ -1,0 +1,358 @@
+"""Serving stack: slot pool, per-row sampling, the two-program engine,
+scheduler edge cases (queue-full backpressure, EOS retirement + same-
+iteration admission, per-row isolation, deadlines), and the serving
+telemetry artifacts. Everything runs the tiny CPU GPT-2 from
+tests/test_generate.py's config — tier-1 budget is tight, and the
+engine's whole point is that programs compile twice and never again."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nezha_tpu.models.generate import generate
+from nezha_tpu.models.gpt2 import GPT2, GPT2Config
+from nezha_tpu.serve import (
+    Engine,
+    QueueFull,
+    Request,
+    Scheduler,
+    ServeConfig,
+    SlotPool,
+    sample_tokens,
+)
+
+CFG = dict(vocab_size=97, max_positions=64, num_layers=2, num_heads=4,
+           hidden_size=64)
+SCFG = ServeConfig(max_batch_size=3, max_len=48, max_prefill_len=8,
+                   k_max=16, queue_capacity=4, cache_dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def model_and_vars():
+    model = GPT2(GPT2Config(**CFG))
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def engine(model_and_vars):
+    """ONE engine for the whole module: its two programs compile once
+    and every test reuses them (the serving property under test)."""
+    model, variables = model_and_vars
+    return Engine(model, variables, SCFG)
+
+
+def _drain(sched, max_iters=200):
+    iters = sched.run_until_idle(max_iters=max_iters)
+    assert not sched.has_work(), "scheduler did not drain"
+    return iters
+
+
+# ------------------------------------------------------------- slot pool
+def test_slot_pool_alloc_free(model_and_vars):
+    model, _ = model_and_vars
+    pool = SlotPool(model, capacity=2, max_len=8, dtype=jnp.float32)
+    a, b = pool.alloc(), pool.alloc()
+    assert {a, b} == {0, 1} and pool.alloc() is None
+    assert pool.num_active == 2 and pool.occupancy == 1.0
+    pool.free(a)
+    assert pool.num_free == 1 and pool.alloc() == a
+    with pytest.raises(ValueError, match="double free"):
+        pool.free(b)
+        pool.free(b)
+    with pytest.raises(ValueError, match="out of range"):
+        pool.free(7)
+    assert pool.caches[0]["k"].shape == (2, CFG["num_heads"], 8,
+                                         CFG["hidden_size"]
+                                         // CFG["num_heads"])
+
+
+# ------------------------------------------------------ per-row sampling
+def test_sample_tokens_per_row_params():
+    logits = jnp.asarray([[5.0, 4.0, 3.0, 2.0, 1.0]] * 4, jnp.float32)
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(4, dtype=jnp.uint32))
+    # row 0 greedy, row 1 top-k=1 (forced argmax), row 2 nucleus p->0
+    # (degrades to argmax), row 3 unconstrained sampling.
+    for seed in range(10):
+        keys = jax.vmap(jax.random.PRNGKey)(
+            jnp.arange(4, dtype=jnp.uint32) + seed * 7)
+        tok = np.asarray(sample_tokens(
+            logits, keys,
+            temperature=jnp.asarray([0.0, 1.0, 1.0, 1.0]),
+            top_k=jnp.asarray([0, 1, 0, 0], jnp.int32),
+            top_p=jnp.asarray([1.0, 1.0, 1e-6, 1.0]),
+            k_max=4))
+        assert tok[0] == 0 and tok[1] == 0 and tok[2] == 0
+        assert 0 <= tok[3] < 5
+
+    # per-row k under the static cap: k=2 rows never leave the top-2 set
+    # even when a batch neighbor samples the full vocab.
+    seen = set()
+    for seed in range(50):
+        keys = jax.vmap(jax.random.PRNGKey)(
+            jnp.arange(2, dtype=jnp.uint32) + seed * 13)
+        tok = np.asarray(sample_tokens(
+            jnp.asarray([[1.0, 2.0, 3.0, 2.5, 0.0]] * 2, jnp.float32),
+            keys, temperature=jnp.asarray([2.0, 2.0]),
+            top_k=jnp.asarray([2, 0], jnp.int32),
+            top_p=jnp.asarray([1.0, 1.0]), k_max=4))
+        seen.add(int(tok[0]))
+    assert seen <= {2, 3}, seen  # the two largest logits
+
+    with pytest.raises(ValueError, match="k_max"):
+        sample_tokens(logits, keys[:4], jnp.zeros(4),
+                      jnp.zeros(4, jnp.int32), jnp.ones(4), k_max=99)
+
+
+# ------------------------------------------------------- scheduler edges
+def test_queue_full_rejection(engine):
+    sched = Scheduler(engine)
+    for _ in range(SCFG.queue_capacity):
+        sched.submit(Request(prompt=[1, 2], max_new_tokens=2))
+    with pytest.raises(QueueFull):
+        sched.submit(Request(prompt=[1, 2], max_new_tokens=2))
+    _drain(sched)
+
+    with pytest.raises(ValueError, match="max_prefill_len"):
+        sched.submit(Request(prompt=list(range(20)), max_new_tokens=2))
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        sched.submit(Request(prompt=[1, 2], max_new_tokens=100))
+
+
+def test_deadline_expiry_of_queued_request(engine):
+    sched = Scheduler(engine)
+    # Capacity 3: occupy every slot with long decodes, then queue one
+    # request with an already-hopeless deadline.
+    for i in range(SCFG.max_batch_size):
+        sched.submit(Request(prompt=[5, 17], max_new_tokens=12,
+                             request_id=f"long-{i}"))
+    rid = sched.submit(Request(prompt=[1, 2], max_new_tokens=4,
+                               deadline_s=0.0, request_id="doomed"))
+    sched.step()
+    res = sched.results[rid]
+    assert res.finish_reason == "deadline"
+    assert res.tokens == [] and res.ttft_s is None
+    _drain(sched)
+
+
+def test_eos_retirement_admits_waiter_same_iteration(engine):
+    # Learn a seed-deterministic SAMPLED continuation (greedy repeats one
+    # token on this random init), then plant its first fresh token as
+    # EOS — the request must retire right there on the replay.
+    probe_kw = dict(prompt=[5, 17, 3, 42], max_new_tokens=8,
+                    temperature=0.9, top_k=10, seed=7)
+    sched = Scheduler(engine)
+    probe = sched.submit(Request(**probe_kw))
+    _drain(sched)
+    seq = sched.results[probe].tokens
+    stop = next(i for i in range(1, len(seq)) if seq[i] not in seq[:i])
+    eos, ref = seq[stop], seq[:stop + 1]
+    # Fill all 3 slots; the EOS request retires first and must hand its
+    # slot to the queued waiter WITHIN the same scheduler iteration.
+    sched.submit(Request(prompt=[7, 7, 23], max_new_tokens=12,
+                         request_id="long-a"))
+    sched.submit(Request(prompt=[1, 2, 3], max_new_tokens=12,
+                         request_id="long-b"))
+    rid = sched.submit(Request(**probe_kw, eos_id=eos,
+                               request_id="eos-req"))
+    waiter = sched.submit(Request(prompt=[9, 9], max_new_tokens=2,
+                                  request_id="waiter"))
+    while rid not in sched.results:
+        assert sched.step() > 0
+        live_ids = {lv.request_id for lv in sched._live.values()}
+        if rid not in sched.results:
+            assert waiter not in live_ids  # no free slot before EOS
+    res = sched.results[rid]
+    assert res.finish_reason == "eos"
+    assert res.tokens == ref  # ends WITH the eos token
+    # Same iteration: the retiring step's trailing admit filled the slot.
+    live_ids = {lv.request_id for lv in sched._live.values()}
+    assert waiter in live_ids
+    assert engine.pool.num_active == 3
+    _drain(sched)
+
+
+def test_per_row_sampling_isolation(engine):
+    """A greedy request's tokens are bit-identical whether it runs alone
+    or next to a temperature-1.0 neighbor (per-row RNG keys, per-row
+    params: nothing leaks across slots)."""
+    sched = Scheduler(engine)
+    alone = sched.submit(Request(prompt=[5, 17, 3], max_new_tokens=10))
+    _drain(sched)
+    solo_tokens = sched.results[alone].tokens
+
+    paired = sched.submit(Request(prompt=[5, 17, 3], max_new_tokens=10))
+    sched.submit(Request(prompt=[8, 1, 4], max_new_tokens=10,
+                         temperature=1.0, seed=11))
+    sched.submit(Request(prompt=[2, 2], max_new_tokens=10,
+                         temperature=1.0, top_k=5, seed=23))
+    _drain(sched)
+    assert sched.results[paired].tokens == solo_tokens
+
+    # Sampling is seed-deterministic per request, also regardless of mix.
+    a = sched.submit(Request(prompt=[4, 4, 4], max_new_tokens=6,
+                             temperature=0.9, top_k=10, seed=7))
+    _drain(sched)
+    b = sched.submit(Request(prompt=[4, 4, 4], max_new_tokens=6,
+                             temperature=0.9, top_k=10, seed=7))
+    c = sched.submit(Request(prompt=[4, 4, 4], max_new_tokens=6,
+                             temperature=0.9, top_k=10, seed=8))
+    _drain(sched)
+    assert sched.results[a].tokens == sched.results[b].tokens
+    assert sched.results[b].tokens != sched.results[c].tokens
+
+
+# ----------------------------------------- e2e smoke + the two programs
+def test_serving_smoke_two_programs_and_artifacts(model_and_vars,
+                                                  tmp_path):
+    """The acceptance smoke: ≥3 concurrent requests with different
+    sampling params and lengths, a LATE request admitted while earlier
+    ones still decode (continuous batching observable via the occupancy
+    gauge), greedy rows matching one-shot generate() token-for-token —
+    and steady state compiles exactly TWO programs (prefill + step),
+    pinned through the obs compile-cache counters. The run dir must
+    pass the frozen serving schema and render a serving report."""
+    import os
+    import sys
+
+    from nezha_tpu import obs
+
+    model, variables = model_and_vars
+    run_dir = str(tmp_path / "run")
+    obs.start_run(run_dir, meta={"kind": "serve_test"})
+    try:
+        engine = Engine(model, variables, SCFG)  # fresh compile counters
+        sched = Scheduler(engine)
+        r1 = sched.submit(Request(prompt=[5, 17, 3, 42],
+                                  max_new_tokens=10))
+        r2 = sched.submit(Request(prompt=[7, 7, 23], max_new_tokens=5,
+                                  temperature=1.0, top_k=10, seed=3))
+        r3 = sched.submit(Request(prompt=[1, 2, 3, 4, 5],
+                                  max_new_tokens=7, temperature=0.8,
+                                  top_p=0.9, seed=9))
+        for _ in range(3):
+            sched.step()
+        # All three in flight, none finished: continuous batch is full.
+        assert engine.pool.num_active == 3
+        assert obs.gauge("serve.batch_occupancy").value == 1.0
+        # r2 (5 tokens) retires first; the LATE request then joins while
+        # r1/r3 are still decoding.
+        late = sched.submit(Request(prompt=[6, 5], max_new_tokens=4,
+                                    request_id="late"))
+        while r2 not in sched.results:
+            sched.step()
+        live = {lv.request_id for lv in sched._live.values()}
+        assert "late" in live and r1 not in sched.results
+        assert engine.pool.num_active == 3  # refilled, mid-flight
+        _drain(sched)
+
+        # Greedy row == one-shot generate, token for token.
+        ref = np.asarray(generate(
+            model, variables, np.asarray([[5, 17, 3, 42]], np.int32),
+            max_new_tokens=10, temperature=0.0,
+            cache_dtype=jnp.float32))[0, 4:]
+        assert sched.results[r1].tokens == ref.tolist()
+        assert len(sched.results[r3].tokens) == 7
+
+        # Exactly two compiled programs for the whole mixed-request run,
+        # by the engine's own cache AND the process-wide obs counters.
+        stats = engine.compile_stats()
+        assert stats == {"entries": 2,
+                         "hits": stats["hits"], "misses": 2}
+        assert stats["hits"] > 10
+        assert obs.counter("compile_cache.misses").value == 2
+        assert obs.counter("serve.admitted_total").value == 4
+        assert obs.counter("serve.retired_total").value == 4
+        assert obs.counter("serve.tokens_total").value == \
+            sum(len(sched.results[r].tokens) for r in (r1, r2, r3, "late"))
+        assert obs.histogram("serve.ttft_s").count == 4
+    finally:
+        obs.end_run()
+
+    # Frozen serving schema + report rendering.
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    from check_telemetry_schema import check_run_dir
+    assert check_run_dir(run_dir) == []
+    from nezha_tpu.obs.report import render_report
+    report = render_report(run_dir)
+    assert "serving:" in report and "ttft" in report and "tpot" in report
+    assert "4 admitted" in report
+
+    # The schema checker actually pins the serve names: dropping one
+    # histogram from the summary must fail.
+    with open(os.path.join(run_dir, "summary.json")) as f:
+        summary = json.load(f)
+    del summary["histograms"]["serve.ttft_s"]
+    with open(os.path.join(run_dir, "summary.json"), "w") as f:
+        json.dump(summary, f)
+    assert any("serve.ttft_s" in e for e in check_run_dir(run_dir))
+
+
+def test_engine_rejects_bad_shapes(model_and_vars):
+    model, variables = model_and_vars
+    with pytest.raises(ValueError, match="max_positions"):
+        Engine(model, variables, ServeConfig(max_len=1024))
+    with pytest.raises(ValueError, match="max_prefill_len"):
+        ServeConfig(max_len=8, max_prefill_len=16)
+
+
+def test_serving_benchmark_cli(tmp_path):
+    """benchmarks/serving.py drives the stack end to end and writes
+    schema-valid artifacts (the load-vs-latency record of the ISSUE)."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "benchmarks"))
+    import serving as bench
+
+    run_dir = str(tmp_path / "bench")
+    rec = bench.run(bench.build_parser().parse_args(
+        ["--requests", "6", "--concurrency", "2", "--prompt-len", "4",
+         "--max-new-tokens", "4", "--max-batch-size", "2",
+         "--max-len", "16", "--max-prefill-len", "8",
+         "--run-dir", run_dir]))
+    assert rec["finished"] == 6 and rec["tokens"] == 24
+    assert rec["compile_cache"]["misses"] == 2
+    assert rec["ttft_s"]["p50"] > 0 and rec["tokens_per_sec"] > 0
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    from check_telemetry_schema import check_run_dir
+    assert check_run_dir(run_dir) == []
+
+
+def test_nezha_serve_stdio_jsonl():
+    """The nezha-serve stdio front end: JSONL requests in (including a
+    bad line), streamed token + done events out, byte-level text."""
+    import io
+
+    from nezha_tpu.cli.serve import build_parser, run as serve_run
+
+    lines = "\n".join([
+        json.dumps({"id": "a", "prompt_tokens": [5, 17, 3, 42],
+                    "max_new_tokens": 5}),
+        json.dumps({"id": "b", "prompt": "hi", "max_new_tokens": 3,
+                    "temperature": 1.0, "top_k": 9, "seed": 4}),
+        "garbage line",
+        json.dumps({"id": "c", "prompt_tokens": [999]}),  # out of vocab
+    ]) + "\n"
+    stdout = io.StringIO()
+    args = build_parser().parse_args(
+        ["--random-init", "--model-preset", "tiny", "--max-batch-size",
+         "2", "--max-len", "32", "--max-prefill-len", "8",
+         "--platform", "cpu"])
+    assert serve_run(args, stdin=io.StringIO(lines), stdout=stdout) == 0
+    events = [json.loads(ln) for ln in stdout.getvalue().splitlines()]
+    done = {e["id"]: e for e in events if e["event"] == "done"}
+    errors = [e for e in events if e["event"] == "error"]
+    assert len(done["a"]["tokens"]) == 5
+    assert done["a"]["finish_reason"] == "length"
+    assert len(done["b"]["tokens"]) == 3
+    assert isinstance(done["b"]["text"], str)
+    assert len(errors) == 2
+    # token events streamed before each done, tagged per request
+    a_tokens = [e["token"] for e in events
+                if e["event"] == "token" and e["id"] == "a"]
+    assert a_tokens == done["a"]["tokens"]

@@ -33,6 +33,17 @@ _SUMMARY_KEYS = {"schema_version", "counters", "gauges", "histograms",
                  "collectives", "compile_cache", "num_spans",
                  "slowest_spans"}
 
+# Serving-run schema (nezha-serve / benchmarks/serving.py): the scheduler
+# pre-registers this full instrument set, so a summary that carries the
+# marker counter must carry ALL of them — dashboards key on the names
+# (ttft, tpot, queue_depth, batch_occupancy, rejected_total, ...).
+_SERVE_MARKER = "serve.admitted_total"
+_SERVE_COUNTERS = {"serve.admitted_total", "serve.rejected_total",
+                   "serve.expired_total", "serve.retired_total",
+                   "serve.tokens_total"}
+_SERVE_GAUGES = {"serve.queue_depth", "serve.batch_occupancy"}
+_SERVE_HISTOGRAMS = {"serve.ttft_s", "serve.tpot_s"}
+
 
 def _is_num(v) -> bool:
     return isinstance(v, (int, float)) and not isinstance(v, bool)
@@ -157,6 +168,27 @@ def check_summary_json(path: str, errors: List[str]) -> None:
             _check_span(rec, f"summary.json: slowest_spans[{j}]", errors)
     else:
         errors.append("summary.json: 'slowest_spans' must be a list")
+    _check_serving(summary, errors)
+
+
+def _check_serving(summary: dict, errors: List[str]) -> None:
+    """Serving-run summaries (marker: serve.admitted_total) must carry
+    the complete pinned serve instrument set."""
+    counters = summary.get("counters")
+    if not isinstance(counters, dict) or _SERVE_MARKER not in counters:
+        return
+    for name in sorted(_SERVE_COUNTERS - set(counters)):
+        errors.append(f"summary.json: serving run missing counter "
+                      f"{name!r}")
+    gauges = summary.get("gauges")
+    gauges = gauges if isinstance(gauges, dict) else {}
+    for name in sorted(_SERVE_GAUGES - set(gauges)):
+        errors.append(f"summary.json: serving run missing gauge {name!r}")
+    hists = summary.get("histograms")
+    hists = hists if isinstance(hists, dict) else {}
+    for name in sorted(_SERVE_HISTOGRAMS - set(hists)):
+        errors.append(f"summary.json: serving run missing histogram "
+                      f"{name!r}")
 
 
 def check_run_dir(run_dir: str) -> List[str]:
