@@ -1,0 +1,182 @@
+"""Flash-decode kernel vs composed masked attention, on the decode shape.
+
+The serving decode step computes attention for ONE query token per row
+against a pooled ``[B, H, L_max, D]`` KV cache. This bench measures that
+op in isolation — the Pallas split-K kernel
+(``ops.pallas.flash_decode_attention``, per-row lengths skip KV blocks)
+against the composed path the engine used before it (dense
+``dot_product_attention`` under a ``[B, 1, 1, L_max]`` ``-inf`` mask) —
+sweeping batch size, pool capacity, and per-row length SKEW: the skew
+sweep is the kernel's whole argument, because the dense path's cost is
+flat in the lengths while the kernel's is proportional to
+``sum(lengths)``.
+
+With ``--run-dir`` each configuration is recorded through the standard
+telemetry artifacts (one metrics.jsonl record per config), so runs can
+be diffed like any other capture. On non-TPU backends the kernel runs in
+INTERPRET mode — numerically the real kernel, wildly slower than
+compiled; the record carries ``backend``/``interpreted`` so nobody reads
+a CPU artifact as a perf claim (tier-1 runs it for correctness/coverage
+at tiny shapes).
+
+Usage::
+
+    python benchmarks/decode_attention.py --batch-sizes 4 --max-lens 128 \
+        --skews full,half,short,mixed --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+SKEWS = ("full", "half", "short", "mixed", "one_active")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch-sizes", default="4",
+                   help="comma-separated slot counts B")
+    p.add_argument("--max-lens", default="128",
+                   help="comma-separated KV pool capacities L_max")
+    p.add_argument("--num-heads", type=int, default=12)
+    p.add_argument("--head-dim", type=int, default=64)
+    p.add_argument("--skews", default="full,half,short,mixed",
+                   help=f"comma-separated per-row length patterns from "
+                        f"{SKEWS}: full = every row at L_max, half/short "
+                        f"= L_max/2 / L_max/8, mixed = linspace(1, "
+                        f"L_max), one_active = one full row + inactive "
+                        f"rest")
+    p.add_argument("--dtype", choices=["bf16", "f32"], default="bf16",
+                   help="cache dtype (q follows)")
+    p.add_argument("--block-k", type=int, default=None)
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--run-dir", default=None,
+                   help="write telemetry artifacts here")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--platform", default=None)
+    return p
+
+
+def _make_lengths(skew: str, b: int, L: int) -> np.ndarray:
+    if skew == "full":
+        lens = np.full((b,), L)
+    elif skew == "half":
+        lens = np.full((b,), max(1, L // 2))
+    elif skew == "short":
+        lens = np.full((b,), max(1, L // 8))
+    elif skew == "mixed":
+        lens = np.linspace(1, L, b).round()
+    elif skew == "one_active":
+        lens = np.zeros((b,))
+        lens[0] = L
+    else:
+        raise SystemExit(f"unknown skew {skew!r} (choose from {SKEWS})")
+    return lens.astype(np.int32)
+
+
+def _time(fn, args, iters: int, warmup: int) -> float:
+    """Median seconds per call, device-synchronized."""
+    import jax
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        samples.append(time.perf_counter() - t0)
+    return float(np.median(samples))
+
+
+def run(args) -> dict:
+    from nezha_tpu.cli.common import setup_jax
+    setup_jax(args)
+
+    import jax
+    import jax.numpy as jnp
+
+    from nezha_tpu import obs, ops
+    from nezha_tpu.ops.pallas import flash_decode_attention
+
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    backend = jax.default_backend()
+    interpreted = backend != "tpu"
+
+    @jax.jit
+    def kernel(q, k, v, lens):
+        return flash_decode_attention(q, k, v, lens,
+                                      block_k=args.block_k)
+
+    @jax.jit
+    def composed(q, k, v, lens):
+        L = k.shape[2]
+        mask = jnp.where(jnp.arange(L)[None, :] < lens[:, None],
+                         0.0, -jnp.inf).astype(jnp.float32)
+        return ops.dot_product_attention(q, k.astype(q.dtype),
+                                         v.astype(q.dtype),
+                                         mask=mask[:, None, None, :])
+
+    sink = None
+    if args.run_dir:
+        sink = obs.start_run(args.run_dir, meta={
+            "tool": "benchmarks/decode_attention", "backend": backend,
+            "dtype": args.dtype, "interpreted": interpreted})
+
+    configs = []
+    step = 0
+    for b in (int(x) for x in str(args.batch_sizes).split(",")):
+        for L in (int(x) for x in str(args.max_lens).split(",")):
+            kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+            h, d = args.num_heads, args.head_dim
+            q = jax.random.normal(kq, (b, h, 1, d), dtype)
+            k = jax.random.normal(kk, (b, h, L, d), dtype)
+            v = jax.random.normal(kv, (b, h, L, d), dtype)
+            for skew in str(args.skews).split(","):
+                lens = jnp.asarray(_make_lengths(skew, b, L))
+                t_kernel = _time(kernel, (q, k, v, lens),
+                                 args.iters, args.warmup)
+                t_composed = _time(composed, (q, k, v, lens),
+                                   args.iters, args.warmup)
+                rec = {"B": b, "L_max": L, "skew": skew,
+                       "kernel_ms": t_kernel * 1e3,
+                       "composed_ms": t_composed * 1e3,
+                       "speedup": t_composed / t_kernel if t_kernel
+                       else 0.0}
+                configs.append(rec)
+                obs.record_metrics(step, {"bench": "decode_attention",
+                                          **rec})
+                step += 1
+                if not args.json:
+                    print(f"B={b} L={L} {skew:>10}: kernel "
+                          f"{rec['kernel_ms']:8.3f} ms  composed "
+                          f"{rec['composed_ms']:8.3f} ms  "
+                          f"({rec['speedup']:.2f}x)")
+
+    record = {"backend": backend, "interpreted": interpreted,
+              "dtype": args.dtype, "num_heads": args.num_heads,
+              "head_dim": args.head_dim, "iters": args.iters,
+              "configs": configs}
+    if sink is not None:
+        obs.end_run()
+    if args.json:
+        print(json.dumps(record, indent=2, sort_keys=True))
+    return record
+
+
+def main(argv=None) -> int:
+    run(build_parser().parse_args(argv))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

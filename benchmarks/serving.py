@@ -46,6 +46,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rate", type=float, default=8.0,
                    help="open loop: offered arrivals per second")
     p.add_argument("--prompt-len", type=int, default=8)
+    p.add_argument("--prompt-len-mix", default=None,
+                   help="comma-separated prompt lengths cycled across "
+                        "requests (overrides --prompt-len) — a mixed-"
+                        "length load exercises the prefill buckets, and "
+                        "the record reports TTFT percentiles per bucket")
     p.add_argument("--max-new-tokens", type=int, default=16)
     p.add_argument("--sample-fraction", type=float, default=0.5,
                    help="fraction of requests that sample at temperature "
@@ -54,6 +59,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-batch-size", type=int, default=4)
     p.add_argument("--max-len", type=int, default=64)
     p.add_argument("--max-prefill-len", type=int, default=16)
+    p.add_argument("--prefill-buckets", default=None,
+                   help="comma-separated static prefill pad widths "
+                        "(default: powers of two up to --max-prefill-len)")
+    p.add_argument("--decode-impl",
+                   choices=["auto", "kernel", "xla"], default=None,
+                   help="decode attention: flash-decode kernel vs the "
+                        "composed masked path (the before/after knob)")
     p.add_argument("--queue-capacity", type=int, default=16)
     p.add_argument("--model-preset", choices=["tiny", "full"],
                    default="tiny")
@@ -92,31 +104,42 @@ def run(args) -> dict:
         from nezha_tpu.models.gpt2 import gpt2_124m
         model = gpt2_124m()
     variables = model.init(jax.random.PRNGKey(args.seed))
+    buckets = tuple(int(b) for b in args.prefill_buckets.split(",")) \
+        if args.prefill_buckets else ()
     cfg = ServeConfig(
         max_batch_size=args.max_batch_size, max_len=args.max_len,
-        max_prefill_len=args.max_prefill_len,
-        queue_capacity=args.queue_capacity, cache_dtype=jnp.bfloat16)
+        max_prefill_len=args.max_prefill_len, prefill_buckets=buckets,
+        queue_capacity=args.queue_capacity, cache_dtype=jnp.bfloat16,
+        decode_impl=args.decode_impl)
     engine = Engine(model, variables, cfg)
     sched = Scheduler(engine)
     rng = random.Random(args.seed)
     vocab = engine.vocab
 
+    prompt_lens = ([int(x) for x in str(args.prompt_len_mix).split(",")]
+                   if args.prompt_len_mix else [args.prompt_len])
+    prompt_len_of = {}                 # request_id -> prompt length
+
     def make_request(i: int) -> Request:
         sampled = rng.random() < args.sample_fraction
+        n = prompt_lens[i % len(prompt_lens)]
+        prompt_len_of[f"bench-{i}"] = n
         return Request(
-            prompt=[rng.randrange(vocab)
-                    for _ in range(args.prompt_len)],
+            prompt=[rng.randrange(vocab) for _ in range(n)],
             max_new_tokens=args.max_new_tokens,
             temperature=0.8 if sampled else 0.0,
             top_k=40 if sampled else None,
             seed=i, request_id=f"bench-{i}")
 
-    # Warm both programs off the clock — serving steady state never pays
-    # trace+compile, and neither should the measurement. The telemetry
+    # Warm EVERY program off the clock — serving steady state never pays
+    # trace+compile, and neither should the measurement: one request per
+    # prefill bucket (chunked prompts reuse the bucket programs, so this
+    # covers long prompts too) plus the shared decode step. The telemetry
     # run starts AFTER warmup so the artifacts hold steady-state
     # percentiles only (no multi-second compile spike in ttft p99).
-    sched.submit(Request(prompt=[0], max_new_tokens=1,
-                         request_id="warmup"))
+    for j, w in enumerate(engine.cfg.prefill_buckets):
+        sched.submit(Request(prompt=[0] * min(w, args.max_len - 1),
+                             max_new_tokens=1, request_id=f"warmup-{j}"))
     sched.run_until_idle()
 
     sink = None
@@ -169,12 +192,26 @@ def run(args) -> dict:
             finished = issued - sched.queue_depth - len(sched._live)
     wall = time.monotonic() - t0
 
-    results = [r for rid, r in sched.results.items() if rid != "warmup"]
+    results = [r for rid, r in sched.results.items()
+               if not rid.startswith("warmup")]
     ttfts = [r.ttft_s for r in results if r.ttft_s is not None]
     lats = [r.latency_s for r in results]
     total_tokens = sum(len(r.tokens) for r in results)
     tpots = [(r.latency_s - r.ttft_s) / max(len(r.tokens) - 1, 1)
              for r in results if r.ttft_s is not None]
+    # TTFT per prefill bucket: mixed-length loads show whether short
+    # prompts actually get the short-bucket TTFT or queue behind wide
+    # prefills (keys are the TAIL-chunk pad widths; chunked prompts
+    # group under their tail bucket with chunk count in the label).
+    by_bucket = {}
+    for r in results:
+        n = prompt_len_of.get(r.request_id)
+        if n is None or r.ttft_s is None:
+            continue
+        chunks = -(-n // args.max_prefill_len)  # ceil
+        key = f"{engine.bucket_for(n)}" if chunks == 1 \
+            else f"{engine.bucket_for(n)}x{chunks}"
+        by_bucket.setdefault(key, []).append(r.ttft_s)
     record = {
         "mode": args.mode,
         "offered": (args.concurrency if args.mode == "closed"
@@ -185,8 +222,12 @@ def run(args) -> dict:
         "tokens": total_tokens,
         "tokens_per_sec": total_tokens / wall if wall else 0.0,
         "ttft_s": _percentiles(ttfts),
+        "ttft_by_bucket": {k: _percentiles(v)
+                           for k, v in sorted(by_bucket.items())},
         "tpot_s": _percentiles(tpots),
         "latency_s": _percentiles(lats),
+        "prefill_buckets": list(engine.cfg.prefill_buckets),
+        "decode_impl": args.decode_impl or "auto",
         "compile_cache": engine.compile_stats(),
     }
     if sink is not None:

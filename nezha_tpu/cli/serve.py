@@ -59,8 +59,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-len", type=int, default=96,
                    help="per-slot KV capacity: prompt + generated tokens")
     p.add_argument("--max-prefill-len", type=int, default=32,
-                   help="static prompt pad width; longer prompts are "
-                        "rejected at admission")
+                   help="widest single prefill chunk; longer prompts "
+                        "(up to --max-len) prefill in successive chunks")
+    p.add_argument("--prefill-buckets", default=None,
+                   help="comma-separated static prompt pad widths (one "
+                        "compiled prefill program each, last must equal "
+                        "--max-prefill-len); default: powers of two up "
+                        "to --max-prefill-len")
+    p.add_argument("--decode-impl",
+                   choices=["auto", "kernel", "xla"], default=None,
+                   help="decode attention: auto = Pallas flash-decode "
+                        "kernel on TPU / composed elsewhere, kernel = "
+                        "force the kernel (interpret off-TPU), xla = "
+                        "force the composed masked path; default: the "
+                        "model config's choice (auto)")
     p.add_argument("--k-max", type=int, default=64,
                    help="static top-k cap; per-request top_k is clamped "
                         "to it")
@@ -99,12 +111,23 @@ def _build_stack(args):
     from nezha_tpu.cli.common import resolve_eos_id
     eos_id = resolve_eos_id(args.eos_id, tokenizer, model.cfg.vocab_size)
     max_len = min(args.max_len, model.cfg.max_positions)
+    buckets = ()
+    if args.prefill_buckets:
+        try:
+            buckets = tuple(int(b) for b in
+                            str(args.prefill_buckets).split(","))
+        except ValueError:
+            raise SystemExit(
+                f"--prefill-buckets must be comma-separated ints, got "
+                f"{args.prefill_buckets!r}")
     cfg = ServeConfig(
         max_batch_size=args.max_batch_size, max_len=max_len,
-        max_prefill_len=args.max_prefill_len, k_max=args.k_max,
+        max_prefill_len=args.max_prefill_len,
+        prefill_buckets=buckets, k_max=args.k_max,
         queue_capacity=args.queue_capacity,
         cache_dtype=jnp.float32 if args.cache_dtype == "f32"
-        else jnp.bfloat16)
+        else jnp.bfloat16,
+        decode_impl=args.decode_impl)
     engine = Engine(model, variables, cfg)
     return Scheduler(engine), tokenizer, eos_id
 

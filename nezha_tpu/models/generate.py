@@ -5,6 +5,14 @@ decode step reused for every generated token (`lax.scan`, static shapes,
 traced position scalar) — the XLA-friendly decode loop: no per-token
 recompilation, no growing shapes, cache updates via dynamic_update_slice.
 Sampling: greedy, temperature, top-k, and top-p (nucleus).
+
+The scanned step's single-token attention takes the same flash-decode
+kernel path as the serving engine (models/gpt2.py routes ``s == 1``
+cache attention through ``ops.pallas.flash_decode_attention`` under the
+``attn_impl="auto"`` / ``GPT2Config.decode_impl`` resolution), so
+training-side eval sampling shares the serving hot-path win; the
+composed masked path remains the off-TPU / escape-hatch fallback and is
+bit-compatible for greedy decoding (tests pin the parity).
 """
 
 from __future__ import annotations

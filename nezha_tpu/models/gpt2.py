@@ -62,6 +62,17 @@ class GPT2Config:
     moe_top_k: int = 2
     moe_every: int = 2  # blocks 1, 3, 5, ... are MoE when moe_every=2
     moe_aux_weight: float = 0.01
+    # Single-token KV-cache decode attention (the serving hot path):
+    # "auto" (default) runs the Pallas flash-decode kernel
+    # (ops/pallas/decode_attention.py — split-K online softmax, per-row
+    # lengths skip KV blocks) under the same backend policy as the
+    # prefill flash path (compiled on TPU, composed masked attention
+    # elsewhere, and wherever attn_impl itself forces "xla");
+    # "kernel" forces the kernel (interpret mode off-TPU — the parity-
+    # test path); "xla" forces the composed masked path.
+    # NEZHA_NO_DECODE_KERNEL=1 is the day-1 escape hatch back to the
+    # composed path without editing configs.
+    decode_impl: str = "auto"
     # "pallas" opts layer norms into the fused kernel (fwd + bwd) on TPU.
     ln_impl: str = "xla"
     # Rematerialize each transformer block in backward (jax.checkpoint):
@@ -146,6 +157,29 @@ def _resolve_auto_impl(cfg) -> str:
     return "xla"
 
 
+def _decode_flash_ok(cfg) -> bool:
+    """Whether the single-token decode step takes the flash-decode kernel.
+
+    Same escape-hatch shape as the prefill flash path: an env kill switch
+    (``NEZHA_NO_DECODE_KERNEL=1``), an explicit config override
+    (``decode_impl="kernel"``/``"xla"``), and otherwise the shared
+    ``attn_impl`` resolution — the kernel fires exactly where prefill
+    flash would (TPU backend, not under the auto-partitioner), so one
+    flag set governs the whole attention surface."""
+    import os
+
+    if os.environ.get("NEZHA_NO_DECODE_KERNEL"):
+        return False
+    if cfg.decode_impl == "kernel":
+        return True
+    if cfg.decode_impl != "auto":
+        return False
+    impl = cfg.attn_impl
+    if impl == "auto":
+        return _flash_auto_ok()
+    return impl == "flash"
+
+
 def _flash_auto_ok() -> bool:
     """ONE backend policy for every attn_impl='auto' site (train, prefill,
     BERT): compiled flash on TPU, and never under the GSPMD
@@ -169,7 +203,7 @@ class Attention(Module):
         self.drop = nn.Dropout(cfg.dropout)
 
     def apply(self, variables: Variables, x, training: bool = False, rng=None,
-              cache=None, pos=None, prefill: bool = False):
+              cache=None, pos=None, prefill: bool = False, active=None):
         cfg = self.cfg
         b, s, h = x.shape
         d = h // cfg.num_heads
@@ -234,6 +268,8 @@ class Attention(Module):
                     # runs outside the gspmd trace, where auto resolves to
                     # plain flash/xla.)
                     use_flash_prefill = impl == "flash"
+            use_decode_kernel = (not prefill and s == 1
+                                 and _decode_flash_ok(cfg))
             if use_flash_prefill:
                 from nezha_tpu.ops.pallas import flash_attention
                 # Arbitrary prompt lengths: pad to a lane multiple so the
@@ -250,6 +286,18 @@ class Attention(Module):
                                           kv_lengths=lens)[:, :, :s, :]
                 else:
                     out = flash_attention(q, k, v, causal=True)
+            elif use_decode_kernel:
+                # Single-token decode: the flash-decode kernel attends the
+                # one query row over the cache prefix [0, pos] with per-row
+                # lengths — rows only touch KV blocks below their own
+                # depth, and inactive rows (the serve engine's empty slots)
+                # skip every block instead of computing masked garbage.
+                from nezha_tpu.ops.pallas import flash_decode_attention
+                lengths = (pos if per_row
+                           else jnp.broadcast_to(pos, (b,))) + 1
+                if active is not None:
+                    lengths = jnp.where(active, lengths, 0)
+                out = flash_decode_attention(q, k_all, v_all, lengths)
             else:
                 L = k_all.shape[2]
                 if per_row:
@@ -354,12 +402,12 @@ class Block(Module):
             self.mlp = MLPBlock(cfg, policy)
 
     def apply(self, variables: Variables, x, training: bool = False, rng=None,
-              cache=None, pos=None, prefill: bool = False):
+              cache=None, pos=None, prefill: bool = False, active=None):
         states: dict = {}
         y = run_child(self.ln_1, "ln_1", variables, states, x, training=training)
         y = run_child(self.attn, "attn", variables, states, y,
                       training=training, rng=rng, cache=cache, pos=pos,
-                      prefill=prefill)
+                      prefill=prefill, active=active)
         x = x + y
         y = run_child(self.ln_2, "ln_2", variables, states, x, training=training)
         y = run_child(self.mlp, "mlp", variables, states, y,
@@ -449,7 +497,13 @@ class GPT2(Module):
                           impl=cfg.ln_impl)
 
     def apply(self, variables: Variables, batch, training: bool = False,
-              rng=None, cache=None, pos=None, prefill: bool = False):
+              rng=None, cache=None, pos=None, prefill: bool = False,
+              active=None):
+        # ``active`` ([B] bool, decode-with-cache only) marks rows whose
+        # output is consumed — the serve engine's occupancy mask. It is
+        # advisory: the flash-decode kernel skips ALL work for inactive
+        # rows (length 0); the composed path ignores it (garbage rows are
+        # masked host-side either way).
         if isinstance(batch, dict):
             tokens = batch["tokens"][:, :-1]
         else:
@@ -495,7 +549,7 @@ class GPT2(Module):
                     x, st = self.h_scan.block.apply(
                         lvars, x, training=training,
                         rng=child_rng(rng, f"h{i}"), cache=cache[i],
-                        pos=pos, prefill=prefill)
+                        pos=pos, prefill=prefill, active=active)
                     if st:
                         states[f"h{i}"] = st
         # (With scan_layers, self.h is empty — the loop below is a no-op
@@ -520,7 +574,7 @@ class GPT2(Module):
                 x = run_child(block, f"h{i}", variables, states, x,
                               training=training, rng=rng,
                               cache=None if cache is None else cache[i],
-                              pos=pos, prefill=prefill)
+                              pos=pos, prefill=prefill, active=active)
         x = run_child(self.ln_f, "ln_f", variables, states, x,
                       training=training)
         # MoE blocks report their load-balance losses through child state;

@@ -99,6 +99,17 @@ def render_serving_section(summary: Optional[dict]) -> List[str]:
                 f"  {label}: p50 {h['p50'] * 1e3:.1f} ms  "
                 f"p90 {h['p90'] * 1e3:.1f} ms  "
                 f"p99 {h['p99'] * 1e3:.1f} ms  (n={h['count']})")
+    ph = hists.get("serve.prefill.bucket_len")
+    if ph and ph.get("count"):
+        # Bucket occupancy: how wide the static prefill programs
+        # actually ran (p50/max widths + chunk count — a max stuck at
+        # the top bucket under short-prompt traffic means the bucket set
+        # is too coarse).
+        chunks = counters.get("serve.prefill.chunks_total", ph["count"])
+        lines.append(
+            f"  prefill: {chunks:.0f} chunk(s)  "
+            f"bucket len p50 {ph['p50']:.0f}  p90 {ph['p90']:.0f}  "
+            f"max {ph['max']:.0f}")
     tokens = counters.get("serve.tokens_total", 0)
     wall = (summary.get("run") or {}).get("wall_seconds")
     if tokens and wall:

@@ -5,12 +5,16 @@ layernorm, fused elementwise — SURVEY.md §2 `pkg/cuda`). Here the hot ops
 are Mosaic/Pallas kernels tiled for MXU/VPU and VMEM:
 
 - `flash_attention`: blockwise attention, online softmax, O(S) memory.
+- `flash_decode_attention`: split-K single-token decode attention over a
+  pooled KV cache — per-row lengths skip KV blocks instead of masking
+  them (the serving hot path).
 - `fused_layer_norm`: single-pass normalization on VMEM rows.
 
 All kernels run in interpret mode on CPU (tests) and compile on TPU.
 """
 
+from nezha_tpu.ops.pallas.decode_attention import flash_decode_attention
 from nezha_tpu.ops.pallas.flash_attention import flash_attention
 from nezha_tpu.ops.pallas.layer_norm import fused_layer_norm
 
-__all__ = ["flash_attention", "fused_layer_norm"]
+__all__ = ["flash_attention", "flash_decode_attention", "fused_layer_norm"]

@@ -1,0 +1,149 @@
+"""Flash-decode: batched single-token attention against a pooled KV cache.
+
+The serving decode step asks one question per row: attend ONE query token
+over that row's cache prefix ``[0, length)``. The composed path answers it
+by materializing a ``[B, 1, 1, L_max]`` additive mask and running dense
+attention over the FULL pool — every row pays ``L_max`` bandwidth whatever
+its depth, and the softmax round-trips a score matrix through HBM. This
+kernel is built for the actual access pattern (the "Harnessing HPC
+Kernels" argument from PAPERS.md: shape-specialized hot loops deserve a
+kernel, not a generic lowering):
+
+- grid ``(B, H, KV-blocks)`` with the KV dimension sequential
+  ("arbitrary" semantics) — the split-K layout: each program folds one
+  KV block into VMEM running ``(max, sum, acc)`` scratch via online
+  softmax, merged at the final block (no score matrix, no mask tensor);
+- a per-row ``lengths`` operand: a program whose block starts at or past
+  its row's length SKIPS the block entirely (``@pl.when``), so short rows
+  and inactive rows (``length == 0``) cost block-bookkeeping only — work
+  is proportional to ``sum(lengths)``, not ``B * L_max``;
+- Q·Kᵀ and P·V accumulate fp32 over the caches' native dtype (bf16 pool
+  dots run at the doubled MXU rate; the softmax statistics and the
+  accumulator stay fp32 throughout);
+- ``interpret=None`` auto-selects the Pallas interpreter off-TPU, so CPU
+  tests exercise the same kernel code that compiles on hardware.
+
+Decode is inference-only, so there is no VJP; ``models/gpt2.py`` routes
+its single-token cache branch here behind the ``attn_impl="auto"``
+resolution (``GPT2Config.decode_impl`` / ``NEZHA_NO_DECODE_KERNEL=1``
+are the escape hatches back to the composed masked path).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from nezha_tpu.ops.pallas.flash_attention import _compiler_params, _pick_block
+
+_NEG_BIG = -1e30
+_LANES = 128  # lengths ride lane-broadcast: [B, 128] int32
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, m_scr, l_scr,
+                   acc_scr, *, scale: float, block_k: int):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_BIG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[0, 0]
+    # The block-skip that the dense masked path cannot see: blocks at or
+    # past this row's length never load K/V or touch the MXU. A row with
+    # length == 0 (inactive slot) runs no block at all and finalizes to
+    # an all-zero output.
+    run = ki * block_k < length
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[0, 0]                                      # [1, d]
+        k = k_ref[0, 0]                                      # [bk, d]
+        v = v_ref[0, 0]                                      # [bk, d]
+        s = lax.dot_general(q.astype(k.dtype), k,
+                            (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        kpos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kpos < length, s, _NEG_BIG)            # partial block
+
+        m_prev = m_scr[:, :1]                                # [1, 1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                               # [1, bk]
+        corr = jnp.exp(m_prev - m_new)
+        l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finalize():
+        denom = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+def flash_decode_attention(q, k, v, lengths,
+                           scale: Optional[float] = None,
+                           block_k: Optional[int] = None,
+                           interpret: Optional[bool] = None):
+    """q ``[B, H, 1, D]``, k/v ``[B, H, L, D]``, lengths ``[B]`` int32
+    -> ``[B, H, 1, D]``.
+
+    ``lengths[b]`` is the number of attendable cache positions for row
+    ``b`` (the decode convention: ``pos + 1``, the query's own position
+    included). ``lengths[b] == 0`` marks an inactive row: every KV block
+    is skipped and the output row is exactly zero (callers discard it —
+    the serve engine freezes inactive rows host-side). Lengths are
+    clamped to ``[0, L]``.
+
+    ``block_k`` defaults to the largest divisor of ``L`` that is <= 256
+    (KV pools are padded to power-of-two-ish capacities, so real shapes
+    get real blocks). ``interpret=None`` auto-selects: compiled on TPU,
+    interpreter elsewhere.
+    """
+    b, h, s_q, d = q.shape
+    if s_q != 1:
+        raise ValueError(
+            f"flash_decode_attention is the single-token kernel; got "
+            f"s_q={s_q} (use flash_attention for prefill/training)")
+    if k.shape != v.shape or k.shape[:2] != (b, h) or k.shape[3] != d:
+        raise ValueError(f"k/v {k.shape}/{v.shape} do not match q {q.shape}")
+    L = k.shape[2]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    bk = _pick_block(L, block_k or min(L, 256))
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    kernel = functools.partial(_decode_kernel, scale=scale, block_k=bk)
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = _compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    len2d = jnp.broadcast_to(
+        jnp.clip(jnp.asarray(lengths, jnp.int32), 0, L)[:, None],
+        (b, _LANES))
+    q_spec = pl.BlockSpec((1, 1, 1, d), lambda b_, h_, ki: (b_, h_, 0, 0))
+    kv_spec = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, ki: (b_, h_, ki, 0))
+    len_spec = pl.BlockSpec((1, _LANES), lambda b_, h_, ki: (b_, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(b, h, L // bk),
+        in_specs=[q_spec, kv_spec, kv_spec, len_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((1, _LANES), jnp.float32),
+                        pltpu.VMEM((1, _LANES), jnp.float32),
+                        pltpu.VMEM((1, d), jnp.float32)],
+        interpret=interpret,
+        **kwargs,
+    )(q, k, v, len2d)

@@ -48,6 +48,12 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_BIG = -1e30
 _LANES = 128  # lse is stored lane-broadcast: [B, H, S, 128]
 
+# jax renamed pltpu.TPUCompilerParams -> CompilerParams; resolve whichever
+# this install ships so the compiled-TPU path works on either side of the
+# rename (the interpret path never touches it).
+_compiler_params = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 
 def _pick_block(size: int, target: int) -> int:
     """Largest divisor of ``size`` that is <= target (block shapes must tile
@@ -175,7 +181,7 @@ def _flash_call(q, k, v, causal, scale, block_q, block_k, interpret,
                  acc_scr)
     kwargs = {}
     if not interpret:
-        kwargs["compiler_params"] = pltpu.CompilerParams(
+        kwargs["compiler_params"] = _compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary"))
     scratch = [pltpu.VMEM((bq, _LANES), jnp.float32),
@@ -337,7 +343,7 @@ def _flash_bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k,
 
     kwargs = {}
     if not interpret:
-        kwargs["compiler_params"] = pltpu.CompilerParams(
+        kwargs["compiler_params"] = _compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary"))
 

@@ -11,10 +11,15 @@ serve stack replaces the batch lifecycle with a slot lifecycle:
 - ``sampling``: per-row temperature / top-k / top-p as traced arrays, so
   one compiled program serves every mix of requests (top-k masks by
   per-row k under a static ``k_max`` cap — ``lax.top_k``'s k is static).
-- ``engine``: exactly two jitted programs, reused forever — prefill (one
-  request into one slot) and the batched single-token decode step over
-  all ``B_max`` rows (active-row mask, per-row traced positions). Both
-  route through the runtime ``CompileCache``, so the two-program steady
+- ``engine``: exactly ``1 + len(prefill_buckets)`` jitted programs,
+  reused forever — one prefill program per static prompt-pad bucket
+  (prompts pick the smallest bucket that fits; prompts longer than
+  ``max_prefill_len`` prefill in successive chunks through the same
+  programs at traced offsets) and the batched single-token decode step
+  over all ``B_max`` rows (active-row mask, per-row traced positions;
+  on TPU the attention is the Pallas flash-decode kernel — per-row
+  lengths skip KV blocks instead of masking them). All programs route
+  through the runtime ``CompileCache``, so the frozen-program steady
   state is provable from the ``compile_cache.*`` obs counters.
 - ``scheduler``: bounded FIFO admission with backpressure, per-request
   deadlines, and the iteration loop (admit -> decode one token for all

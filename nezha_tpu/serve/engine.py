@@ -1,36 +1,60 @@
-"""The continuous-batching engine: two compiled programs, reused forever.
+"""The continuous-batching engine: a frozen set of programs, reused forever.
 
-Steady-state serving is exactly TWO XLA programs regardless of request
-mix — the property that keeps TPU serving latency flat:
+Steady-state serving is exactly ``1 + len(prefill_buckets)`` XLA
+programs regardless of request mix — the property that keeps TPU serving
+latency flat:
 
-- **prefill** — one request's prompt (padded to the static
-  ``max_prefill_len``) runs through the model against a scratch cache,
-  and its K/V rows, position, PRNG key, and sampling params are written
-  into one SLOT of the pooled batch state via ``dynamic_update_slice``.
-  Pad positions beyond the prompt write garbage K/V that is never
-  attended (the decode mask stops at ``pos``, and every position below
-  ``pos`` is rewritten by a decode step before the mask reaches it).
+- **prefill** — one compiled program per PREFILL BUCKET (static prompt
+  pad widths, default powers of two up to ``max_prefill_len``). A
+  prompt's tokens are padded to the smallest bucket that fits, the
+  slot's pooled cache rows are sliced out (``read_slot``), the chunk
+  runs through the model at its TRACED position offset via the masked
+  attention path (which attends everything previously written to the
+  slot), and the updated rows are written back (``write_slot``).
+  Prompts longer than ``max_prefill_len`` are no longer rejected: they
+  prefill in successive chunks — full ``max_prefill_len``-wide chunks,
+  then a bucketed tail — reusing the same bucket programs at advancing
+  offsets, so CHUNKING ADDS NO PROGRAMS. Bucket pads beyond the prompt
+  write garbage K/V that is never attended (the masks stop at the
+  written prefix, and decode overwrites pad positions before its mask
+  reaches them). The traced offset is the trade the chunk contract
+  buys: a traced ``pos`` cannot take the static-pos-0 flash-prefill
+  path, so chunk attention is masked-dense over the slot's ``L_max``
+  rows — paid once per request, versus the per-token decode win; a
+  diagonal-offset flash prefill kernel would recover it without
+  touching the program count and is the obvious next kernel.
 - **step** — one batched single-token decode over all ``B_max`` rows:
   sample per row from the carried last-logits (per-row traced
   temperature / top-k / top-p — serve/sampling.py), forward through the
   model with PER-ROW cache positions (models/gpt2.py per-row pos path),
-  advance active rows. Inactive rows compute garbage that is masked out
-  host-side; their state is frozen by ``where(active, ...)``.
+  advance active rows. On TPU the attention inside this step is the
+  Pallas flash-decode kernel (ops/pallas/decode_attention.py): per-row
+  ``lengths`` skip KV blocks above each row's depth, and inactive rows
+  skip every block instead of computing masked garbage (host-side
+  masking still applies — their state is frozen by ``where(active,
+  ...)``).
 
-Both programs route through the runtime ``Executor`` (compile-cache
-keyed on function identity + full arg shape signature), so the
-two-program claim is enforced by the ``compile_cache.*`` obs counters:
-a shape drift would show up as a third miss, and tests pin it.
+All programs route through the runtime ``Executor`` (compile-cache keyed
+on function identity + full arg shape signature), so the program-count
+claim is enforced by the ``compile_cache.*`` obs counters: a shape drift
+would show up as an extra miss, and tests pin the count at
+``1 + len(prefill_buckets)`` with misses frozen after warmup (a bucket
+program compiles the first time a prompt lands in its bucket).
 
 All per-request scalars cross into the programs as 0-d ARRAYS, never
 Python numbers — the executor's signature (and jax.jit's) would
 otherwise key on the literal value and recompile per request.
+
+Token-range validation lives in the scheduler's admission path
+(``Scheduler.submit``), NOT here: the engine trusts its caller so the
+per-prefill host work is one ``np.zeros`` + copy per chunk, and a bad
+request is bounced before it ever holds a slot.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, List, Optional, Sequence
+from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -38,10 +62,25 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from nezha_tpu import obs
 from nezha_tpu.models.generate import _caches_from_states
 from nezha_tpu.runtime.executor import Executor
 from nezha_tpu.serve.sampling import sample_tokens
-from nezha_tpu.serve.slots import SlotPool, write_slot
+from nezha_tpu.serve.slots import SlotPool, read_slot, write_slot
+
+
+def default_prefill_buckets(max_prefill_len: int) -> Tuple[int, ...]:
+    """Powers of two from 8 up to (and always ending exactly at)
+    ``max_prefill_len`` — e.g. 32 -> (8, 16, 32), 24 -> (8, 16, 24),
+    8 -> (8,). Small prompts pad to a small program instead of the full
+    width, so short-prompt TTFT stops paying the long-prompt pad tax."""
+    buckets: List[int] = []
+    b = 8
+    while b < max_prefill_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_prefill_len)
+    return tuple(buckets)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -50,19 +89,29 @@ class ServeConfig:
 
     ``max_batch_size`` is the slot count (rows decoded per step),
     ``max_len`` the per-slot KV capacity (prompt + generated),
-    ``max_prefill_len`` the static prompt pad width (prompts longer than
-    this are rejected at admission), ``k_max`` the static top-k cap
+    ``max_prefill_len`` the widest single prefill chunk — longer prompts
+    (up to ``max_len``) are prefilled in successive chunks, not
+    rejected. ``prefill_buckets`` are the static prompt pad widths (one
+    compiled prefill program each; ``()`` selects the powers-of-two
+    default from :func:`default_prefill_buckets` — the last bucket must
+    equal ``max_prefill_len``). ``k_max`` is the static top-k cap
     per-row ks are clamped to. ``queue_capacity`` bounds the scheduler's
     FIFO (backpressure); ``pad_id`` is the token fed for inactive rows.
+    ``decode_impl`` (None = keep the model's own ``GPT2Config.
+    decode_impl``) overrides the decode-attention choice for this
+    engine: "auto" | "kernel" | "xla" — the serving-side toggle for the
+    flash-decode kernel.
     """
 
     max_batch_size: int = 4
     max_len: int = 128
     max_prefill_len: int = 32
+    prefill_buckets: Tuple[int, ...] = ()
     k_max: int = 64
     queue_capacity: int = 16
     pad_id: int = 0
     cache_dtype: Any = jnp.bfloat16
+    decode_impl: Optional[str] = None
 
     def __post_init__(self):
         if self.max_batch_size < 1:
@@ -75,16 +124,38 @@ class ServeConfig:
             raise ValueError("k_max must be >= 1")
         if self.queue_capacity < 1:
             raise ValueError("queue_capacity must be >= 1")
+        if self.decode_impl not in (None, "auto", "kernel", "xla"):
+            raise ValueError(
+                f"decode_impl must be None, 'auto', 'kernel', or 'xla'; "
+                f"got {self.decode_impl!r}")
+        buckets = tuple(self.prefill_buckets) or default_prefill_buckets(
+            self.max_prefill_len)
+        if list(buckets) != sorted(set(buckets)):
+            raise ValueError(
+                f"prefill_buckets must be strictly increasing, got "
+                f"{buckets}")
+        if buckets[0] < 1 or buckets[-1] != self.max_prefill_len:
+            # The last bucket IS the chunk width: every admissible tail
+            # must fit some bucket, and chunking advances in
+            # max_prefill_len strides.
+            raise ValueError(
+                f"prefill_buckets must be >= 1 and end exactly at "
+                f"max_prefill_len={self.max_prefill_len}, got {buckets}")
+        object.__setattr__(self, "prefill_buckets", buckets)
 
 
 class Engine:
-    """Device-side serving state + the two compiled programs.
+    """Device-side serving state + the frozen program set.
 
     The engine is deliberately request-blind: it knows slots, not
-    requests. Admission policy, deadlines, retirement, and telemetry
-    live in the scheduler; the engine's contract is ``prefill(slot, ...)``
-    to load one slot and ``step(active)`` to decode one token for every
-    row and hand the batch back to the host.
+    requests. Admission policy, deadlines, retirement, and the
+    request-level telemetry (TTFT/TPOT, queue depth, spans) live in the
+    scheduler; the engine emits only what it alone can see — the
+    bucket/chunk instruments (``serve.prefill.bucket_len`` /
+    ``serve.prefill.chunks_total``), since the bucket choice is made
+    here. The contract is ``prefill(slot, ...)`` to load one slot
+    (however many chunks that takes) and ``step(active)`` to decode one
+    token for every row and hand the batch back to the host.
     """
 
     def __init__(self, model, variables, cfg: ServeConfig = ServeConfig()):
@@ -92,6 +163,16 @@ class Engine:
             raise ValueError(
                 f"max_len {cfg.max_len} exceeds the model's max_positions "
                 f"{model.cfg.max_positions}")
+        if (cfg.decode_impl is not None
+                and cfg.decode_impl != model.cfg.decode_impl):
+            # The decode-attention choice is a model-config knob (the
+            # attention module reads it at trace time); honor the serving
+            # override by rebuilding the module tree around a replaced
+            # config — pure structure, the caller's ``variables`` slot
+            # straight in.
+            model = type(model)(
+                dataclasses.replace(model.cfg, decode_impl=cfg.decode_impl),
+                policy=model.policy)
         self.model = model
         self.variables = variables
         self.cfg = cfg
@@ -106,44 +187,83 @@ class Engine:
         self.temps = jnp.zeros((b,), jnp.float32)
         self.top_ks = jnp.zeros((b,), jnp.int32)
         self.top_ps = jnp.ones((b,), jnp.float32)
-        # Donate the pooled caches (positional arg 1 in BOTH programs):
+        # Donate the pooled caches (positional arg 1 in EVERY program):
         # without donation every decoded token would copy the whole
         # [B_max, H, L_max, D] K/V pool per layer just to write one row —
         # double the KV memory and a full-pool bandwidth tax on the
         # latency-bound loop. The engine rebinds the returned buffers
         # immediately, so the invalidated inputs are never reused.
         self.executor = Executor(donate_argnums=(1,))
-        self._prefill_fn = _build_prefill(model, cfg)
-        self._step_fn = _build_step(model, self.k_max, cfg.pad_id)
+        # One prefill program per bucket width (compiled lazily: the
+        # executor keys on the function object, so each closure is its
+        # own cache entry the first time a prompt lands in its bucket).
+        self._prefill_fns = {w: _build_prefill(self.model, w)
+                             for w in cfg.prefill_buckets}
+        self._step_fn = _build_step(self.model, self.k_max, cfg.pad_id)
 
     # -------------------------------------------------------- host API
+    def bucket_for(self, n: int) -> int:
+        """The static pad width the TAIL chunk of an ``n``-token prompt
+        runs at: the smallest bucket >= n for single-chunk prompts,
+        else the smallest bucket >= the chunked remainder. Benchmarks
+        group TTFT by this value."""
+        p_max = self.cfg.max_prefill_len
+        rem = n if n <= p_max else (n % p_max or p_max)
+        return next(w for w in self.cfg.prefill_buckets if w >= rem)
+
     def prefill(self, slot: int, tokens: Sequence[int], *, seed: int = 0,
                 temperature: float = 0.0, top_k: Optional[int] = None,
                 top_p: Optional[float] = None) -> None:
         """Load one request into ``slot``: prompt K/V, position, PRNG
-        key, and sampling params. ``tokens`` must fit
-        ``max_prefill_len``; the first generated token comes from the
-        next :meth:`step`."""
+        key, and sampling params. ``tokens`` may be up to
+        ``max_len - 1`` long (room for at least one generated token);
+        prompts wider than ``max_prefill_len`` run as successive chunks
+        through the same bucket programs. Token ids are NOT validated
+        here — admission (``Scheduler.submit``) is the validation
+        boundary. The first generated token comes from the next
+        :meth:`step`."""
         n = len(tokens)
-        p_max = self.cfg.max_prefill_len
-        if not 1 <= n <= p_max:
+        if not 1 <= n < self.cfg.max_len:
             raise ValueError(
-                f"prompt length {n} not in [1, max_prefill_len={p_max}]")
-        padded = np.zeros((1, p_max), np.int32)
-        padded[0, :n] = np.asarray(tokens, np.int32)
-        if padded.max() >= self.vocab or padded.min() < 0:
-            raise ValueError(f"prompt ids must be in [0, {self.vocab})")
-        out = self.executor.run(
-            self._prefill_fn, self.variables, self.pool.caches,
-            jnp.asarray(padded),
-            np.int32(n), np.int32(slot), np.int32(seed),
-            np.float32(temperature),
-            np.int32(0 if top_k is None else top_k),
-            np.float32(1.0 if top_p is None else top_p),
-            self.last_logits, self.positions, self.keys,
-            self.temps, self.top_ks, self.top_ps)
-        (self.pool.caches, self.last_logits, self.positions, self.keys,
-         self.temps, self.top_ks, self.top_ps) = out
+                f"prompt length {n} not in [1, max_len-1="
+                f"{self.cfg.max_len - 1}]")
+        p_max = self.cfg.max_prefill_len
+        tokens = np.asarray(tokens, np.int32)
+        chunks: List[Tuple[int, int, int]] = []      # (offset, len, width)
+        off = 0
+        while n - off > p_max:
+            chunks.append((off, p_max, p_max))
+            off += p_max
+        rem = n - off
+        width = self.bucket_for(rem)
+        if off + width > self.cfg.max_len:
+            # A padded tail would spill past the slot's KV capacity
+            # (max_len not a multiple of max_prefill_len, prompt near
+            # capacity) — and dynamic_update_slice would CLAMP the write
+            # start, corrupting the already-written prefix. Slide the
+            # window back to cover the last `width` REAL tokens instead:
+            # rewriting those positions recomputes identical K/V (same
+            # tokens, same prefix), and no pad lands past capacity.
+            # (Only reachable when chunked, where n > max_prefill_len
+            # >= width, so off stays >= 0.)
+            off, rem = n - width, width
+        chunks.append((off, rem, width))
+        obs.counter("serve.prefill.chunks_total").inc(len(chunks))
+        for off, ln, width in chunks:
+            obs.histogram("serve.prefill.bucket_len").observe(width)
+            padded = np.zeros((1, width), np.int32)
+            padded[0, :ln] = tokens[off:off + ln]
+            out = self.executor.run(
+                self._prefill_fns[width], self.variables, self.pool.caches,
+                jnp.asarray(padded),
+                np.int32(ln), np.int32(slot), np.int32(off),
+                np.int32(seed), np.float32(temperature),
+                np.int32(0 if top_k is None else top_k),
+                np.float32(1.0 if top_p is None else top_p),
+                self.last_logits, self.positions, self.keys,
+                self.temps, self.top_ks, self.top_ps)
+            (self.pool.caches, self.last_logits, self.positions, self.keys,
+             self.temps, self.top_ks, self.top_ps) = out
 
     def step(self, active: np.ndarray) -> np.ndarray:
         """Decode one token for every row; ``active`` is a ``[B_max]``
@@ -159,36 +279,33 @@ class Engine:
         return np.asarray(tok)
 
     def compile_stats(self) -> dict:
-        """Executor cache stats — steady state is ``entries == 2``
-        (prefill + step), misses frozen at 2 while hits grow."""
+        """Executor cache stats — steady state is ``entries ==
+        1 + len(prefill_buckets)`` (step + one prefill per bucket),
+        misses frozen there after every bucket has been warmed while
+        hits grow."""
         return self.executor.stats()
 
 
-def _scratch_cache(model, p_max: int, dtype) -> List[dict]:
-    cfg = model.cfg
-    d = cfg.hidden_size // cfg.num_heads
-    shape = (1, cfg.num_heads, p_max, d)
-    return [{"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
-            for _ in range(cfg.num_layers)]
-
-
-def _build_prefill(model, cfg: ServeConfig):
-    p_max = cfg.max_prefill_len
-
-    def prefill(variables, caches, tokens, length, slot, seed,
+def _build_prefill(model, width: int):
+    def prefill(variables, caches, tokens, length, slot, pos, seed,
                 temperature, top_k, top_p,
                 last_logits, positions, keys, temps, top_ks, top_ps):
-        # The prompt runs against a scratch cache at STATIC pos=0 (the
-        # flash-prefill fast path on TPU), then its K/V rows land in the
-        # pooled slot. tokens is [1, p_max]; rows past `length` are pad.
-        scratch = _scratch_cache(model, p_max, caches[0]["k"].dtype)
+        # One prompt chunk, padded to this bucket's static `width`, runs
+        # against the SLOT'S OWN cache rows at a traced offset: the
+        # masked attention path sees the prefix earlier chunks wrote
+        # (pos > 0) or nothing (pos == 0), so the same program serves
+        # first chunks, middle chunks, and bucketed tails. Rows past
+        # `length` are pad — their K/V lands above the prompt and is
+        # overwritten by decode before any mask attends it.
+        rows = [{"k": read_slot(pool["k"], slot),
+                 "v": read_slot(pool["v"], slot)} for pool in caches]
         logits, states = model.apply(variables, tokens, training=False,
-                                     cache=scratch, pos=0, prefill=True)
-        chunk = _caches_from_states(model, states, scratch)
+                                     cache=rows, pos=pos)
+        new_rows = _caches_from_states(model, states, rows)
         new_caches = [
-            {"k": write_slot(pool["k"], ck["k"], slot),
-             "v": write_slot(pool["v"], ck["v"], slot)}
-            for pool, ck in zip(caches, chunk)]
+            {"k": write_slot(pool["k"], rk["k"], slot),
+             "v": write_slot(pool["v"], rk["v"], slot)}
+            for pool, rk in zip(caches, new_rows)]
         row = lax.dynamic_slice(
             logits, (0, length - 1, jnp.zeros((), jnp.int32)),
             (1, 1, logits.shape[-1]))[:, 0, :]          # [1, V] last REAL row
@@ -200,9 +317,12 @@ def _build_prefill(model, cfg: ServeConfig):
                     (1,) + buf.shape[1:]),
                 (slot,) + (jnp.zeros((), jnp.int32),) * (buf.ndim - 1))
 
+        # Every chunk overwrites the whole per-slot state; only the final
+        # chunk's values survive to decode (positions advances to the
+        # running prefix length either way).
         return (new_caches,
                 set_row(last_logits, row),
-                set_row(positions, length),
+                set_row(positions, pos + length),
                 set_row(keys, key),
                 set_row(temps, temperature),
                 set_row(top_ks, top_k),
@@ -221,9 +341,12 @@ def _build_step(model, k_max: int, pad_id: int):
         tok = sample_tokens(last_logits, subs, temps, top_ks, top_ps,
                             k_max)
         tok = jnp.where(active, tok, pad_id)
+        # `active` rides into the model so the flash-decode kernel can
+        # zero inactive rows' lengths and skip their KV blocks entirely;
+        # the composed fallback ignores it (garbage rows masked below).
         logits, states = model.apply(variables, tok[:, None],
                                      training=False, cache=caches,
-                                     pos=positions)
+                                     pos=positions, active=active)
         new_caches = _caches_from_states(model, states, caches)
         row_logits = logits[:, -1, :]
         act = active[:, None]

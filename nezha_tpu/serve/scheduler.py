@@ -11,9 +11,13 @@ memory), per-request deadlines, and the continuous-batching iteration:
 
 Telemetry flows through ``nezha_tpu.obs`` at the serving layer's
 metrics of record: ``serve.ttft_s`` (submit -> first token) and
-``serve.tpot_s`` (per decoded token) histograms, ``serve.queue_depth``
-and ``serve.batch_occupancy`` gauges, and
-``serve.{admitted,rejected,expired,retired,tokens}_total`` counters —
+``serve.tpot_s`` (per decoded token) histograms,
+``serve.prefill.bucket_len`` (static pad width per prefill chunk — the
+bucket-occupancy view), ``serve.queue_depth`` and
+``serve.batch_occupancy`` gauges,
+``serve.{admitted,rejected,expired,retired,tokens}_total`` and
+``serve.prefill.chunks_total`` counters, and a
+``serve.decode_attention`` span around every batched decode step —
 the names tools/check_telemetry_schema.py pins. With no run active
 every call site is the registry's branch-only no-op.
 """
@@ -91,10 +95,12 @@ def register_serve_instruments() -> None:
     that starts its run AFTER warmup)."""
     for c in ("admitted", "rejected", "expired", "retired", "tokens"):
         obs.counter(f"serve.{c}_total")
+    obs.counter("serve.prefill.chunks_total")
     obs.gauge("serve.queue_depth")
     obs.gauge("serve.batch_occupancy")
     obs.histogram("serve.ttft_s")
     obs.histogram("serve.tpot_s")
+    obs.histogram("serve.prefill.bucket_len")
 
 
 class Scheduler:
@@ -128,10 +134,11 @@ class Scheduler:
         prompt + max_new_tokens past the slot's KV capacity)."""
         cfg = self.engine.cfg
         n = len(req.prompt)
-        if not 1 <= n <= cfg.max_prefill_len:
-            raise ValueError(
-                f"prompt length {n} not in [1, max_prefill_len="
-                f"{cfg.max_prefill_len}]")
+        # Admission limit is the slot's KV capacity, not the prefill
+        # width: prompts past max_prefill_len prefill in chunks
+        # (engine.py), so only max_len bounds what can be served.
+        if n < 1:
+            raise ValueError("prompt must be non-empty")
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         if n + req.max_new_tokens > cfg.max_len:
@@ -140,9 +147,11 @@ class Scheduler:
                 f"exceeds max_len {cfg.max_len}")
         vocab = self.engine.vocab
         if not all(0 <= t < vocab for t in req.prompt):
-            # Validate HERE, not in prefill: a bad id surfacing inside
-            # step() would kill the decode loop with other requests in
-            # flight instead of bouncing this submit.
+            # Admission IS the validation boundary (the engine trusts its
+            # caller): a bad id surfacing inside prefill/step would kill
+            # the decode loop with other requests in flight — and would
+            # have allocated a slot first — instead of bouncing this
+            # submit before any resource is held.
             raise ValueError(f"prompt ids must be in [0, {vocab})")
         with self._lock:
             if len(self._queue) >= self.queue_capacity:
@@ -239,7 +248,8 @@ class Scheduler:
         obs.histogram("metric.batch_occupancy").observe(
             len(self._live) / self.engine.cfg.max_batch_size)
         t0 = time.monotonic()
-        tokens = self.engine.step(active)
+        with obs.span("serve.decode_attention", rows=len(self._live)):
+            tokens = self.engine.step(active)
         dt = time.monotonic() - t0
         now = time.monotonic()
         emitted = 0

@@ -7,18 +7,21 @@ as SLOTS). A slot is one in-flight request's cache rows; slots are
 allocated host-side (plain free list — allocation must not touch the
 device) and their contents are written device-side:
 
-- prefill writes a request's prompt K/V into its slot's rows via
-  ``lax.dynamic_update_slice`` at ``(slot, 0, 0, 0)`` (engine.py builds
-  the jitted program; :func:`write_slot` is the update it uses),
+- prefill slices a slot's rows out of the pool (:func:`read_slot`), runs
+  the prompt chunk against them at its traced offset, and writes the
+  updated rows back at ``(slot, 0, 0, 0)`` (:func:`write_slot`;
+  engine.py builds the jitted bucket programs),
 - decode steps append one position per ACTIVE row via the model's
   per-row-position cache path (models/gpt2.py).
 
 Freeing a slot is bookkeeping only — stale K/V stays in the buffers.
-That is safe by construction: a new occupant's prefill overwrites rows
-``[0, P_max)``, and its decode mask only ever attends positions
-``<= pos``, each of which the request itself has written first (prefill
-pads beyond the prompt are likewise never attended: the first decode
-write lands at ``pos = prompt_len`` before the mask reaches it).
+That is safe by construction: a new occupant's prefill chunks overwrite
+``[0, prompt_len)`` in order, and attention only ever covers positions
+the request itself has written first — each chunk attends the prefix
+earlier chunks wrote plus its own causal window, and the decode path
+(mask or flash-decode ``lengths``) stops at ``pos``. Bucket pads beyond
+the prompt write garbage K/V above ``prompt_len`` that the first decode
+writes overwrite before any mask reaches them.
 """
 
 from __future__ import annotations
@@ -79,9 +82,17 @@ class SlotPool:
         return self.num_active / self.capacity
 
 
+def read_slot(pool_leaf, slot):
+    """Slice one slot's rows out of a pooled cache leaf:
+    ``pool_leaf [B_max, H, L_max, D]`` -> ``[1, H, L_max, D]``, ``slot``
+    a traced int32 scalar. Pure — call under jit (the engine's bucket
+    prefill programs run each prompt chunk against this view)."""
+    return lax.dynamic_slice_in_dim(pool_leaf, slot, 1, axis=0)
+
+
 def write_slot(pool_leaf, chunk_leaf, slot):
-    """Write one request's prefill rows into a slot of a pooled cache
-    leaf: ``pool_leaf [B_max, H, L_max, D]``, ``chunk_leaf [1, H, P, D]``
+    """Write rows back into a slot of a pooled cache leaf:
+    ``pool_leaf [B_max, H, L_max, D]``, ``chunk_leaf [1, H, P, D]``
     (P <= L_max), ``slot`` a traced int32 scalar. Pure — returns the
     updated leaf; call under jit (engine prefill program)."""
     zero = jnp.zeros((), jnp.int32)

@@ -1,9 +1,11 @@
-"""Serving stack: slot pool, per-row sampling, the two-program engine,
-scheduler edge cases (queue-full backpressure, EOS retirement + same-
-iteration admission, per-row isolation, deadlines), and the serving
-telemetry artifacts. Everything runs the tiny CPU GPT-2 from
-tests/test_generate.py's config — tier-1 budget is tight, and the
-engine's whole point is that programs compile twice and never again."""
+"""Serving stack: slot pool, per-row sampling, the frozen-program engine
+(1 + len(prefill_buckets) compiled programs), bucketed + chunked
+prefill, scheduler edge cases (queue-full backpressure, EOS retirement +
+same-iteration admission, per-row isolation, deadlines, validation
+before slot allocation), and the serving telemetry artifacts.
+Everything runs the tiny CPU GPT-2 from tests/test_generate.py's
+config — tier-1 budget is tight, and the engine's whole point is that
+the program set compiles once per bucket and never again."""
 
 import json
 
@@ -27,7 +29,8 @@ from nezha_tpu.serve import (
 CFG = dict(vocab_size=97, max_positions=64, num_layers=2, num_heads=4,
            hidden_size=64)
 SCFG = ServeConfig(max_batch_size=3, max_len=48, max_prefill_len=8,
-                   k_max=16, queue_capacity=4, cache_dtype=jnp.float32)
+                   prefill_buckets=(4, 8), k_max=16, queue_capacity=4,
+                   cache_dtype=jnp.float32)
 
 
 @pytest.fixture(scope="module")
@@ -38,8 +41,9 @@ def model_and_vars():
 
 @pytest.fixture(scope="module")
 def engine(model_and_vars):
-    """ONE engine for the whole module: its two programs compile once
-    and every test reuses them (the serving property under test)."""
+    """ONE engine for the whole module: its program set (step + one
+    prefill per bucket) compiles once and every test reuses it (the
+    serving property under test)."""
     model, variables = model_and_vars
     return Engine(model, variables, SCFG)
 
@@ -115,10 +119,33 @@ def test_queue_full_rejection(engine):
         sched.submit(Request(prompt=[1, 2], max_new_tokens=2))
     _drain(sched)
 
-    with pytest.raises(ValueError, match="max_prefill_len"):
-        sched.submit(Request(prompt=list(range(20)), max_new_tokens=2))
+    # The admission limit is the slot's KV capacity, NOT the prefill
+    # width — a 20-token prompt (> max_prefill_len=8) is admissible
+    # (chunked prefill); only max_len bounds what can be served.
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        sched.submit(Request(prompt=list(range(1, 48)), max_new_tokens=2))
     with pytest.raises(ValueError, match="exceeds max_len"):
         sched.submit(Request(prompt=[1, 2], max_new_tokens=100))
+    with pytest.raises(ValueError, match="non-empty"):
+        sched.submit(Request(prompt=[], max_new_tokens=2))
+
+
+def test_rejected_request_never_consumes_slot(engine):
+    """Validation is hoisted into admission: a bad request bounces at
+    submit() with no slot held, no queue entry, and no program run."""
+    sched = Scheduler(engine)
+    free_before = engine.pool.num_free
+    stats_before = engine.compile_stats()
+    for bad in (Request(prompt=[1, 2, 999], max_new_tokens=2),  # id range
+                Request(prompt=[-1], max_new_tokens=2),
+                Request(prompt=list(range(1, 48)), max_new_tokens=2),
+                Request(prompt=[1], max_new_tokens=0)):
+        with pytest.raises(ValueError):
+            sched.submit(bad)
+    assert engine.pool.num_free == free_before
+    assert sched.queue_depth == 0 and not sched.has_work()
+    # No prefill/step program was even dispatched for the rejects.
+    assert engine.compile_stats() == stats_before
 
 
 def test_deadline_expiry_of_queued_request(engine):
@@ -204,16 +231,18 @@ def test_per_row_sampling_isolation(engine):
     assert sched.results[b].tokens != sched.results[c].tokens
 
 
-# ----------------------------------------- e2e smoke + the two programs
-def test_serving_smoke_two_programs_and_artifacts(model_and_vars,
-                                                  tmp_path):
+# ------------------------------------ e2e smoke + the frozen program set
+def test_serving_smoke_program_count_and_artifacts(model_and_vars,
+                                                   tmp_path):
     """The acceptance smoke: ≥3 concurrent requests with different
     sampling params and lengths, a LATE request admitted while earlier
     ones still decode (continuous batching observable via the occupancy
     gauge), greedy rows matching one-shot generate() token-for-token —
-    and steady state compiles exactly TWO programs (prefill + step),
-    pinned through the obs compile-cache counters. The run dir must
-    pass the frozen serving schema and render a serving report."""
+    and steady state compiles exactly ``1 + len(prefill_buckets)``
+    programs (the batched step + one prefill per bucket), pinned through
+    the obs compile-cache counters and FROZEN once every bucket has been
+    warmed. The run dir must pass the frozen serving schema and render a
+    serving report."""
     import os
     import sys
 
@@ -256,18 +285,40 @@ def test_serving_smoke_two_programs_and_artifacts(model_and_vars,
         assert sched.results[r1].tokens == ref.tolist()
         assert len(sched.results[r3].tokens) == 7
 
-        # Exactly two compiled programs for the whole mixed-request run,
-        # by the engine's own cache AND the process-wide obs counters.
+        # Exactly 1 + len(prefill_buckets) compiled programs for the
+        # whole mixed-request run (prompt lengths 4/3/5/2 hit both the
+        # 4- and 8-buckets), by the engine's own cache AND the
+        # process-wide obs counters.
+        n_programs = 1 + len(SCFG.prefill_buckets)
         stats = engine.compile_stats()
-        assert stats == {"entries": 2,
-                         "hits": stats["hits"], "misses": 2}
+        assert stats == {"entries": n_programs,
+                         "hits": stats["hits"], "misses": n_programs}
         assert stats["hits"] > 10
-        assert obs.counter("compile_cache.misses").value == 2
-        assert obs.counter("serve.admitted_total").value == 4
-        assert obs.counter("serve.retired_total").value == 4
+        assert obs.counter("compile_cache.misses").value == n_programs
+
+        # Warmed means FROZEN: another mixed batch (including a chunked
+        # 13-token prompt, which must reuse the bucket programs at
+        # advancing offsets) adds hits, never misses.
+        f1 = sched.submit(Request(prompt=[3, 1, 4], max_new_tokens=3))
+        f2 = sched.submit(Request(prompt=list(range(2, 15)),
+                                  max_new_tokens=3))
+        _drain(sched)
+        assert len(sched.results[f2].tokens) == 3
+        stats2 = engine.compile_stats()
+        assert stats2["entries"] == n_programs
+        assert stats2["misses"] == n_programs
+        assert stats2["hits"] > stats["hits"]
+
+        assert obs.counter("serve.admitted_total").value == 6
+        assert obs.counter("serve.retired_total").value == 6
         assert obs.counter("serve.tokens_total").value == \
-            sum(len(sched.results[r].tokens) for r in (r1, r2, r3, "late"))
-        assert obs.histogram("serve.ttft_s").count == 4
+            sum(len(sched.results[r].tokens)
+                for r in (r1, r2, r3, "late", f1, f2))
+        assert obs.histogram("serve.ttft_s").count == 6
+        # Bucket telemetry: 5 single-chunk prefills + a 2-chunk prefill
+        # (13 = 8 + a 5-tail in the 8-bucket) = 7 chunk calls.
+        assert obs.counter("serve.prefill.chunks_total").value == 7
+        assert obs.histogram("serve.prefill.bucket_len").count == 7
     finally:
         obs.end_run()
 
@@ -279,7 +330,14 @@ def test_serving_smoke_two_programs_and_artifacts(model_and_vars,
     from nezha_tpu.obs.report import render_report
     report = render_report(run_dir)
     assert "serving:" in report and "ttft" in report and "tpot" in report
-    assert "4 admitted" in report
+    assert "6 admitted" in report
+    assert "prefill: 7 chunk(s)" in report  # bucket-occupancy line
+
+    # Every batched decode step is labeled with its own span.
+    with open(os.path.join(run_dir, "spans.jsonl")) as f:
+        span_names = {json.loads(ln)["name"] for ln in f if ln.strip()}
+    assert "serve.decode_attention" in span_names
+    assert "serve.prefill" in span_names
 
     # The schema checker actually pins the serve names: dropping one
     # histogram from the summary must fail.
@@ -289,6 +347,92 @@ def test_serving_smoke_two_programs_and_artifacts(model_and_vars,
     with open(os.path.join(run_dir, "summary.json"), "w") as f:
         json.dump(summary, f)
     assert any("serve.ttft_s" in e for e in check_run_dir(run_dir))
+
+
+# --------------------------------------- bucketed and chunked prefill
+def test_bucketed_prefill_matches_single_bucket(model_and_vars, engine):
+    """A 3-token prompt lands in the 4-bucket on the module engine and
+    in the 8-bucket on a single-bucket engine (the old padded-to-
+    max_prefill_len behavior) — greedy tokens must be identical: the
+    bucket is a pad width, never a semantic."""
+    model, variables = model_and_vars
+    wide = Engine(model, variables, ServeConfig(
+        max_batch_size=1, max_len=48, max_prefill_len=8,
+        prefill_buckets=(8,), cache_dtype=jnp.float32))
+    prompt = [5, 17, 3]
+    out = {}
+    for name, eng in (("bucketed", engine), ("padded", wide)):
+        sched = Scheduler(eng)
+        rid = sched.submit(Request(prompt=prompt, max_new_tokens=8))
+        _drain(sched)
+        out[name] = sched.results[rid].tokens
+    assert out["bucketed"] == out["padded"]
+    ref = np.asarray(generate(
+        model, variables, np.asarray([prompt], np.int32),
+        max_new_tokens=8, cache_dtype=jnp.float32))[0, len(prompt):]
+    assert out["bucketed"] == ref.tolist()
+
+
+def test_chunked_long_prompt_matches_single_shot(model_and_vars, engine):
+    """A prompt longer than max_prefill_len (20 > 8: two full 8-chunks
+    + a 4-tail) prefills in successive chunks at traced offsets and must
+    decode exactly like a single-shot prefill of the same prompt — both
+    against an engine whose max_prefill_len covers it in one program,
+    and against one-shot generate()."""
+    model, variables = model_and_vars
+    prompt = [(7 * i + 3) % 97 for i in range(20)]
+    sched = Scheduler(engine)                   # max_prefill_len=8
+    rid = sched.submit(Request(prompt=prompt, max_new_tokens=6))
+    _drain(sched)
+    chunked = sched.results[rid].tokens
+
+    single = Engine(model, variables, ServeConfig(
+        max_batch_size=1, max_len=48, max_prefill_len=32,
+        prefill_buckets=(32,), cache_dtype=jnp.float32))
+    sched1 = Scheduler(single)
+    rid1 = sched1.submit(Request(prompt=prompt, max_new_tokens=6))
+    _drain(sched1)
+    assert chunked == sched1.results[rid1].tokens
+
+    ref = np.asarray(generate(
+        model, variables, np.asarray([prompt], np.int32),
+        max_new_tokens=6, cache_dtype=jnp.float32))[0, len(prompt):]
+    assert chunked == ref.tolist()
+
+
+def test_chunked_tail_never_spills_past_capacity(model_and_vars):
+    """max_len NOT a multiple of max_prefill_len + a near-capacity
+    prompt: the padded tail chunk would write past the slot's KV
+    capacity (dynamic_update_slice clamps the start — silent prefix
+    corruption); the engine must slide the tail window back over real
+    tokens instead. Greedy output still matches one-shot generate()."""
+    model, variables = model_and_vars
+    eng = Engine(model, variables, ServeConfig(
+        max_batch_size=1, max_len=50, max_prefill_len=8,
+        prefill_buckets=(8,), cache_dtype=jnp.float32))
+    prompt = [(11 * i + 5) % 97 for i in range(49)]   # 6 full chunks + 1
+    sched = Scheduler(eng)
+    rid = sched.submit(Request(prompt=prompt, max_new_tokens=1))
+    _drain(sched)
+    ref = np.asarray(generate(
+        model, variables, np.asarray([prompt], np.int32),
+        max_new_tokens=1, cache_dtype=jnp.float32))[0, len(prompt):]
+    assert sched.results[rid].tokens == ref.tolist()
+
+
+def test_default_buckets_and_validation():
+    from nezha_tpu.serve.engine import default_prefill_buckets
+    assert default_prefill_buckets(32) == (8, 16, 32)
+    assert default_prefill_buckets(24) == (8, 16, 24)
+    assert default_prefill_buckets(8) == (8,)
+    assert default_prefill_buckets(5) == (5,)
+    assert ServeConfig(max_prefill_len=32).prefill_buckets == (8, 16, 32)
+    with pytest.raises(ValueError, match="end exactly"):
+        ServeConfig(max_prefill_len=16, prefill_buckets=(4, 8))
+    with pytest.raises(ValueError, match="strictly increasing"):
+        ServeConfig(max_prefill_len=16, prefill_buckets=(8, 4, 16))
+    with pytest.raises(ValueError, match="decode_impl"):
+        ServeConfig(decode_impl="pallas")
 
 
 def test_engine_rejects_bad_shapes(model_and_vars):

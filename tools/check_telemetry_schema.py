@@ -40,9 +40,10 @@ _SUMMARY_KEYS = {"schema_version", "counters", "gauges", "histograms",
 _SERVE_MARKER = "serve.admitted_total"
 _SERVE_COUNTERS = {"serve.admitted_total", "serve.rejected_total",
                    "serve.expired_total", "serve.retired_total",
-                   "serve.tokens_total"}
+                   "serve.tokens_total", "serve.prefill.chunks_total"}
 _SERVE_GAUGES = {"serve.queue_depth", "serve.batch_occupancy"}
-_SERVE_HISTOGRAMS = {"serve.ttft_s", "serve.tpot_s"}
+_SERVE_HISTOGRAMS = {"serve.ttft_s", "serve.tpot_s",
+                     "serve.prefill.bucket_len"}
 
 
 def _is_num(v) -> bool:
