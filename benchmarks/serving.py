@@ -66,6 +66,14 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["auto", "kernel", "xla"], default=None,
                    help="decode attention: flash-decode kernel vs the "
                         "composed masked path (the before/after knob)")
+    p.add_argument("--fault-rate", type=float, default=0.0,
+                   help="probability per prefill / per decode step of an "
+                        "injected fault (prefill errors + NaN logit "
+                        "bursts, seeded by --seed) — measures resilience "
+                        "overhead: errored requests retire with "
+                        "finish_reason 'error' while the run keeps "
+                        "serving, and the error/retry counters land in "
+                        "the run-dir artifact next to TTFT/TPOT")
     p.add_argument("--queue-capacity", type=int, default=16)
     p.add_argument("--model-preset", choices=["tiny", "full"],
                    default="tiny")
@@ -86,6 +94,10 @@ def _percentiles(values):
 
 
 def run(args) -> dict:
+    # Argv validation BEFORE the (expensive) model build + warmup.
+    if not 0.0 <= args.fault_rate < 1.0:
+        raise SystemExit(f"--fault-rate must be in [0, 1), got "
+                         f"{args.fault_rate}")
     from nezha_tpu.cli.common import setup_jax
     setup_jax(args)
 
@@ -142,6 +154,21 @@ def run(args) -> dict:
                              max_new_tokens=1, request_id=f"warmup-{j}"))
     sched.run_until_idle()
 
+    # Chaos mode: a seeded probabilistic plan armed AFTER warmup (a
+    # faulted warmup would skip compiling a bucket program) injecting
+    # the two request-isolated failure modes — prefill errors and NaN
+    # logit bursts. Step crashes are excluded on purpose: their bounded
+    # retry means back-to-back coin-flip failures would kill the whole
+    # run, which is a different experiment than measuring overhead.
+    from nezha_tpu import faults
+    prev_plan = faults.active()
+    plan = None
+    if args.fault_rate > 0:
+        plan = faults.FaultPlan.parse(
+            f"serve.prefill:error%{args.fault_rate};"
+            f"serve.step.logits:nan%{args.fault_rate}", seed=args.seed)
+        faults.install(plan)
+
     sink = None
     if args.run_dir:
         from nezha_tpu.serve.scheduler import register_serve_instruments
@@ -156,55 +183,63 @@ def run(args) -> dict:
     # per-decode occupancy into the metric.batch_occupancy histogram.)
     t0 = time.monotonic()
     issued = finished = dropped = 0
-    if args.mode == "closed":
-        while finished < args.requests:
-            # Pace by queue room: a closed-loop client waits, it does
-            # not shed — hammering submit would inflate rejected_total.
-            while (issued < args.requests
-                   and issued - finished < args.concurrency
-                   and sched.queue_depth < sched.queue_capacity):
-                sched.submit(make_request(issued))
-                issued += 1
-            sched.step()
-            finished = issued - sched.queue_depth - len(sched._live)
-    else:
-        # Poisson arrivals: exponential inter-arrival gaps at --rate.
-        # Arrivals hitting a full queue are DROPPED (open-loop clients
-        # don't wait) — the genuine load-shed rejected_total measures.
-        arrivals = []
-        t = 0.0
-        for _ in range(args.requests):
-            t += rng.expovariate(args.rate)
-            arrivals.append(t)
-        while finished + dropped < args.requests:
-            now = time.monotonic() - t0
-            while issued + dropped < args.requests \
-                    and arrivals[issued + dropped] <= now:
-                try:
-                    sched.submit(make_request(issued + dropped))
+    try:
+        if args.mode == "closed":
+            while finished < args.requests:
+                # Pace by queue room: a closed-loop client waits, it does
+                # not shed — hammering submit would inflate rejected_total.
+                while (issued < args.requests
+                       and issued - finished < args.concurrency
+                       and sched.queue_depth < sched.queue_capacity):
+                    sched.submit(make_request(issued))
                     issued += 1
-                except QueueFull:
-                    dropped += 1
-            if sched.has_work():
                 sched.step()
-            else:
-                time.sleep(0.001)
-            finished = issued - sched.queue_depth - len(sched._live)
+                finished = issued - sched.queue_depth - len(sched._live)
+        else:
+            # Poisson arrivals: exponential inter-arrival gaps at --rate.
+            # Arrivals hitting a full queue are DROPPED (open-loop clients
+            # don't wait) — the genuine load-shed rejected_total measures.
+            arrivals = []
+            t = 0.0
+            for _ in range(args.requests):
+                t += rng.expovariate(args.rate)
+                arrivals.append(t)
+            while finished + dropped < args.requests:
+                now = time.monotonic() - t0
+                while issued + dropped < args.requests \
+                        and arrivals[issued + dropped] <= now:
+                    try:
+                        sched.submit(make_request(issued + dropped))
+                        issued += 1
+                    except QueueFull:
+                        dropped += 1
+                if sched.has_work():
+                    sched.step()
+                else:
+                    time.sleep(0.001)
+                finished = issued - sched.queue_depth - len(sched._live)
+    finally:
+        faults.install(prev_plan)
     wall = time.monotonic() - t0
 
     results = [r for rid, r in sched.results.items()
                if not rid.startswith("warmup")]
-    ttfts = [r.ttft_s for r in results if r.ttft_s is not None]
-    lats = [r.latency_s for r in results]
+    errored = [r for r in results if r.finish_reason == "error"]
+    # Error retirements carry partial decodes (or nothing): keep the
+    # latency percentiles clean by computing them over CLEAN finishes,
+    # while the record reports the error count alongside.
+    clean = [r for r in results if r.finish_reason != "error"]
+    ttfts = [r.ttft_s for r in clean if r.ttft_s is not None]
+    lats = [r.latency_s for r in clean]
     total_tokens = sum(len(r.tokens) for r in results)
     tpots = [(r.latency_s - r.ttft_s) / max(len(r.tokens) - 1, 1)
-             for r in results if r.ttft_s is not None]
+             for r in clean if r.ttft_s is not None]
     # TTFT per prefill bucket: mixed-length loads show whether short
     # prompts actually get the short-bucket TTFT or queue behind wide
     # prefills (keys are the TAIL-chunk pad widths; chunked prompts
     # group under their tail bucket with chunk count in the label).
     by_bucket = {}
-    for r in results:
+    for r in clean:   # same population as the headline ttft_s above
         n = prompt_len_of.get(r.request_id)
         if n is None or r.ttft_s is None:
             continue
@@ -229,6 +264,12 @@ def run(args) -> dict:
         "prefill_buckets": list(engine.cfg.prefill_buckets),
         "decode_impl": args.decode_impl or "auto",
         "compile_cache": engine.compile_stats(),
+        "faults": {
+            "rate": args.fault_rate,
+            "injected": plan.num_injected if plan else 0,
+            "by_point": plan.injected_counts if plan else {},
+            "errored": len(errored),
+        },
     }
     if sink is not None:
         obs.end_run()

@@ -24,7 +24,7 @@ which were derived from BASELINE.json.
 __version__ = "0.1.0"
 
 from nezha_tpu import nn, ops, optim, parallel, models, data, train, graph, runtime
-from nezha_tpu import dist, obs, utils
+from nezha_tpu import dist, obs, utils, faults
 
 __all__ = [
     "nn",
@@ -39,5 +39,6 @@ __all__ = [
     "dist",
     "obs",
     "utils",
+    "faults",
     "__version__",
 ]

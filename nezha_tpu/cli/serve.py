@@ -21,6 +21,16 @@ HTTP (--http PORT, stdlib http.server) — POST /generate with the same
 request object (response once finished; queue-full = 503), GET /healthz
 for liveness + occupancy.
 
+Lifecycle: SIGTERM/SIGINT triggers a GRACEFUL DRAIN — admission closes
+immediately (stdio stops reading stdin; HTTP answers 503 "draining" on
+POST /generate and flips /healthz to 503), in-flight requests keep
+decoding for up to --drain-timeout seconds, stragglers retire with
+finish_reason "deadline", and stdio flushes a final {"event": "drain"}
+line before exit. A second signal during the drain is ignored (the
+drain is already as fast as the deadline allows). NEZHA_FAULT_PLAN /
+NEZHA_FAULT_SEED install a fault-injection plan for chaos drills
+(docs/RUNBOOK.md §9).
+
 With --run-dir the run writes the standard telemetry artifacts;
 `nezha-telemetry RUN_DIR` then renders the serving section (TTFT/TPOT
 percentiles, tokens/sec, batch occupancy).
@@ -37,6 +47,7 @@ import json
 import sys
 import threading
 import time
+from typing import Optional
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -87,6 +98,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "-1 disables even then")
     p.add_argument("--cache-dtype", choices=["bf16", "f32"], default="bf16",
                    help="KV pool dtype (f32 for bit-exact parity checks)")
+    p.add_argument("--drain-timeout", type=float, default=30.0,
+                   help="graceful-drain budget in seconds after SIGTERM/"
+                        "SIGINT: admission closes at the signal, "
+                        "in-flight requests may finish within this "
+                        "window, stragglers retire with finish_reason "
+                        "'deadline'")
     p.add_argument("--http", type=int, default=None, metavar="PORT",
                    help="serve HTTP on PORT instead of stdio JSONL")
     p.add_argument("--run-dir", default=None,
@@ -187,20 +204,63 @@ def _decode_text(tokens, tokenizer):
 
 
 def _result_obj(res, tokenizer) -> dict:
-    return {"id": res.request_id, "event": "done", "tokens": res.tokens,
-            "text": _decode_text(res.tokens, tokenizer),
-            "finish_reason": res.finish_reason, "ttft_s": res.ttft_s,
-            "latency_s": res.latency_s}
+    out = {"id": res.request_id, "event": "done", "tokens": res.tokens,
+           "text": _decode_text(res.tokens, tokenizer),
+           "finish_reason": res.finish_reason, "ttft_s": res.ttft_s,
+           "latency_s": res.latency_s}
+    if res.error is not None:     # finish_reason "error": what broke
+        out["error"] = res.error
+    return out
+
+
+def _drain(scheduler, budget_s: float, drive: bool,
+           dead: Optional[threading.Event] = None,
+           abort: Optional[threading.Event] = None) -> int:
+    """Graceful-drain tail shared by both front ends: keep the decode
+    loop running (``drive=True`` steps it here; ``drive=False`` trusts a
+    live decode thread, passing its death signal as ``dead`` and the
+    server's shutdown signal as ``abort``) until in-flight work
+    finishes, ``budget_s`` expires, or one of the signals fires —
+    nothing will ever finish after the engine dies, so waiting out the
+    budget only delays shutdown. Stragglers are cancelled with
+    finish_reason "deadline" — or "error" when the engine died, so an
+    engine crash at shutdown is never dressed up as a routine deadline.
+    Returns how many were cancelled; the whole window is the
+    ``serve.drain`` span the telemetry report surfaces."""
+    from nezha_tpu import obs
+    from nezha_tpu.serve import FinishReason
+    reason, error = FinishReason.DEADLINE, None
+    with obs.span("serve.drain", budget_s=budget_s) as sp:
+        t_end = time.monotonic() + budget_s
+        while scheduler.has_work() and time.monotonic() < t_end:
+            if dead is not None and dead.is_set():
+                reason = FinishReason.ERROR
+                error = "decode loop died during drain"
+                break
+            if abort is not None and abort.is_set():
+                break
+            if drive:
+                if not scheduler.step():
+                    time.sleep(0.002)
+            else:
+                time.sleep(0.005)
+        cancelled = scheduler.cancel_remaining(reason, error=error)
+        sp.set(cancelled=cancelled, reason=reason)
+    return cancelled
 
 
 # ------------------------------------------------------------- stdio mode
 def run_stdio(scheduler, args, tokenizer, eos_id,
-              stdin=None, stdout=None) -> int:
+              stdin=None, stdout=None, drain=None) -> int:
     """JSONL in, JSONL events out. A reader thread feeds the admission
     queue as lines arrive (QueueFull = wait: stdin IS the backpressure
-    channel); the caller's thread drives the decode loop."""
+    channel); the caller's thread drives the decode loop. Setting
+    ``drain`` (the signal handlers do) closes admission, finishes
+    in-flight work within --drain-timeout, and flushes one final
+    ``{"event": "drain"}`` line."""
     stdin = stdin if stdin is not None else sys.stdin
     stdout = stdout if stdout is not None else sys.stdout
+    drain = drain if drain is not None else threading.Event()
     vocab = scheduler.engine.vocab
     out_lock = threading.Lock()
 
@@ -226,6 +286,23 @@ def run_stdio(scheduler, args, tokenizer, eos_id,
     def reader():
         try:
             for line in stdin:
+                if drain.is_set():
+                    # Admission closed with this line already read off
+                    # stdin: answer it (the stdio analogue of HTTP's
+                    # 503) before stopping, so the client isn't left
+                    # waiting for an event that will never come. Lines
+                    # never read stay un-accepted — the final drain
+                    # event tells the client to stop expecting answers.
+                    if line.strip():
+                        try:
+                            obj = json.loads(line)
+                            rid = obj.get("id") \
+                                if isinstance(obj, dict) else None
+                        except ValueError:
+                            rid = None
+                        emit({"id": rid, "event": "error",
+                              "error": "draining"})
+                    break
                 line = line.strip()
                 if not line:
                     continue
@@ -244,6 +321,14 @@ def run_stdio(scheduler, args, tokenizer, eos_id,
                           "event": "error", "error": str(e)})
                     continue
                 while True:
+                    if drain.is_set():
+                        # Admission closed with this request parsed but
+                        # never submitted: answer it (the stdio analogue
+                        # of HTTP's 503) so the client isn't left
+                        # waiting for an event that will never come.
+                        emit({"id": req.request_id, "event": "error",
+                              "error": "draining"})
+                        break
                     # Wait for queue room rather than spamming submit:
                     # stdin is the backpressure channel, and QueueFull
                     # increments the rejected_total SHED metric.
@@ -264,22 +349,32 @@ def run_stdio(scheduler, args, tokenizer, eos_id,
 
     t = threading.Thread(target=reader, daemon=True)
     t.start()
-    while not done_reading.is_set() or scheduler.has_work():
+    while ((not done_reading.is_set() or scheduler.has_work())
+           and not drain.is_set()):
         if not scheduler.step():
             time.sleep(0.002)
+    if drain.is_set():
+        cancelled = _drain(scheduler, args.drain_timeout, drive=True)
+        # The final flushed event: supervisors tailing stdout know the
+        # drain ran and whether the deadline cut anything off.
+        emit({"id": None, "event": "drain", "cancelled": cancelled})
     return 0
 
 
 # -------------------------------------------------------------- http mode
 def run_http(scheduler, args, tokenizer, eos_id, port: int,
-             ready_cb=None) -> int:
+             ready_cb=None, drain=None) -> int:
     """Stdlib http.server front end: POST /generate (blocks until the
     request retires; 503 on queue-full backpressure), GET /healthz.
-    Handlers run on server threads; one daemon thread drives decode."""
+    Handlers run on server threads; one daemon thread drives decode.
+    Setting ``drain`` (the signal handlers do) closes admission (POST ->
+    503 "draining", /healthz -> 503), lets in-flight requests finish
+    within --drain-timeout, then shuts the server down."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     from nezha_tpu.serve import QueueFull
 
+    drain = drain if drain is not None else threading.Event()
     vocab = scheduler.engine.vocab
     events = {}
     events_lock = threading.Lock()
@@ -291,7 +386,8 @@ def run_http(scheduler, args, tokenizer, eos_id, port: int,
             ev.set()
 
     scheduler.on_finish = on_finish
-    stop = threading.Event()
+    stop = threading.Event()          # server is shutting down (any cause)
+    engine_dead = threading.Event()   # the decode loop CRASHED (subset)
 
     def loop():
         # Fail LOUD and release every waiter: a dead decode thread with
@@ -304,6 +400,7 @@ def run_http(scheduler, args, tokenizer, eos_id, port: int,
         except Exception:
             import traceback
             traceback.print_exc()
+            engine_dead.set()
             stop.set()
             with events_lock:
                 for ev in events.values():
@@ -313,6 +410,11 @@ def run_http(scheduler, args, tokenizer, eos_id, port: int,
     decode_thread.start()
 
     class Handler(BaseHTTPRequestHandler):
+        # Bound the life of a stalled connection (a client that never
+        # finishes its upload) so joining handler threads at shutdown
+        # can't hang on it.
+        timeout = 60
+
         def log_message(self, *a):  # stderr noise off the request path
             pass
 
@@ -328,10 +430,16 @@ def run_http(scheduler, args, tokenizer, eos_id, port: int,
             if self.path != "/healthz":
                 return self._send(404, {"error": "unknown path"})
             pool = scheduler.engine.pool
-            code = 503 if stop.is_set() else 200
-            self._send(code, {
-                "status": "decode loop stopped" if stop.is_set()
-                else "ok",
+            if stop.is_set():
+                status = "decode loop stopped"
+            elif drain.is_set():
+                # Draining flips healthz FIRST: load balancers stop
+                # routing here while in-flight requests finish.
+                status = "draining"
+            else:
+                status = "ok"
+            self._send(200 if status == "ok" else 503, {
+                "status": status,
                 "active": pool.num_active,
                 "capacity": pool.capacity,
                 "queued": scheduler.queue_depth,
@@ -340,6 +448,8 @@ def run_http(scheduler, args, tokenizer, eos_id, port: int,
         def do_POST(self):
             if self.path != "/generate":
                 return self._send(404, {"error": "unknown path"})
+            if drain.is_set():   # admission is closed for good
+                return self._send(503, {"error": "draining"})
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = _parse_request(json.loads(self.rfile.read(n)),
@@ -375,6 +485,16 @@ def run_http(scheduler, args, tokenizer, eos_id, port: int,
                 with events_lock:
                     events.pop(rid, None)
                 return self._send(400, {"error": str(e)})
+            if stop.is_set():
+                # TOCTOU guard: the drain (or a decode-loop death)
+                # completed between the admission check above — which
+                # ran before this request's body finished uploading —
+                # and the submit. Nobody will ever retire this request,
+                # so answer 503 now instead of parking on ev.wait()
+                # forever.
+                with events_lock:
+                    events.pop(rid, None)
+                return self._send(503, {"error": "draining"})
             ev.wait()
             with events_lock:
                 events.pop(rid, None)
@@ -385,7 +505,61 @@ def run_http(scheduler, args, tokenizer, eos_id, port: int,
             out.pop("event")
             self._send(200, out)
 
-    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    class Server(ThreadingHTTPServer):
+        # Join handler threads on close instead of abandoning them as
+        # daemons: a client whose in-flight POST was cancelled at the
+        # drain deadline gets its final "deadline" response flushed
+        # before the process exits, not a connection reset. The drain
+        # sweeps release every parked handler first, and the per-
+        # connection timeout above bounds stalled ones.
+        daemon_threads = False
+
+    server = Server(("127.0.0.1", port), Handler)
+
+    def drain_watch():
+        # Runs the drain off the signal handler: handlers must return
+        # immediately, so they only set the event; this thread does the
+        # waiting, the straggler cancellation (which releases every
+        # parked POST via on_finish), and the server shutdown. With the
+        # decode loop already dead there is nothing left to drain, but
+        # the signal must STILL stop the server — shutdown() is a no-op
+        # if serve_forever already exited.
+        from nezha_tpu.serve import FinishReason
+
+        def cancel_stragglers():
+            # A request whose body upload straddled the drain can slip
+            # past the admission check and submit late; retire it before
+            # releasing events, so its handler finds a RESULT (deadline
+            # on a healthy shutdown, error on a dead engine), not a
+            # spurious 500.
+            if engine_dead.is_set():
+                scheduler.cancel_remaining(FinishReason.ERROR,
+                                           error="decode loop died")
+            else:
+                scheduler.cancel_remaining()
+
+        drain.wait()
+        if not stop.is_set():
+            # If the engine dies mid-drain the wait breaks immediately
+            # (and the cancellations say "error") instead of idling out
+            # the budget over work that can never finish; a server-exit
+            # abort (the serve_forever finally) just cuts it short.
+            _drain(scheduler, args.drain_timeout, drive=False,
+                   dead=engine_dead, abort=stop)
+            stop.set()
+        cancel_stragglers()
+        with events_lock:
+            for ev in events.values():
+                ev.set()
+        server.shutdown()
+        # Once more after shutdown: a handler registering later than
+        # this sweep sees stop already set and answers 503 itself.
+        cancel_stragglers()
+        with events_lock:
+            for ev in events.values():
+                ev.set()
+
+    threading.Thread(target=drain_watch, daemon=True).start()
     if ready_cb is not None:
         ready_cb(server)
     print(f"nezha-serve listening on http://127.0.0.1:"
@@ -397,13 +571,28 @@ def run_http(scheduler, args, tokenizer, eos_id, port: int,
         pass
     finally:
         stop.set()
+        drain.set()    # unblock the watcher thread on non-signal exits
         server.server_close()
     return 0
 
 
-def run(args, stdin=None, stdout=None, ready_cb=None) -> int:
+def run(args, stdin=None, stdout=None, ready_cb=None,
+        drain_event=None) -> int:
+    import signal
+
+    from nezha_tpu import faults
     from nezha_tpu.cli.common import setup_jax
     setup_jax(args)
+
+    # Chaos drills: NEZHA_FAULT_PLAN installs a seeded fault plan for
+    # this serve process (restored on exit so embedded callers — tests —
+    # don't leak plans across runs; restoring an unchanged plan is a
+    # no-op).
+    prev_plan = faults.active()
+    faults.install_from_env()
+
+    drain = drain_event if drain_event is not None else threading.Event()
+    old_handlers = {}
 
     sink = None
     if args.run_dir:
@@ -412,15 +601,32 @@ def run(args, stdin=None, stdout=None, ready_cb=None) -> int:
             "kind": "serve", "mode": "http" if args.http else "stdio"})
     try:
         scheduler, tokenizer, eos_id = _build_stack(args)
+        # SIGTERM/SIGINT = graceful drain, not an exception mid-decode.
+        # Installed only AFTER the stack is built: during the (possibly
+        # minutes-long) weight load + compile there is nothing to drain,
+        # and a wedged startup must stay killable with plain Ctrl-C.
+        # The handler only sets the event; the front ends own the drain
+        # itself. ``drain_event`` lets embedded callers trigger the same
+        # path without a signal (run() off the main thread cannot
+        # install handlers — the ValueError guard below).
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                old_handlers[sig] = signal.signal(
+                    sig, lambda signum, frame: drain.set())
+            except ValueError:
+                break   # not the main thread of the main interpreter
         if args.http is not None:
             return run_http(scheduler, args, tokenizer, eos_id, args.http,
-                            ready_cb=ready_cb)
+                            ready_cb=ready_cb, drain=drain)
         return run_stdio(scheduler, args, tokenizer, eos_id,
-                         stdin=stdin, stdout=stdout)
+                         stdin=stdin, stdout=stdout, drain=drain)
     finally:
         if sink is not None:
             from nezha_tpu import obs
             obs.end_run()
+        for sig, handler in old_handlers.items():
+            signal.signal(sig, handler)
+        faults.install(prev_plan)
 
 
 def main(argv=None) -> int:

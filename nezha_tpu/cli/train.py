@@ -534,6 +534,20 @@ def run(args) -> Dict[str, float]:
     metrics/spans stream into the directory, and ``summary.json`` lands on
     every exit path (success or raise) — `nezha-telemetry RUN_DIR` renders
     the report."""
+    from nezha_tpu import faults
+    # Chaos drills (docs/RUNBOOK.md §9): NEZHA_FAULT_PLAN arms the
+    # registered fault points (e.g. checkpoint.save) for this run —
+    # restored on exit so embedded callers don't leak the plan
+    # (restoring an unchanged plan is a no-op).
+    prev_plan = faults.active()
+    faults.install_from_env()
+    try:
+        return _run_checked(args)
+    finally:
+        faults.install(prev_plan)
+
+
+def _run_checked(args) -> Dict[str, float]:
     if args.trace_dir:
         # --trace-dir is the observability-workflow spelling of
         # --profile-dir (XProf/XLA trace window; see docs/RUNBOOK.md §7).

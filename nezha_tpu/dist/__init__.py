@@ -14,6 +14,8 @@ this layer is strictly host-side control plane.
 
 from nezha_tpu.dist.coordinator import (
     Coordinator,
+    CoordinatorError,
+    JoinTimeout,
     ProcessGroup,
     join,
 )
@@ -21,6 +23,8 @@ from nezha_tpu.dist.launch import initialize_jax_distributed
 
 __all__ = [
     "Coordinator",
+    "CoordinatorError",
+    "JoinTimeout",
     "ProcessGroup",
     "join",
     "initialize_jax_distributed",

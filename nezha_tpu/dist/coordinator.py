@@ -11,14 +11,23 @@ and any Python-side work proceed concurrently.
 from __future__ import annotations
 
 import ctypes
+import random
+import time
 from typing import List, Optional
 
-from nezha_tpu import obs
+from nezha_tpu import faults, obs
 from nezha_tpu.runtime.native import load_library
 
 
 class CoordinatorError(RuntimeError):
     pass
+
+
+class JoinTimeout(CoordinatorError):
+    """:func:`join` exhausted its retry budget without a successful
+    rendezvous. Typed (and a CoordinatorError, so existing handlers
+    still catch it) so supervisors can tell "the coordinator never came
+    up" from in-band control-plane failures."""
 
 
 class Coordinator:
@@ -132,18 +141,25 @@ class ProcessGroup:
 
     def failed_ranks(self) -> List[int]:
         """Ranks the coordinator considers dead: dropped their connection
-        without leaving, or silent past the heartbeat timeout."""
+        without leaving, or silent past the heartbeat timeout. Heartbeat
+        loss is a COUNTED, span-recorded event here (the reacting layer
+        — Trainer, supervisor — decides whether it is fatal), not a bare
+        exception."""
         cap = max(self.world_size, 1)
         arr = (ctypes.c_int32 * cap)()
         n = self._lib.nz_client_failed(self._h, arr, cap)
         if n < 0:
             raise CoordinatorError(self._lib.nz_last_error().decode())
         failed = sorted(arr[i] for i in range(min(n, cap)))
-        if obs.enabled() and failed != self._last_failed:
-            # Heartbeat-failure EVENT (zero-duration span), recorded once
-            # per transition — the poll itself runs every few steps.
+        if failed != self._last_failed:
+            # Heartbeat-failure EVENT (zero-duration span + counter),
+            # recorded once per transition — the poll itself runs every
+            # few steps. Newly-dead ranks only; a rank that rejoins and
+            # dies again counts again.
+            newly = [r for r in failed if r not in self._last_failed]
             self._last_failed = failed
-            if failed:
+            if newly:
+                obs.counter("dist.heartbeat_lost_total").inc(len(newly))
                 with obs.span("dist.failure", rank=self.rank,
                               failed=failed):
                     pass
@@ -179,17 +195,73 @@ class ProcessGroup:
 
 def join(host: str, port: int, rank_hint: int = -1,
          timeout_s: float = 60.0,
-         heartbeat_interval_s: float = 2.0) -> ProcessGroup:
+         heartbeat_interval_s: float = 2.0,
+         attempt_timeout_s: float = 10.0,
+         backoff_base_s: float = 0.25,
+         backoff_max_s: float = 5.0,
+         jitter: float = 0.5) -> ProcessGroup:
     """Join the coordinator at host:port; returns a ProcessGroup with an
-    assigned rank. Retries until the coordinator is up (launch skew)."""
+    assigned rank.
+
+    The dial is a bounded RETRY ENVELOPE, not a single attempt: each
+    native connect gets at most ``attempt_timeout_s`` (the native layer
+    already rides out refused connections inside that window — launch
+    skew), failures back off exponentially from ``backoff_base_s`` up to
+    ``backoff_max_s`` with ±``jitter`` fractional randomization (OS
+    entropy) so a mass-restarted world doesn't redial in lockstep,
+    and once ``timeout_s`` is spent the typed :class:`JoinTimeout`
+    surfaces. Every failed attempt counts into
+    ``dist.join_retries_total`` (pre-registered here, with
+    ``dist.heartbeat_lost_total``, so any joined run's summary carries
+    both — the schema tools/check_telemetry_schema.py pins).
+    """
     lib = load_library()
+    obs.counter("dist.join_retries_total")
+    obs.counter("dist.heartbeat_lost_total")
+    # OS-entropy RNG: pid-derived seeds collapse in containers (every
+    # rank is pid 1 dialing the same port), which would re-correlate
+    # the very redial herd the jitter is here to break up.
+    rng = random.SystemRandom()
+    deadline = time.monotonic() + timeout_s
+    attempt = 0
+    last_err: Optional[BaseException] = None
     with obs.span("dist.join", host=host, port=port) as sp:
-        h = lib.nz_client_connect(
-            host.encode(), int(port), int(rank_hint), int(timeout_s * 1000),
-            int(heartbeat_interval_s * 1000))
-        if not h:
-            raise CoordinatorError(
-                lib.nz_last_error().decode() or "join failed")
-        group = ProcessGroup(h, lib)
-        sp.set(rank=group.rank, world=group.world_size)
-    return group
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise JoinTimeout(
+                    f"could not join coordinator at {host}:{port} within "
+                    f"{timeout_s:.1f}s ({attempt} failed attempt(s)"
+                    f"{f'; last: {last_err}' if last_err else ''})") \
+                    from last_err
+            try:
+                faults.point("dist.join")
+                h = lib.nz_client_connect(
+                    host.encode(), int(port), int(rank_hint),
+                    int(min(remaining, attempt_timeout_s) * 1000),
+                    int(heartbeat_interval_s * 1000))
+                if not h:
+                    raise CoordinatorError(
+                        lib.nz_last_error().decode() or "join failed")
+            except (CoordinatorError, faults.InjectedFault) as e:
+                attempt += 1
+                last_err = e
+                obs.counter("dist.join_retries_total").inc()
+                sp.set(retries=attempt)
+                delay = min(backoff_max_s,
+                            backoff_base_s * (2.0 ** (attempt - 1)))
+                delay *= 1.0 + jitter * (2.0 * rng.random() - 1.0)
+                # Never abandon budget early: when the backoff would
+                # overrun the deadline, shrink it so a final dial slice
+                # (up to 1s) remains — a coordinator coming up late in
+                # the window still gets attempted before JoinTimeout.
+                reserve = min(attempt_timeout_s, 1.0)
+                delay = min(delay,
+                            deadline - time.monotonic() - reserve)
+                if delay > 0:
+                    time.sleep(delay)
+                continue
+            group = ProcessGroup(h, lib)
+            sp.set(rank=group.rank, world=group.world_size,
+                   retries=attempt)
+            return group

@@ -1,0 +1,49 @@
+"""Deterministic fault injection — the failure-mode mirror of
+``nezha_tpu.obs``.
+
+The obs registry made every subsystem permanently *observable* at
+near-zero disabled cost; this package makes the same subsystems
+permanently *breakable* on demand: named fault points stay in the
+production code (serving admission/decode, checkpoint save, coordinator
+dial — tools/check_fault_points.py pins the registry and requires each
+name documented in the RUNBOOK and covered by a test), and a seeded
+:class:`FaultPlan` — built in code or parsed from ``NEZHA_FAULT_PLAN`` —
+decides which hits raise a typed :class:`InjectedFault`, sleep a delay,
+or poison a tensor with nan/inf/zero. With no plan installed every site
+is a branch-only no-op.
+
+This is what lets the resilience claims be TESTED instead of asserted:
+the tier-1 chaos suite (tests/test_faults.py) drives the serving loop,
+checkpoint save, and coordinator join through seeded failure schedules
+and proves isolation (errors retire one request, never the batch),
+recovery (step retry, checkpoint fallback, join backoff), and zero slot
+leaks. ``benchmarks/serving.py --fault-rate`` runs the same machinery
+probabilistically to price the overhead.
+"""
+
+from nezha_tpu.faults.injector import (
+    ENV_PLAN,
+    ENV_SEED,
+    active,
+    clear,
+    corrupt,
+    enabled,
+    install,
+    install_from_env,
+    point,
+)
+from nezha_tpu.faults.plan import (
+    ACTIONS,
+    CORRUPT_ACTIONS,
+    FaultPlan,
+    FaultRule,
+    InjectedFault,
+    parse_rule,
+)
+
+__all__ = [
+    "FaultPlan", "FaultRule", "InjectedFault", "parse_rule",
+    "ACTIONS", "CORRUPT_ACTIONS", "ENV_PLAN", "ENV_SEED",
+    "point", "corrupt", "install", "install_from_env", "clear",
+    "active", "enabled",
+]

@@ -92,6 +92,17 @@ def render_serving_section(summary: Optional[dict]) -> List[str]:
         f"{counters.get('serve.rejected_total', 0)} rejected  "
         f"{counters.get('serve.expired_total', 0)} expired  "
         f"{counters.get('serve.retired_total', 0)} retired")
+    if "serve.errors_total" in counters:
+        # Resilience accounting (absent only in pre-PR-4 captures):
+        # errored requests, bounded step retries, and how many faults
+        # the chaos plan injected (0 on a clean run).
+        lines.append(
+            "  errors: "
+            f"{counters.get('serve.errors_total', 0):.0f} errored  "
+            f"{counters.get('serve.step_retries_total', 0):.0f} "
+            f"step retries  "
+            f"{counters.get('faults.injected_total', 0):.0f} "
+            f"faults injected")
     for key, label in (("serve.ttft_s", "ttft"), ("serve.tpot_s", "tpot")):
         h = hists.get(key)
         if h and h.get("count"):

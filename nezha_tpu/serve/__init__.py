@@ -24,9 +24,14 @@ serve stack replaces the batch lifecycle with a slot lifecycle:
 - ``scheduler``: bounded FIFO admission with backpressure, per-request
   deadlines, and the iteration loop (admit -> decode one token for all
   active rows -> retire on EOS / max-new-tokens / deadline, freeing
-  slots for waiters). Fully instrumented through ``nezha_tpu.obs``
-  (serve.ttft_s / serve.tpot_s histograms, queue-depth and
-  batch-occupancy gauges, admitted/rejected/retired counters).
+  slots for waiters). Failure is request-scoped: a prefill exception or
+  NaN/inf logit burst retires only the affected request
+  (``FinishReason.ERROR``) while the batch keeps decoding, and a step
+  crash gets one bounded retry — provable on demand through the
+  ``nezha_tpu.faults`` injection layer. Fully instrumented through
+  ``nezha_tpu.obs`` (serve.ttft_s / serve.tpot_s histograms,
+  queue-depth and batch-occupancy gauges,
+  admitted/rejected/retired/errors counters).
 
 ``nezha-serve`` (cli/serve.py) fronts the scheduler with stdio-JSONL and
 stdlib-http modes; ``benchmarks/serving.py`` load-tests it into the same

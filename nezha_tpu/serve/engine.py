@@ -62,10 +62,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from nezha_tpu import obs
+from nezha_tpu import faults, obs
 from nezha_tpu.models.generate import _caches_from_states
 from nezha_tpu.runtime.executor import Executor
-from nezha_tpu.serve.sampling import sample_tokens
+from nezha_tpu.serve.sampling import finite_rows, sample_tokens
 from nezha_tpu.serve.slots import SlotPool, read_slot, write_slot
 
 
@@ -182,6 +182,10 @@ class Engine:
                              cfg.cache_dtype)
         b = cfg.max_batch_size
         self.last_logits = jnp.zeros((b, self.vocab), jnp.float32)
+        # [B] bool from the latest step: False where that row's logits
+        # (carried-in or freshly produced) went non-finite — the
+        # scheduler's signal to retire the row with FinishReason.ERROR.
+        self.step_ok: Optional[np.ndarray] = None
         self.positions = jnp.zeros((b,), jnp.int32)
         self.keys = jnp.zeros((b, 2), jnp.uint32)
         self.temps = jnp.zeros((b,), jnp.float32)
@@ -222,6 +226,7 @@ class Engine:
         here — admission (``Scheduler.submit``) is the validation
         boundary. The first generated token comes from the next
         :meth:`step`."""
+        faults.point("serve.prefill")
         n = len(tokens)
         if not 1 <= n < self.cfg.max_len:
             raise ValueError(
@@ -264,18 +269,30 @@ class Engine:
                 self.temps, self.top_ks, self.top_ps)
             (self.pool.caches, self.last_logits, self.positions, self.keys,
              self.temps, self.top_ks, self.top_ps) = out
+        if faults.enabled():
+            self.last_logits = faults.corrupt(
+                "serve.prefill.logits", self.last_logits, rows=(slot,))
 
     def step(self, active: np.ndarray) -> np.ndarray:
         """Decode one token for every row; ``active`` is a ``[B_max]``
         bool mask. Returns the sampled tokens as a host array — entries
-        for inactive rows are garbage and must be ignored."""
-        tok, caches, last, pos, keys = self.executor.run(
+        for inactive rows are garbage and must be ignored. After the
+        call :attr:`step_ok` holds a ``[B_max]`` bool health mask: False
+        where a row's logits went non-finite (only meaningful for rows
+        the caller knows are active)."""
+        faults.point("serve.step")
+        tok, ok, caches, last, pos, keys = self.executor.run(
             self._step_fn, self.variables, self.pool.caches,
             self.last_logits, self.positions,
             jnp.asarray(active, bool), self.keys,
             self.temps, self.top_ks, self.top_ps)
         self.pool.caches = caches
+        if faults.enabled():
+            last = faults.corrupt(
+                "serve.step.logits", last,
+                rows=lambda: np.flatnonzero(active))
         self.last_logits, self.positions, self.keys = last, pos, keys
+        self.step_ok = np.asarray(ok)
         return np.asarray(tok)
 
     def compile_stats(self) -> dict:
@@ -334,6 +351,13 @@ def _build_prefill(model, width: int):
 def _build_step(model, k_max: int, pad_id: int):
     def step(variables, caches, last_logits, positions, active, keys,
              temps, top_ks, top_ps):
+        # Row health, checked in-program (no extra host round-trip): the
+        # carried-in logits catch a burst that landed BETWEEN steps (the
+        # sampled token below is then garbage and the scheduler discards
+        # it), the fresh row catches one the forward pass itself
+        # produced. Either way the scheduler retires the row with
+        # FinishReason.ERROR while its neighbors keep decoding.
+        in_ok = finite_rows(last_logits)
         # One key split per row per step: a request's RNG stream depends
         # only on its seed and step count, never on its batch neighbors.
         splits = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
@@ -351,6 +375,7 @@ def _build_step(model, k_max: int, pad_id: int):
         row_logits = logits[:, -1, :]
         act = active[:, None]
         return (tok,
+                in_ok & finite_rows(row_logits),
                 new_caches,
                 jnp.where(act, row_logits, last_logits),
                 jnp.where(active, positions + 1, positions),

@@ -29,6 +29,17 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def finite_rows(logits) -> jax.Array:
+    """``[B, V]`` logits -> ``[B]`` bool: True where every entry in the
+    row is finite. The decode step's NaN/inf tripwire: computed in-program
+    (two cheap reductions against a forward pass) on both the carried-in
+    logits and the fresh row, so the host learns which rows went bad
+    without an extra device round-trip — the scheduler retires those
+    requests with ``FinishReason.ERROR`` instead of decoding garbage
+    forever or killing the batch."""
+    return jnp.isfinite(logits).all(axis=-1)
+
+
 def sample_tokens(logits, keys, temperature, top_k, top_p,
                   k_max: int) -> jax.Array:
     """logits ``[B, V]``, keys ``[B, 2]`` (one PRNG key per row),

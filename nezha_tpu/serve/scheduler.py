@@ -15,11 +15,20 @@ metrics of record: ``serve.ttft_s`` (submit -> first token) and
 ``serve.prefill.bucket_len`` (static pad width per prefill chunk — the
 bucket-occupancy view), ``serve.queue_depth`` and
 ``serve.batch_occupancy`` gauges,
-``serve.{admitted,rejected,expired,retired,tokens}_total`` and
-``serve.prefill.chunks_total`` counters, and a
+``serve.{admitted,rejected,expired,retired,tokens}_total``,
+``serve.{errors,step_retries}_total`` and ``serve.prefill.chunks_total``
+counters, ``faults.injected_total`` (the chaos ledger), and a
 ``serve.decode_attention`` span around every batched decode step —
 the names tools/check_telemetry_schema.py pins. With no run active
 every call site is the registry's branch-only no-op.
+
+Failure isolation is request-scoped by design: a prefill exception or a
+non-finite logit row retires ONLY the affected request
+(``FinishReason.ERROR``, slot freed the same iteration) while the loop
+keeps decoding everyone else, and a crashed ``engine.step`` gets one
+bounded backoff retry before the failure surfaces. The fault-injection
+layer (``nezha_tpu.faults``) manufactures all three on demand;
+tests/test_faults.py proves zero slot leaks under a seeded chaos plan.
 """
 
 from __future__ import annotations
@@ -45,7 +54,11 @@ class QueueFull(Exception):
 class FinishReason:
     EOS = "eos"
     LENGTH = "length"          # max_new_tokens reached
-    DEADLINE = "deadline"      # expired (queued or mid-decode)
+    DEADLINE = "deadline"      # expired (queued, mid-decode, or at the
+                               # drain cutoff)
+    ERROR = "error"            # prefill failure or non-finite logits —
+                               # the request is retired, its slot freed,
+                               # and the batch keeps decoding
 
 
 @dataclasses.dataclass
@@ -72,6 +85,7 @@ class RequestResult:
     finish_reason: str
     ttft_s: Optional[float]    # None when expired before the first token
     latency_s: float
+    error: Optional[str] = None   # set for FinishReason.ERROR: what broke
 
 
 @dataclasses.dataclass
@@ -93,9 +107,14 @@ def register_serve_instruments() -> None:
     tools/check_telemetry_schema.py pins). Called at scheduler
     construction; call again after a registry reset (e.g. a benchmark
     that starts its run AFTER warmup)."""
-    for c in ("admitted", "rejected", "expired", "retired", "tokens"):
+    for c in ("admitted", "rejected", "expired", "retired", "tokens",
+              "errors", "step_retries"):
         obs.counter(f"serve.{c}_total")
     obs.counter("serve.prefill.chunks_total")
+    # The fault layer's injection count rides in every serving summary
+    # (0 when no plan is active) so chaos runs and clean runs share one
+    # schema — dashboards can divide errors by injections.
+    obs.counter("faults.injected_total")
     obs.gauge("serve.queue_depth")
     obs.gauge("serve.batch_occupancy")
     obs.histogram("serve.ttft_s")
@@ -110,7 +129,13 @@ class Scheduler:
     ``on_finish(result)`` fires at retirement. Both run on the thread
     driving :meth:`step`. ``submit`` is thread-safe (HTTP handlers call
     it concurrently with the decode loop).
+
+    ``step_retry_backoff_s`` is the pause before the single
+    ``engine.step`` retry — long enough for a transient to clear, short
+    enough that in-flight TPOT survives one hiccup.
     """
+
+    step_retry_backoff_s = 0.05
 
     def __init__(self, engine: Engine,
                  on_token: Optional[Callable[[str, int], None]] = None,
@@ -231,9 +256,19 @@ class Scheduler:
                         slot, req.prompt, seed=req.seed,
                         temperature=req.temperature, top_k=req.top_k,
                         top_p=req.top_p)
-            except Exception:   # submit() pre-validates; never leak a slot
+            except Exception as e:
+                # submit() pre-validates the request SHAPE, but runtime/
+                # XLA errors (OOM-ish transients, injected faults) can
+                # still surface here — and one bad request must never
+                # kill the decode loop with neighbors in flight. Free
+                # the slot, retire the request as an ERROR, keep
+                # admitting. (The span recorded the exception type.)
                 pool.free(slot)
-                raise
+                obs.counter("serve.errors_total").inc()
+                self._finish(live, FinishReason.ERROR,
+                             error=f"prefill failed: "
+                                   f"{type(e).__name__}: {e}")
+                continue
             self._live[slot] = live
             obs.counter("serve.admitted_total").inc()
 
@@ -249,12 +284,36 @@ class Scheduler:
             len(self._live) / self.engine.cfg.max_batch_size)
         t0 = time.monotonic()
         with obs.span("serve.decode_attention", rows=len(self._live)):
-            tokens = self.engine.step(active)
+            try:
+                tokens = self.engine.step(active)
+            except Exception:
+                # One bounded retry with backoff: a transient step crash
+                # (preempted device, injected fault) must not retire
+                # every in-flight request. A second consecutive failure
+                # surfaces to the caller — that is a dead engine, not a
+                # hiccup. (If the first dispatch died AFTER consuming
+                # its donated cache buffers the retry fails fast on the
+                # donation error and surfaces the same way.)
+                obs.counter("serve.step_retries_total").inc()
+                time.sleep(self.step_retry_backoff_s)
+                tokens = self.engine.step(active)
         dt = time.monotonic() - t0
+        ok = self.engine.step_ok
         now = time.monotonic()
         emitted = 0
         for slot in list(self._live):
             live = self._live[slot]
+            if ok is not None and not ok[slot]:
+                # Non-finite logits (NaN/inf burst): this row's sampled
+                # token is garbage — discard it and retire ONLY this
+                # request; the rest of the batch keeps its tokens.
+                del self._live[slot]
+                self.engine.pool.free(slot)
+                obs.counter("serve.errors_total").inc()
+                obs.counter("serve.retired_total").inc()
+                self._finish(live, FinishReason.ERROR,
+                             error="non-finite logits")
+                continue
             tok = int(tokens[slot])
             live.tokens.append(tok)
             emitted += 1
@@ -283,11 +342,48 @@ class Scheduler:
         obs.counter("serve.tokens_total").inc(emitted)
         return emitted
 
-    def _finish(self, live: _Live, reason: str) -> None:
+    def _finish(self, live: _Live, reason: str,
+                error: Optional[str] = None) -> None:
         result = RequestResult(
             request_id=live.request_id, tokens=live.tokens,
             finish_reason=reason, ttft_s=live.ttft_s,
-            latency_s=time.monotonic() - live.submit_t)
+            latency_s=time.monotonic() - live.submit_t, error=error)
         self.results[live.request_id] = result
         if self.on_finish is not None:
             self.on_finish(result)
+
+    # ----------------------------------------------------------- drain
+    def cancel_remaining(self, reason: str = FinishReason.DEADLINE,
+                         error: Optional[str] = None) -> int:
+        """Retire EVERYTHING still queued or in flight — the drain
+        cutoff. Each request finishes with ``reason`` and whatever
+        tokens it already has, every slot returns to the pool, and the
+        count of cancellations comes back (0 when already idle).
+        Deadline-reason cancellations count into ``serve.expired_total``
+        (the documented every-deadline-miss contract); error-reason ones
+        (a dead engine at shutdown) into ``serve.errors_total`` with
+        ``error`` as the detail."""
+        def _count():
+            if reason == FinishReason.DEADLINE:
+                obs.counter("serve.expired_total").inc()
+            elif reason == FinishReason.ERROR:
+                obs.counter("serve.errors_total").inc()
+
+        with self._lock:
+            n = 0
+            while self._queue:
+                live = self._queue.popleft()
+                _count()
+                self._finish(live, reason, error=error)
+                n += 1
+            for slot in list(self._live):
+                live = self._live.pop(slot)
+                self.engine.pool.free(slot)
+                obs.counter("serve.retired_total").inc()
+                _count()
+                self._finish(live, reason, error=error)
+                n += 1
+            obs.gauge("serve.queue_depth").set(0)
+            obs.gauge("serve.batch_occupancy").set(
+                self.engine.pool.occupancy)
+            return n

@@ -1,22 +1,55 @@
-"""Checkpoint save/restore + resume.
+"""Checkpoint save/restore + resume — durable and verified.
 
-Flat-key ``.npz`` snapshots of the full TrainState (params, optimizer state,
-BatchNorm stats, RNG) with atomic rename, plus ``try_restore`` for
-crash-resume (aux subsystem per the build brief; the reference's equivalent
-was not observable — SURVEY.md §5). Format is plain numpy so checkpoints are
-portable and inspectable without the framework.
+Flat-key ``.npz`` snapshots of the full TrainState (params, optimizer
+state, BatchNorm stats, RNG) in plain numpy, portable and inspectable
+without the framework. The durability contract (hardened for the
+robustness leg, PR 4):
+
+- **save** writes the npz — including a per-leaf CRC32 manifest
+  EMBEDDED as a ``__manifest__`` entry, so data and checksums publish
+  in one atomic rename with no sidecar-pairing window — to a temp file,
+  fsyncs the FILE, ``os.replace``s it into place, and fsyncs the
+  DIRECTORY, so "atomically write" holds across power loss, not just
+  process crash (neither fsync happened before).
+- **restore** verifies integrity under a ``checkpoint.verify`` span:
+  the npz must unzip cleanly and, when it carries a manifest, hold
+  exactly the manifested leaves with matching CRC32s. Corruption raises
+  the typed :class:`CheckpointCorrupt` instead of whatever zipfile
+  error a torn write happens to produce.
+- **try_restore** walks steps newest -> oldest and resumes from the
+  newest INTACT checkpoint: a torn/truncated file or stray ``.tmp`` at
+  the head (the kill-during-save signature) costs one step of progress,
+  never the run. Rejected steps count into ``checkpoint.corrupt_total``.
+
+(The per-shard format has its own path — train/sharded_checkpoint.py.)
 """
 
 from __future__ import annotations
 
+import json
+import logging
 import os
 import re
 import tempfile
+import zlib
 from pathlib import Path
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
+
+from nezha_tpu import faults, obs
+
+MANIFEST_VERSION = 1
+MANIFEST_KEY = "__manifest__"   # reserved npz entry holding the JSON
+                                # CRC32 manifest — never a state leaf
+
+_log = logging.getLogger("nezha_tpu.checkpoint")
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint failed integrity verification: torn zip, truncated
+    leaf, manifest/leaf-set mismatch, or CRC32 mismatch."""
 
 
 def _flatten(tree: Any) -> dict:
@@ -49,9 +82,31 @@ def _unflatten(template: Any, flat: dict) -> Any:
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
 
+def _leaf_crc(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
+def _fsync_dir(path: Path) -> None:
+    """Make a rename durable: fsync the containing directory so the new
+    directory entry itself survives power loss."""
+    fd = os.open(str(path), os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 def save_checkpoint(ckpt_dir: str, state: Any, step: int,
                     keep_last: Optional[int] = None) -> str:
-    """Atomically write ``step_<N>.npz``; returns the path.
+    """Durably and atomically write ``step_<N>.npz``; returns the path.
+
+    The per-leaf CRC32 manifest travels INSIDE the npz (the
+    ``__manifest__`` entry), so checksums and data publish in one
+    atomic rename — there is no state where a reader can pair one
+    step's data with another save's manifest. Publication order: npz
+    bytes (leaves + manifest) -> file fsync -> rename -> directory
+    fsync; a crash at any point leaves at worst a stray ``*.tmp``,
+    which restore ignores.
 
     ``keep_last=N`` prunes all but the N newest checkpoints AFTER the new
     one is durably in place (a failed save never costs an old checkpoint).
@@ -59,12 +114,27 @@ def save_checkpoint(ckpt_dir: str, state: Any, step: int,
     d = Path(ckpt_dir)
     d.mkdir(parents=True, exist_ok=True)
     flat = _flatten(jax.device_get(state))
+    if MANIFEST_KEY in flat:
+        raise ValueError(
+            f"state tree contains a leaf named {MANIFEST_KEY!r} — that "
+            f"key is reserved for the checkpoint integrity manifest")
     final = d / f"step_{step:08d}.npz"
+    manifest = json.dumps({
+        "manifest_version": MANIFEST_VERSION,
+        "step": int(step),
+        "leaves": {k: {"crc32": _leaf_crc(v), "shape": list(v.shape),
+                       "dtype": str(v.dtype)}
+                   for k, v in flat.items()},
+    })
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
-            np.savez(f, **flat)
+            np.savez(f, **flat, **{MANIFEST_KEY: np.asarray(manifest)})
+            f.flush()
+            os.fsync(f.fileno())
+        faults.point("checkpoint.save")
         os.replace(tmp, final)
+        _fsync_dir(d)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
@@ -75,8 +145,8 @@ def save_checkpoint(ckpt_dir: str, state: Any, step: int,
 
 def prune_old_checkpoints(ckpt_dir: str, keep_last: int) -> None:
     """Delete all but the ``keep_last`` newest ``step_*.npz`` files.
-    Concurrent pruners (multi-host) race benignly: a loser's missing path
-    is ignored. (Sharded checkpoints have their own pruner with
+    Concurrent pruners (multi-host) race benignly: a loser's missing
+    path is ignored. (Sharded checkpoints have their own pruner with
     completeness checks — sharded_checkpoint.prune_old_sharded.)"""
     d = Path(ckpt_dir)
     entries = sorted(p for p in d.glob("step_*.npz")
@@ -88,30 +158,87 @@ def prune_old_checkpoints(ckpt_dir: str, keep_last: int) -> None:
             pass
 
 
-def latest_step(ckpt_dir: str) -> Optional[int]:
+def checkpoint_steps(ckpt_dir: str) -> List[int]:
+    """All on-disk step numbers, ascending (no integrity claim — a
+    listed step may still fail verification at restore)."""
     d = Path(ckpt_dir)
     if not d.exists():
-        return None
-    steps = [int(m.group(1)) for p in d.glob("step_*.npz")
-             if (m := re.match(r"step_(\d+)\.npz$", p.name))]
-    return max(steps) if steps else None
+        return []
+    return sorted(int(m.group(1)) for p in d.glob("step_*.npz")
+                  if (m := re.match(r"step_(\d+)\.npz$", p.name)))
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    steps = checkpoint_steps(ckpt_dir)
+    return steps[-1] if steps else None
+
+
+def verify_checkpoint(ckpt_dir: str, step: int) -> Dict[str, np.ndarray]:
+    """Load + integrity-check one checkpoint; returns the flat
+    ``{key: array}`` dict (manifest entry stripped). Raises
+    :class:`CheckpointCorrupt` when the npz is torn or disagrees with
+    its embedded manifest, ``FileNotFoundError`` when the step doesn't
+    exist. Manifest-less checkpoints (pre-manifest saves) pass on a
+    clean unzip alone."""
+    path = Path(ckpt_dir) / f"step_{step:08d}.npz"
+    if not path.exists():
+        raise FileNotFoundError(f"no checkpoint for step {step} in "
+                                f"{ckpt_dir}")
+    with obs.span("checkpoint.verify", step=step):
+        try:
+            with np.load(path) as z:
+                flat = {k: z[k] for k in z.files}
+        except Exception as e:  # torn zip / truncated entry / bad header
+            raise CheckpointCorrupt(
+                f"{path.name}: unreadable "
+                f"({type(e).__name__}: {e})") from e
+        if MANIFEST_KEY not in flat:
+            return flat
+        try:
+            leaves = json.loads(str(flat.pop(MANIFEST_KEY)))["leaves"]
+        except Exception as e:
+            raise CheckpointCorrupt(
+                f"{path.name}: unreadable embedded manifest "
+                f"({type(e).__name__}: {e})") from e
+        missing = set(leaves) - set(flat)
+        extra = set(flat) - set(leaves)
+        if missing or extra:
+            raise CheckpointCorrupt(
+                f"{path.name}: leaf set disagrees with manifest "
+                f"(missing {sorted(missing)}, extra {sorted(extra)})")
+        for key, meta in leaves.items():
+            if _leaf_crc(flat[key]) != meta["crc32"]:
+                raise CheckpointCorrupt(
+                    f"{path.name}: CRC32 mismatch for leaf {key!r}")
+        return flat
 
 
 def restore_checkpoint(ckpt_dir: str, template: Any, step: Optional[int] = None) -> Tuple[Any, int]:
-    """Restore into the structure of ``template`` (a freshly-init'd state)."""
+    """Restore into the structure of ``template`` (a freshly-init'd
+    state), verifying integrity first (:func:`verify_checkpoint`)."""
     if step is None:
         step = latest_step(ckpt_dir)
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {ckpt_dir}")
-    path = Path(ckpt_dir) / f"step_{step:08d}.npz"
-    with np.load(path) as z:
-        flat = {k: z[k] for k in z.files}
-    return _unflatten(template, flat), step
+    return _unflatten(template, verify_checkpoint(ckpt_dir, step)), step
 
 
 def try_restore(ckpt_dir: str, template: Any) -> Tuple[Optional[Any], int]:
-    step = latest_step(ckpt_dir)
-    if step is None:
-        return None, 0
-    state, step = restore_checkpoint(ckpt_dir, template, step)
-    return state, step
+    """Crash-resume entry: the newest INTACT checkpoint, or ``(None, 0)``
+    when none verifies. A corrupt head (torn write from a mid-save kill)
+    falls back to the previous step instead of raising — each rejected
+    step is logged and counted (``checkpoint.corrupt_total``)."""
+    for step in reversed(checkpoint_steps(ckpt_dir)):
+        try:
+            return (_unflatten(template, verify_checkpoint(ckpt_dir, step)),
+                    step)
+        except CheckpointCorrupt as e:
+            obs.counter("checkpoint.corrupt_total").inc()
+            _log.warning("skipping corrupt checkpoint at step %d: %s",
+                         step, e)
+        except FileNotFoundError:
+            # A concurrent pruner (multi-host) deleted it between the
+            # listing and the open — not corruption, just keep walking.
+            _log.warning("checkpoint for step %d vanished (concurrent "
+                         "prune?); falling back", step)
+    return None, 0

@@ -40,7 +40,7 @@ from typing import Any, Callable, List, Optional, Tuple
 import jax
 import numpy as np
 
-from .checkpoint import _path_str
+from .checkpoint import _fsync_dir, _path_str
 
 
 def _leaf_key(path) -> str:
@@ -353,11 +353,24 @@ def _write_prefetched(ckpt_dir: str, host_state: Any, step: int) -> str:
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, d / f"shards_p{proc}.npz")
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
-    (d / f"meta_p{proc}.json.tmp").write_text(json.dumps(meta))
-    os.replace(d / f"meta_p{proc}.json.tmp", d / f"meta_p{proc}.json")
+    mtmp = d / f"meta_p{proc}.json.tmp"
+    with open(mtmp, "w") as f:
+        f.write(json.dumps(meta))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(mtmp, d / f"meta_p{proc}.json")
+    # The COMPLETE marker is only meaningful if the data it vouches for
+    # is durable FIRST: fsync the dir (making both renames durable)
+    # before touching the marker, then again after, so a power loss can
+    # leave a torn dir without its marker — which latest_step skips —
+    # but never a marker vouching for missing bytes.
+    _fsync_dir(d)
     (d / f"COMPLETE_p{proc}").touch()
+    _fsync_dir(d)
     return str(d)

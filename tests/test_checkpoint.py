@@ -64,3 +64,157 @@ def test_keep_last_prunes_oldest(tmp_path):
     restored, step = restore_checkpoint(tmp_path, state)
     assert step == 5 == latest_step(tmp_path)
     np.testing.assert_array_equal(restored["w"], state["w"] + 5)
+
+
+# ------------------------------------------- durability + crash-resume
+def test_save_embeds_crc_manifest(tmp_path):
+    """The CRC32 manifest travels INSIDE the npz (one atomic publish —
+    checksums can never pair with another save's data)."""
+    import json
+
+    from nezha_tpu.train import checkpoint as ckpt
+    state = {"w": np.arange(8.0), "b": np.ones((3,), np.float32)}
+    ckpt.save_checkpoint(tmp_path, state, 1)
+    with np.load(tmp_path / "step_00000001.npz") as z:
+        assert ckpt.MANIFEST_KEY in z.files
+        man = json.loads(str(z[ckpt.MANIFEST_KEY]))
+    assert man["step"] == 1
+    assert set(man["leaves"]) == {"w", "b"}
+    assert man["leaves"]["w"]["shape"] == [8]
+    assert man["leaves"]["b"]["dtype"] == "float32"
+    flat = ckpt.verify_checkpoint(tmp_path, 1)   # intact: verifies clean
+    assert ckpt.MANIFEST_KEY not in flat         # stripped for restore
+    np.testing.assert_array_equal(flat["w"], state["w"])
+
+
+def test_try_restore_falls_back_on_torn_newest(tmp_path):
+    """The kill-during-save signature — a truncated npz and a stray
+    .tmp at the newest step — costs one checkpoint of progress, never
+    the run: try_restore returns the previous INTACT step, and an
+    explicit restore of the torn step raises the typed error."""
+    import pytest
+
+    from nezha_tpu.train import checkpoint as ckpt
+    state = {"w": np.arange(4.0)}
+    ckpt.save_checkpoint(tmp_path, {"w": state["w"] + 1}, 1)
+    ckpt.save_checkpoint(tmp_path, {"w": state["w"] + 2}, 2)
+    torn = tmp_path / "step_00000002.npz"
+    torn.write_bytes(torn.read_bytes()[: torn.stat().st_size // 2])
+    (tmp_path / "abc123.tmp").write_bytes(b"partial save junk")
+    restored, step = ckpt.try_restore(tmp_path, state)
+    assert step == 1
+    np.testing.assert_array_equal(restored["w"], state["w"] + 1)
+    with pytest.raises(ckpt.CheckpointCorrupt):
+        ckpt.restore_checkpoint(tmp_path, state, step=2)
+    # resume then continues: the next save REPLACES the torn head and
+    # restores normal service
+    ckpt.save_checkpoint(tmp_path, {"w": restored["w"] + 1}, 2)
+    restored2, step2 = ckpt.try_restore(tmp_path, state)
+    assert step2 == 2
+    np.testing.assert_array_equal(restored2["w"], state["w"] + 2)
+
+
+def test_crc_mismatch_detected_and_skipped(tmp_path):
+    """A bit-rotted npz that still unzips cleanly is caught by the
+    embedded per-leaf CRC32 manifest; try_restore with no intact step
+    left returns (None, 0) — train starts fresh instead of loading
+    garbage."""
+    import pytest
+
+    from nezha_tpu.train import checkpoint as ckpt
+    state = {"w": np.arange(4.0)}
+    ckpt.save_checkpoint(tmp_path, {"w": state["w"] + 1}, 1)
+    p = tmp_path / "step_00000001.npz"
+    with np.load(p) as z:
+        man = str(z[ckpt.MANIFEST_KEY])
+    # same leaves + original manifest, different bytes: valid zip,
+    # wrong CRC (the bit-rot signature)
+    np.savez(p, w=np.zeros(4), **{ckpt.MANIFEST_KEY: np.asarray(man)})
+    with pytest.raises(ckpt.CheckpointCorrupt, match="CRC32"):
+        ckpt.verify_checkpoint(tmp_path, 1)
+    restored, step = ckpt.try_restore(tmp_path, state)
+    assert restored is None and step == 0
+
+
+def test_manifestless_checkpoint_still_loads(tmp_path):
+    """Pre-manifest saves (older runs: a plain npz with no embedded
+    manifest) restore on a clean unzip alone."""
+    from nezha_tpu.train import checkpoint as ckpt
+    state = {"w": np.arange(4.0)}
+    np.savez(tmp_path / "step_00000001.npz", w=state["w"] + 1)
+    restored, step = ckpt.try_restore(tmp_path, state)
+    assert step == 1
+    np.testing.assert_array_equal(restored["w"], state["w"] + 1)
+
+
+def test_try_restore_survives_concurrently_pruned_step(tmp_path):
+    """A checkpoint deleted between the directory listing and the open
+    (multi-host pruner race) is not corruption — try_restore walks past
+    it to the next intact step instead of raising FileNotFoundError."""
+    from nezha_tpu.train import checkpoint as ckpt
+    state = {"w": np.arange(4.0)}
+    ckpt.save_checkpoint(tmp_path, {"w": state["w"] + 1}, 1)
+    ckpt.save_checkpoint(tmp_path, {"w": state["w"] + 2}, 2)
+    real_verify = ckpt.verify_checkpoint
+    (tmp_path / "step_00000002.npz").unlink()   # "pruned" after listing
+
+    steps = ckpt.checkpoint_steps(tmp_path)
+    assert steps == [1]                          # listing sees reality...
+    # ...but simulate the race: walk a stale listing through try_restore
+    import unittest.mock as mock
+    with mock.patch.object(ckpt, "checkpoint_steps",
+                           return_value=[1, 2]):
+        restored, step = ckpt.try_restore(tmp_path, state)
+    assert step == 1
+    np.testing.assert_array_equal(restored["w"], state["w"] + 1)
+    assert ckpt.verify_checkpoint is real_verify
+
+
+def test_kill_during_save_fault_leaves_previous_intact(tmp_path):
+    """Fault-plan drill at the checkpoint.save point (between the npz
+    tmp write and publication): the save dies, no partial step becomes
+    visible, and resume still lands on the previous step."""
+    import pytest
+
+    from nezha_tpu import faults
+    from nezha_tpu.train import checkpoint as ckpt
+    state = {"w": np.arange(4.0)}
+    ckpt.save_checkpoint(tmp_path, {"w": state["w"] + 1}, 1)
+    faults.install(faults.FaultPlan.parse("checkpoint.save:error@1"))
+    try:
+        with pytest.raises(faults.InjectedFault):
+            ckpt.save_checkpoint(tmp_path, {"w": state["w"] + 2}, 2)
+    finally:
+        faults.clear()
+    assert not (tmp_path / "step_00000002.npz").exists()
+    assert ckpt.latest_step(tmp_path) == 1
+    restored, step = ckpt.try_restore(tmp_path, state)
+    assert step == 1
+    np.testing.assert_array_equal(restored["w"], state["w"] + 1)
+    # the interrupted step saves cleanly on retry
+    ckpt.save_checkpoint(tmp_path, {"w": state["w"] + 2}, 2)
+    assert ckpt.try_restore(tmp_path, state)[1] == 2
+
+
+def test_trainer_resumes_past_torn_checkpoint(tmp_path):
+    """End to end: training saved steps 3 and 6, the newest save was
+    torn by a crash — resume falls back to step 3 and training
+    CONTINUES from there."""
+    model = MLP(hidden=(16,))
+    opt = optim.momentum(0.05)
+    t1 = Trainer(model, opt, _loss_fn, rng=jax.random.PRNGKey(7),
+                 checkpoint_dir=str(tmp_path), checkpoint_every=3,
+                 log_every=10)
+    t1.initialize(resume=False)
+    t1.fit(data.mnist_batches(32, seed=1), steps=6)
+    torn = tmp_path / "step_00000006.npz"
+    assert torn.exists()
+    torn.write_bytes(torn.read_bytes()[:128])
+
+    t2 = Trainer(model, opt, _loss_fn, rng=jax.random.PRNGKey(7),
+                 checkpoint_dir=str(tmp_path), checkpoint_every=3,
+                 log_every=10)
+    t2.initialize(resume=True)
+    assert t2.global_step == 3            # newest INTACT step
+    t2.fit(data.mnist_batches(32, seed=1), steps=3)   # resumes training
+    assert t2.global_step == 6

@@ -279,6 +279,83 @@ def test_peer_death_during_barrier_is_detected():
         g0.leave()
 
 
+def test_join_timeout_is_typed_and_counted():
+    """Dialing a port nobody serves exhausts the retry envelope inside
+    timeout_s and raises the typed JoinTimeout (still a
+    CoordinatorError), counting every failed attempt."""
+    import socket
+
+    from nezha_tpu import obs
+
+    with socket.socket() as s:   # grab-and-release: a dead port
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+    obs.enable()
+    try:
+        before = obs.counter("dist.join_retries_total").value
+        t0 = time.monotonic()
+        with pytest.raises(dist.JoinTimeout):
+            dist.join("127.0.0.1", dead_port, timeout_s=1.0,
+                      attempt_timeout_s=0.2, backoff_base_s=0.02)
+        assert time.monotonic() - t0 < 5.0        # bounded, not hung
+        assert obs.counter("dist.join_retries_total").value > before
+    finally:
+        obs.disable()
+    assert issubclass(dist.JoinTimeout, dist.CoordinatorError)
+
+
+def test_join_retries_through_injected_dial_failure():
+    """A fault-injected failure on the first dial attempt is absorbed by
+    the backoff envelope: the second attempt lands and the group works."""
+    from nezha_tpu import faults
+
+    faults.install(faults.FaultPlan.parse("dist.join:error@1"))
+    try:
+        with dist.Coordinator(world_size=1) as coord:
+            g = dist.join("127.0.0.1", coord.port, backoff_base_s=0.01)
+            assert g.rank == 0
+            g.put("k", b"v")
+            assert g.get("k", timeout_s=5) == b"v"
+            g.leave()
+        assert faults.active().injected_counts == {"dist.join": 1}
+    finally:
+        faults.clear()
+
+
+def test_heartbeat_loss_is_counted_event():
+    """An abrupt peer death surfaces from failed_ranks() as a counted
+    (dist.heartbeat_lost_total) span-recorded event, not an exception."""
+    from nezha_tpu import obs
+
+    obs.enable()
+    try:
+        before = obs.counter("dist.heartbeat_lost_total").value
+        spans_before = len(obs.REGISTRY.spans)
+        with dist.Coordinator(world_size=2,
+                              heartbeat_timeout_s=0.5) as coord:
+            g0 = dist.join("127.0.0.1", coord.port,
+                           heartbeat_interval_s=0.1)
+            g1 = dist.join("127.0.0.1", coord.port,
+                           heartbeat_interval_s=0.1)
+            g1.close()  # abrupt: no LEAVE
+            deadline = time.time() + 5
+            failed = []
+            while time.time() < deadline and not failed:
+                failed = g0.failed_ranks()
+                time.sleep(0.05)
+            assert failed == [1]
+            g0.failed_ranks()   # repeat poll: same transition, no recount
+            assert (obs.counter("dist.heartbeat_lost_total").value
+                    == before + 1)
+            failure_spans = [s for s in obs.REGISTRY.spans[spans_before:]
+                             if s["name"] == "dist.failure"]
+            assert len(failure_spans) == 1
+            assert failure_spans[0]["attrs"]["failed"] == [1]
+            g0.leave()
+    finally:
+        obs.disable()
+
+
 def test_incr_is_atomic_across_ranks():
     def fn(g):
         return [g.incr("ctr") for _ in range(10)]

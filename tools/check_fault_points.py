@@ -1,0 +1,114 @@
+#!/usr/bin/env python3
+"""Fault-point registry validator.
+
+The fault-injection layer (``nezha_tpu.faults``) only earns its keep if
+every registered point stays discoverable, documented, and actually
+exercised — an undocumented point is a chaos knob nobody can use, and an
+untested one is a resilience claim nobody has proven. This validator
+walks the source tree for ``faults.point("...")`` / ``faults.corrupt(
+"...")`` literals and asserts each name is
+
+1. **unique** — one call site per name, so hit counts and plan rules
+   are unambiguous;
+2. **documented** — the name appears in docs/RUNBOOK.md (the fault-point
+   table in the "Failure modes & recovery" section);
+3. **tested** — the name appears in at least one file under tests/
+   (a plan rule string or a direct reference).
+
+Stdlib-only, same pattern as check_telemetry_schema.py: run from the
+tier-1 suite (tests/test_faults.py) or standalone:
+
+    python tools/check_fault_points.py [REPO_ROOT]
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict, List
+
+POINT_RE = re.compile(
+    r"""faults\.(?:point|corrupt)\(\s*["']([A-Za-z0-9_.]+)["']""")
+SOURCE_DIR = "nezha_tpu"
+# The faults package itself is excluded: its docstrings describe the API
+# with example call patterns, which are not registered points.
+EXCLUDE_PREFIX = os.path.join("nezha_tpu", "faults")
+RUNBOOK = os.path.join("docs", "RUNBOOK.md")
+TESTS_DIR = "tests"
+
+
+def find_points(root: str) -> Dict[str, List[str]]:
+    """-> {point name: [repo-relative files registering it]}."""
+    points: Dict[str, List[str]] = {}
+    for dirpath, _, files in os.walk(os.path.join(root, SOURCE_DIR)):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root)
+            if rel.startswith(EXCLUDE_PREFIX):
+                continue
+            with open(path) as f:
+                for name in POINT_RE.findall(f.read()):
+                    points.setdefault(name, []).append(rel)
+    return points
+
+
+def check(root: str) -> List[str]:
+    """-> list of violations (empty = registry is clean)."""
+    errors: List[str] = []
+    points = find_points(root)
+    if not points:
+        errors.append(f"no faults.point()/faults.corrupt() call sites "
+                      f"found under {SOURCE_DIR}/")
+        return errors
+    for name, files in sorted(points.items()):
+        if len(files) > 1:
+            errors.append(
+                f"fault point {name!r} registered at {len(files)} call "
+                f"sites ({', '.join(files)}) — names must be unique")
+    with open(os.path.join(root, RUNBOOK)) as f:
+        runbook = f.read()
+    tests_text = []
+    tests_root = os.path.join(root, TESTS_DIR)
+    for dirpath, _, files in os.walk(tests_root):
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                with open(os.path.join(dirpath, fn)) as f:
+                    tests_text.append(f.read())
+    tests_blob = "\n".join(tests_text)
+    for name in sorted(points):
+        # Boundary-anchored match: a point whose name prefixes another's
+        # ("serve.step" vs "serve.step.logits") must NOT pass vacuously
+        # via its sibling's mentions.
+        exact = re.compile(
+            rf"(?<![A-Za-z0-9_.]){re.escape(name)}(?![A-Za-z0-9_.])")
+        if not exact.search(runbook):
+            errors.append(f"fault point {name!r} is not documented in "
+                          f"{RUNBOOK}")
+        if not exact.search(tests_blob):
+            errors.append(f"fault point {name!r} is not covered by any "
+                          f"test under {TESTS_DIR}/")
+    return errors
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    errors = check(root)
+    for e in errors:
+        print(e, file=sys.stderr)
+    if errors:
+        print(f"FAIL: {len(errors)} fault-registry violation(s)",
+              file=sys.stderr)
+        return 1
+    points = find_points(root)
+    print(f"OK: {len(points)} fault point(s) registered, documented, "
+          f"and tested")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,14 +36,33 @@ _SUMMARY_KEYS = {"schema_version", "counters", "gauges", "histograms",
 # Serving-run schema (nezha-serve / benchmarks/serving.py): the scheduler
 # pre-registers this full instrument set, so a summary that carries the
 # marker counter must carry ALL of them — dashboards key on the names
-# (ttft, tpot, queue_depth, batch_occupancy, rejected_total, ...).
+# (ttft, tpot, queue_depth, batch_occupancy, rejected_total, errors, ...).
 _SERVE_MARKER = "serve.admitted_total"
 _SERVE_COUNTERS = {"serve.admitted_total", "serve.rejected_total",
                    "serve.expired_total", "serve.retired_total",
-                   "serve.tokens_total", "serve.prefill.chunks_total"}
+                   "serve.tokens_total", "serve.prefill.chunks_total",
+                   "serve.errors_total", "serve.step_retries_total",
+                   "faults.injected_total"}
 _SERVE_GAUGES = {"serve.queue_depth", "serve.batch_occupancy"}
 _SERVE_HISTOGRAMS = {"serve.ttft_s", "serve.tpot_s",
                      "serve.prefill.bucket_len"}
+
+# Dist-run schema: any run that touched the coordinator (any dist.*
+# counter present — join() pre-registers the pair) must carry the full
+# failure-accounting set, so a world that never retried still reports
+# join_retries_total = 0.
+_DIST_COUNTERS = {"dist.join_retries_total", "dist.heartbeat_lost_total"}
+
+# Span-name registry for the namespaces this tool owns: spans under
+# serve./checkpoint./dist. are an interface (reports and dashboards key
+# on them), so an unknown name in those namespaces is drift — add new
+# spans HERE (and to the emitting layer's docs) deliberately.
+_PINNED_SPAN_PREFIXES = ("serve.", "checkpoint.", "dist.")
+_PINNED_SPANS = {
+    "serve.prefill", "serve.decode_attention", "serve.drain",
+    "checkpoint.save", "checkpoint.verify",
+    "dist.join", "dist.barrier", "dist.failure", "dist.leave",
+}
 
 
 def _is_num(v) -> bool:
@@ -64,6 +83,11 @@ def _check_span(rec, where: str, errors: List[str]) -> None:
         errors.append(f"{where}: span t1 < t0")
     if not isinstance(rec.get("attrs"), dict):
         errors.append(f"{where}: span 'attrs' must be an object")
+    name = rec.get("name")
+    if (isinstance(name, str) and name.startswith(_PINNED_SPAN_PREFIXES)
+            and name not in _PINNED_SPANS):
+        errors.append(f"{where}: span name {name!r} is not in the pinned "
+                      f"span registry (_PINNED_SPANS) for its namespace")
 
 
 def check_metrics_jsonl(path: str, errors: List[str]) -> None:
@@ -170,6 +194,7 @@ def check_summary_json(path: str, errors: List[str]) -> None:
     else:
         errors.append("summary.json: 'slowest_spans' must be a list")
     _check_serving(summary, errors)
+    _check_dist(summary, errors)
 
 
 def _check_serving(summary: dict, errors: List[str]) -> None:
@@ -190,6 +215,18 @@ def _check_serving(summary: dict, errors: List[str]) -> None:
     for name in sorted(_SERVE_HISTOGRAMS - set(hists)):
         errors.append(f"summary.json: serving run missing histogram "
                       f"{name!r}")
+
+
+def _check_dist(summary: dict, errors: List[str]) -> None:
+    """Runs that touched the coordinator (any ``dist.*`` counter) must
+    carry the complete failure-accounting counter set."""
+    counters = summary.get("counters")
+    if not isinstance(counters, dict):
+        return
+    if not any(k.startswith("dist.") for k in counters):
+        return
+    for name in sorted(_DIST_COUNTERS - set(counters)):
+        errors.append(f"summary.json: dist run missing counter {name!r}")
 
 
 def check_run_dir(run_dir: str) -> List[str]:
