@@ -66,6 +66,12 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["auto", "kernel", "xla"], default=None,
                    help="decode attention: flash-decode kernel vs the "
                         "composed masked path (the before/after knob)")
+    p.add_argument("--decode-horizon", default="1",
+                   help="tokens decoded per compiled step dispatch; a "
+                        "comma-separated list (e.g. 1,4,8) sweeps the "
+                        "horizon — one engine + fresh warmup per value, "
+                        "with per-horizon sub-records (and per-horizon "
+                        "run-dir subdirectories h<N>/) in the output")
     p.add_argument("--fault-rate", type=float, default=0.0,
                    help="probability per prefill / per decode step of an "
                         "injected fault (prefill errors + NaN logit "
@@ -98,15 +104,17 @@ def run(args) -> dict:
     if not 0.0 <= args.fault_rate < 1.0:
         raise SystemExit(f"--fault-rate must be in [0, 1), got "
                          f"{args.fault_rate}")
+    try:
+        horizons = [int(h) for h in str(args.decode_horizon).split(",")]
+        if not horizons or min(horizons) < 1:
+            raise ValueError
+    except ValueError:
+        raise SystemExit(f"--decode-horizon must be comma-separated "
+                         f"ints >= 1, got {args.decode_horizon!r}")
     from nezha_tpu.cli.common import setup_jax
     setup_jax(args)
 
     import jax
-    import jax.numpy as jnp
-
-    from nezha_tpu import obs
-    from nezha_tpu.serve import (Engine, QueueFull, Request, Scheduler,
-                                 ServeConfig)
 
     if args.model_preset == "tiny":
         from nezha_tpu.cli.train import TINY_GPT2_KW
@@ -116,13 +124,56 @@ def run(args) -> dict:
         from nezha_tpu.models.gpt2 import gpt2_124m
         model = gpt2_124m()
     variables = model.init(jax.random.PRNGKey(args.seed))
+    if len(horizons) == 1:
+        record = _run_one(args, model, variables, horizons[0],
+                          args.run_dir)
+    else:
+        # Horizon sweep: one engine + warmup + (optional) run-dir
+        # capture per value, same offered load — the dispatch-
+        # amortization record ISSUE 5 establishes.
+        by_horizon = {}
+        for h in horizons:
+            sub = (os.path.join(args.run_dir, f"h{h}")
+                   if args.run_dir else None)
+            by_horizon[str(h)] = _run_one(args, model, variables, h, sub)
+        record = {"sweep": "decode_horizon",
+                  "horizons": horizons,
+                  "mode": args.mode,
+                  "by_horizon": by_horizon}
+    if args.json:
+        print(json.dumps(record, indent=2, sort_keys=True))
+    else:
+        for rec in (record["by_horizon"].values()
+                    if "by_horizon" in record else [record]):
+            gap = rec.get("host_gap_s") or {}
+            gap_s = (f", host gap p50 {gap['p50'] * 1e3:.2f} ms"
+                     if gap else "")
+            print(f"h={rec['decode_horizon']} {rec['mode']} load: "
+                  f"{rec['offered']} -> "
+                  f"{rec['tokens_per_sec']:.1f} tok/s "
+                  f"({rec['steps_per_sec']:.1f} steps/s, "
+                  f"{rec['dispatches_per_token']:.3f} disp/tok), "
+                  f"ttft p50 {rec['ttft_s']['p50'] * 1e3:.1f} ms, "
+                  f"tpot p50 {rec['tpot_s']['p50'] * 1e3:.1f} ms, "
+                  f"{rec['dropped_queue_full']} dropped{gap_s}")
+    return record
+
+
+def _run_one(args, model, variables, decode_horizon: int,
+             run_dir) -> dict:
+    import jax.numpy as jnp
+
+    from nezha_tpu import obs
+    from nezha_tpu.serve import (Engine, QueueFull, Request, Scheduler,
+                                 ServeConfig)
+
     buckets = tuple(int(b) for b in args.prefill_buckets.split(",")) \
         if args.prefill_buckets else ()
     cfg = ServeConfig(
         max_batch_size=args.max_batch_size, max_len=args.max_len,
         max_prefill_len=args.max_prefill_len, prefill_buckets=buckets,
         queue_capacity=args.queue_capacity, cache_dtype=jnp.bfloat16,
-        decode_impl=args.decode_impl)
+        decode_impl=args.decode_impl, decode_horizon=decode_horizon)
     engine = Engine(model, variables, cfg)
     sched = Scheduler(engine)
     rng = random.Random(args.seed)
@@ -170,14 +221,16 @@ def run(args) -> dict:
         faults.install(plan)
 
     sink = None
-    if args.run_dir:
+    if run_dir:
         from nezha_tpu.serve.scheduler import register_serve_instruments
-        sink = obs.start_run(args.run_dir, meta={
+        sink = obs.start_run(run_dir, meta={
             "kind": "serve_bench", "mode": args.mode,
             "requests": args.requests,
+            "decode_horizon": decode_horizon,
             "offered": (args.concurrency if args.mode == "closed"
                         else args.rate)})
         register_serve_instruments()
+    steps_before = engine.step_calls      # exclude warmup dispatches
 
     # (Occupancy percentiles come from the scheduler itself — it folds
     # per-decode occupancy into the metric.batch_occupancy histogram.)
@@ -221,6 +274,7 @@ def run(args) -> dict:
     finally:
         faults.install(prev_plan)
     wall = time.monotonic() - t0
+    decode_steps = engine.step_calls - steps_before
 
     results = [r for rid, r in sched.results.items()
                if not rid.startswith("warmup")]
@@ -247,6 +301,14 @@ def run(args) -> dict:
         key = f"{engine.bucket_for(n)}" if chunks == 1 \
             else f"{engine.bucket_for(n)}x{chunks}"
         by_bucket.setdefault(key, []).append(r.ttft_s)
+    # Host-gap percentiles straight from the live registry (it is only
+    # populated while a run is active — the histogram is the same
+    # serve.host_gap_s the run-dir summary carries).
+    host_gap = None
+    if sink is not None:
+        hg = obs.histogram("serve.host_gap_s").summary()
+        if hg["count"]:
+            host_gap = {k: hg[k] for k in ("count", "p50", "p90", "p99")}
     record = {
         "mode": args.mode,
         "offered": (args.concurrency if args.mode == "closed"
@@ -256,6 +318,15 @@ def run(args) -> dict:
         "wall_s": wall,
         "tokens": total_tokens,
         "tokens_per_sec": total_tokens / wall if wall else 0.0,
+        # The dispatch-amortization record: compiled step dispatches
+        # for the measured load (warmup excluded) — horizon H should
+        # show ~1/H the dispatches per token of horizon 1.
+        "decode_horizon": decode_horizon,
+        "decode_steps": decode_steps,
+        "steps_per_sec": decode_steps / wall if wall else 0.0,
+        "dispatches_per_token": (decode_steps / total_tokens
+                                 if total_tokens else 0.0),
+        "host_gap_s": host_gap,
         "ttft_s": _percentiles(ttfts),
         "ttft_by_bucket": {k: _percentiles(v)
                            for k, v in sorted(by_bucket.items())},
@@ -273,14 +344,6 @@ def run(args) -> dict:
     }
     if sink is not None:
         obs.end_run()
-    if args.json:
-        print(json.dumps(record, indent=2, sort_keys=True))
-    else:
-        print(f"{args.mode} load: {record['offered']} -> "
-              f"{record['tokens_per_sec']:.1f} tok/s, "
-              f"ttft p50 {record['ttft_s']['p50'] * 1e3:.1f} ms, "
-              f"tpot p50 {record['tpot_s']['p50'] * 1e3:.1f} ms, "
-              f"{dropped} dropped")
     return record
 
 

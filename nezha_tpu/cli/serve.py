@@ -27,9 +27,11 @@ POST /generate and flips /healthz to 503), in-flight requests keep
 decoding for up to --drain-timeout seconds, stragglers retire with
 finish_reason "deadline", and stdio flushes a final {"event": "drain"}
 line before exit. A second signal during the drain is ignored (the
-drain is already as fast as the deadline allows). NEZHA_FAULT_PLAN /
-NEZHA_FAULT_SEED install a fault-injection plan for chaos drills
-(docs/RUNBOOK.md §9).
+drain is already as fast as the deadline allows). With
+--decode-horizon N the drain cutoff lands on a block boundary, so the
+drain (like deadlines) is granular to one horizon — up to N tokens
+later than the signal. NEZHA_FAULT_PLAN / NEZHA_FAULT_SEED install a
+fault-injection plan for chaos drills (docs/RUNBOOK.md §9).
 
 With --run-dir the run writes the standard telemetry artifacts;
 `nezha-telemetry RUN_DIR` then renders the serving section (TTFT/TPOT
@@ -84,6 +86,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "force the kernel (interpret off-TPU), xla = "
                         "force the composed masked path; default: the "
                         "model config's choice (auto)")
+    p.add_argument("--decode-horizon", type=int, default=1,
+                   help="tokens decoded per compiled step dispatch (the "
+                        "device-resident sampling loop): 1 = classic "
+                        "per-token stepping; N > 1 amortizes the host "
+                        "gap over N tokens — streaming still emits "
+                        "per-token events, but deadline/drain "
+                        "granularity coarsens to one horizon "
+                        "(docs/RUNBOOK.md §8)")
     p.add_argument("--k-max", type=int, default=64,
                    help="static top-k cap; per-request top_k is clamped "
                         "to it")
@@ -144,7 +154,8 @@ def _build_stack(args):
         queue_capacity=args.queue_capacity,
         cache_dtype=jnp.float32 if args.cache_dtype == "f32"
         else jnp.bfloat16,
-        decode_impl=args.decode_impl)
+        decode_impl=args.decode_impl,
+        decode_horizon=args.decode_horizon)
     engine = Engine(model, variables, cfg)
     return Scheduler(engine), tokenizer, eos_id
 

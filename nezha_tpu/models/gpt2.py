@@ -500,10 +500,14 @@ class GPT2(Module):
               rng=None, cache=None, pos=None, prefill: bool = False,
               active=None):
         # ``active`` ([B] bool, decode-with-cache only) marks rows whose
-        # output is consumed — the serve engine's occupancy mask. It is
-        # advisory: the flash-decode kernel skips ALL work for inactive
-        # rows (length 0); the composed path ignores it (garbage rows are
-        # masked host-side either way).
+        # output is consumed. For single-token serving steps that is the
+        # engine's occupancy mask; inside a decode-horizon scan it is the
+        # per-scan-step ``active ∧ ¬done ∧ ok`` emit mask, so rows that
+        # hit EOS / budget / a NaN freeze mid-block stop doing attention
+        # work exactly like empty slots. It is advisory: the
+        # flash-decode kernel skips ALL work for non-emitting rows
+        # (length 0); the composed path ignores it (garbage rows are
+        # masked by the engine's ``where(emit, ...)`` either way).
         if isinstance(batch, dict):
             tokens = batch["tokens"][:, :-1]
         else:

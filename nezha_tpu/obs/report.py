@@ -110,6 +110,19 @@ def render_serving_section(summary: Optional[dict]) -> List[str]:
                 f"  {label}: p50 {h['p50'] * 1e3:.1f} ms  "
                 f"p90 {h['p90'] * 1e3:.1f} ms  "
                 f"p99 {h['p99'] * 1e3:.1f} ms  (n={h['count']})")
+    hg = hists.get("serve.host_gap_s")
+    if hg and hg.get("count"):
+        # The decode-horizon view: host time between consecutive step
+        # dispatches (the overhead a horizon > 1 amortizes over H
+        # tokens) and the tokens-per-dispatch ceiling the blocks ran at
+        # (absent in pre-horizon captures).
+        dh = hists.get("serve.decode.horizon") or {}
+        hz = (f"  horizon p50 {dh['p50']:.0f}"
+              if dh.get("count") else "")
+        lines.append(
+            f"  host gap: p50 {hg['p50'] * 1e3:.2f} ms  "
+            f"p90 {hg['p90'] * 1e3:.2f} ms  "
+            f"p99 {hg['p99'] * 1e3:.2f} ms  (n={hg['count']}){hz}")
     ph = hists.get("serve.prefill.bucket_len")
     if ph and ph.get("count"):
         # Bucket occupancy: how wide the static prefill programs

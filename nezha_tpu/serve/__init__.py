@@ -15,16 +15,25 @@ serve stack replaces the batch lifecycle with a slot lifecycle:
   reused forever — one prefill program per static prompt-pad bucket
   (prompts pick the smallest bucket that fits; prompts longer than
   ``max_prefill_len`` prefill in successive chunks through the same
-  programs at traced offsets) and the batched single-token decode step
-  over all ``B_max`` rows (active-row mask, per-row traced positions;
-  on TPU the attention is the Pallas flash-decode kernel — per-row
-  lengths skip KV blocks instead of masking them). All programs route
-  through the runtime ``CompileCache``, so the frozen-program steady
-  state is provable from the ``compile_cache.*`` obs counters.
+  programs at traced offsets) and the batched decode step over all
+  ``B_max`` rows. The step is a DEVICE-RESIDENT sampling loop: a
+  ``lax.scan`` of ``ServeConfig.decode_horizon`` single-token steps in
+  one compiled program (sampled tokens feed the next step's embedding
+  without visiting the host; per-row EOS ids and new-token budgets are
+  engine state, so completion flips a carried ``done`` mask mid-block
+  and the row stops sampling and writing K/V), returning a ``[B, H]``
+  token block + per-row emitted counts — the per-token host dispatch +
+  sync cost shrinks by the horizon. On TPU the attention per scan step
+  is the Pallas flash-decode kernel — per-row lengths skip KV blocks
+  instead of masking them, and the emit mask zeroes finished rows'
+  lengths. All programs route through the runtime ``CompileCache``, so
+  the frozen-program steady state is provable from the
+  ``compile_cache.*`` obs counters.
 - ``scheduler``: bounded FIFO admission with backpressure, per-request
-  deadlines, and the iteration loop (admit -> decode one token for all
+  deadlines, and the iteration loop (admit -> decode one block for all
   active rows -> retire on EOS / max-new-tokens / deadline, freeing
-  slots for waiters). Failure is request-scoped: a prefill exception or
+  slots for waiters; retire/admit and deadline checks run once per
+  horizon). Failure is request-scoped: a prefill exception or
   NaN/inf logit burst retires only the affected request
   (``FinishReason.ERROR``) while the batch keeps decoding, and a step
   crash gets one bounded retry — provable on demand through the

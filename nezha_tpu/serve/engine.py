@@ -23,16 +23,33 @@ latency flat:
   rows — paid once per request, versus the per-token decode win; a
   diagonal-offset flash prefill kernel would recover it without
   touching the program count and is the obvious next kernel.
-- **step** — one batched single-token decode over all ``B_max`` rows:
-  sample per row from the carried last-logits (per-row traced
-  temperature / top-k / top-p — serve/sampling.py), forward through the
-  model with PER-ROW cache positions (models/gpt2.py per-row pos path),
-  advance active rows. On TPU the attention inside this step is the
-  Pallas flash-decode kernel (ops/pallas/decode_attention.py): per-row
-  ``lengths`` skip KV blocks above each row's depth, and inactive rows
-  skip every block instead of computing masked garbage (host-side
-  masking still applies — their state is frozen by ``where(active,
-  ...)``).
+- **step** — one batched decode BLOCK over all ``B_max`` rows: a
+  ``lax.scan`` of ``decode_horizon`` single-token steps, the whole
+  horizon inside one compiled program. Each scan step samples per row
+  from the carried last-logits (per-row traced temperature / top-k /
+  top-p — serve/sampling.py), forwards through the model with PER-ROW
+  cache positions (models/gpt2.py per-row pos path), and feeds the
+  sampled token straight into the next step's embedding — tokens never
+  visit the host mid-block, so the per-token Python→XLA dispatch +
+  device→host sync cost is paid once per H tokens instead of once per
+  token. Completion is decided ON DEVICE: per-row ``eos_ids`` and
+  remaining-``budgets`` (engine state set at prefill) flip a carried
+  ``done`` mask the moment a row emits EOS or exhausts its budget, and
+  the carried ``ok`` health mask (NaN/inf tripwire, ANDed per scan
+  step) freezes a poisoned row from the bad step on — either way the
+  row stops sampling AND stops writing K/V for the rest of the block,
+  because the per-step ``active ∧ ¬done ∧ ok`` emit mask is what
+  threads into the model as ``active``. On TPU the attention inside
+  each scan step is the Pallas flash-decode kernel
+  (ops/pallas/decode_attention.py): per-row ``lengths`` skip KV blocks
+  above each row's depth, and non-emitting rows (inactive slots, done
+  rows, frozen rows) skip every block instead of computing masked
+  garbage (host-side masking still applies — their state is frozen by
+  ``where(emit, ...)``). The program returns a ``[B, H]`` token block
+  plus per-row ``emitted`` counts; overshoot columns past a row's
+  count are pad and never reach the client. ``decode_horizon=1``
+  (default) runs the scan body once inline — bit-identical to the
+  classic one-token step.
 
 All programs route through the runtime ``Executor`` (compile-cache keyed
 on function identity + full arg shape signature), so the program-count
@@ -65,7 +82,7 @@ from jax import lax
 from nezha_tpu import faults, obs
 from nezha_tpu.models.generate import _caches_from_states
 from nezha_tpu.runtime.executor import Executor
-from nezha_tpu.serve.sampling import finite_rows, sample_tokens
+from nezha_tpu.serve.sampling import finite_rows, split_and_sample
 from nezha_tpu.serve.slots import SlotPool, read_slot, write_slot
 
 
@@ -100,7 +117,15 @@ class ServeConfig:
     ``decode_impl`` (None = keep the model's own ``GPT2Config.
     decode_impl``) overrides the decode-attention choice for this
     engine: "auto" | "kernel" | "xla" — the serving-side toggle for the
-    flash-decode kernel.
+    flash-decode kernel. ``decode_horizon`` is the number of tokens one
+    compiled step program decodes per dispatch (the fused device-
+    resident sampling loop): 1 (default) is the classic one-token step,
+    bit-identical to pre-horizon behavior; H > 1 amortizes the
+    per-dispatch host gap over H tokens at the cost of coarser
+    deadline/drain granularity (one horizon) — EOS/budget completion
+    moves on device, so a row finishing mid-block stops sampling and
+    K/V writes immediately and its overshoot is dropped before the
+    block reaches the host.
     """
 
     max_batch_size: int = 4
@@ -112,10 +137,14 @@ class ServeConfig:
     pad_id: int = 0
     cache_dtype: Any = jnp.bfloat16
     decode_impl: Optional[str] = None
+    decode_horizon: int = 1
 
     def __post_init__(self):
         if self.max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
+        if self.decode_horizon < 1:
+            raise ValueError(
+                f"decode_horizon must be >= 1, got {self.decode_horizon}")
         if not 1 <= self.max_prefill_len <= self.max_len:
             raise ValueError(
                 f"need 1 <= max_prefill_len <= max_len, got "
@@ -154,8 +183,13 @@ class Engine:
     bucket/chunk instruments (``serve.prefill.bucket_len`` /
     ``serve.prefill.chunks_total``), since the bucket choice is made
     here. The contract is ``prefill(slot, ...)`` to load one slot
-    (however many chunks that takes) and ``step(active)`` to decode one
-    token for every row and hand the batch back to the host.
+    (however many chunks that takes — including the row's EOS id and
+    new-token budget, which become device state) and ``step(active)``
+    to decode one BLOCK of up to ``decode_horizon`` tokens for every
+    row and hand the ``[B, H]`` batch back to the host along with
+    per-row emitted counts. ``step_calls`` counts host dispatches of
+    the step program — the denominator of the dispatch-per-token
+    amortization this engine exists to improve.
     """
 
     def __init__(self, model, variables, cfg: ServeConfig = ServeConfig()):
@@ -191,6 +225,17 @@ class Engine:
         self.temps = jnp.zeros((b,), jnp.float32)
         self.top_ks = jnp.zeros((b,), jnp.int32)
         self.top_ps = jnp.ones((b,), jnp.float32)
+        # On-device completion state, set per row at prefill: the EOS id
+        # (-1 = none) and the remaining new-token budget. Inside a decode
+        # block a row that emits its EOS or exhausts its budget flips the
+        # scan's carried `done` mask and stops sampling + K/V writes for
+        # the rest of the block — the host never sees overshoot.
+        self.eos_ids = jnp.full((b,), -1, jnp.int32)
+        self.budgets = jnp.zeros((b,), jnp.int32)
+        # Host dispatches of the step program (1 dispatch = up to
+        # decode_horizon tokens for every row) — tests assert the
+        # dispatch-per-token amortization against this.
+        self.step_calls = 0
         # Donate the pooled caches (positional arg 1 in EVERY program):
         # without donation every decoded token would copy the whole
         # [B_max, H, L_max, D] K/V pool per layer just to write one row —
@@ -203,7 +248,8 @@ class Engine:
         # own cache entry the first time a prompt lands in its bucket).
         self._prefill_fns = {w: _build_prefill(self.model, w)
                              for w in cfg.prefill_buckets}
-        self._step_fn = _build_step(self.model, self.k_max, cfg.pad_id)
+        self._step_fn = _build_step(self.model, self.k_max, cfg.pad_id,
+                                    cfg.decode_horizon)
 
     # -------------------------------------------------------- host API
     def bucket_for(self, n: int) -> int:
@@ -217,21 +263,31 @@ class Engine:
 
     def prefill(self, slot: int, tokens: Sequence[int], *, seed: int = 0,
                 temperature: float = 0.0, top_k: Optional[int] = None,
-                top_p: Optional[float] = None) -> None:
+                top_p: Optional[float] = None,
+                eos_id: Optional[int] = None,
+                max_new_tokens: Optional[int] = None) -> None:
         """Load one request into ``slot``: prompt K/V, position, PRNG
-        key, and sampling params. ``tokens`` may be up to
-        ``max_len - 1`` long (room for at least one generated token);
-        prompts wider than ``max_prefill_len`` run as successive chunks
-        through the same bucket programs. Token ids are NOT validated
-        here — admission (``Scheduler.submit``) is the validation
-        boundary. The first generated token comes from the next
-        :meth:`step`."""
+        key, sampling params, and the row's on-device completion state
+        (``eos_id``, ``None`` = never stop on a token; and its
+        new-token budget, ``None`` = everything the slot's KV capacity
+        allows). ``tokens`` may be up to ``max_len - 1`` long (room for
+        at least one generated token); prompts wider than
+        ``max_prefill_len`` run as successive chunks through the same
+        bucket programs. Token ids are NOT validated here — admission
+        (``Scheduler.submit``) is the validation boundary. The first
+        generated token comes from the next :meth:`step`."""
         faults.point("serve.prefill")
         n = len(tokens)
         if not 1 <= n < self.cfg.max_len:
             raise ValueError(
                 f"prompt length {n} not in [1, max_len-1="
                 f"{self.cfg.max_len - 1}]")
+        # The device budget is what stops a row mid-block; capping it at
+        # the slot's remaining KV capacity means a block can never write
+        # past max_len even for budget-less direct engine callers.
+        cap = self.cfg.max_len - n
+        budget = cap if max_new_tokens is None else min(max_new_tokens,
+                                                        cap)
         p_max = self.cfg.max_prefill_len
         tokens = np.asarray(tokens, np.int32)
         chunks: List[Tuple[int, int, int]] = []      # (offset, len, width)
@@ -265,35 +321,56 @@ class Engine:
                 np.int32(seed), np.float32(temperature),
                 np.int32(0 if top_k is None else top_k),
                 np.float32(1.0 if top_p is None else top_p),
+                np.int32(-1 if eos_id is None else eos_id),
+                np.int32(budget),
                 self.last_logits, self.positions, self.keys,
-                self.temps, self.top_ks, self.top_ps)
+                self.temps, self.top_ks, self.top_ps,
+                self.eos_ids, self.budgets)
             (self.pool.caches, self.last_logits, self.positions, self.keys,
-             self.temps, self.top_ks, self.top_ps) = out
+             self.temps, self.top_ks, self.top_ps,
+             self.eos_ids, self.budgets) = out
         if faults.enabled():
             self.last_logits = faults.corrupt(
                 "serve.prefill.logits", self.last_logits, rows=(slot,))
 
-    def step(self, active: np.ndarray) -> np.ndarray:
-        """Decode one token for every row; ``active`` is a ``[B_max]``
-        bool mask. Returns the sampled tokens as a host array — entries
-        for inactive rows are garbage and must be ignored. After the
-        call :attr:`step_ok` holds a ``[B_max]`` bool health mask: False
-        where a row's logits went non-finite (only meaningful for rows
-        the caller knows are active)."""
+    def step(self, active: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Decode one BLOCK of up to ``decode_horizon`` tokens for every
+        row; ``active`` is a ``[B_max]`` bool mask. Returns
+        ``(tokens, emitted)`` as host arrays: ``tokens`` is the
+        ``[B_max, H]`` block — a row's valid tokens are
+        ``tokens[r, :emitted[r]]``; everything past its count (overshoot
+        after EOS / budget / a mid-block NaN freeze, or all H columns of
+        an inactive row) is pad and must be ignored. After the call
+        :attr:`step_ok` holds a ``[B_max]`` bool health mask: False
+        where a row's logits went non-finite at any scan step (only
+        meaningful for rows the caller knows are active) — such a row's
+        pre-burst tokens are still counted in ``emitted``."""
         faults.point("serve.step")
-        tok, ok, caches, last, pos, keys = self.executor.run(
+        self.step_calls += 1
+        out = self.executor.run(
             self._step_fn, self.variables, self.pool.caches,
             self.last_logits, self.positions,
             jnp.asarray(active, bool), self.keys,
-            self.temps, self.top_ks, self.top_ps)
+            self.temps, self.top_ks, self.top_ps,
+            self.eos_ids, self.budgets)
+        tok, emitted, ok, caches, last, pos, keys, budgets = out
+        # Start the block's device->host transfers NOW, before any host
+        # bookkeeping (state rebinds here, retire/admit/stream in the
+        # scheduler): the np.asarray reads below then find bytes already
+        # in flight instead of paying the full sync serially.
+        for arr in (tok, emitted, ok):
+            copy_async = getattr(arr, "copy_to_host_async", None)
+            if copy_async is not None:
+                copy_async()
         self.pool.caches = caches
         if faults.enabled():
             last = faults.corrupt(
                 "serve.step.logits", last,
                 rows=lambda: np.flatnonzero(active))
         self.last_logits, self.positions, self.keys = last, pos, keys
+        self.budgets = budgets
         self.step_ok = np.asarray(ok)
-        return np.asarray(tok)
+        return np.asarray(tok), np.asarray(emitted)
 
     def compile_stats(self) -> dict:
         """Executor cache stats — steady state is ``entries ==
@@ -305,8 +382,9 @@ class Engine:
 
 def _build_prefill(model, width: int):
     def prefill(variables, caches, tokens, length, slot, pos, seed,
-                temperature, top_k, top_p,
-                last_logits, positions, keys, temps, top_ks, top_ps):
+                temperature, top_k, top_p, eos_id, budget,
+                last_logits, positions, keys, temps, top_ks, top_ps,
+                eos_ids, budgets):
         # One prompt chunk, padded to this bucket's static `width`, runs
         # against the SLOT'S OWN cache rows at a traced offset: the
         # masked attention path sees the prefix earlier chunks wrote
@@ -343,42 +421,86 @@ def _build_prefill(model, width: int):
                 set_row(keys, key),
                 set_row(temps, temperature),
                 set_row(top_ks, top_k),
-                set_row(top_ps, top_p))
+                set_row(top_ps, top_p),
+                set_row(eos_ids, eos_id),
+                set_row(budgets, budget))
 
     return prefill
 
 
-def _build_step(model, k_max: int, pad_id: int):
-    def step(variables, caches, last_logits, positions, active, keys,
-             temps, top_ks, top_ps):
-        # Row health, checked in-program (no extra host round-trip): the
-        # carried-in logits catch a burst that landed BETWEEN steps (the
-        # sampled token below is then garbage and the scheduler discards
-        # it), the fresh row catches one the forward pass itself
-        # produced. Either way the scheduler retires the row with
-        # FinishReason.ERROR while its neighbors keep decoding.
-        in_ok = finite_rows(last_logits)
-        # One key split per row per step: a request's RNG stream depends
-        # only on its seed and step count, never on its batch neighbors.
-        splits = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
-        next_keys, subs = splits[:, 0], splits[:, 1]
-        tok = sample_tokens(last_logits, subs, temps, top_ks, top_ps,
-                            k_max)
-        tok = jnp.where(active, tok, pad_id)
-        # `active` rides into the model so the flash-decode kernel can
-        # zero inactive rows' lengths and skip their KV blocks entirely;
-        # the composed fallback ignores it (garbage rows masked below).
+def _build_step(model, k_max: int, pad_id: int, horizon: int):
+    def body(active, temps, top_ks, top_ps, eos_ids, budgets,
+             variables, carry):
+        """One fused decode step: the single-token body the horizon scan
+        iterates. Everything request-terminating happens on device:
+
+        - ``ok`` is the carried health mask (PR 4's NaN/inf tripwire),
+          ANDed per step against the carried-in logits BEFORE sampling
+          (a burst that landed between steps makes this step's sample
+          garbage — never emit it) and against the fresh row AFTER the
+          forward pass (matching the classic step's conservative
+          discard). A row that trips freezes from that step on.
+        - ``done`` flips when a row emits its EOS id or fills its
+          remaining budget; ``emitted`` counts only genuinely emitted
+          tokens, so the host can slice each row's valid prefix out of
+          the block.
+        - ``emit = active ∧ ¬done ∧ ok`` is the mask that threads into
+          the model as ``active``: the flash-decode kernel zeroes
+          non-emitting rows' lengths and skips their KV blocks, so a
+          finished/frozen row stops writing K/V mid-block (the composed
+          fallback ignores it; garbage rows are masked below either
+          way). Keys advance only on emit — a request's RNG stream is a
+          function of (seed, emitted count), horizon-invariant.
+        """
+        caches, last_logits, positions, keys, done, ok, emitted = carry
+        ok = ok & finite_rows(last_logits)
+        # (emitted < budgets) is redundant with the done flip below for
+        # every block the scheduler dispatches (live rows always carry
+        # budget >= 1) — it guards the degenerate budget-0 row a direct
+        # engine caller could create, which must emit nothing.
+        emit = active & ~done & ok & (emitted < budgets)
+        next_keys, tok = split_and_sample(keys, last_logits, temps,
+                                          top_ks, top_ps, k_max)
+        tok = jnp.where(emit, tok, pad_id)
         logits, states = model.apply(variables, tok[:, None],
                                      training=False, cache=caches,
-                                     pos=positions, active=active)
+                                     pos=positions, active=emit)
         new_caches = _caches_from_states(model, states, caches)
         row_logits = logits[:, -1, :]
-        act = active[:, None]
-        return (tok,
-                in_ok & finite_rows(row_logits),
-                new_caches,
+        ok = jnp.where(emit, ok & finite_rows(row_logits), ok)
+        counted = emit & ok
+        emitted = emitted + counted.astype(jnp.int32)
+        done = done | (counted & (eos_ids >= 0) & (tok == eos_ids)) \
+                    | (counted & (emitted >= budgets))
+        act = emit[:, None]
+        return (new_caches,
                 jnp.where(act, row_logits, last_logits),
-                jnp.where(active, positions + 1, positions),
-                jnp.where(act, next_keys, keys))
+                jnp.where(emit, positions + 1, positions),
+                jnp.where(act, next_keys, keys),
+                done, ok, emitted), tok
+
+    def step(variables, caches, last_logits, positions, active, keys,
+             temps, top_ks, top_ps, eos_ids, budgets):
+        b = positions.shape[0]
+        init = (caches, last_logits, positions, keys,
+                jnp.zeros((b,), bool),        # done (within this block)
+                jnp.ones((b,), bool),         # ok   (health, carried)
+                jnp.zeros((b,), jnp.int32))   # emitted (within block)
+
+        def scan_body(carry, _):
+            return body(active, temps, top_ks, top_ps, eos_ids, budgets,
+                        variables, carry)
+
+        if horizon == 1:
+            # Inline, not a length-1 scan: the default must stay
+            # bit-identical to the classic single-token step program.
+            carry, tok = scan_body(init, None)
+            tok_block = tok[:, None]
+        else:
+            carry, toks = lax.scan(scan_body, init, None, length=horizon)
+            tok_block = jnp.transpose(toks, (1, 0))        # [H,B]->[B,H]
+        caches, last_logits, positions, keys, done, ok, emitted = carry
+        return (tok_block, emitted, ok, caches, last_logits, positions,
+                keys, jnp.maximum(budgets - emitted, 0))
 
     return step

@@ -20,6 +20,14 @@ mix of requests:
 - rows draw from their OWN PRNG key (vmapped categorical), so sampling
   rows are also isolated: a request's token sequence depends only on its
   seed and its step count, never on who shares the batch.
+
+:func:`split_and_sample` packages one decode step's sampling move —
+split every row's key, sample from the carried logits — for the
+engine's block-decode scan body: the caller advances a row's key only
+when the token is actually EMITTED, so a request's RNG stream depends
+on its seed and emitted-token count alone, never on the decode horizon
+or its batch neighbors (horizon=1 and horizon=8 sample identical
+sequences).
 """
 
 from __future__ import annotations
@@ -73,3 +81,18 @@ def sample_tokens(logits, keys, temperature, top_k, top_p,
     sampled = jax.vmap(jax.random.categorical)(keys, scaled)
     return jnp.where(greedy, jnp.argmax(logits, axis=-1),
                      sampled).astype(jnp.int32)
+
+
+def split_and_sample(keys, logits, temperature, top_k, top_p,
+                     k_max: int):
+    """One decode step's sampling move: split every row's PRNG key and
+    sample from the carried logits. ``keys`` ``[B, 2]`` -> ``(next_keys
+    [B, 2], tokens [B])``. The caller commits ``next_keys`` only for
+    rows whose token is actually emitted — that is what keeps a
+    request's RNG stream a function of (seed, emitted count) alone, so
+    the same request samples bit-identical tokens at any decode horizon
+    and next to any batch mix."""
+    splits = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+    tok = sample_tokens(logits, splits[:, 1], temperature, top_k, top_p,
+                        k_max)
+    return splits[:, 0], tok

@@ -4,21 +4,34 @@ The scheduler owns everything request-shaped: a BOUNDED FIFO admission
 queue (submit past capacity fails fast — backpressure, not unbounded
 memory), per-request deadlines, and the continuous-batching iteration:
 
-    admit waiters into free slots -> decode one token for all active
-    rows -> retire rows on EOS / max-new-tokens / deadline -> admit
-    again (a slot freed by retirement is refilled in the SAME iteration,
-    so capacity never idles while work is queued).
+    admit waiters into free slots -> decode one BLOCK (up to
+    ``decode_horizon`` tokens per row, one compiled dispatch) for all
+    active rows -> retire rows on EOS / max-new-tokens / deadline ->
+    admit again (a slot freed by retirement is refilled in the SAME
+    iteration, so capacity never idles while work is queued).
+
+The decode consumes the engine's ``[B, H]`` token block: each live
+row's tokens are sliced at its device-computed emitted count (overshoot
+past EOS/budget never reaches here — it was dropped on device), events
+stream per token, and retire/admit runs once per horizon, so the host
+cost between dispatches is paid once per H tokens. Deadlines are
+checked once per block — granularity coarsens to one horizon.
 
 Telemetry flows through ``nezha_tpu.obs`` at the serving layer's
-metrics of record: ``serve.ttft_s`` (submit -> first token) and
-``serve.tpot_s`` (per decoded token) histograms,
+metrics of record: ``serve.ttft_s`` (submit -> first token, placed at
+the row's position WITHIN its first block) and ``serve.tpot_s``
+(``block_dt / tokens_emitted`` observed once per emitted token, so
+percentiles stay comparable across horizon settings) histograms,
+``serve.host_gap_s`` (host time between consecutive step dispatches —
+the gap the decode horizon amortizes) and ``serve.decode.horizon``
+(tokens-per-dispatch ceiling in effect) histograms,
 ``serve.prefill.bucket_len`` (static pad width per prefill chunk — the
 bucket-occupancy view), ``serve.queue_depth`` and
 ``serve.batch_occupancy`` gauges,
 ``serve.{admitted,rejected,expired,retired,tokens}_total``,
 ``serve.{errors,step_retries}_total`` and ``serve.prefill.chunks_total``
 counters, ``faults.injected_total`` (the chaos ledger), and a
-``serve.decode_attention`` span around every batched decode step —
+``serve.decode_attention`` span around every batched decode block —
 the names tools/check_telemetry_schema.py pins. With no run active
 every call site is the registry's branch-only no-op.
 
@@ -120,6 +133,12 @@ def register_serve_instruments() -> None:
     obs.histogram("serve.ttft_s")
     obs.histogram("serve.tpot_s")
     obs.histogram("serve.prefill.bucket_len")
+    # Decode-horizon instruments: the host gap between consecutive step
+    # dispatches (what a horizon > 1 amortizes over H tokens) and the
+    # horizon each dispatch ran at (count = dispatches, so
+    # tokens_total / count is the realized tokens-per-dispatch).
+    obs.histogram("serve.host_gap_s")
+    obs.histogram("serve.decode.horizon")
 
 
 class Scheduler:
@@ -149,6 +168,10 @@ class Scheduler:
         self._lock = threading.RLock()
         self._ids = itertools.count()
         self.results: Dict[str, RequestResult] = {}
+        # End timestamp of the previous decode dispatch, None when the
+        # loop was idle in between — serve.host_gap_s only measures the
+        # host gap WITHIN continuous decoding, never idle waits.
+        self._host_gap_t: Optional[float] = None
         register_serve_instruments()
 
     # ------------------------------------------------------- admission
@@ -199,7 +222,11 @@ class Scheduler:
         with self._lock:
             self._expire_queued()
             self._admit()
-            emitted = self._decode() if self._live else 0
+            if self._live:
+                emitted = self._decode()
+            else:
+                emitted = 0
+                self._host_gap_t = None     # idle: no gap to measure
             self._admit()          # refill slots freed by retirement
             obs.gauge("serve.queue_depth").set(len(self._queue))
             obs.gauge("serve.batch_occupancy").set(
@@ -255,7 +282,8 @@ class Scheduler:
                     self.engine.prefill(
                         slot, req.prompt, seed=req.seed,
                         temperature=req.temperature, top_k=req.top_k,
-                        top_p=req.top_p)
+                        top_p=req.top_p, eos_id=req.eos_id,
+                        max_new_tokens=req.max_new_tokens)
             except Exception as e:
                 # submit() pre-validates the request SHAPE, but runtime/
                 # XLA errors (OOM-ish transients, injected faults) can
@@ -273,6 +301,7 @@ class Scheduler:
             obs.counter("serve.admitted_total").inc()
 
     def _decode(self) -> int:
+        horizon = self.engine.cfg.decode_horizon
         active = np.zeros((self.engine.cfg.max_batch_size,), bool)
         for slot in self._live:
             active[slot] = True
@@ -283,9 +312,15 @@ class Scheduler:
         obs.histogram("metric.batch_occupancy").observe(
             len(self._live) / self.engine.cfg.max_batch_size)
         t0 = time.monotonic()
+        if self._host_gap_t is not None:
+            # Host time since the previous block came back: the
+            # retire/admit/stream pass plus any interleaved prefill —
+            # the per-dispatch cost a horizon > 1 spreads over H tokens.
+            obs.histogram("serve.host_gap_s").observe(
+                t0 - self._host_gap_t)
         with obs.span("serve.decode_attention", rows=len(self._live)):
             try:
-                tokens = self.engine.step(active)
+                tokens, block_emitted = self.engine.step(active)
             except Exception:
                 # One bounded retry with backoff: a transient step crash
                 # (preempted device, injected fault) must not retire
@@ -296,50 +331,84 @@ class Scheduler:
                 # donation error and surfaces the same way.)
                 obs.counter("serve.step_retries_total").inc()
                 time.sleep(self.step_retry_backoff_s)
-                tokens = self.engine.step(active)
-        dt = time.monotonic() - t0
-        ok = self.engine.step_ok
+                tokens, block_emitted = self.engine.step(active)
         now = time.monotonic()
+        dt = now - t0
+        self._host_gap_t = now
+        obs.histogram("serve.decode.horizon").observe(horizon)
+        ok = self.engine.step_ok
         emitted = 0
         for slot in list(self._live):
             live = self._live[slot]
+            e = int(block_emitted[slot])
+            retired = False
+            for i in range(e):
+                tok = int(tokens[slot, i])
+                live.tokens.append(tok)
+                emitted += 1
+                if live.ttft_s is None:
+                    # The first token landed at its position WITHIN the
+                    # block, not at the block end — a fresh row emits
+                    # from scan step 0, so crediting the whole block
+                    # would overstate TTFT by (H-1)/H of a block.
+                    live.ttft_s = ((t0 - live.submit_t)
+                                   + dt * (i + 1) / horizon)
+                    obs.histogram("serve.ttft_s").observe(live.ttft_s)
+                # Per-token decode latency: the block cost split over
+                # the tokens it produced, observed once per token —
+                # horizon=1 degenerates to the classic one-dt-per-token
+                # and percentiles stay comparable across horizons.
+                obs.histogram("serve.tpot_s").observe(dt / e)
+                if self.on_token is not None:
+                    self.on_token(live.request_id, tok)
+                reason = None
+                if (live.req.eos_id is not None
+                        and tok == live.req.eos_id):
+                    reason = FinishReason.EOS
+                elif len(live.tokens) >= live.req.max_new_tokens:
+                    reason = FinishReason.LENGTH
+                elif (live.deadline_t is not None
+                        and now >= live.deadline_t):
+                    # Deadlines are block-granular now: the whole block
+                    # shares one `now`, and tokens decoded past a
+                    # mid-block deadline are dropped with the
+                    # retirement (RUNBOOK §8 documents the coarsening).
+                    reason = FinishReason.DEADLINE
+                if reason is not None:
+                    del self._live[slot]
+                    self.engine.pool.free(slot)
+                    obs.counter("serve.retired_total").inc()
+                    if reason == FinishReason.DEADLINE:
+                        # expired_total counts EVERY deadline miss,
+                        # queued or mid-decode (FinishReason's
+                        # documented contract).
+                        obs.counter("serve.expired_total").inc()
+                    self._finish(live, reason)
+                    retired = True
+                    break
+            if retired:
+                continue
             if ok is not None and not ok[slot]:
-                # Non-finite logits (NaN/inf burst): this row's sampled
-                # token is garbage — discard it and retire ONLY this
-                # request; the rest of the batch keeps its tokens.
+                # Non-finite logits (NaN/inf burst) at some scan step:
+                # the device froze the row there and excluded the
+                # garbage from its emitted count, so everything
+                # delivered above is pre-burst. Retire ONLY this
+                # request; the rest of the batch keeps decoding.
                 del self._live[slot]
                 self.engine.pool.free(slot)
                 obs.counter("serve.errors_total").inc()
                 obs.counter("serve.retired_total").inc()
                 self._finish(live, FinishReason.ERROR,
                              error="non-finite logits")
-                continue
-            tok = int(tokens[slot])
-            live.tokens.append(tok)
-            emitted += 1
-            if live.ttft_s is None:
-                live.ttft_s = now - live.submit_t
-                obs.histogram("serve.ttft_s").observe(live.ttft_s)
-            obs.histogram("serve.tpot_s").observe(dt)
-            if self.on_token is not None:
-                self.on_token(live.request_id, tok)
-            reason = None
-            if live.req.eos_id is not None and tok == live.req.eos_id:
-                reason = FinishReason.EOS
-            elif len(live.tokens) >= live.req.max_new_tokens:
-                reason = FinishReason.LENGTH
-            elif live.deadline_t is not None and now >= live.deadline_t:
-                reason = FinishReason.DEADLINE
-            if reason is not None:
-                del self._live[slot]
-                self.engine.pool.free(slot)
-                obs.counter("serve.retired_total").inc()
-                if reason == FinishReason.DEADLINE:
-                    # expired_total counts EVERY deadline miss, queued
-                    # or mid-decode (FinishReason's documented contract).
-                    obs.counter("serve.expired_total").inc()
-                self._finish(live, reason)
         obs.counter("serve.tokens_total").inc(emitted)
+        if not self._live:
+            # The block retired the whole batch: the next decode only
+            # happens after new admissions, which may be arbitrarily
+            # later (open-loop callers gate step() on has_work(), so
+            # the idle reset in step() never runs for them) — a gap
+            # measured across that wait would be idle time, not host
+            # overhead.
+            self._host_gap_t = None
         return emitted
 
     def _finish(self, live: _Live, reason: str,

@@ -11,8 +11,12 @@ device) and their contents are written device-side:
   the prompt chunk against them at its traced offset, and writes the
   updated rows back at ``(slot, 0, 0, 0)`` (:func:`write_slot`;
   engine.py builds the jitted bucket programs),
-- decode steps append one position per ACTIVE row via the model's
-  per-row-position cache path (models/gpt2.py).
+- decode blocks append one position per EMITTING row per scan step via
+  the model's per-row-position cache path (models/gpt2.py): with a
+  decode horizon the engine's ``active ∧ ¬done ∧ ok`` emit mask plays
+  the role ``active`` played for single-token steps, so a row that hit
+  EOS / its budget / a NaN freeze mid-block stops appending exactly
+  like an empty slot does.
 
 Freeing a slot is bookkeeping only — stale K/V stays in the buffers.
 That is safe by construction: a new occupant's prefill chunks overwrite
@@ -21,7 +25,13 @@ the request itself has written first — each chunk attends the prefix
 earlier chunks wrote plus its own causal window, and the decode path
 (mask or flash-decode ``lengths``) stops at ``pos``. Bucket pads beyond
 the prompt write garbage K/V above ``prompt_len`` that the first decode
-writes overwrite before any mask reaches them.
+writes overwrite before any mask reaches them. Non-emitting rows in a
+decode block (inactive slots, rows done mid-horizon) write one pad
+token's K/V at their FROZEN position each scan step — always one past
+the row's real content, at most at ``max_len - 1`` via the update-slice
+clamp on a row that filled its capacity (such a row is always done →
+retired), and never attended: the row's own ``lengths`` stop at its
+content, and the next occupant rebuilds everything it will ever attend.
 """
 
 from __future__ import annotations

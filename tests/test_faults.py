@@ -11,6 +11,7 @@ on a module-scoped engine — injected faults fire host-side (before
 dispatch or on returned arrays), so a faulted engine's program set stays
 valid for the next test."""
 
+import dataclasses
 import io
 import json
 import os
@@ -354,6 +355,68 @@ def test_chaos_open_loop_32_requests(model_and_vars, tmp_path):
     assert "errors:" in report and "faults injected" in report
 
 
+def test_chaos_at_decode_horizon_4(model_and_vars, tmp_path):
+    """The chaos acceptance re-run at decode_horizon=4 (ISSUE 5): with
+    the health mask CARRIED ACROSS THE SCAN, a NaN burst between blocks
+    freezes only its victim from the next block's first step (pre-burst
+    tokens delivered, overshoot dropped on device), injected prefill
+    errors stay request-scoped, neighbors sharing the victim's blocks
+    decode to completion, zero slots leak, and the frozen program set +
+    pinned telemetry schema survive block decoding."""
+    model, variables = model_and_vars
+    cfg = dataclasses.replace(SCFG, decode_horizon=4)
+    run_dir = str(tmp_path / "chaos_h4")
+    obs.start_run(run_dir, meta={"kind": "chaos_test_h4"})
+    try:
+        engine = Engine(model, variables, cfg)
+        sched = Scheduler(engine)
+        faults.install(FaultPlan.parse(
+            "serve.prefill:error@5;serve.prefill.logits:nan@11;"
+            "serve.step.logits:nan@3", seed=3))
+        issued = 0
+        while issued < 32 or sched.has_work():
+            while issued < 32 and sched.queue_depth < cfg.queue_capacity:
+                n = 3 if issued % 2 == 0 else 6   # both prefill buckets
+                sched.submit(Request(
+                    prompt=[(3 * issued + j + 1) % 97 for j in range(n)],
+                    max_new_tokens=6, request_id=f"c{issued}"))
+                issued += 1
+            sched.step()
+        plan = faults.active()
+        results = [sched.results[f"c{i}"] for i in range(32)]
+        errored = [r for r in results if r.finish_reason == "error"]
+        clean = [r for r in results if r.finish_reason != "error"]
+        # The prefill error and prefill NaN each claim exactly one
+        # victim; the between-blocks NaN burst claims one more UNLESS
+        # its seeded victim row retired on that very block (its slot
+        # then holds no request when the poisoned carry is noticed).
+        assert plan.injected_counts["serve.prefill"] == 1
+        assert plan.injected_counts["serve.prefill.logits"] == 1
+        assert plan.injected_counts["serve.step.logits"] == 1
+        assert 2 <= len(errored) <= 3
+        assert all(r.error for r in errored)
+        # A step.logits victim keeps its pre-burst blocks: whatever it
+        # has is a clean prefix (< 6, or it would have finished clean).
+        for r in errored:
+            assert len(r.tokens) < 6
+        # Everyone else decoded to completion next to the chaos —
+        # including rows that shared scan steps with frozen victims.
+        assert all(r.finish_reason == "length" for r in clean)
+        assert all(len(r.tokens) == 6 for r in clean)
+        # Zero slot leaks, frozen program set (horizon baked into the
+        # one step program — still 1 + len(prefill_buckets)).
+        assert engine.pool.num_free == cfg.max_batch_size
+        stats = engine.compile_stats()
+        assert stats["entries"] == stats["misses"] == \
+            1 + len(cfg.prefill_buckets)
+        assert obs.counter("serve.errors_total").value == len(errored)
+    finally:
+        faults.clear()
+        obs.end_run()
+    from check_telemetry_schema import check_run_dir
+    assert check_run_dir(run_dir) == []
+
+
 # -------------------------------------------------------- graceful drain
 def _stdio_server(tmp_args=()):
     """Start nezha-serve stdio mode on a background thread against a
@@ -513,7 +576,11 @@ def test_http_drain_closes_admission_and_finishes(tmp_path, monkeypatch):
 
     from nezha_tpu.cli.serve import build_parser, run as serve_run
 
-    monkeypatch.setenv("NEZHA_FAULT_PLAN", "serve.step:delay=0.05x*")
+    # 60 tokens x 80ms: a ~5s draining window, wide enough that the
+    # healthz poll below observes it even on a loaded machine (48 x
+    # 50ms flaked under CPU contention — every poll in the window can
+    # time out behind the GIL).
+    monkeypatch.setenv("NEZHA_FAULT_PLAN", "serve.step:delay=0.08x*")
     ready = {}
     ready_evt = threading.Event()
 
@@ -526,7 +593,7 @@ def test_http_drain_closes_admission_and_finishes(tmp_path, monkeypatch):
     args = build_parser().parse_args(
         ["--random-init", "--model-preset", "tiny", "--max-batch-size",
          "2", "--max-len", "64", "--max-prefill-len", "8",
-         "--max-new-tokens", "48", "--platform", "cpu",
+         "--max-new-tokens", "60", "--platform", "cpu",
          "--http", "0", "--drain-timeout", "30"])
     t = threading.Thread(
         target=lambda: rc.update(rc=serve_run(args, ready_cb=ready_cb,
@@ -547,7 +614,7 @@ def test_http_drain_closes_admission_and_finishes(tmp_path, monkeypatch):
     inflight = threading.Thread(
         target=lambda: result.update(post(
             {"id": "slow", "prompt_tokens": [5, 17, 3],
-             "max_new_tokens": 48})),
+             "max_new_tokens": 60})),
         daemon=True)
     inflight.start()
     # wait until the slow request is actually occupying a slot
@@ -588,12 +655,16 @@ def test_http_drain_closes_admission_and_finishes(tmp_path, monkeypatch):
         refused = False
     except urllib.error.HTTPError as e:
         refused = e.code == 503
+        # A 503 on POST /generate mid-drain is the same admission-
+        # closed observation the healthz poll hunts for — count it, in
+        # case contention made every poll in the window time out.
+        saw_draining = saw_draining or e.code == 503
     except (urllib.error.URLError, ConnectionError, OSError):
         refused = True
     assert refused
     inflight.join(timeout=120)
     assert result.get("finish_reason") == "length"
-    assert len(result["tokens"]) == 48     # drain let it finish
+    assert len(result["tokens"]) == 60     # drain let it finish
     t.join(timeout=120)
     assert not t.is_alive() and rc["rc"] == 0
     assert saw_draining

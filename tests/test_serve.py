@@ -7,6 +7,7 @@ Everything runs the tiny CPU GPT-2 from tests/test_generate.py's
 config — tier-1 budget is tight, and the engine's whole point is that
 the program set compiles once per bucket and never again."""
 
+import dataclasses
 import json
 
 import jax
@@ -441,6 +442,203 @@ def test_engine_rejects_bad_shapes(model_and_vars):
         Engine(model, variables, ServeConfig(max_len=1024))
     with pytest.raises(ValueError, match="max_prefill_len"):
         ServeConfig(max_len=8, max_prefill_len=16)
+    with pytest.raises(ValueError, match="decode_horizon"):
+        ServeConfig(decode_horizon=0)
+
+
+# ------------------------------------------------- decode horizon (PR 5)
+def test_decode_horizon_parity_bit_identical(model_and_vars):
+    """horizon=8 delivers bit-identical per-request outputs to horizon=1
+    — for a greedy row, a sampled row (RNG streams advance per EMITTED
+    token, so they are horizon-invariant), and a chunked-prompt row —
+    and the greedy row matches one-shot generate() token for token.
+    max_new_tokens=10 with H=8 also exercises the on-device budget
+    stopping a block mid-horizon (8 + 2)."""
+    model, variables = model_and_vars
+    outs = {}
+    for h in (1, 8):
+        eng = Engine(model, variables,
+                     dataclasses.replace(SCFG, decode_horizon=h))
+        sched = Scheduler(eng)
+        a = sched.submit(Request(prompt=[5, 17, 3, 42],
+                                 max_new_tokens=10))
+        b = sched.submit(Request(prompt=[7, 7], max_new_tokens=9,
+                                 temperature=0.9, top_k=10, seed=7))
+        c = sched.submit(Request(prompt=list(range(2, 15)),
+                                 max_new_tokens=5))
+        _drain(sched)
+        outs[h] = {k: (sched.results[k].tokens,
+                       sched.results[k].finish_reason)
+                   for k in (a, b, c)}
+    assert outs[1] == outs[8]
+    ref = np.asarray(generate(
+        model, variables, np.asarray([[5, 17, 3, 42]], np.int32),
+        max_new_tokens=10, temperature=0.0,
+        cache_dtype=jnp.float32))[0, 4:]
+    greedy_tokens = list(outs[8].values())[0][0]
+    assert greedy_tokens == ref.tolist()
+
+
+def test_eos_mid_horizon_stops_kv_writes_and_overshoot(model_and_vars):
+    """A row whose EOS lands at scan step k < H flips the carried done
+    mask ON DEVICE: its emitted count stops at k+1, its cache position
+    freezes there (no K/V appended for the rest of the block), the
+    block's overshoot columns are pad — and through the scheduler the
+    client sees tokens ending exactly at the EOS, never overshoot."""
+    model, variables = model_and_vars
+    cfg8 = dataclasses.replace(SCFG, decode_horizon=8)
+    eng = Engine(model, variables, cfg8)
+    # Learn a seed-deterministic SAMPLED continuation (distinct tokens;
+    # greedy repeats one token on this random init), then plant a
+    # mid-horizon token as EOS on the replay.
+    kw = dict(prompt=[5, 17, 3, 42], max_new_tokens=8, temperature=0.9,
+              top_k=10, seed=7)
+    sched = Scheduler(eng)
+    probe = sched.submit(Request(**kw))
+    _drain(sched)
+    seq = sched.results[probe].tokens
+    stop = next(i for i in range(1, len(seq)) if seq[i] not in seq[:i])
+    eos, ref = seq[stop], seq[:stop + 1]
+    assert 1 <= stop < 7          # genuinely mid-horizon
+
+    # Engine-level: one block, device-side stop.
+    eng2 = Engine(model, variables, cfg8)
+    eng2.prefill(0, kw["prompt"], seed=7, temperature=0.9, top_k=10,
+                 eos_id=eos, max_new_tokens=8)
+    active = np.zeros((SCFG.max_batch_size,), bool)
+    active[0] = True
+    tok, emitted = eng2.step(active)
+    assert tok.shape == (SCFG.max_batch_size, 8)
+    assert emitted[0] == stop + 1
+    assert tok[0, :stop + 1].tolist() == ref    # ends WITH the eos
+    # Overshoot columns are pad, sampled by nobody.
+    assert (tok[0, stop + 1:] == SCFG.pad_id).all()
+    # Inactive rows emit nothing.
+    assert (emitted[1:] == 0).all()
+    # KV writes stopped with the done flip: the cache position froze at
+    # prompt + emitted instead of advancing through the whole block.
+    assert int(np.asarray(eng2.positions)[0]) == len(kw["prompt"]) + stop + 1
+
+    # Scheduler-level: the client never sees overshoot.
+    sched2 = Scheduler(eng)
+    rid = sched2.submit(Request(**kw, eos_id=eos))
+    _drain(sched2)
+    res = sched2.results[rid]
+    assert res.finish_reason == "eos"
+    assert res.tokens == ref
+
+
+def test_horizon_frozen_programs_and_dispatch_amortization(
+        model_and_vars):
+    """horizon > 1 keeps the '1 step + len(prefill_buckets) programs,
+    frozen after warmup' contract (the horizon is baked INTO the one
+    step program), decodes bit-identically — and performs <= 1/8 the
+    host dispatches per token of horizon=1, by the engine's own
+    dispatch counter (the acceptance bound of ISSUE 5)."""
+    model, variables = model_and_vars
+    steps, tokens, all_tokens = {}, {}, {}
+    n_programs = 1 + len(SCFG.prefill_buckets)
+    for h in (1, 8):
+        eng = Engine(model, variables,
+                     dataclasses.replace(SCFG, decode_horizon=h))
+        sched = Scheduler(eng)
+        # Alternate prompt lengths 3/6 so BOTH prefill buckets (4, 8)
+        # compile and the frozen-program assertion covers the full set.
+        rids = [sched.submit(Request(
+                    prompt=[3 + i, 1, 4] * (1 + i % 2),
+                    max_new_tokens=16, request_id=f"r{i}"))
+                for i in range(4)]
+        _drain(sched)
+        stats = eng.compile_stats()
+        assert stats["entries"] == n_programs
+        assert stats["misses"] == n_programs     # frozen after warmup
+        steps[h] = eng.step_calls
+        all_tokens[h] = {r: sched.results[r].tokens for r in rids}
+        tokens[h] = sum(len(t) for t in all_tokens[h].values())
+    assert all_tokens[1] == all_tokens[8]
+    assert tokens[1] == tokens[8] == 64
+    # <= 1/8 of the dispatches per token (4 requests x 16 tokens over
+    # batch 3: 32 single-token dispatches vs 4 blocks of 8).
+    assert steps[8] / tokens[8] <= (steps[1] / tokens[1]) / 8
+
+
+def test_horizon_telemetry_host_gap_and_horizon_hist(model_and_vars,
+                                                     tmp_path):
+    """The two PR-5 instruments: serve.host_gap_s (host time between
+    consecutive step dispatches) and serve.decode.horizon (tokens-per-
+    dispatch ceiling) land in the run artifacts, pass the pinned schema,
+    and render as the report's host-gap line."""
+    import os
+    import sys
+
+    from nezha_tpu import obs
+
+    model, variables = model_and_vars
+    run_dir = str(tmp_path / "hrun")
+    obs.start_run(run_dir, meta={"kind": "serve_test"})
+    try:
+        eng = Engine(model, variables,
+                     dataclasses.replace(SCFG, decode_horizon=4))
+        sched = Scheduler(eng)
+        for i in range(3):
+            sched.submit(Request(prompt=[1 + i, 2], max_new_tokens=8))
+        _drain(sched)
+        # 8 tokens at H=4 = 2 blocks -> at least one inter-dispatch gap.
+        assert obs.histogram("serve.host_gap_s").count >= 1
+        dh = obs.histogram("serve.decode.horizon")
+        assert dh.count == eng.step_calls
+        assert dh.summary()["max"] == 4
+    finally:
+        obs.end_run()
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    from check_telemetry_schema import check_run_dir
+    assert check_run_dir(run_dir) == []
+    from nezha_tpu.obs.report import render_report
+    report = render_report(run_dir)
+    assert "host gap" in report and "horizon p50 4" in report
+    # The schema checker actually pins the new names.
+    with open(os.path.join(run_dir, "summary.json")) as f:
+        summary = json.load(f)
+    del summary["histograms"]["serve.host_gap_s"]
+    with open(os.path.join(run_dir, "summary.json"), "w") as f:
+        json.dump(summary, f)
+    assert any("serve.host_gap_s" in e for e in check_run_dir(run_dir))
+
+
+def test_horizon_tpot_accounting_block_dt_split(model_and_vars,
+                                                tmp_path):
+    """serve.tpot_s folds block_dt / tokens_emitted once PER EMITTED
+    token (not one block_dt per dispatch): at H=4 the per-token
+    percentiles must sit near a quarter of the block cost, not at it —
+    pinned by count (one observation per token) and by sum ~= total
+    decode wall time regardless of horizon."""
+    from nezha_tpu import obs
+
+    model, variables = model_and_vars
+    obs.start_run(str(tmp_path / "tpot"), meta={"kind": "serve_test"})
+    try:
+        eng = Engine(model, variables,
+                     dataclasses.replace(SCFG, max_batch_size=1,
+                                         decode_horizon=4))
+        sched = Scheduler(eng)
+        rid = sched.submit(Request(prompt=[5, 17, 3], max_new_tokens=8))
+        _drain(sched)
+        h = obs.histogram("serve.tpot_s")
+        assert h.count == 8            # one observation per token...
+        assert eng.step_calls == 2     # ...from only two dispatches
+        # Each block contributes e * (dt / e) = dt to the sum, so the
+        # mean tpot is (total decode time) / tokens — the number that
+        # stays comparable across horizon settings.
+        s = h.summary()
+        assert s["p50"] <= s["sum"] / 2     # not one whole block per tok
+        tt = obs.histogram("serve.ttft_s")
+        assert tt.count == 1
+        # TTFT used the first token's position within the first block:
+        # strictly less than the full block would have charged.
+        assert sched.results[rid].ttft_s < sched.results[rid].latency_s
+    finally:
+        obs.end_run()
 
 
 def test_serving_benchmark_cli(tmp_path):

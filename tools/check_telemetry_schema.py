@@ -45,7 +45,11 @@ _SERVE_COUNTERS = {"serve.admitted_total", "serve.rejected_total",
                    "faults.injected_total"}
 _SERVE_GAUGES = {"serve.queue_depth", "serve.batch_occupancy"}
 _SERVE_HISTOGRAMS = {"serve.ttft_s", "serve.tpot_s",
-                     "serve.prefill.bucket_len"}
+                     "serve.prefill.bucket_len",
+                     # Decode-horizon instruments (PR 5): host time
+                     # between consecutive step dispatches, and the
+                     # tokens-per-dispatch ceiling each block ran at.
+                     "serve.host_gap_s", "serve.decode.horizon"}
 
 # Dist-run schema: any run that touched the coordinator (any dist.*
 # counter present — join() pre-registers the pair) must carry the full
