@@ -15,11 +15,21 @@ Two load models:
   measures latency under offered load; queue-full arrivals are DROPPED
   and counted (that is the backpressure behaving, not an error).
 
+With ``--replicas N`` (closed loop only) the same load drives the
+multi-replica ROUTER instead of one scheduler — N thread-hosted
+replicas, each its own engine behind a real HTTP socket — and
+``--kill-rate R`` hard-kills live replicas on a seeded Poisson schedule
+while the load runs: the record pins ``lost == 0`` (every request gets
+a 200 or a typed error) next to kills / restarts / failovers and
+clean-finish percentiles (docs/RUNBOOK.md §10).
+
 Usage::
 
     python benchmarks/serving.py --requests 32 --concurrency 4 \
         --run-dir /tmp/serve_bench --json
     python benchmarks/serving.py --mode open --rate 20 --requests 64
+    python benchmarks/serving.py --replicas 3 --kill-rate 0.5 \
+        --requests 64 --concurrency 8 --json
 """
 
 from __future__ import annotations
@@ -81,6 +91,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "serving, and the error/retry counters land in "
                         "the run-dir artifact next to TTFT/TPOT")
     p.add_argument("--queue-capacity", type=int, default=16)
+    p.add_argument("--replicas", type=int, default=1,
+                   help="N > 1 drives the multi-replica router "
+                        "(supervisor + N in-process replicas, each its "
+                        "own engine, reached over real HTTP) instead "
+                        "of one scheduler — closed loop only")
+    p.add_argument("--kill-rate", type=float, default=0.0,
+                   help="expected replica kills per second (seeded "
+                        "Poisson schedule) while the measured load "
+                        "runs — requires --replicas > 1; killed "
+                        "replicas are restarted by the supervisor and "
+                        "the record reports kills / restarts / "
+                        "failovers / typed errors next to the "
+                        "clean-finish percentiles")
     p.add_argument("--model-preset", choices=["tiny", "full"],
                    default="tiny")
     p.add_argument("--seed", type=int, default=0)
@@ -111,8 +134,42 @@ def run(args) -> dict:
     except ValueError:
         raise SystemExit(f"--decode-horizon must be comma-separated "
                          f"ints >= 1, got {args.decode_horizon!r}")
+    if args.replicas < 1:
+        raise SystemExit(f"--replicas must be >= 1, got {args.replicas}")
+    if args.kill_rate < 0:
+        raise SystemExit(f"--kill-rate must be >= 0, got "
+                         f"{args.kill_rate}")
+    if args.kill_rate > 0 and args.replicas < 2:
+        raise SystemExit("--kill-rate needs --replicas > 1 (killing "
+                         "the only replica measures a blackout, not "
+                         "failover)")
     from nezha_tpu.cli.common import setup_jax
     setup_jax(args)
+
+    if args.replicas > 1:
+        if len(horizons) != 1:
+            raise SystemExit("--replicas > 1 takes a single "
+                             "--decode-horizon value, not a sweep")
+        if args.mode != "closed":
+            raise SystemExit("--replicas > 1 supports closed-loop "
+                             "load only (open-loop arrivals belong to "
+                             "the single-replica latency study)")
+        record = _run_replicas(args, horizons[0])
+        if args.json:
+            print(json.dumps(record, indent=2, sort_keys=True))
+        else:
+            lat = record["latency_s"]
+            print(f"replicas={record['replicas']} closed load "
+                  f"{record['offered']}: "
+                  f"{record['finished_clean']}/{record['requests']} "
+                  f"clean ({record['answered']} answered, "
+                  f"{record['lost']} lost), "
+                  f"{record['kills']} kills {record['restarts']} "
+                  f"restarts {record['failovers']} failovers "
+                  f"{record['retries']} retries, "
+                  f"latency p50 {lat['p50'] * 1e3:.1f} ms "
+                  f"p99 {lat['p99'] * 1e3:.1f} ms")
+        return record
 
     import jax
 
@@ -345,6 +402,220 @@ def _run_one(args, model, variables, decode_horizon: int,
     if sink is not None:
         obs.end_run()
     return record
+
+
+def _run_replicas(args, decode_horizon: int) -> dict:
+    """Closed-loop load against the multi-replica router, optionally
+    under a seeded replica-kill schedule (``--kill-rate``): measures
+    what scale-out is FOR — the service keeps answering while members
+    die and restart. Every request gets exactly one answer (200 or a
+    typed error object); the record pins ``lost == 0`` alongside
+    kills / restarts / failovers / retries and clean-finish
+    percentiles. Replicas are thread-backed (each its own engine,
+    reached over real HTTP sockets, killable mid-decode) so the bench
+    pays one process."""
+    import threading
+
+    from nezha_tpu import faults, obs
+    from nezha_tpu.cli.serve import build_parser as serve_parser
+    from nezha_tpu.serve.router import Router, register_router_instruments
+    from nezha_tpu.serve.scheduler import register_serve_instruments
+    from nezha_tpu.serve.supervisor import (RouterConfig, Supervisor,
+                                            ThreadBackend)
+
+    wargv = ["--random-init", "--model-preset", args.model_preset,
+             "--max-batch-size", str(args.max_batch_size),
+             "--max-len", str(args.max_len),
+             "--max-prefill-len", str(args.max_prefill_len),
+             "--queue-capacity", str(args.queue_capacity),
+             "--decode-horizon", str(decode_horizon),
+             "--max-new-tokens", str(args.max_new_tokens),
+             "--seed", str(args.seed)]
+    if args.prefill_buckets:
+        wargv += ["--prefill-buckets", str(args.prefill_buckets)]
+    if args.decode_impl:
+        wargv += ["--decode-impl", args.decode_impl]
+    if args.platform:
+        wargv += ["--platform", args.platform]
+    wargs = serve_parser().parse_args(wargv)
+    cfg = RouterConfig(
+        replicas=args.replicas, probe_interval_s=0.1, probe_misses=3,
+        restart_backoff_base_s=0.05, restart_backoff_max_s=0.5,
+        drain_timeout_s=5.0, seed=args.seed)
+    sup = Supervisor(ThreadBackend(wargs, drain_timeout_s=5.0), cfg)
+    router = Router(sup, cfg)
+
+    rng = random.Random(args.seed)
+    vocab = 512 if args.model_preset == "tiny" else 50257
+    prompt_lens = ([int(x) for x in str(args.prompt_len_mix).split(",")]
+                   if args.prompt_len_mix else [args.prompt_len])
+    payloads = []
+    for i in range(args.requests):
+        sampled = rng.random() < args.sample_fraction
+        n = prompt_lens[i % len(prompt_lens)]
+        p = {"id": f"bench-{i}",
+             "prompt_tokens": [rng.randrange(vocab) for _ in range(n)],
+             "max_new_tokens": args.max_new_tokens, "seed": i}
+        if sampled:
+            p.update(temperature=0.8, top_k=40)
+        payloads.append(p)
+
+    sink = plan = None
+    prev_plan = faults.active()
+    try:
+        sup.start()
+        router.start()
+        if not router.wait_live(args.replicas, timeout_s=600):
+            raise SystemExit(f"replicas never became live: "
+                             f"{sup.describe()}")
+        # Warm EVERY replica's programs off the clock — every prompt
+        # length in the mix, posted DIRECTLY to each replica's port
+        # (router balancing could race two warmups onto one replica
+        # and leave another cold; a cold bucket or step program would
+        # then compile inside the measured percentiles). Mirrors
+        # _run_one's per-bucket warmup.
+        import http.client
+
+        def _warm_one(port, j, n):
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=600)
+            try:
+                conn.request("POST", "/generate", body=json.dumps(
+                    {"id": f"warmup-{port}-{j}",
+                     "prompt_tokens": [0] * n,
+                     "max_new_tokens": 1}).encode())
+                conn.getresponse().read()
+            finally:
+                conn.close()
+
+        warm = [threading.Thread(target=_warm_one, args=(r.port, j, n))
+                for r in sup.live_replicas()
+                for j, n in enumerate(sorted(set(prompt_lens)))]
+        for t in warm:
+            t.start()
+        for t in warm:
+            t.join()
+        if args.fault_rate > 0:
+            plan = faults.FaultPlan.parse(
+                f"serve.prefill:error%{args.fault_rate};"
+                f"serve.step.logits:nan%{args.fault_rate}",
+                seed=args.seed)
+            faults.install(plan)
+        if args.run_dir:
+            sink = obs.start_run(args.run_dir, meta={
+                "kind": "serve_router_bench", "mode": "closed",
+                "replicas": args.replicas, "kill_rate": args.kill_rate,
+                "requests": args.requests,
+                "decode_horizon": decode_horizon,
+                "offered": args.concurrency})
+            register_router_instruments()
+            register_serve_instruments()
+        retries0, failovers0 = router.retries, router.failovers
+        restarts0 = sup.restarts
+
+        lock = threading.Lock()
+        next_idx = {"n": 0}
+        results = []
+
+        def client():
+            while True:
+                with lock:
+                    i = next_idx["n"]
+                    if i >= args.requests:
+                        return
+                    next_idx["n"] += 1
+                t_req = time.monotonic()
+                code, obj = router.route(payloads[i])
+                with lock:
+                    results.append(
+                        (i, code, obj, time.monotonic() - t_req))
+
+        kills = []
+        stop_kill = threading.Event()
+
+        def killer():
+            # Seeded Poisson kill schedule; never kills the LAST live
+            # replica (that measures a blackout, not failover).
+            krng = random.Random(args.seed + 1)
+            while not stop_kill.is_set():
+                if stop_kill.wait(min(krng.expovariate(args.kill_rate),
+                                      5.0)):
+                    return
+                live = sup.live_replicas()
+                if len(live) >= 2:
+                    victim = live[krng.randrange(len(live))].rid
+                    sup.kill(victim)
+                    kills.append(victim)
+
+        t0 = time.monotonic()
+        clients = [threading.Thread(target=client)
+                   for _ in range(args.concurrency)]
+        for t in clients:
+            t.start()
+        kt = None
+        if args.kill_rate > 0:
+            kt = threading.Thread(target=killer, daemon=True)
+            kt.start()
+        for t in clients:
+            t.join()
+        stop_kill.set()
+        if kt is not None:
+            kt.join(timeout=10)
+        wall = time.monotonic() - t0
+        # Recovery check: the supervisor should restart every kill;
+        # give backoff a moment before reading the final live count.
+        router.wait_live(args.replicas, timeout_s=120)
+        recovered_live = sup.live_count()
+    finally:
+        faults.install(prev_plan)
+        if sink is not None:
+            obs.end_run()
+        router.stop()
+        sup.shutdown()
+
+    ok = [(i, c, o, lat) for i, c, o, lat in results if c == 200]
+    clean = [(i, c, o, lat) for i, c, o, lat in ok
+             if o.get("finish_reason") in ("length", "eos")]
+    errors_typed = {}
+    for i, c, o, lat in results:
+        if c != 200:
+            kind = (o.get("error_type") if isinstance(o, dict)
+                    else None) or f"http_{c}"
+            errors_typed[kind] = errors_typed.get(kind, 0) + 1
+    tokens = sum(len(o.get("tokens", [])) for _, _, o, _ in ok)
+    return {
+        "mode": "closed",
+        "replicas": args.replicas,
+        "kill_rate": args.kill_rate,
+        "decode_horizon": decode_horizon,
+        "offered": args.concurrency,
+        "requests": args.requests,
+        "answered": len(results),
+        # The zero-silently-lost pin: every issued request produced
+        # exactly one answer — a 200 or a typed error object.
+        "lost": args.requests - len(results),
+        "finished_clean": len(clean),
+        "clean_finish_fraction": (len(clean) / args.requests
+                                  if args.requests else 0.0),
+        "errors_typed": errors_typed,
+        "kills": len(kills), "killed_rids": kills,
+        "restarts": sup.restarts - restarts0,
+        "failovers": router.failovers - failovers0,
+        "retries": router.retries - retries0,
+        "recovered_live": recovered_live,
+        "wall_s": wall,
+        "tokens": tokens,
+        "tokens_per_sec": tokens / wall if wall else 0.0,
+        "latency_s": _percentiles(
+            [lat for _, _, _, lat in clean] or [0.0]),
+        "ttft_s": _percentiles(
+            [o["ttft_s"] for _, _, o, _ in clean
+             if o.get("ttft_s") is not None] or [0.0]),
+        "faults": {"rate": args.fault_rate,
+                   "injected": plan.num_injected if plan else 0,
+                   "errored": sum(1 for _, _, o, _ in ok
+                                  if o.get("finish_reason") == "error")},
+    }
 
 
 def main(argv=None) -> int:

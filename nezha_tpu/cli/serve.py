@@ -33,6 +33,18 @@ drain (like deadlines) is granular to one horizon — up to N tokens
 later than the signal. NEZHA_FAULT_PLAN / NEZHA_FAULT_SEED install a
 fault-injection plan for chaos drills (docs/RUNBOOK.md §9).
 
+Scale-out (--replicas N, N > 1, requires --http): the process becomes a
+ROUTER/SUPERVISOR front end instead of an engine — the supervisor
+spawns N worker processes (each this same single-replica stack, via
+run_worker(), on its own port), the router probes their /healthz,
+load-balances by live queue depth, fails a request over to another
+replica when its replica dies before answering, and restarts crashed
+workers with capped backoff (circuit breaker after --max-restart-
+failures consecutive startup failures). SIGTERM then performs a
+ROLLING drain: replicas stop one at a time, each finishing its
+in-flight work, so capacity never drops to zero until the end
+(docs/RUNBOOK.md §10).
+
 With --run-dir the run writes the standard telemetry artifacts;
 `nezha-telemetry RUN_DIR` then renders the serving section (TTFT/TPOT
 percentiles, tokens/sec, batch occupancy).
@@ -116,6 +128,35 @@ def build_parser() -> argparse.ArgumentParser:
                         "'deadline'")
     p.add_argument("--http", type=int, default=None, metavar="PORT",
                    help="serve HTTP on PORT instead of stdio JSONL")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="N > 1 turns this process into a router/"
+                        "supervisor front end over N engine worker "
+                        "processes (requires --http; each worker is "
+                        "the single-replica stack on its own port)")
+    p.add_argument("--replica-backend", choices=["process", "thread"],
+                   default="process",
+                   help="how workers are hosted: 'process' spawns real "
+                        "nezha-serve subprocesses (production — an OS "
+                        "failure domain each); 'thread' hosts them "
+                        "in-process (tests/benchmarks — no spawn cost, "
+                        "no OS isolation)")
+    p.add_argument("--probe-interval", type=float, default=0.5,
+                   help="seconds between per-replica /healthz probes")
+    p.add_argument("--probe-misses", type=int, default=3,
+                   help="consecutive missed probes that eject a replica "
+                        "from routing (one success readmits it)")
+    p.add_argument("--route-retries", type=int, default=2,
+                   help="times one request may be re-dispatched after "
+                        "its replica died before answering (seeded "
+                        "backoff between attempts); a committed "
+                        "response is never retried")
+    p.add_argument("--restart-backoff", type=float, default=0.25,
+                   help="base seconds of the capped-exponential restart "
+                        "backoff for crashed replicas")
+    p.add_argument("--max-restart-failures", type=int, default=5,
+                   help="consecutive startup failures after which a "
+                        "replica's circuit breaker opens (the "
+                        "supervisor stops restarting it)")
     p.add_argument("--run-dir", default=None,
                    help="write telemetry artifacts (metrics.jsonl / "
                         "spans.jsonl / summary.json) here")
@@ -587,8 +628,13 @@ def run_http(scheduler, args, tokenizer, eos_id, port: int,
     return 0
 
 
-def run(args, stdin=None, stdout=None, ready_cb=None,
-        drain_event=None) -> int:
+def run_worker(args, stdin=None, stdout=None, ready_cb=None,
+               drain_event=None) -> int:
+    """The single-replica stack — the classic ``--replicas 1`` entry
+    AND the worker the supervisor spawns (``--replicas N`` workers run
+    exactly this, one per port), so there is one code path to keep
+    correct. The ``replica.exec`` fault point fires at entry: the
+    crash-at-startup drill behind the supervisor's restart backoff."""
     import signal
 
     from nezha_tpu import faults
@@ -601,6 +647,12 @@ def run(args, stdin=None, stdout=None, ready_cb=None,
     # no-op).
     prev_plan = faults.active()
     faults.install_from_env()
+    from nezha_tpu.serve.supervisor import replica_exec_point
+    try:
+        replica_exec_point()
+    except BaseException:     # crash-at-startup drill: die loudly, but
+        faults.install(prev_plan)   # never leak the plan into embedders
+        raise
 
     drain = drain_event if drain_event is not None else threading.Event()
     old_handlers = {}
@@ -638,6 +690,136 @@ def run(args, stdin=None, stdout=None, ready_cb=None,
         for sig, handler in old_handlers.items():
             signal.signal(sig, handler)
         faults.install(prev_plan)
+
+
+# ------------------------------------------------------- multi-replica
+def _worker_argv(args, rid: int, port: int) -> list:
+    """The argv for one spawned worker process: the front end's own
+    flags minus the router-only ones, plus the worker's port (and a
+    per-replica run-dir subdirectory when telemetry is on)."""
+    argv = [sys.executable, "-m", "nezha_tpu.cli.serve"]
+    if args.random_init:
+        argv.append("--random-init")
+    elif args.ckpt_dir:
+        argv += ["--ckpt-dir", args.ckpt_dir]
+    elif args.hf_dir:
+        argv += ["--hf-dir", args.hf_dir]
+    argv += ["--model-preset", args.model_preset,
+             "--max-batch-size", str(args.max_batch_size),
+             "--max-len", str(args.max_len),
+             "--max-prefill-len", str(args.max_prefill_len),
+             "--k-max", str(args.k_max),
+             "--queue-capacity", str(args.queue_capacity),
+             "--max-new-tokens", str(args.max_new_tokens),
+             "--cache-dtype", args.cache_dtype,
+             "--decode-horizon", str(args.decode_horizon),
+             "--drain-timeout", str(args.drain_timeout),
+             "--seed", str(args.seed),
+             "--http", str(port)]
+    if args.tokenizer:
+        argv += ["--tokenizer", args.tokenizer]
+    if args.prefill_buckets:
+        argv += ["--prefill-buckets", str(args.prefill_buckets)]
+    if args.decode_impl:
+        argv += ["--decode-impl", args.decode_impl]
+    if args.eos_id is not None:
+        argv += ["--eos-id", str(args.eos_id)]
+    if args.platform:
+        argv += ["--platform", args.platform]
+    if args.run_dir:
+        import os
+        argv += ["--run-dir", os.path.join(args.run_dir,
+                                           f"replica{rid}")]
+    return argv
+
+
+def run_multi(args, ready_cb=None, drain_event=None) -> int:
+    """The ``--replicas N`` front end: supervisor spawns N workers,
+    router serves HTTP over them, SIGTERM/SIGINT rolls the drain
+    through the replicas one at a time. This process never initializes
+    a jax backend or compiles a program — the workers own the engines
+    (the parent package import itself is still paid once at CLI
+    startup). With ``--replica-backend thread`` the workers share this
+    process instead, trading OS isolation for spawn cost
+    (tests/benchmarks)."""
+    import copy
+    import signal
+
+    from nezha_tpu import faults
+    from nezha_tpu.serve.router import Router, run_front_end
+    from nezha_tpu.serve.supervisor import (ProcessBackend, RouterConfig,
+                                            Supervisor, ThreadBackend)
+    if args.http is None:
+        raise SystemExit("--replicas N > 1 requires --http PORT "
+                         "(the router is an HTTP front end)")
+    prev_plan = faults.active()
+    faults.install_from_env()
+
+    cfg = RouterConfig(
+        replicas=args.replicas,
+        probe_interval_s=args.probe_interval,
+        probe_misses=args.probe_misses,
+        route_retries=args.route_retries,
+        restart_backoff_base_s=args.restart_backoff,
+        max_restart_failures=args.max_restart_failures,
+        drain_timeout_s=args.drain_timeout,
+        seed=args.seed)
+    sink = None
+    if args.run_dir:
+        from nezha_tpu import obs
+        from nezha_tpu.serve.router import register_router_instruments
+        sink = obs.start_run(args.run_dir, meta={
+            "kind": "serve_router", "replicas": args.replicas,
+            "backend": args.replica_backend})
+        register_router_instruments()
+    if args.replica_backend == "thread":
+        wargs = copy.copy(args)
+        wargs.replicas, wargs.http, wargs.run_dir = 1, None, None
+        backend = ThreadBackend(wargs,
+                                drain_timeout_s=args.drain_timeout)
+    else:
+        import os
+        backend = ProcessBackend(
+            lambda rid, port: _worker_argv(args, rid, port),
+            log_dir=(os.path.join(args.run_dir, "logs")
+                     if args.run_dir else None))
+    sup = Supervisor(backend, cfg)
+    router = Router(sup, cfg)
+    drain = drain_event if drain_event is not None else threading.Event()
+    old_handlers = {}
+    try:
+        sup.start()
+        router.start()
+        # Same contract as the worker: handlers only set the event; the
+        # front end owns the rolling drain. Installed after the
+        # supervisor is up so a wedged spawn stays Ctrl-C-able.
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                old_handlers[sig] = signal.signal(
+                    sig, lambda signum, frame: drain.set())
+            except ValueError:
+                break   # not the main thread of the main interpreter
+        return run_front_end(router, sup, args.http, ready_cb=ready_cb,
+                             drain=drain,
+                             drain_timeout_s=args.drain_timeout)
+    finally:
+        router.stop()
+        sup.shutdown()
+        if sink is not None:
+            from nezha_tpu import obs
+            obs.end_run()
+        for sig, handler in old_handlers.items():
+            signal.signal(sig, handler)
+        faults.install(prev_plan)
+
+
+def run(args, stdin=None, stdout=None, ready_cb=None,
+        drain_event=None) -> int:
+    if getattr(args, "replicas", 1) > 1:
+        return run_multi(args, ready_cb=ready_cb,
+                         drain_event=drain_event)
+    return run_worker(args, stdin=stdin, stdout=stdout,
+                      ready_cb=ready_cb, drain_event=drain_event)
 
 
 def main(argv=None) -> int:

@@ -152,6 +152,34 @@ def render_serving_section(summary: Optional[dict]) -> List[str]:
     return lines
 
 
+def render_replicas_section(summary: Optional[dict]) -> List[str]:
+    """The multi-replica block (present only for router runs —
+    detected by the pre-registered ``router.*`` instruments): live
+    replica count, restart/failover/retry ledger, and route-latency
+    percentiles."""
+    if not summary:
+        return []
+    counters = summary.get("counters", {})
+    if "router.retries_total" not in counters:
+        return []
+    gauges = summary.get("gauges", {})
+    hists = summary.get("histograms", {})
+    lines = ["replicas:"]
+    lines.append(
+        f"  live: {gauges.get('router.replicas_live', 0):.0f} (final)  "
+        f"{counters.get('router.replica_restarts_total', 0):.0f} "
+        f"restarts  "
+        f"{counters.get('router.failovers_total', 0):.0f} failovers  "
+        f"{counters.get('router.retries_total', 0):.0f} retries")
+    h = hists.get("router.route_s")
+    if h and h.get("count"):
+        lines.append(
+            f"  route: p50 {h['p50'] * 1e3:.1f} ms  "
+            f"p90 {h['p90'] * 1e3:.1f} ms  "
+            f"p99 {h['p99'] * 1e3:.1f} ms  (n={h['count']})")
+    return lines
+
+
 def render_report(run_dir: str) -> str:
     """The full plain-text report for a run directory."""
     run = load_run(run_dir)
@@ -215,6 +243,12 @@ def render_report(run_dir: str) -> str:
     if serving:
         lines.append("")
         lines.extend(serving)
+
+    # --------------------------------------------------------- replicas
+    replicas = render_replicas_section(summary)
+    if replicas:
+        lines.append("")
+        lines.extend(replicas)
 
     # ---------------------------------------------------- compile cache
     cc = (summary or {}).get("compile_cache")

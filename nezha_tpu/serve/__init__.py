@@ -42,12 +42,29 @@ serve stack replaces the batch lifecycle with a slot lifecycle:
   queue-depth and batch-occupancy gauges,
   admitted/rejected/retired/errors counters).
 
+Scale-out rides on top of the single-replica stack rather than inside
+it:
+
+- ``supervisor``: spawn N replicas (each the whole stack above on its
+  own port — subprocess or in-process-thread backed), restart crashed
+  ones with capped seeded backoff and a circuit breaker, and perform
+  the rolling drain (replicas stop one at a time, so capacity never
+  hits zero mid-drain).
+- ``router``: the HTTP front end over those replicas — /healthz
+  probing with K-miss ejection and readmission, least-loaded routing,
+  queue-full 503 only when EVERY live replica is full, and bounded
+  seeded-backoff failover for replicas that die before their response
+  begins (a committed stream is never retried — typed error instead).
+
 ``nezha-serve`` (cli/serve.py) fronts the scheduler with stdio-JSONL and
-stdlib-http modes; ``benchmarks/serving.py`` load-tests it into the same
-run-dir telemetry artifacts training writes.
+stdlib-http modes (``--replicas N`` puts the router/supervisor pair in
+front of N worker processes); ``benchmarks/serving.py`` load-tests it
+into the same run-dir telemetry artifacts training writes
+(``--replicas/--kill-rate`` chaos-loads the router).
 """
 
 from nezha_tpu.serve.engine import Engine, ServeConfig
+from nezha_tpu.serve.router import Router, register_router_instruments
 from nezha_tpu.serve.sampling import sample_tokens
 from nezha_tpu.serve.scheduler import (
     FinishReason,
@@ -57,8 +74,16 @@ from nezha_tpu.serve.scheduler import (
     Scheduler,
 )
 from nezha_tpu.serve.slots import SlotPool
+from nezha_tpu.serve.supervisor import (
+    ProcessBackend,
+    RouterConfig,
+    Supervisor,
+    ThreadBackend,
+)
 
 __all__ = [
     "Engine", "ServeConfig", "SlotPool", "sample_tokens",
     "Scheduler", "Request", "RequestResult", "QueueFull", "FinishReason",
+    "Router", "RouterConfig", "Supervisor", "ProcessBackend",
+    "ThreadBackend", "register_router_instruments",
 ]

@@ -1,0 +1,386 @@
+"""The multi-replica HTTP front end: health-checked routing + failover.
+
+One process in front of N single-replica engines (spawned and restarted
+by ``serve/supervisor.py``), answering the SAME protocol one replica
+does (POST ``/generate``, GET ``/healthz``) so clients cannot tell one
+engine from a crowd — except that losing any replica no longer loses
+the service:
+
+- **probing** — a background prober GETs each replica's ``/healthz``
+  every ``probe_interval_s``; ``probe_misses`` consecutive failures
+  EJECT the replica from routing, one success readmits it. A forward
+  that dies on the wire ejects immediately (stronger evidence than a
+  missed probe).
+- **balancing** — least-loaded: the replica with the fewest
+  router-tracked in-flight forwards (ties broken by its last-probed
+  queue depth). A replica answering 503 (queue full / draining) is
+  skipped for that request; the client sees 503 only when EVERY live
+  replica refused.
+- **failover** — a replica that dies BEFORE its response begins
+  provably delivered nothing, so the request is re-dispatched to a
+  different replica: bounded retries (``route_retries``) with the PR-4
+  seeded-backoff envelope (base doubling to a cap, ±50% jitter).
+  Once a response has BEGUN, the stream is committed — a death
+  mid-response returns the typed ``replica_lost`` error instead of a
+  retry (the replica may have observably acted; re-running it could
+  double-serve). Timeouts are typed errors too, never retries: a slow
+  replica is not a dead one.
+- **typed errors** — every failure mode the client can see carries an
+  ``error_type``: ``no_live_replicas`` / ``queue_full`` (503, nothing
+  could take the request), ``replica_lost`` (502), ``replica_timeout``
+  (504), ``draining`` (503), ``bad_request`` (400, passthrough),
+  ``injected_fault`` (500, chaos drills). A request is NEVER silently
+  dropped — the replica-kill chaos acceptance pins that.
+
+Telemetry (schema-pinned by tools/check_telemetry_schema.py, rendered
+as the report's "replicas" section): ``router.replicas_live`` gauge,
+``router.retries_total`` / ``router.failovers_total`` /
+``router.replica_restarts_total`` counters, ``router.route_s``
+histogram, and the ``router.drain`` span around the rolling drain.
+Plain attribute ledgers (:attr:`Router.retries`,
+:attr:`Router.failovers`, ``Supervisor.restarts``) mirror the counters
+for callers outside a telemetry run (obs counters are branch-only
+no-ops while disabled). Fault points ``router.route`` and
+``router.probe`` make both paths chaos-drillable.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import random
+import socket
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from nezha_tpu import faults, obs
+from nezha_tpu.faults import InjectedFault
+from nezha_tpu.serve.supervisor import LIVE, STARTING, RouterConfig
+
+
+def register_router_instruments() -> None:
+    """Pre-register (get-or-create) the router instrument set so every
+    router run's summary carries all of it — a run with zero failovers
+    still reports ``failovers_total = 0`` (the stable schema
+    tools/check_telemetry_schema.py pins). Called at Supervisor/Router
+    construction; call again after a registry reset (a benchmark that
+    starts its run AFTER warmup)."""
+    for c in ("retries", "failovers", "replica_restarts"):
+        obs.counter(f"router.{c}_total")
+    obs.gauge("router.replicas_live")
+    obs.histogram("router.route_s")
+
+
+def _typed(status: int, kind: str, msg: str) -> Tuple[int, dict]:
+    return status, {"error": msg, "error_type": kind}
+
+
+class Router:
+    """Route requests across a :class:`~nezha_tpu.serve.supervisor.
+    Supervisor`'s replicas. :meth:`route` is the whole contract: it
+    takes the client's request payload and ALWAYS returns an
+    ``(http_status, response_object)`` pair — success, a replica's own
+    4xx passed through, or a typed error object; it never raises for a
+    replica failure. Thread-safe: HTTP handler threads call it
+    concurrently."""
+
+    def __init__(self, supervisor, cfg: Optional[RouterConfig] = None):
+        self.sup = supervisor
+        self.cfg = cfg if cfg is not None else supervisor.cfg
+        self._rng = random.Random(self.cfg.seed)
+        self._rng_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+        # Plain ledgers: obs counters only count inside a telemetry run.
+        self.retries = 0
+        self.failovers = 0
+        self._ledger_lock = threading.Lock()
+        register_router_instruments()
+
+    # -------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        """Run the prober on a background thread (tests drive
+        :meth:`probe_all` directly for determinism instead)."""
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, daemon=True, name="nezha-prober")
+        self._probe_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=5)
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.cfg.probe_interval_s):
+            self.probe_all()
+
+    # ---------------------------------------------------------- probing
+    def probe_all(self) -> None:
+        """One probe sweep over every replica that should be serving."""
+        for r in self.sup.replicas():
+            if r.state not in (STARTING, LIVE):
+                continue
+            ok, payload = self._probe(r)
+            self.sup.mark_probe(r.rid, ok, payload)
+
+    def _probe(self, r) -> Tuple[bool, Optional[dict]]:
+        conn = None
+        try:
+            faults.point("router.probe")
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", r.port, timeout=self.cfg.probe_timeout_s)
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                return False, None
+            return True, json.loads(body)
+        except Exception:
+            # Connection refused, reset, timeout, bad JSON, or an
+            # injected router.probe fault: all the same verdict — this
+            # probe was MISSED.
+            return False, None
+        finally:
+            if conn is not None:
+                conn.close()
+
+    def wait_live(self, n: int, timeout_s: float = 300.0) -> bool:
+        """Probe until ``n`` replicas are live (startup convenience for
+        benchmarks/tests). Returns False on timeout."""
+        t_end = time.monotonic() + timeout_s
+        while time.monotonic() < t_end:
+            self.probe_all()
+            if self.sup.live_count() >= n:
+                return True
+            time.sleep(0.05)
+        return False
+
+    # ---------------------------------------------------------- routing
+    def route(self, payload: dict) -> Tuple[int, dict]:
+        """Dispatch one request: pick the least-loaded live replica,
+        forward, fail over on uncommitted replica loss. Always returns
+        ``(status, object)`` — see the module docstring for the error
+        taxonomy."""
+        t0 = time.monotonic()
+        try:
+            faults.point("router.route")
+            return self._route_inner(json.dumps(payload).encode())
+        except InjectedFault as e:
+            return _typed(500, "injected_fault", str(e))
+        finally:
+            obs.histogram("router.route_s").observe(
+                time.monotonic() - t0)
+
+    def _route_inner(self, body: bytes) -> Tuple[int, dict]:
+        excluded: set = set()
+        retries = 0
+        failed_over = False
+        while True:
+            usable = [r for r in self.sup.live_replicas()
+                      if r.rid not in excluded]
+            if not usable:
+                if failed_over:
+                    return _typed(502, "replica_lost",
+                                  f"no live replica left after "
+                                  f"{retries} dispatch(es) failed")
+                return _typed(503, "no_live_replicas",
+                              "no live replicas")
+            full: set = set()
+            while True:
+                cand = [r for r in usable if r.rid not in full]
+                if not cand:
+                    return _typed(
+                        503, "queue_full",
+                        f"all {len(usable)} live replica(s) at "
+                        f"capacity")
+                r = min(cand, key=lambda x: (
+                    x.in_flight, x.last_health.get("queued", 0), x.rid))
+                outcome, detail = self._forward(r, body)
+                if outcome == "ok":
+                    if failed_over:
+                        with self._ledger_lock:
+                            self.failovers += 1
+                        obs.counter("router.failovers_total").inc()
+                    return 200, detail
+                if outcome == "pass":       # the replica's own 4xx
+                    return detail
+                if outcome == "full":
+                    full.add(r.rid)
+                    continue
+                if outcome == "timeout":
+                    return _typed(504, "replica_timeout", detail)
+                if outcome == "committed":
+                    # The response had begun: the stream is committed
+                    # and a retry could double-serve — typed error.
+                    return _typed(502, "replica_lost",
+                                  f"replica {r.rid} lost after its "
+                                  f"response began: {detail}")
+                # outcome == "lost": died before any response byte —
+                # provably delivered nothing, safe to fail over.
+                failed_over = True
+                excluded.add(r.rid)
+                self.sup.note_forward_failure(r.rid)
+                if retries >= self.cfg.route_retries:
+                    return _typed(502, "replica_lost",
+                                  f"replica {r.rid} died before the "
+                                  f"first token; {retries} retr"
+                                  f"{'y' if retries == 1 else 'ies'} "
+                                  f"exhausted: {detail}")
+                retries += 1
+                with self._ledger_lock:
+                    self.retries += 1
+                obs.counter("router.retries_total").inc()
+                time.sleep(self._retry_backoff(retries))
+                break     # rebuild the live set — it may have changed
+
+    def _retry_backoff(self, attempt: int) -> float:
+        base = min(self.cfg.retry_backoff_base_s * (2 ** (attempt - 1)),
+                   self.cfg.retry_backoff_max_s)
+        with self._rng_lock:
+            return base * (0.5 + self._rng.random())   # ±50% jitter
+
+    def _forward(self, r, body: bytes) -> Tuple[str, object]:
+        """One dispatch to one replica -> (outcome, detail):
+
+        - ``("ok", result)`` — 200, the finished generation
+        - ``("pass", (status, obj))`` — the replica's own 4xx, passed
+          through untouched (a bad request is bad on every replica)
+        - ``("full", obj)`` — 503 from the replica (queue full /
+          draining): unavailable for THIS request, not dead
+        - ``("lost", msg)`` — failed before any response byte (connect
+          refused/reset, or the replica answered 5xx declaring the
+          request failed without serving it) — retryable
+        - ``("committed", msg)`` — failed AFTER the response began —
+          not retryable
+        - ``("timeout", msg)`` — no answer within
+          ``forward_timeout_s`` — not retryable (slow != dead)
+        """
+        self.sup.add_in_flight(r.rid, +1)
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", r.port, timeout=self.cfg.forward_timeout_s)
+        committed = False
+        try:
+            conn.request("POST", "/generate", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            committed = True
+            raw = resp.read()
+            try:
+                obj = json.loads(raw)
+            except ValueError:
+                obj = {"error": "replica returned non-JSON"}
+            if resp.status == 200:
+                return "ok", obj
+            if resp.status == 503:
+                return "full", obj
+            if resp.status >= 500:
+                return "lost", (f"replica {r.rid} answered "
+                                f"{resp.status}: {obj.get('error')}")
+            return "pass", (resp.status, obj)
+        except socket.timeout:
+            return "timeout", (f"replica {r.rid} gave no answer within "
+                               f"{self.cfg.forward_timeout_s}s")
+        except Exception as e:
+            kind = "committed" if committed else "lost"
+            return kind, f"{type(e).__name__}: {e}"
+        finally:
+            conn.close()
+            self.sup.add_in_flight(r.rid, -1)
+
+
+# ---------------------------------------------------------- HTTP front end
+def run_front_end(router: Router, supervisor, port: int, *,
+                  ready_cb=None, drain: Optional[threading.Event] = None,
+                  drain_timeout_s: float = 30.0) -> int:
+    """Serve the router over stdlib HTTP: POST ``/generate`` routes
+    across replicas, GET ``/healthz`` reports the replica set. Setting
+    ``drain`` (the signal handlers do) closes admission (POST -> 503
+    "draining", ``/healthz`` -> 503) and runs the ROLLING drain —
+    replicas stop one at a time, each finishing its in-flight work, so
+    capacity never hits zero before the last one — then shuts the
+    server down. Mirrors ``cli/serve.run_http``'s lifecycle contract
+    (non-daemon handlers flush final responses; a second signal is
+    ignored)."""
+    import sys
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    drain = drain if drain is not None else threading.Event()
+    stop = threading.Event()
+
+    class Handler(BaseHTTPRequestHandler):
+        timeout = 60
+
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code: int, obj: dict):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path != "/healthz":
+                return self._send(404, {"error": "unknown path"})
+            live = supervisor.live_count()
+            if drain.is_set():
+                status = "draining"
+            elif live == 0:
+                status = "no live replicas"
+            else:
+                status = "ok"
+            self._send(200 if status == "ok" else 503, {
+                "status": status, "replicas_live": live,
+                "replicas": supervisor.describe()})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                return self._send(404, {"error": "unknown path"})
+            if drain.is_set():
+                return self._send(*_typed(503, "draining", "draining"))
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n))
+            except (ValueError, json.JSONDecodeError) as e:
+                return self._send(*_typed(400, "bad_request", str(e)))
+            if not isinstance(payload, dict):
+                return self._send(*_typed(400, "bad_request",
+                                          "request must be a JSON "
+                                          "object"))
+            code, obj = router.route(payload)
+            self._send(code, obj)
+
+    class Server(ThreadingHTTPServer):
+        daemon_threads = False    # flush final responses at shutdown
+
+    server = Server(("127.0.0.1", port), Handler)
+
+    def drain_watch():
+        drain.wait()
+        if not stop.is_set():
+            with obs.span("router.drain",
+                          replicas=len(supervisor.replicas())) as sp:
+                supervisor.rolling_drain(drain_timeout_s)
+                sp.set(replicas_live=supervisor.live_count())
+            stop.set()
+        server.shutdown()
+
+    threading.Thread(target=drain_watch, daemon=True).start()
+    if ready_cb is not None:
+        ready_cb(server)
+    print(f"nezha-serve router listening on http://127.0.0.1:"
+          f"{server.server_address[1]} "
+          f"({supervisor.cfg.replicas} replicas; POST /generate, "
+          f"GET /healthz)", file=sys.stderr)
+    try:
+        server.serve_forever(poll_interval=0.1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        stop.set()
+        drain.set()     # unblock the watcher on non-signal exits
+        server.server_close()
+    return 0

@@ -1,0 +1,753 @@
+"""Replica supervision for multi-replica serving.
+
+One engine process is one failure domain: a crash kills every in-flight
+request it holds (and, pre-scale-out, the whole service). The supervisor
+turns N copies of the existing single-replica stack into a crowd the
+front end (`serve/router.py`) can survive losing members of:
+
+- **spawn** — each replica is the SAME single-replica `nezha-serve`
+  stack on its own port, launched through a pluggable backend:
+  ``ProcessBackend`` runs ``python -m nezha_tpu.cli.serve ... --http
+  PORT`` subprocesses (production: a real OS failure domain, SIGTERM
+  drains it, SIGKILL is a genuine crash), ``ThreadBackend`` hosts the
+  same engine/scheduler stack in-process behind a real HTTP socket
+  (tests and benchmarks: killable without paying a process spawn +
+  jax import per replica — a kill severs its sockets, so the router
+  observes the same connection resets a SIGKILLed process produces).
+- **restart** — a replica that dies while it should be serving is
+  respawned with capped exponential backoff (the PR-4 retry-envelope
+  idiom: base doubling to a cap, seeded ±50% jitter so a mass failure
+  doesn't respawn in lockstep). Failures that never reach a healthy
+  probe count consecutively; after ``max_restart_failures`` of them the
+  replica's CIRCUIT BREAKER opens (state ``failed``) and the supervisor
+  stops burning spawns on it — a replica that crashes at startup every
+  time is a config problem, not a transient. Reaching healthy resets
+  the count. Successful respawns count into
+  ``router.replica_restarts_total`` (and :attr:`Supervisor.restarts`).
+- **rolling drain** — SIGTERM at the front end drains replicas ONE AT A
+  TIME: each gets a graceful drain (SIGTERM to a process — the worker's
+  own PR-4 drain semantics; the in-process worker's drain method for
+  threads) and its full drain budget while every later replica keeps
+  serving its in-flight work, so live capacity steps down N, N-1, ...,
+  1, 0 and never hits zero before the last replica.
+
+Health (probe misses, ejection, readmission) is the router's verdict,
+stored here per replica so routing and lifecycle share one record under
+one lock. Chaos enters through :meth:`Supervisor.kill` (the seeded
+replica-kill knob ``benchmarks/serving.py --kill-rate`` and the chaos
+tests drive) and through the fault points ``supervisor.spawn`` (a spawn
+attempt that fails before the backend runs) and ``replica.exec`` (the
+worker crashes at startup — both the subprocess entry and the thread
+worker route through :func:`replica_exec_point`, keeping one registered
+site).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from nezha_tpu import faults, obs
+
+# Replica lifecycle states. healthy (the router's probe verdict) is a
+# separate axis: only a LIVE + healthy replica is routable.
+STARTING = "starting"    # spawned, not yet probed healthy
+LIVE = "live"            # probed healthy at least once since spawn
+DRAINING = "draining"    # rolling drain in progress on this replica
+STOPPED = "stopped"      # drained/shut down deliberately — never restarted
+DEAD = "dead"            # died; restart scheduled (next_restart_t)
+FAILED = "failed"        # circuit breaker open — restarts exhausted
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """Shared knobs for the supervisor + router pair (the scale-out
+    analogue of ``ServeConfig``): how many replicas, how health is
+    judged, how failures are retried, and how restarts back off.
+
+    ``probe_misses`` consecutive failed /healthz probes eject a replica
+    from routing (one success readmits it). ``route_retries`` bounds
+    how many times one request may be re-dispatched after its replica
+    died before answering; retry sleeps follow the PR-4 envelope
+    (``retry_backoff_base_s`` doubling to ``retry_backoff_max_s``,
+    seeded ±50% jitter). ``forward_timeout_s`` bounds one replica
+    answer — it must exceed the worst-case request latency, and a
+    timeout is a typed error, never a retry (a slow replica is not a
+    dead one, and re-dispatching its request would double-serve it).
+    ``max_restart_failures`` consecutive spawn/startup failures open a
+    replica's circuit breaker. ``drain_timeout_s`` is the per-replica
+    budget of the rolling drain."""
+
+    replicas: int = 2
+    probe_interval_s: float = 0.5
+    probe_timeout_s: float = 5.0
+    probe_misses: int = 3
+    route_retries: int = 2
+    retry_backoff_base_s: float = 0.05
+    retry_backoff_max_s: float = 1.0
+    forward_timeout_s: float = 120.0
+    restart_backoff_base_s: float = 0.25
+    restart_backoff_max_s: float = 5.0
+    max_restart_failures: int = 5
+    spawn_timeout_s: float = 300.0
+    drain_timeout_s: float = 30.0
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {self.replicas}")
+        if self.probe_misses < 1:
+            raise ValueError("probe_misses must be >= 1")
+        if self.route_retries < 0:
+            raise ValueError("route_retries must be >= 0")
+        if self.max_restart_failures < 1:
+            raise ValueError("max_restart_failures must be >= 1")
+
+
+def replica_exec_point() -> None:
+    """The ``replica.exec`` fault point: hit once when a replica worker
+    begins executing, BEFORE it builds its engine. Both worker hosts
+    route through here — the subprocess entry (``cli/serve.run_worker``)
+    and the in-process thread worker — keeping one registered call site
+    (tools/check_fault_points.py requires names to be unique). An
+    ``error`` rule makes the replica crash at startup: the drill behind
+    the supervisor's restart backoff and circuit breaker."""
+    faults.point("replica.exec")
+
+
+def free_port() -> int:
+    """An ephemeral localhost port. Bound-then-released, so a parallel
+    process could steal it before the worker binds — the supervisor's
+    restart path absorbs that exactly like any other startup failure."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclasses.dataclass
+class Replica:
+    """One replica's record: lifecycle (supervisor's), health (router's
+    probe verdict), and routing load — mutated only under the
+    supervisor lock so the two layers can't disagree."""
+
+    rid: int
+    state: str = STARTING
+    handle: Optional[object] = None
+    port: int = 0
+    healthy: bool = False
+    probe_misses: int = 0
+    restart_failures: int = 0
+    next_restart_t: float = 0.0
+    spawned_t: float = 0.0
+    in_flight: int = 0
+    last_health: Dict[str, object] = dataclasses.field(default_factory=dict)
+    error: Optional[str] = None
+
+
+# ------------------------------------------------------------- backends
+class ProcessHandle:
+    """A replica hosted as an OS process."""
+
+    def __init__(self, proc: subprocess.Popen, port: int):
+        self.proc = proc
+        self.port = port
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def terminate(self) -> None:
+        """Graceful: SIGTERM — the worker's own PR-4 drain semantics
+        (admission closes, in-flight finishes within its
+        --drain-timeout, stragglers cancel as "deadline")."""
+        if self.alive():
+            try:
+                self.proc.terminate()
+            except OSError:
+                pass
+
+    def kill(self) -> None:
+        """Abrupt: SIGKILL — the chaos/crash path. The OS closes the
+        worker's sockets, so the router sees connection resets."""
+        if self.alive():
+            try:
+                self.proc.kill()
+            except OSError:
+                pass
+
+    def wait(self, timeout: float) -> bool:
+        try:
+            self.proc.wait(timeout=timeout)
+            return True
+        except subprocess.TimeoutExpired:
+            return False
+
+
+class ProcessBackend:
+    """Spawn replicas as ``nezha-serve`` subprocesses (the production
+    backend): each runs the full single-replica stack via
+    ``cli/serve.run_worker`` — the SAME code path ``--replicas 1``
+    uses. ``argv_for`` maps ``(rid, port)`` to the worker argv
+    (cli/serve.py builds it from the front end's own flags); stderr
+    goes to ``log_dir/replica<rid>.log`` when given (the listening
+    banner and tracebacks land there), else is inherited."""
+
+    kind = "process"
+
+    def __init__(self, argv_for: Callable[[int, int], List[str]],
+                 env: Optional[Dict[str, str]] = None,
+                 log_dir: Optional[str] = None):
+        self.argv_for = argv_for
+        self.env = env
+        self.log_dir = log_dir
+
+    def spawn(self, rid: int, port: int) -> ProcessHandle:
+        stderr = None
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+            stderr = open(os.path.join(self.log_dir,
+                                       f"replica{rid}.log"), "ab")
+        try:
+            proc = subprocess.Popen(
+                self.argv_for(rid, port), stdin=subprocess.DEVNULL,
+                stdout=subprocess.DEVNULL, stderr=stderr,
+                env=self.env)
+        finally:
+            if stderr is not None:
+                stderr.close()   # the child holds its own fd now
+        return ProcessHandle(proc, port)
+
+
+class ThreadHandle:
+    """A replica hosted as an in-process worker thread."""
+
+    def __init__(self, worker: "_ThreadWorker"):
+        self.worker = worker
+        self.port = worker.port
+
+    def alive(self) -> bool:
+        return not self.worker.dead.is_set()
+
+    def terminate(self) -> None:
+        self.worker.drain()
+
+    def kill(self) -> None:
+        self.worker.kill()
+
+    def wait(self, timeout: float) -> bool:
+        return self.worker.dead.wait(timeout)
+
+
+class ThreadBackend:
+    """Host replicas as in-process worker threads behind real HTTP
+    sockets — the test/benchmark backend. Each replica still builds its
+    OWN engine/scheduler from ``worker_args`` (a parsed ``nezha-serve``
+    namespace) and is reached over 127.0.0.1 exactly like a process
+    replica, so the router code has ONE transport; what thread hosting
+    trades away is OS-level isolation (a worker that corrupts the
+    interpreter takes the house down — production uses
+    :class:`ProcessBackend`). ``kill()`` severs the worker's open
+    sockets before stopping it, so the router observes the same
+    connection resets a SIGKILL produces."""
+
+    kind = "thread"
+
+    def __init__(self, worker_args, drain_timeout_s: float = 30.0):
+        self.worker_args = worker_args
+        self.drain_timeout_s = drain_timeout_s
+
+    def spawn(self, rid: int, port: int) -> ThreadHandle:
+        # port is ignored: the worker binds port 0 and reports the real
+        # one via the handle — no bind race to absorb.
+        worker = _ThreadWorker(self.worker_args, rid,
+                               drain_timeout_s=self.drain_timeout_s)
+        worker.start()
+        return ThreadHandle(worker)
+
+
+# Engine builds trace + compile; serializing them keeps concurrent
+# replica spawns deterministic and off each other's compile locks.
+_BUILD_LOCK = threading.Lock()
+
+
+class _ThreadWorker:
+    """One in-process replica: engine + scheduler + a /generate +
+    /healthz HTTP server matching the ``cli/serve.run_http`` protocol,
+    purpose-built to be KILLABLE (connection tracking, daemon handler
+    threads, abrupt socket severing) — the properties an OS process
+    gets for free and a thread has to engineer."""
+
+    def __init__(self, worker_args, rid: int, drain_timeout_s: float):
+        from http.server import ThreadingHTTPServer
+
+        self.args = worker_args
+        self.rid = rid
+        self.drain_timeout_s = drain_timeout_s
+        self.dead = threading.Event()     # worker finished, any cause
+        self.crashed = False
+        self._drain_evt = threading.Event()
+        self._killed = threading.Event()
+        self._ready = threading.Event()   # stack built, serving
+        self._sched = None
+        self._events: Dict[str, threading.Event] = {}
+        self._events_lock = threading.Lock()
+        self._conns = set()
+        self._conns_lock = threading.Lock()
+
+        worker = self
+
+        from http.server import BaseHTTPRequestHandler
+
+        class Handler(BaseHTTPRequestHandler):
+            timeout = 60
+
+            def log_message(self, *a):
+                pass
+
+            def setup(self):
+                super().setup()
+                with worker._conns_lock:
+                    worker._conns.add(self.connection)
+
+            def finish(self):
+                with worker._conns_lock:
+                    worker._conns.discard(self.connection)
+                try:
+                    super().finish()
+                except OSError:
+                    pass    # connection already severed by kill()
+
+            def _send(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path != "/healthz":
+                    return self._send(404, {"error": "unknown path"})
+                if not worker._ready.is_set():
+                    return self._send(503, {"status": "starting"})
+                if worker._drain_evt.is_set() or worker._killed.is_set():
+                    return self._send(503, {"status": "draining"})
+                sched = worker._sched
+                pool = sched.engine.pool
+                self._send(200, {
+                    "status": "ok", "active": pool.num_active,
+                    "capacity": pool.capacity,
+                    "queued": sched.queue_depth,
+                    "occupancy": pool.occupancy})
+
+            def do_POST(self):
+                worker._handle_generate(self)
+
+        class Server(ThreadingHTTPServer):
+            # Handlers are daemons here, unlike run_http: a killed
+            # replica abandons its parked handlers by design (their
+            # sockets are already severed), and non-daemon threads
+            # would wedge interpreter exit.
+            daemon_threads = True
+
+            def handle_error(self, request, client_address):
+                pass     # severed sockets raise in handlers — expected
+
+        self._server = Server(("127.0.0.1", 0), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"nezha-replica-{rid}")
+
+    def start(self) -> None:
+        self._thread.start()
+
+    # ---------------------------------------------------- request path
+    def _handle_generate(self, h) -> None:
+        if h.path != "/generate":
+            return h._send(404, {"error": "unknown path"})
+        if not self._ready.is_set():
+            return h._send(503, {"error": "starting"})
+        if self._drain_evt.is_set() or self._killed.is_set():
+            return h._send(503, {"error": "draining"})
+        from nezha_tpu.cli.serve import _parse_request, _result_obj
+        from nezha_tpu.serve import QueueFull
+        sched = self._sched
+        try:
+            n = int(h.headers.get("Content-Length", 0))
+            req = _parse_request(json.loads(h.rfile.read(n)), self.args,
+                                 self._tokenizer, self._eos_id,
+                                 sched.engine.vocab)
+        except (ValueError, json.JSONDecodeError) as e:
+            return h._send(400, {"error": str(e)})
+        import uuid
+        rid = req.request_id or f"r{self.rid}-{uuid.uuid4().hex[:12]}"
+        req.request_id = rid
+        ev = threading.Event()
+        with self._events_lock:
+            if rid in self._events:
+                return h._send(409, {"error": f"request id {rid!r} "
+                                              f"already in flight"})
+            self._events[rid] = ev
+        try:
+            sched.submit(req)
+        except QueueFull as e:
+            with self._events_lock:
+                self._events.pop(rid, None)
+            return h._send(503, {"error": str(e)})
+        except ValueError as e:
+            with self._events_lock:
+                self._events.pop(rid, None)
+            return h._send(400, {"error": str(e)})
+        if self.dead.is_set():
+            # TOCTOU guard (same race run_http closes): the worker
+            # finished its final waiter sweep between the admission
+            # check above — which ran before this request's body
+            # finished uploading — and the submit. Nobody will ever
+            # retire the request or set the event, so answer 503 now
+            # instead of parking on ev.wait() forever.
+            with self._events_lock:
+                self._events.pop(rid, None)
+            return h._send(503, {"error": "draining"})
+        ev.wait()
+        with self._events_lock:
+            self._events.pop(rid, None)
+        res = sched.results.pop(rid, None)
+        if res is None:
+            return h._send(503, {"error": "replica stopped"})
+        out = _result_obj(res, self._tokenizer)
+        out.pop("event")
+        h._send(200, out)
+
+    # ------------------------------------------------------ worker body
+    def _run(self) -> None:
+        try:
+            replica_exec_point()
+            with _BUILD_LOCK:
+                from nezha_tpu.cli.serve import _build_stack
+                sched, tokenizer, eos_id = _build_stack(self.args)
+            self._tokenizer, self._eos_id = tokenizer, eos_id
+
+            def on_finish(res):
+                with self._events_lock:
+                    ev = self._events.get(res.request_id)
+                if ev is not None:
+                    ev.set()
+
+            sched.on_finish = on_finish
+            self._sched = sched
+            self._ready.set()
+            threading.Thread(target=self._server.serve_forever,
+                             kwargs={"poll_interval": 0.05},
+                             daemon=True).start()
+            while not self._killed.is_set() and not self._drain_evt.is_set():
+                if not sched.step():
+                    time.sleep(0.002)
+            if self._drain_evt.is_set() and not self._killed.is_set():
+                # Graceful drain: admission already closed (the handler
+                # checks the event); finish in-flight within the
+                # budget, cancel stragglers as "deadline".
+                t_end = time.monotonic() + self.drain_timeout_s
+                while (sched.has_work() and time.monotonic() < t_end
+                       and not self._killed.is_set()):
+                    if not sched.step():
+                        time.sleep(0.002)
+                sched.cancel_remaining()
+        except BaseException:
+            self.crashed = True
+        finally:
+            self.dead.set()
+            try:
+                self._server.shutdown()
+                self._server.server_close()
+            except OSError:
+                pass
+            self._release_waiters()
+
+    def _release_waiters(self) -> None:
+        sched = self._sched
+        if sched is not None:
+            try:
+                from nezha_tpu.serve import FinishReason
+                sched.cancel_remaining(FinishReason.ERROR,
+                                       error="replica stopped")
+            except Exception:
+                pass
+        with self._events_lock:
+            for ev in self._events.values():
+                ev.set()
+
+    # -------------------------------------------------------- lifecycle
+    def drain(self) -> None:
+        self._drain_evt.set()
+
+    def kill(self) -> None:
+        """Abrupt stop, modelled on SIGKILL: sever every open
+        connection FIRST (the router observes resets, exactly like a
+        killed process), then stop the decode loop and the server."""
+        self._killed.set()
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except OSError:
+            pass
+        self._release_waiters()
+
+
+# ------------------------------------------------------------ supervisor
+class Supervisor:
+    """Owns the replica set: spawns it, restarts crashed members with
+    capped seeded backoff (circuit breaker after
+    ``cfg.max_restart_failures`` consecutive startup failures), and
+    performs the rolling drain. The router reads/writes health and load
+    through the accessor methods — every mutation happens under one
+    lock. ``tick()`` is the monitor step; ``start()`` runs it on a
+    background thread, tests may drive it directly."""
+
+    tick_interval_s = 0.05
+
+    def __init__(self, backend, cfg: RouterConfig):
+        self.backend = backend
+        self.cfg = cfg
+        self._replicas = [Replica(rid=i) for i in range(cfg.replicas)]
+        self._lock = threading.RLock()
+        self._rng = random.Random(cfg.seed)
+        self._draining = False
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self.restarts = 0     # obs counters only count inside a run;
+        #                       this plain ledger always does
+        from nezha_tpu.serve.router import register_router_instruments
+        register_router_instruments()
+
+    # ------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        with self._lock:
+            for r in self._replicas:
+                try:
+                    self._spawn(r)
+                except Exception as e:
+                    self._spawn_failed(r, e, time.monotonic())
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         daemon=True,
+                                         name="nezha-supervisor")
+        self._monitor.start()
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self.tick_interval_s):
+            self.tick()
+
+    def tick(self) -> None:
+        """One monitor step: notice deaths, time out wedged startups,
+        perform restarts that have reached their backoff time."""
+        now = time.monotonic()
+        with self._lock:
+            if self._draining:
+                return
+            for r in self._replicas:
+                if r.state in (STARTING, LIVE) and not r.handle.alive():
+                    self._note_death(r, now, "replica died")
+                elif (r.state == STARTING
+                        and now - r.spawned_t > self.cfg.spawn_timeout_s):
+                    r.handle.kill()
+                    self._note_death(r, now, "startup timed out")
+                elif r.state == DEAD and now >= r.next_restart_t:
+                    self._restart(r, now)
+
+    def shutdown(self) -> None:
+        """Stop the monitor and kill whatever is still running (the
+        abrupt teardown — :meth:`rolling_drain` is the graceful one)."""
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5)
+        with self._lock:
+            self._draining = True
+            for r in self._replicas:
+                if r.handle is not None and r.state not in (STOPPED,
+                                                            FAILED):
+                    r.handle.kill()
+                    r.state = STOPPED
+                r.healthy = False
+            self._update_live_gauge()
+
+    # ------------------------------------------------------- internals
+    def _spawn(self, r: Replica) -> None:
+        """Lock held. Raises on spawn failure (callers route the
+        exception into the backoff/breaker accounting)."""
+        faults.point("supervisor.spawn")
+        port = free_port()
+        r.handle = self.backend.spawn(r.rid, port)
+        r.port = getattr(r.handle, "port", port)
+        r.state = STARTING
+        r.healthy = False
+        r.probe_misses = 0
+        r.spawned_t = time.monotonic()
+        r.error = None
+
+    def _spawn_failed(self, r: Replica, e: Exception, now: float) -> None:
+        r.restart_failures += 1
+        r.error = f"spawn failed: {type(e).__name__}: {e}"
+        if r.restart_failures >= self.cfg.max_restart_failures:
+            r.state = FAILED
+            print(f"supervisor: replica {r.rid} circuit breaker OPEN "
+                  f"after {r.restart_failures} consecutive failures "
+                  f"({r.error})", file=sys.stderr)
+        else:
+            r.state = DEAD
+            r.next_restart_t = now + self._restart_backoff(
+                r.restart_failures)
+
+    def _note_death(self, r: Replica, now: float, why: str) -> None:
+        # Only deaths that never reached a healthy probe count toward
+        # the breaker: a replica that serves and then gets killed is
+        # RECOVERING each time, not failing to start.
+        if r.state == STARTING:
+            r.restart_failures += 1
+        r.healthy = False
+        r.error = why
+        if r.restart_failures >= self.cfg.max_restart_failures:
+            r.state = FAILED
+            print(f"supervisor: replica {r.rid} circuit breaker OPEN "
+                  f"after {r.restart_failures} consecutive startup "
+                  f"failures", file=sys.stderr)
+        else:
+            r.state = DEAD
+            r.next_restart_t = now + self._restart_backoff(
+                r.restart_failures)
+        self._update_live_gauge()
+
+    def _restart(self, r: Replica, now: float) -> None:
+        try:
+            self._spawn(r)
+        except Exception as e:
+            self._spawn_failed(r, e, now)
+            return
+        self.restarts += 1
+        obs.counter("router.replica_restarts_total").inc()
+
+    def _restart_backoff(self, failures: int) -> float:
+        base = min(self.cfg.restart_backoff_base_s * (2 ** failures),
+                   self.cfg.restart_backoff_max_s)
+        return base * (0.5 + self._rng.random())   # ±50% seeded jitter
+
+    def _update_live_gauge(self) -> None:
+        obs.gauge("router.replicas_live").set(sum(
+            1 for r in self._replicas if r.state == LIVE and r.healthy))
+
+    # ------------------------------------------------- router interface
+    def replicas(self) -> List[Replica]:
+        with self._lock:
+            return list(self._replicas)
+
+    def live_replicas(self) -> List[Replica]:
+        with self._lock:
+            return [r for r in self._replicas
+                    if r.state == LIVE and r.healthy]
+
+    def live_count(self) -> int:
+        return len(self.live_replicas())
+
+    def describe(self) -> List[dict]:
+        with self._lock:
+            return [{"rid": r.rid, "port": r.port, "state": r.state,
+                     "healthy": r.healthy, "in_flight": r.in_flight,
+                     "restart_failures": r.restart_failures}
+                    for r in self._replicas]
+
+    def mark_probe(self, rid: int, ok: bool,
+                   payload: Optional[dict] = None) -> None:
+        """The prober's verdict for one replica: a success readmits it
+        (and promotes STARTING -> LIVE, resetting the breaker count);
+        ``cfg.probe_misses`` consecutive failures eject it."""
+        with self._lock:
+            r = self._replicas[rid]
+            if r.state not in (STARTING, LIVE):
+                return
+            if ok:
+                r.probe_misses = 0
+                r.last_health = dict(payload or {})
+                r.healthy = True
+                if r.state == STARTING:
+                    r.state = LIVE
+                    r.restart_failures = 0
+            else:
+                r.probe_misses += 1
+                if r.healthy and r.probe_misses >= self.cfg.probe_misses:
+                    r.healthy = False    # ejected until a probe succeeds
+            self._update_live_gauge()
+
+    def note_forward_failure(self, rid: int) -> None:
+        """A forward died on the wire — stronger evidence than a missed
+        probe, so the replica is ejected immediately; the prober
+        readmits it the moment it answers again."""
+        with self._lock:
+            r = self._replicas[rid]
+            r.healthy = False
+            r.probe_misses = max(r.probe_misses, self.cfg.probe_misses)
+            self._update_live_gauge()
+
+    def add_in_flight(self, rid: int, delta: int) -> None:
+        with self._lock:
+            self._replicas[rid].in_flight += delta
+
+    # ------------------------------------------------------------ chaos
+    def kill(self, rid: int) -> None:
+        """Hard-kill one replica (chaos): its sockets sever, in-flight
+        forwards fail, and the monitor restarts it with backoff."""
+        with self._lock:
+            handle = self._replicas[rid].handle
+        if handle is not None:
+            handle.kill()
+
+    # ------------------------------------------------------------ drain
+    def rolling_drain(self, timeout_s: Optional[float] = None,
+                      progress: Optional[Callable[[int], None]] = None
+                      ) -> None:
+        """Drain replicas ONE AT A TIME: each gets a graceful stop and
+        up to ``timeout_s`` (default ``cfg.drain_timeout_s``) to finish
+        its in-flight work while every later replica keeps serving — so
+        live capacity steps down one replica per round and only reaches
+        zero when the last one exits. Restarts are frozen for the
+        duration. ``progress(live_count)`` fires after each replica
+        stops (tests assert the never-zero-mid-drain ladder with it)."""
+        timeout_s = (self.cfg.drain_timeout_s if timeout_s is None
+                     else timeout_s)
+        with self._lock:
+            self._draining = True
+        for r in self._replicas:
+            with self._lock:
+                handle = r.handle
+                if r.state in (STOPPED, FAILED) or handle is None:
+                    continue
+                r.state = DRAINING
+            if handle.alive():
+                handle.terminate()
+                # The worker runs its own drain inside; +5s covers its
+                # shutdown tail so a healthy drain never gets killed at
+                # exactly the budget.
+                if not handle.wait(timeout_s + 5.0):
+                    handle.kill()
+                    handle.wait(5.0)
+            with self._lock:
+                r.state = STOPPED
+                r.healthy = False
+                self._update_live_gauge()
+            if progress is not None:
+                progress(self.live_count())

@@ -1,0 +1,146 @@
+"""bench.py self-healing + per-platform baselines (ROADMAP item 5):
+backend-init failure falls back to CPU instead of producing a crash
+record, the JSON line is platform-labeled, and vs_baseline is tracked
+PER PLATFORM FAMILY — a CPU fallback run can neither regress nor
+overwrite the TPU anchor. The e2e test runs the real main() with the
+config benches stubbed out (their numerics are covered elsewhere; this
+file pins the record/baseline plumbing)."""
+
+import json
+
+import pytest
+
+import bench
+
+
+# ------------------------------------------------------- backend init
+def test_init_backend_falls_back_to_cpu(monkeypatch, capsys):
+    import jax
+
+    calls = {"n": 0}
+    real_devices = jax.devices
+
+    def flaky_devices():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("Unable to initialize backend 'axon'")
+        return real_devices()
+
+    monkeypatch.setattr(jax, "devices", flaky_devices)
+    assert bench._init_backend() == "cpu"
+    assert calls["n"] == 2
+    assert "retrying on cpu" in capsys.readouterr().err
+
+
+def test_init_backend_env_escape_hatch(monkeypatch):
+    monkeypatch.setenv("NEZHA_BENCH_CPU", "1")
+    assert bench._init_backend() == "cpu"
+
+
+# -------------------------------------------------- baseline plumbing
+def test_family_baseline_legacy_flat_record_is_tpu():
+    legacy = {"gpt2_124m_tokens_per_sec_per_chip": 87564.0,
+              "platform": "tpu",
+              "resnet50_images_per_sec_per_chip": 2373.7}
+    # the tunneled TPU ('axon') and 'tpu' share one family
+    assert bench._platform_family("axon") == "tpu"
+    tpu = bench._family_baseline(legacy, "tpu")
+    assert tpu["gpt2_124m_tokens_per_sec_per_chip"] == 87564.0
+    # a CPU run sees NO anchors in a legacy tpu record
+    assert bench._family_baseline(legacy, "cpu") == {}
+
+
+def test_family_baseline_by_platform_overlays_flat():
+    rec = {"gpt2_124m_tokens_per_sec_per_chip": 100.0, "platform": "tpu",
+           "by_platform": {
+               "tpu": {"gpt2_124m_tokens_per_sec_per_chip": 200.0},
+               "cpu": {"gpt2_124m_tokens_per_sec_per_chip": 5.0}}}
+    assert bench._family_baseline(rec, "tpu")[
+        "gpt2_124m_tokens_per_sec_per_chip"] == 200.0
+    assert bench._family_baseline(rec, "cpu")[
+        "gpt2_124m_tokens_per_sec_per_chip"] == 5.0
+
+
+def test_load_baseline_corruption_is_sticky(tmp_path):
+    path = tmp_path / "b.json"
+    path.write_text("{not json")
+    rec, corrupt = bench._load_baseline(str(path))
+    assert rec == {} and corrupt
+    path.write_text("[1, 2]")       # parseable but not a record
+    rec, corrupt = bench._load_baseline(str(path))
+    assert rec == {} and corrupt
+    rec, corrupt = bench._load_baseline(str(tmp_path / "missing.json"))
+    assert rec == {} and not corrupt
+
+
+# --------------------------------------------------------- e2e record
+@pytest.fixture()
+def stubbed_bench(monkeypatch):
+    """main() with the config benches stubbed to constants — the run
+    exercises backend init, the dispatch-ping loop, and the whole
+    baseline/record path, without minutes of CPU training."""
+    monkeypatch.setattr(bench, "bench_gpt2",
+                        lambda on_tpu, peak, **kw: (1000.0, None, 0.01))
+    monkeypatch.setattr(bench, "bench_resnet50",
+                        lambda on_tpu, peak: (50.0, None, 0.02))
+    monkeypatch.setattr(bench, "bench_bert",
+                        lambda on_tpu, peak: (800.0, None, 0.01))
+    monkeypatch.setattr(bench, "bench_wrn101",
+                        lambda on_tpu, peak: (20.0, None, 0.01))
+    monkeypatch.setattr(bench, "bench_mlp", lambda on_tpu: 5.0)
+    return bench
+
+
+def _run_main(capsys) -> dict:
+    assert bench.main() == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    return json.loads(out)
+
+
+def test_bench_writes_platform_labeled_record(stubbed_bench, tmp_path,
+                                              monkeypatch, capsys):
+    """The acceptance path: on a machine with no TPU backend, bench.py
+    completes, labels the record with its platform, seeds the CPU
+    baseline slot, and tracks vs_baseline against it on the next run —
+    all without touching a pre-existing TPU anchor."""
+    path = tmp_path / "baseline.json"
+    # a legacy TPU record is already there — the CPU run must not read
+    # or clobber it
+    path.write_text(json.dumps(
+        {"gpt2_124m_tokens_per_sec_per_chip": 87564.0,
+         "platform": "tpu"}))
+    monkeypatch.setenv("NEZHA_BENCH_BASELINE", str(path))
+
+    rec = _run_main(capsys)
+    assert rec["platform"] == "cpu"
+    assert rec["value"] == 1000.0
+    assert rec["vs_baseline"] == 1.0      # first CPU measurement
+    saved = json.loads(path.read_text())
+    # TPU anchor untouched; CPU anchors seeded in their own slot
+    assert saved["gpt2_124m_tokens_per_sec_per_chip"] == 87564.0
+    assert saved["by_platform"]["cpu"][
+        "gpt2_124m_tokens_per_sec_per_chip"] == 1000.0
+    assert saved["by_platform"]["cpu"][
+        "resnet50_images_per_sec_per_chip"] == 50.0
+
+    # second run: vs_baseline is CPU-vs-CPU, anchors not overwritten
+    monkeypatch.setattr(bench, "bench_gpt2",
+                        lambda on_tpu, peak, **kw: (1500.0, None, 0.01))
+    rec2 = _run_main(capsys)
+    assert rec2["vs_baseline"] == 1.5
+    assert rec2["extras"]["resnet50_vs_baseline"] == 1.0
+    saved2 = json.loads(path.read_text())
+    assert saved2["by_platform"]["cpu"][
+        "gpt2_124m_tokens_per_sec_per_chip"] == 1000.0
+
+
+def test_bench_corrupt_baseline_never_overwritten(stubbed_bench,
+                                                  tmp_path, monkeypatch,
+                                                  capsys):
+    path = tmp_path / "baseline.json"
+    path.write_text("{torn write")
+    monkeypatch.setenv("NEZHA_BENCH_BASELINE", str(path))
+    rec = _run_main(capsys)
+    assert rec["vs_baseline"] == 1.0
+    # the corrupt file was left for a human, not reset to this run
+    assert path.read_text() == "{torn write"

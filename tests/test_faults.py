@@ -697,14 +697,18 @@ def test_serving_benchmark_fault_rate(tmp_path):
 
 def test_fault_point_registry_pinned():
     """Every registered faults.point()/corrupt() name is unique,
-    documented in the RUNBOOK, and covered by a test — and the validator
-    actually sees the full set (serve.prefill / serve.prefill.logits /
-    serve.step / serve.step.logits / checkpoint.save / dist.join)."""
-    from check_fault_points import check, find_points
+    documented in the RUNBOOK, covered by a test, and pinned in the
+    validator's EXPECTED_POINTS — and the validator actually sees the
+    full set, including the multi-replica points (router.route /
+    router.probe / supervisor.spawn / replica.exec)."""
+    from check_fault_points import EXPECTED_POINTS, check, find_points
 
     assert check(_ROOT) == []
     assert set(find_points(_ROOT)) == {
         "serve.prefill", "serve.prefill.logits",
         "serve.step", "serve.step.logits",
         "checkpoint.save", "dist.join",
+        "router.route", "router.probe",
+        "supervisor.spawn", "replica.exec",
     }
+    assert set(find_points(_ROOT)) == set(EXPECTED_POINTS)

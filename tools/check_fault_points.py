@@ -13,7 +13,10 @@ walks the source tree for ``faults.point("...")`` / ``faults.corrupt(
 2. **documented** — the name appears in docs/RUNBOOK.md (the fault-point
    table in the "Failure modes & recovery" section);
 3. **tested** — the name appears in at least one file under tests/
-   (a plan rule string or a direct reference).
+   (a plan rule string or a direct reference);
+4. **pinned** — the discovered set matches ``EXPECTED_POINTS`` exactly,
+   so a point can neither appear nor vanish without this file (and the
+   RUNBOOK table) being updated deliberately.
 
 Stdlib-only, same pattern as check_telemetry_schema.py: run from the
 tier-1 suite (tests/test_faults.py) or standalone:
@@ -30,6 +33,16 @@ from typing import Dict, List
 
 POINT_RE = re.compile(
     r"""faults\.(?:point|corrupt)\(\s*["']([A-Za-z0-9_.]+)["']""")
+# The frozen registry: every faults.point()/corrupt() call site in the
+# tree, by name. Adding a fault point means adding it HERE (and to the
+# RUNBOOK table + a test) in the same change.
+EXPECTED_POINTS = frozenset({
+    "serve.prefill", "serve.prefill.logits",
+    "serve.step", "serve.step.logits",
+    "checkpoint.save", "dist.join",
+    # Multi-replica serving (router/supervisor front end):
+    "router.route", "router.probe", "supervisor.spawn", "replica.exec",
+})
 SOURCE_DIR = "nezha_tpu"
 # The faults package itself is excluded: its docstrings describe the API
 # with example call patterns, which are not registered points.
@@ -68,6 +81,14 @@ def check(root: str) -> List[str]:
             errors.append(
                 f"fault point {name!r} registered at {len(files)} call "
                 f"sites ({', '.join(files)}) — names must be unique")
+    for name in sorted(set(points) - EXPECTED_POINTS):
+        errors.append(f"fault point {name!r} is not in EXPECTED_POINTS "
+                      f"— add it to the pinned registry (and the "
+                      f"RUNBOOK table) deliberately")
+    for name in sorted(EXPECTED_POINTS - set(points)):
+        errors.append(f"pinned fault point {name!r} has no "
+                      f"faults.point()/corrupt() call site under "
+                      f"{SOURCE_DIR}/ — the registry lost a point")
     with open(os.path.join(root, RUNBOOK)) as f:
         runbook = f.read()
     tests_text = []

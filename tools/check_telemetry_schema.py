@@ -51,6 +51,16 @@ _SERVE_HISTOGRAMS = {"serve.ttft_s", "serve.tpot_s",
                      # tokens-per-dispatch ceiling each block ran at.
                      "serve.host_gap_s", "serve.decode.horizon"}
 
+# Router-run schema (nezha-serve --replicas N / benchmarks/serving.py
+# --replicas): the supervisor/router pair pre-registers this full set,
+# so a summary carrying the marker counter must carry ALL of it — a run
+# with zero failovers still reports failovers_total = 0.
+_ROUTER_MARKER = "router.retries_total"
+_ROUTER_COUNTERS = {"router.retries_total", "router.failovers_total",
+                    "router.replica_restarts_total"}
+_ROUTER_GAUGES = {"router.replicas_live"}
+_ROUTER_HISTOGRAMS = {"router.route_s"}
+
 # Dist-run schema: any run that touched the coordinator (any dist.*
 # counter present — join() pre-registers the pair) must carry the full
 # failure-accounting set, so a world that never retried still reports
@@ -61,11 +71,12 @@ _DIST_COUNTERS = {"dist.join_retries_total", "dist.heartbeat_lost_total"}
 # serve./checkpoint./dist. are an interface (reports and dashboards key
 # on them), so an unknown name in those namespaces is drift — add new
 # spans HERE (and to the emitting layer's docs) deliberately.
-_PINNED_SPAN_PREFIXES = ("serve.", "checkpoint.", "dist.")
+_PINNED_SPAN_PREFIXES = ("serve.", "checkpoint.", "dist.", "router.")
 _PINNED_SPANS = {
     "serve.prefill", "serve.decode_attention", "serve.drain",
     "checkpoint.save", "checkpoint.verify",
     "dist.join", "dist.barrier", "dist.failure", "dist.leave",
+    "router.drain",
 }
 
 
@@ -198,6 +209,7 @@ def check_summary_json(path: str, errors: List[str]) -> None:
     else:
         errors.append("summary.json: 'slowest_spans' must be a list")
     _check_serving(summary, errors)
+    _check_router(summary, errors)
     _check_dist(summary, errors)
 
 
@@ -218,6 +230,26 @@ def _check_serving(summary: dict, errors: List[str]) -> None:
     hists = hists if isinstance(hists, dict) else {}
     for name in sorted(_SERVE_HISTOGRAMS - set(hists)):
         errors.append(f"summary.json: serving run missing histogram "
+                      f"{name!r}")
+
+
+def _check_router(summary: dict, errors: List[str]) -> None:
+    """Router-run summaries (marker: router.retries_total) must carry
+    the complete pinned router instrument set."""
+    counters = summary.get("counters")
+    if not isinstance(counters, dict) or _ROUTER_MARKER not in counters:
+        return
+    for name in sorted(_ROUTER_COUNTERS - set(counters)):
+        errors.append(f"summary.json: router run missing counter "
+                      f"{name!r}")
+    gauges = summary.get("gauges")
+    gauges = gauges if isinstance(gauges, dict) else {}
+    for name in sorted(_ROUTER_GAUGES - set(gauges)):
+        errors.append(f"summary.json: router run missing gauge {name!r}")
+    hists = summary.get("histograms")
+    hists = hists if isinstance(hists, dict) else {}
+    for name in sorted(_ROUTER_HISTOGRAMS - set(hists)):
+        errors.append(f"summary.json: router run missing histogram "
                       f"{name!r}")
 
 
