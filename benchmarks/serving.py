@@ -82,6 +82,34 @@ def build_parser() -> argparse.ArgumentParser:
                         "horizon — one engine + fresh warmup per value, "
                         "with per-horizon sub-records (and per-horizon "
                         "run-dir subdirectories h<N>/) in the output")
+    p.add_argument("--kv-layout", choices=["paged", "dense"],
+                   default="paged",
+                   help="KV pool layout: paged = block-paged pool with "
+                        "ref-counted blocks + prefix reuse (default); "
+                        "dense = classic worst-case per-slot "
+                        "reservation (the before/after knob)")
+    p.add_argument("--kv-block-size", type=int, default=16,
+                   help="paged: tokens per KV block")
+    p.add_argument("--kv-num-blocks", type=int, default=None,
+                   help="paged: total pool blocks (block 0 scratch); "
+                        "default = dense-equivalent capacity. Set it "
+                        "BELOW the dense equivalent to measure "
+                        "block-budget admission: concurrency then "
+                        "tracks resident tokens, not slots")
+    p.add_argument("--prefix-cache", choices=["on", "off"], default="on",
+                   help="paged: shared-prefix prefill reuse on/off")
+    p.add_argument("--shared-prefix-frac", type=float, default=0.0,
+                   help="templated traffic: this fraction of requests "
+                        "share one common prompt prefix — with the "
+                        "paged pool + prefix cache they take block "
+                        "REFERENCES instead of re-prefilling, and the "
+                        "record reports prefix-hit-rate, "
+                        "blocks-resident, and TTFT split by hit/miss")
+    p.add_argument("--shared-prefix-len", type=int, default=None,
+                   help="shared prefix length in tokens (default: 2 KV "
+                        "blocks); non-shared requests are padded to "
+                        "the same total length so hit/miss TTFT "
+                        "compares like for like")
     p.add_argument("--fault-rate", type=float, default=0.0,
                    help="probability per prefill / per decode step of an "
                         "injected fault (prefill errors + NaN logit "
@@ -230,7 +258,10 @@ def _run_one(args, model, variables, decode_horizon: int,
         max_batch_size=args.max_batch_size, max_len=args.max_len,
         max_prefill_len=args.max_prefill_len, prefill_buckets=buckets,
         queue_capacity=args.queue_capacity, cache_dtype=jnp.bfloat16,
-        decode_impl=args.decode_impl, decode_horizon=decode_horizon)
+        decode_impl=args.decode_impl, decode_horizon=decode_horizon,
+        kv_layout=args.kv_layout, kv_block_size=args.kv_block_size,
+        kv_num_blocks=args.kv_num_blocks,
+        prefix_cache=args.prefix_cache == "on")
     engine = Engine(model, variables, cfg)
     sched = Scheduler(engine)
     rng = random.Random(args.seed)
@@ -239,28 +270,87 @@ def _run_one(args, model, variables, decode_horizon: int,
     prompt_lens = ([int(x) for x in str(args.prompt_len_mix).split(",")]
                    if args.prompt_len_mix else [args.prompt_len])
     prompt_len_of = {}                 # request_id -> prompt length
+    # Templated traffic: one seeded common prefix; shared requests are
+    # prefix + a short random tail, and NON-shared requests draw a fully
+    # random prompt of the SAME total length, so hit-vs-miss TTFT
+    # compares equal prefill spans. The cache seeder (first shared
+    # arrival to actually PREFILL — a miss by construction) is
+    # classified with the misses: classification reads the live trie,
+    # so a would-be seeder that never ran (queue-full drop, injected
+    # prefill error before registration) doesn't misfile its successor.
+    shared_prefix = []
+    expected_hit = {}                  # request_id -> bool
+    if args.shared_prefix_frac > 0:
+        plen = args.shared_prefix_len or 2 * args.kv_block_size
+        if plen + 2 + args.max_new_tokens > args.max_len:
+            raise SystemExit(
+                f"--shared-prefix-len {plen} + tail 2 + max_new_tokens "
+                f"{args.max_new_tokens} exceeds --max-len {args.max_len}")
+        shared_prefix = [rng.randrange(vocab) for _ in range(plen)]
+
+    def _prefix_cached() -> bool:
+        trie = getattr(engine.pool, "trie", None)
+        return bool(trie and trie.match(shared_prefix))
+
+    # A shared request expects a hit once a prior shared request was
+    # actually SUBMITTED (closed-loop bursts create several before the
+    # seeder prefills) or the prefix is already in the trie (the
+    # backstop that survives a dropped/errored would-be seeder).
+    _shared_rids = set()
+    _seeder_submitted = {"done": False}
+
+    def note_submitted(rid: str) -> None:
+        if rid in _shared_rids:
+            _seeder_submitted["done"] = True
 
     def make_request(i: int) -> Request:
         sampled = rng.random() < args.sample_fraction
-        n = prompt_lens[i % len(prompt_lens)]
-        prompt_len_of[f"bench-{i}"] = n
+        rid = f"bench-{i}"
+        if shared_prefix and rng.random() < args.shared_prefix_frac:
+            prompt = shared_prefix + [rng.randrange(vocab),
+                                      rng.randrange(vocab)]
+            expected_hit[rid] = (_seeder_submitted["done"]
+                                 or _prefix_cached())
+            _shared_rids.add(rid)
+        elif shared_prefix:
+            prompt = [rng.randrange(vocab)
+                      for _ in range(len(shared_prefix) + 2)]
+            expected_hit[rid] = False
+        else:
+            n = prompt_lens[i % len(prompt_lens)]
+            prompt = [rng.randrange(vocab) for _ in range(n)]
+        prompt_len_of[rid] = len(prompt)
         return Request(
-            prompt=[rng.randrange(vocab) for _ in range(n)],
+            prompt=prompt,
             max_new_tokens=args.max_new_tokens,
             temperature=0.8 if sampled else 0.0,
             top_k=40 if sampled else None,
-            seed=i, request_id=f"bench-{i}")
+            seed=i, request_id=rid)
 
     # Warm EVERY program off the clock — serving steady state never pays
     # trace+compile, and neither should the measurement: one request per
     # prefill bucket (chunked prompts reuse the bucket programs, so this
-    # covers long prompts too) plus the shared decode step. The telemetry
-    # run starts AFTER warmup so the artifacts hold steady-state
-    # percentiles only (no multi-second compile spike in ttft p99).
+    # covers long prompts too) plus the shared decode step. Warmup
+    # prompts use DISTINCT tokens per bucket: identical prompts would
+    # prefix-hit each other in the paged pool, the wider bucket would
+    # prefill only its un-cached suffix through a NARROWER program, and
+    # the wide program's compile would land inside the measured ttft
+    # p99 — the exact spike warmup exists to keep off the clock. The
+    # telemetry run starts AFTER warmup so the artifacts hold
+    # steady-state percentiles only.
     for j, w in enumerate(engine.cfg.prefill_buckets):
-        sched.submit(Request(prompt=[0] * min(w, args.max_len - 1),
-                             max_new_tokens=1, request_id=f"warmup-{j}"))
+        n = min(w, args.max_len - 1)
+        sched.submit(Request(
+            prompt=[(131 * j + 7 * i + 1) % vocab for i in range(n)],
+            max_new_tokens=1, request_id=f"warmup-{j}"))
     sched.run_until_idle()
+    if engine.paged:
+        # Warmup must not leak into the measured record: drop its
+        # cached blocks and zero the reuse counters so prefix_hit_rate
+        # and blocks-resident peaks describe the measured load only.
+        engine.pool.clear_prefix_cache()
+        engine.pool.prefix_hits = 0
+        engine.pool.cow_copies = 0
 
     # Chaos mode: a seeded probabilistic plan armed AFTER warmup (a
     # faulted warmup would skip compiling a bucket program) injecting
@@ -293,6 +383,18 @@ def _run_one(args, model, variables, decode_horizon: int,
     # per-decode occupancy into the metric.batch_occupancy histogram.)
     t0 = time.monotonic()
     issued = finished = dropped = 0
+    peak_resident = peak_blocks = 0
+
+    def _track_peaks():
+        # The paged-pool occupancy claim: how many requests were
+        # RESIDENT (decoding concurrently) and how many KV blocks that
+        # took — dense reserves worst-case rows, paged only what's
+        # written, so at equal device memory paged peaks strictly
+        # higher on under-max_len traffic.
+        nonlocal peak_resident, peak_blocks
+        peak_resident = max(peak_resident, len(sched._live))
+        peak_blocks = max(peak_blocks, engine.pool.blocks_used)
+
     try:
         if args.mode == "closed":
             while finished < args.requests:
@@ -301,9 +403,12 @@ def _run_one(args, model, variables, decode_horizon: int,
                 while (issued < args.requests
                        and issued - finished < args.concurrency
                        and sched.queue_depth < sched.queue_capacity):
-                    sched.submit(make_request(issued))
+                    req = make_request(issued)
+                    sched.submit(req)
+                    note_submitted(req.request_id)
                     issued += 1
                 sched.step()
+                _track_peaks()
                 finished = issued - sched.queue_depth - len(sched._live)
         else:
             # Poisson arrivals: exponential inter-arrival gaps at --rate.
@@ -318,13 +423,16 @@ def _run_one(args, model, variables, decode_horizon: int,
                 now = time.monotonic() - t0
                 while issued + dropped < args.requests \
                         and arrivals[issued + dropped] <= now:
+                    req = make_request(issued + dropped)
                     try:
-                        sched.submit(make_request(issued + dropped))
+                        sched.submit(req)
+                        note_submitted(req.request_id)
                         issued += 1
                     except QueueFull:
                         dropped += 1
                 if sched.has_work():
                     sched.step()
+                    _track_peaks()
                 else:
                     time.sleep(0.001)
                 finished = issued - sched.queue_depth - len(sched._live)
@@ -392,6 +500,21 @@ def _run_one(args, model, variables, decode_horizon: int,
         "prefill_buckets": list(engine.cfg.prefill_buckets),
         "decode_impl": args.decode_impl or "auto",
         "compile_cache": engine.compile_stats(),
+        # Paged-pool occupancy record: resident-request and
+        # blocks-resident peaks are THE concurrency-at-equal-memory
+        # comparison against a dense run (dense peaks at its slot
+        # count; paged at what the block budget admits).
+        "kv": {
+            "layout": args.kv_layout,
+            "block_size": args.kv_block_size,
+            "num_blocks": (engine.pool.num_blocks if engine.paged
+                           else None),
+            "prefix_cache": args.prefix_cache == "on",
+            "prefix_hits": getattr(engine.pool, "prefix_hits", 0),
+            "cow_copies": getattr(engine.pool, "cow_copies", 0),
+            "peak_resident_requests": peak_resident,
+            "peak_blocks_used": peak_blocks,
+        },
         "faults": {
             "rate": args.fault_rate,
             "injected": plan.num_injected if plan else 0,
@@ -399,6 +522,26 @@ def _run_one(args, model, variables, decode_horizon: int,
             "errored": len(errored),
         },
     }
+    if shared_prefix:
+        # TTFT by hit/miss over clean finishes: the prefix-reuse win is
+        # the GAP between these two (a hit skips the shared span's
+        # prefill entirely; its TTFT is queue wait + one short tail
+        # chunk + its first block slice).
+        ttft_hit = [r.ttft_s for r in clean
+                    if expected_hit.get(r.request_id)
+                    and r.ttft_s is not None]
+        ttft_miss = [r.ttft_s for r in clean
+                     if not expected_hit.get(r.request_id)
+                     and r.ttft_s is not None]
+        record["shared_prefix"] = {
+            "frac": args.shared_prefix_frac,
+            "len": len(shared_prefix),
+            "expected_hits": sum(expected_hit.values()),
+            "prefix_hit_rate": (getattr(engine.pool, "prefix_hits", 0)
+                                / len(results) if results else 0.0),
+            "ttft_hit_s": _percentiles(ttft_hit or [0.0]),
+            "ttft_miss_s": _percentiles(ttft_miss or [0.0]),
+        }
     if sink is not None:
         obs.end_run()
     return record
@@ -480,9 +623,13 @@ def _run_replicas(args, decode_horizon: int) -> dict:
             conn = http.client.HTTPConnection("127.0.0.1", port,
                                               timeout=600)
             try:
+                # Distinct tokens per warmup (see _run_one): identical
+                # prompts would prefix-hit in a replica's paged pool
+                # and leave wider bucket programs cold.
                 conn.request("POST", "/generate", body=json.dumps(
                     {"id": f"warmup-{port}-{j}",
-                     "prompt_tokens": [0] * n,
+                     "prompt_tokens": [(131 * j + 7 * i + 1) % vocab
+                                       for i in range(n)],
                      "max_new_tokens": 1}).encode())
                 conn.getresponse().read()
             finally:

@@ -106,6 +106,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "per-token events, but deadline/drain "
                         "granularity coarsens to one horizon "
                         "(docs/RUNBOOK.md §8)")
+    p.add_argument("--kv-layout", choices=["paged", "dense"],
+                   default="paged",
+                   help="KV pool layout: paged = block-paged pool with "
+                        "ref-counted blocks, lazy binding, and shared-"
+                        "prefix prefill reuse (default); dense = the "
+                        "classic worst-case per-slot reservation")
+    p.add_argument("--kv-block-size", type=int, default=16,
+                   help="paged layout: tokens per KV block")
+    p.add_argument("--kv-num-blocks", type=int, default=None,
+                   help="paged layout: total pool blocks (block 0 is "
+                        "scratch); default = dense-equivalent capacity "
+                        "(1 + max_batch_size * ceil(max_len/block)); "
+                        "smaller makes resident tokens, not slots, the "
+                        "admission limit")
+    p.add_argument("--prefix-cache", choices=["on", "off"], default="on",
+                   help="paged layout: reuse cached blocks for "
+                        "requests whose prompt prefix matches (TTFT "
+                        "collapses for templated traffic)")
+    p.add_argument("--kv-eviction", choices=["lru", "none"],
+                   default="lru",
+                   help="paged layout: when the free list runs dry, "
+                        "evict LRU prefix-cache blocks (lru) or go "
+                        "straight to typed backpressure (none)")
     p.add_argument("--k-max", type=int, default=64,
                    help="static top-k cap; per-request top_k is clamped "
                         "to it")
@@ -196,7 +219,12 @@ def _build_stack(args):
         cache_dtype=jnp.float32 if args.cache_dtype == "f32"
         else jnp.bfloat16,
         decode_impl=args.decode_impl,
-        decode_horizon=args.decode_horizon)
+        decode_horizon=args.decode_horizon,
+        kv_layout=args.kv_layout,
+        kv_block_size=args.kv_block_size,
+        kv_num_blocks=args.kv_num_blocks,
+        prefix_cache=args.prefix_cache == "on",
+        kv_eviction=args.kv_eviction)
     engine = Engine(model, variables, cfg)
     return Scheduler(engine), tokenizer, eos_id
 
@@ -713,9 +741,15 @@ def _worker_argv(args, rid: int, port: int) -> list:
              "--max-new-tokens", str(args.max_new_tokens),
              "--cache-dtype", args.cache_dtype,
              "--decode-horizon", str(args.decode_horizon),
+             "--kv-layout", args.kv_layout,
+             "--kv-block-size", str(args.kv_block_size),
+             "--prefix-cache", args.prefix_cache,
+             "--kv-eviction", args.kv_eviction,
              "--drain-timeout", str(args.drain_timeout),
              "--seed", str(args.seed),
              "--http", str(port)]
+    if args.kv_num_blocks is not None:
+        argv += ["--kv-num-blocks", str(args.kv_num_blocks)]
     if args.tokenizer:
         argv += ["--tokenizer", args.tokenizer]
     if args.prefill_buckets:

@@ -212,6 +212,22 @@ class Attention(Module):
         qkv = qkv.reshape(b, s, 3, cfg.num_heads, d).transpose(2, 0, 3, 1, 4)
         q, k, v = qkv[0], qkv[1], qkv[2]  # each [B, H, S, D]
 
+        if cache is not None and "tables" in cache:
+            # PAGED cache (the serve engine's block-paged pool): k/v are
+            # block POOLS [N, H, bs, D] and cache["tables"] [B, M] maps
+            # this row's position p to pool block tables[p // bs] at
+            # offset p % bs. Writes are a scatter through the table;
+            # attention either runs the flash-decode kernel directly on
+            # the pools (block-table gather operand, per-row length
+            # skip preserved) or gathers the row's blocks and takes the
+            # same masked path as the dense layout. Non-emitting rows
+            # (``active`` False) route their frozen-position pad write
+            # to block 0 — the pool's reserved scratch block — so a
+            # retired slot can never scribble on a block that was
+            # rebound to another request.
+            return self._apply_paged(variables, x, q, k, v, cache, pos,
+                                     prefill, active, states,
+                                     training=training)
         if cache is not None:
             # Incremental decoding: append this chunk's K/V at `pos` in the
             # fixed-size cache and attend causally over everything written
@@ -363,6 +379,98 @@ class Attention(Module):
                         training=training)
         out = run_child(self.drop, "drop", variables, states, out,
                         training=training, rng=rng)
+        return out, states
+
+
+    def _apply_paged(self, variables, x, q, k, v, cache, pos, prefill,
+                     active, states, *, training):
+        """The block-paged cache path (see ``apply``). ``cache`` is
+        ``{"k": [N, H, bs, D], "v": [N, H, bs, D], "tables": [B, M]}``;
+        the engine guarantees every position this call writes sits in a
+        block the row owns exclusively (ref count 1 — prepare_write
+        COWed/bound it), and every position it attends below a row's
+        length was genuinely written (prefill order / prefix refs)."""
+        cfg = self.cfg
+        b, s, h = x.shape
+        d = h // cfg.num_heads
+        kp, vp, tab = cache["k"], cache["v"], cache["tables"]
+        bs_kv = kp.shape[2]
+        m = tab.shape[1]
+        L = m * bs_kv
+        per_row = getattr(pos, "ndim", 0) == 1
+        if per_row:
+            # Decode: one token per row at its own depth. Clamp matches
+            # the dense layout's update-slice clamp (a capacity-filled
+            # row is done — its pad write may land on its own last
+            # position, never past it), and inactive rows write scratch.
+            pos_w = jnp.minimum(pos, L - 1)
+            bi = jnp.clip(pos_w // bs_kv, 0, m - 1)
+            blk = jnp.take_along_axis(tab, bi[:, None], axis=1)[:, 0]
+            off = pos_w % bs_kv
+            if active is not None:
+                blk = jnp.where(active, blk, 0)
+                off = jnp.where(active, off, 0)
+            k_pool = kp.at[blk, :, off, :].set(
+                k[:, :, 0, :].astype(kp.dtype))
+            v_pool = vp.at[blk, :, off, :].set(
+                v[:, :, 0, :].astype(vp.dtype))
+        else:
+            # Prefill chunk at a traced scalar offset: scatter the s
+            # tokens through the table (pads beyond the prompt land in
+            # the row's own bound blocks and are overwritten by decode
+            # before any mask attends them — same argument as dense).
+            ppos = jnp.minimum(pos + jnp.arange(s), L - 1)
+            bi = jnp.clip(ppos // bs_kv, 0, m - 1)
+            blk = tab[:, bi]                                   # [b, s]
+            off = (ppos % bs_kv)[None, :]                      # [1, s]
+            k_pool = kp.at[blk, :, off, :].set(
+                k.transpose(0, 2, 1, 3).astype(kp.dtype))
+            v_pool = vp.at[blk, :, off, :].set(
+                v.transpose(0, 2, 1, 3).astype(vp.dtype))
+        use_decode_kernel = (not prefill and s == 1 and per_row
+                             and _decode_flash_ok(cfg))
+        if use_decode_kernel:
+            # The kernel takes the POOLS + table directly (block-table
+            # gather operand): rows only DMA table entries below their
+            # own length, inactive rows skip every block.
+            from nezha_tpu.ops.pallas import flash_decode_attention
+            lengths = pos + 1
+            if active is not None:
+                lengths = jnp.where(active, lengths, 0)
+            out = flash_decode_attention(q, k_pool, v_pool, lengths,
+                                         block_tables=tab)
+        else:
+            # Composed path: gather the rows' blocks into the dense
+            # [b, H, L, D] view and run the same masked attention the
+            # dense layout uses (unbound table entries gather scratch —
+            # always masked, since they sit at/past the row's length).
+            # Prefill cost note: the serve engine's chunks always reach
+            # here (a traced pos can never take the static-pos-0 flash
+            # branch — true for the DENSE engine too), and dense chunk
+            # attention is already masked-dense over the full L_max
+            # rows, so paged adds only the gather copy itself, not a
+            # new O(L) attention term. A diagonal-offset flash prefill
+            # kernel (the engine docstring's "obvious next kernel")
+            # would lift both layouts at once.
+            k_all = k_pool[tab].transpose(0, 2, 1, 3, 4).reshape(
+                b, cfg.num_heads, L, d)
+            v_all = v_pool[tab].transpose(0, 2, 1, 3, 4).reshape(
+                b, cfg.num_heads, L, d)
+            if per_row:
+                abs_q = pos[:, None] + jnp.arange(s)[None, :]
+                attendable = (jnp.arange(L)[None, None, :]
+                              <= abs_q[:, :, None])[:, None, :, :]
+            else:
+                abs_q = pos + jnp.arange(s)[:, None]
+                attendable = jnp.arange(L)[None, :] <= abs_q
+            mask = jnp.where(attendable, 0.0, -jnp.inf).astype(jnp.float32)
+            out = ops.dot_product_attention(q, k_all.astype(q.dtype),
+                                            v_all.astype(q.dtype),
+                                            mask=mask)
+        states["cache"] = {"k": k_pool, "v": v_pool, "tables": tab}
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, h)
+        out = run_child(self.proj, "proj", variables, states, out,
+                        training=training)
         return out, states
 
 

@@ -123,6 +123,19 @@ def render_serving_section(summary: Optional[dict]) -> List[str]:
             f"  host gap: p50 {hg['p50'] * 1e3:.2f} ms  "
             f"p90 {hg['p90'] * 1e3:.2f} ms  "
             f"p99 {hg['p99'] * 1e3:.2f} ms  (n={hg['count']}){hz}")
+    if "serve.kv.prefix_hits_total" in counters:
+        # Paged-KV view (absent only in pre-paged captures): blocks
+        # resident at run end, prefix-cache hits (requests that took
+        # block references instead of re-prefilling), and
+        # copy-on-write block copies.
+        lines.append(
+            "  kv: "
+            f"{gauges.get('serve.kv.blocks_used', 0):.0f} blocks "
+            f"resident  "
+            f"{counters.get('serve.kv.prefix_hits_total', 0):.0f} "
+            f"prefix hits  "
+            f"{counters.get('serve.kv.cow_copies_total', 0):.0f} "
+            f"cow copies")
     ph = hists.get("serve.prefill.bucket_len")
     if ph and ph.get("count"):
         # Bucket occupancy: how wide the static prefill programs

@@ -92,10 +92,68 @@ def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, m_scr, l_scr,
         o_ref[0, 0] = (acc_scr[:] / denom).astype(o_ref.dtype)
 
 
+def _paged_decode_kernel(tab_ref, q_ref, k_ref, v_ref, len_ref, o_ref,
+                         m_scr, l_scr, acc_scr, *, scale: float,
+                         block_k: int):
+    # Identical math to the dense kernel: the block table only changed
+    # WHERE block ki lives (the BlockSpec index map gathered it), not
+    # what it means — per-row lengths still skip blocks at/past the
+    # row's depth, so work tracks sum(lengths) over the block
+    # indirection exactly as it did over the dense pool.
+    _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, m_scr, l_scr,
+                   acc_scr, scale=scale, block_k=block_k)
+
+
+def _paged_call(q, k, v, lengths, block_tables, scale, interpret):
+    """Paged layout: k/v are BLOCK POOLS ``[N, H, bs, D]`` and
+    ``block_tables [B, M]`` maps row b's KV block ki to pool block
+    ``block_tables[b, ki]``. The table rides as a SCALAR-PREFETCH
+    operand (pltpu.PrefetchScalarGridSpec) so the grid's KV dimension
+    gathers blocks through the table in its index map — the kernel body
+    is unchanged, per-row length skipping included."""
+    b, h, _, d = q.shape
+    n_blocks, _, bs, _ = k.shape
+    m = block_tables.shape[1]
+    kernel = functools.partial(_paged_decode_kernel, scale=scale,
+                               block_k=bs)
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = _compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    len2d = jnp.broadcast_to(
+        jnp.clip(jnp.asarray(lengths, jnp.int32), 0, m * bs)[:, None],
+        (b, _LANES))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, h, m),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, d), lambda b_, h_, ki, tab: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, bs, d),
+                         lambda b_, h_, ki, tab: (tab[b_, ki], h_, 0, 0)),
+            pl.BlockSpec((1, 1, bs, d),
+                         lambda b_, h_, ki, tab: (tab[b_, ki], h_, 0, 0)),
+            pl.BlockSpec((1, _LANES), lambda b_, h_, ki, tab: (b_, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, 1, d),
+                               lambda b_, h_, ki, tab: (b_, h_, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((1, _LANES), jnp.float32),
+                        pltpu.VMEM((1, _LANES), jnp.float32),
+                        pltpu.VMEM((1, d), jnp.float32)],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+        **kwargs,
+    )(jnp.asarray(block_tables, jnp.int32), q, k, v, len2d)
+
+
 def flash_decode_attention(q, k, v, lengths,
                            scale: Optional[float] = None,
                            block_k: Optional[int] = None,
-                           interpret: Optional[bool] = None):
+                           interpret: Optional[bool] = None,
+                           block_tables=None):
     """q ``[B, H, 1, D]``, k/v ``[B, H, L, D]``, lengths ``[B]`` int32
     -> ``[B, H, 1, D]``.
 
@@ -105,6 +163,16 @@ def flash_decode_attention(q, k, v, lengths,
     is skipped and the output row is exactly zero (callers discard it —
     the serve engine freezes inactive rows host-side). Lengths are
     clamped to ``[0, L]``.
+
+    With ``block_tables`` (``[B, M]`` int32 — the paged serving
+    layout), k/v are instead BLOCK POOLS shaped
+    ``[num_blocks, H, block_size, D]``: row ``b``'s positions
+    ``[ki*block_size, (ki+1)*block_size)`` live in pool block
+    ``block_tables[b, ki]``, and the kernel gathers KV blocks through
+    the table via a scalar-prefetch index map. The per-row length skip
+    is preserved verbatim — a row only DMAs the table entries below its
+    own depth. ``block_k`` is ignored (the pool's block_size IS the KV
+    block).
 
     ``block_k`` defaults to the largest divisor of ``L`` that is <= 256
     (KV pools are padded to power-of-two-ish capacities, so real shapes
@@ -116,13 +184,25 @@ def flash_decode_attention(q, k, v, lengths,
         raise ValueError(
             f"flash_decode_attention is the single-token kernel; got "
             f"s_q={s_q} (use flash_attention for prefill/training)")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if block_tables is not None:
+        if k.shape != v.shape or k.shape[1] != h or k.shape[3] != d:
+            raise ValueError(
+                f"paged k/v pools {k.shape}/{v.shape} do not match q "
+                f"{q.shape}")
+        if block_tables.shape[0] != b:
+            raise ValueError(
+                f"block_tables {block_tables.shape} does not match "
+                f"batch {b}")
+        scale = scale if scale is not None else 1.0 / (d ** 0.5)
+        return _paged_call(q, k, v, lengths, block_tables, scale,
+                           interpret)
     if k.shape != v.shape or k.shape[:2] != (b, h) or k.shape[3] != d:
         raise ValueError(f"k/v {k.shape}/{v.shape} do not match q {q.shape}")
     L = k.shape[2]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     bk = _pick_block(L, block_k or min(L, 256))
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
 
     kernel = functools.partial(_decode_kernel, scale=scale, block_k=bk)
     kwargs = {}

@@ -5,9 +5,17 @@ One-shot ``generate()`` decodes a whole batch in lockstep: every request
 shares sampling params, and nothing can join or leave mid-flight. The
 serve stack replaces the batch lifecycle with a slot lifecycle:
 
-- ``slots``: a fixed-capacity KV slot pool — pooled per-layer caches
-  ``[B_max, H, L_max, D]`` with per-slot positions, host-side alloc/free,
-  prefill writes into a slot's rows via ``dynamic_update_slice``.
+- ``slots``: the KV pools. Default is the BLOCK-PAGED layout
+  (``PagedSlotPool``): per-layer ``[num_blocks, H, block_size, D]``
+  buffers, a host-side free list of ref-counted blocks, and per-slot
+  block tables threaded into the compiled programs — admission binds
+  only what the prompt needs, decode binds lazily as positions
+  advance, and a prefix-reuse trie lets a request whose prompt prefix
+  matches cached blocks take REFERENCES instead of re-prefilling
+  (copy-on-write protects shared blocks; exhaustion is typed
+  backpressure, never a crash). ``SlotPool`` is the classic dense
+  ``[B_max, H, L_max, D]`` worst-case-reservation layout
+  (``ServeConfig.kv_layout="dense"``).
 - ``sampling``: per-row temperature / top-k / top-p as traced arrays, so
   one compiled program serves every mix of requests (top-k masks by
   per-row k under a static ``k_max`` cap — ``lax.top_k``'s k is static).
@@ -73,7 +81,8 @@ from nezha_tpu.serve.scheduler import (
     RequestResult,
     Scheduler,
 )
-from nezha_tpu.serve.slots import SlotPool
+from nezha_tpu.serve.slots import (KVBlocksExhausted, PagedSlotPool,
+                                   PrefixTrie, SlotPool)
 from nezha_tpu.serve.supervisor import (
     ProcessBackend,
     RouterConfig,
@@ -82,7 +91,8 @@ from nezha_tpu.serve.supervisor import (
 )
 
 __all__ = [
-    "Engine", "ServeConfig", "SlotPool", "sample_tokens",
+    "Engine", "ServeConfig", "SlotPool", "PagedSlotPool", "PrefixTrie",
+    "KVBlocksExhausted", "sample_tokens",
     "Scheduler", "Request", "RequestResult", "QueueFull", "FinishReason",
     "Router", "RouterConfig", "Supervisor", "ProcessBackend",
     "ThreadBackend", "register_router_instruments",

@@ -51,6 +51,17 @@ latency flat:
   (default) runs the scan body once inline — bit-identical to the
   classic one-token step.
 
+Both KV layouts run through the SAME program set: on the default
+block-paged pool (``ServeConfig.kv_layout="paged"``) every program takes
+one extra static-shaped operand — the per-slot block tables, uploaded
+from the pool's host mirror each dispatch — and the model's cache path
+scatters K/V through the table into shared block pools instead of
+slicing slot rows (prefix-hit requests prefill only their un-cached
+suffix, through the same bucket programs at a nonzero start offset;
+lazy block binding and copy-on-write happen host-side BEFORE each
+dispatch, so in-program writes always land in exclusively-owned
+blocks, with non-emitting rows routed to the reserved scratch block).
+
 All programs route through the runtime ``Executor`` (compile-cache keyed
 on function identity + full arg shape signature), so the program-count
 claim is enforced by the ``compile_cache.*`` obs counters: a shape drift
@@ -83,7 +94,8 @@ from nezha_tpu import faults, obs
 from nezha_tpu.models.generate import _caches_from_states
 from nezha_tpu.runtime.executor import Executor
 from nezha_tpu.serve.sampling import finite_rows, split_and_sample
-from nezha_tpu.serve.slots import SlotPool, read_slot, write_slot
+from nezha_tpu.serve.slots import (KVBlocksExhausted, PagedSlotPool,
+                                   SlotPool, read_slot, write_slot)
 
 
 def default_prefill_buckets(max_prefill_len: int) -> Tuple[int, ...]:
@@ -138,10 +150,43 @@ class ServeConfig:
     cache_dtype: Any = jnp.bfloat16
     decode_impl: Optional[str] = None
     decode_horizon: int = 1
+    # KV layout: "paged" (default) is the block-paged pool — per-layer
+    # [kv_num_blocks, H, kv_block_size, D] buffers, ref-counted blocks
+    # bound lazily as positions advance, per-slot block tables threaded
+    # into the compiled programs, and (with prefix_cache) shared-prefix
+    # prefill reuse. "dense" is the classic [B_max, H, max_len, D]
+    # worst-case-reservation pool. kv_num_blocks None = dense-equivalent
+    # capacity (1 scratch + max_batch_size * ceil(max_len/block_size)),
+    # so the default paged pool can serve everything dense could;
+    # smaller values make block budget (tokens actually resident) the
+    # admission limit instead of slot count. kv_eviction governs what
+    # happens when the free list runs dry: "lru" evicts prefix-cache
+    # blocks held only by the trie, "none" goes straight to typed
+    # backpressure (KVBlocksExhausted).
+    kv_layout: str = "paged"
+    kv_block_size: int = 16
+    kv_num_blocks: Optional[int] = None
+    prefix_cache: bool = True
+    kv_eviction: str = "lru"
 
     def __post_init__(self):
         if self.max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
+        if self.kv_layout not in ("paged", "dense"):
+            raise ValueError(
+                f"kv_layout must be 'paged' or 'dense', got "
+                f"{self.kv_layout!r}")
+        if self.kv_block_size < 1:
+            raise ValueError(
+                f"kv_block_size must be >= 1, got {self.kv_block_size}")
+        if self.kv_num_blocks is not None and self.kv_num_blocks < 2:
+            raise ValueError(
+                f"kv_num_blocks must be >= 2 (block 0 is scratch), got "
+                f"{self.kv_num_blocks}")
+        if self.kv_eviction not in ("lru", "none"):
+            raise ValueError(
+                f"kv_eviction must be 'lru' or 'none', got "
+                f"{self.kv_eviction!r}")
         if self.decode_horizon < 1:
             raise ValueError(
                 f"decode_horizon must be >= 1, got {self.decode_horizon}")
@@ -212,8 +257,26 @@ class Engine:
         self.cfg = cfg
         self.vocab = model.cfg.vocab_size
         self.k_max = min(cfg.k_max, self.vocab)
-        self.pool = SlotPool(model, cfg.max_batch_size, cfg.max_len,
-                             cfg.cache_dtype)
+        self.paged = cfg.kv_layout == "paged"
+        if self.paged:
+            self.pool = PagedSlotPool(
+                model, cfg.max_batch_size, cfg.max_len, cfg.cache_dtype,
+                block_size=cfg.kv_block_size,
+                num_blocks=cfg.kv_num_blocks,
+                prefix_cache=cfg.prefix_cache, eviction=cfg.kv_eviction)
+            # Host mirrors of each row's next write position and
+            # remaining token budget (set at prefill, advanced/decayed
+            # by the block's emitted count): the lazy block binder must
+            # size the write window BEFORE a dispatch without a device
+            # sync, and must not bind blocks a nearly-finished row can
+            # never write.
+            self.host_positions = np.zeros((cfg.max_batch_size,),
+                                           np.int64)
+            self.host_budgets = np.zeros((cfg.max_batch_size,),
+                                         np.int64)
+        else:
+            self.pool = SlotPool(model, cfg.max_batch_size, cfg.max_len,
+                                 cfg.cache_dtype)
         b = cfg.max_batch_size
         self.last_logits = jnp.zeros((b, self.vocab), jnp.float32)
         # [B] bool from the latest step: False where that row's logits
@@ -246,10 +309,15 @@ class Engine:
         # One prefill program per bucket width (compiled lazily: the
         # executor keys on the function object, so each closure is its
         # own cache entry the first time a prompt lands in its bucket).
-        self._prefill_fns = {w: _build_prefill(self.model, w)
+        # The paged variants take the block tables as one extra operand
+        # — shapes are static, so the "1 step + len(prefill_buckets)
+        # programs" contract is layout-invariant.
+        self._prefill_fns = {w: _build_prefill(self.model, w,
+                                               paged=self.paged)
                              for w in cfg.prefill_buckets}
         self._step_fn = _build_step(self.model, self.k_max, cfg.pad_id,
-                                    cfg.decode_horizon)
+                                    cfg.decode_horizon,
+                                    paged=self.paged)
 
     # -------------------------------------------------------- host API
     def bucket_for(self, n: int) -> int:
@@ -261,37 +329,19 @@ class Engine:
         rem = n if n <= p_max else (n % p_max or p_max)
         return next(w for w in self.cfg.prefill_buckets if w >= rem)
 
-    def prefill(self, slot: int, tokens: Sequence[int], *, seed: int = 0,
-                temperature: float = 0.0, top_k: Optional[int] = None,
-                top_p: Optional[float] = None,
-                eos_id: Optional[int] = None,
-                max_new_tokens: Optional[int] = None) -> None:
-        """Load one request into ``slot``: prompt K/V, position, PRNG
-        key, sampling params, and the row's on-device completion state
-        (``eos_id``, ``None`` = never stop on a token; and its
-        new-token budget, ``None`` = everything the slot's KV capacity
-        allows). ``tokens`` may be up to ``max_len - 1`` long (room for
-        at least one generated token); prompts wider than
-        ``max_prefill_len`` run as successive chunks through the same
-        bucket programs. Token ids are NOT validated here — admission
-        (``Scheduler.submit``) is the validation boundary. The first
-        generated token comes from the next :meth:`step`."""
-        faults.point("serve.prefill")
-        n = len(tokens)
-        if not 1 <= n < self.cfg.max_len:
-            raise ValueError(
-                f"prompt length {n} not in [1, max_len-1="
-                f"{self.cfg.max_len - 1}]")
-        # The device budget is what stops a row mid-block; capping it at
-        # the slot's remaining KV capacity means a block can never write
-        # past max_len even for budget-less direct engine callers.
-        cap = self.cfg.max_len - n
-        budget = cap if max_new_tokens is None else min(max_new_tokens,
-                                                        cap)
+    def _plan_chunks(self, n: int,
+                     start: int = 0) -> List[Tuple[int, int, int]]:
+        """Chunk plan for prefilling positions ``[start, n)`` of an
+        ``n``-token prompt: ``(offset, real_len, pad_width)`` triples —
+        full ``max_prefill_len`` strides then a bucketed tail. With a
+        shared-prefix ``start`` only the un-cached suffix is planned
+        (partial-prefix prefill reuses the same bucket machinery). A
+        padded tail that would spill past ``max_len`` slides back over
+        real tokens (rewriting positions recomputes identical K/V; the
+        paged pool COWs any shared block the slide re-enters)."""
         p_max = self.cfg.max_prefill_len
-        tokens = np.asarray(tokens, np.int32)
-        chunks: List[Tuple[int, int, int]] = []      # (offset, len, width)
-        off = 0
+        chunks: List[Tuple[int, int, int]] = []
+        off = start
         while n - off > p_max:
             chunks.append((off, p_max, p_max))
             off += p_max
@@ -305,30 +355,124 @@ class Engine:
             # window back to cover the last `width` REAL tokens instead:
             # rewriting those positions recomputes identical K/V (same
             # tokens, same prefix), and no pad lands past capacity.
-            # (Only reachable when chunked, where n > max_prefill_len
-            # >= width, so off stays >= 0.)
-            off, rem = n - width, width
+            # (off can dip below `start` here — with a shared prefix
+            # the paged pool COWs the re-entered blocks, keeping the
+            # cached copies intact.)
+            off, rem = max(n - width, 0), min(width, n)
         chunks.append((off, rem, width))
+        return chunks
+
+    def prefill_span(self, n: int) -> int:
+        """The highest position (exclusive) a cold prefill of an
+        ``n``-token prompt writes, bucket pads included — what the
+        scheduler's free-block admission budget is sized against."""
+        off, _, width = self._plan_chunks(n)[-1]
+        return max(off + width, n)
+
+    def prefill_blocks_needed(self, n: int) -> int:
+        """Worst-case (no prefix hit) block count an ``n``-token prompt
+        binds at prefill. Paged layout only."""
+        return self.pool.blocks_for_span(self.prefill_span(n))
+
+    def prefill(self, slot: int, tokens: Sequence[int], *, seed: int = 0,
+                temperature: float = 0.0, top_k: Optional[int] = None,
+                top_p: Optional[float] = None,
+                eos_id: Optional[int] = None,
+                max_new_tokens: Optional[int] = None) -> None:
+        """Load one request into ``slot``: prompt K/V, position, PRNG
+        key, sampling params, and the row's on-device completion state
+        (``eos_id``, ``None`` = never stop on a token; and its
+        new-token budget, ``None`` = everything the slot's KV capacity
+        allows). ``tokens`` may be up to ``max_len - 1`` long (room for
+        at least one generated token); prompts wider than
+        ``max_prefill_len`` run as successive chunks through the same
+        bucket programs. On the paged layout the prompt's full-block
+        prefix is first matched against the prefix cache — matched
+        blocks are REFERENCED, not recomputed, and only the suffix
+        prefills (``KVBlocksExhausted`` from binding is typed
+        backpressure the scheduler absorbs). Token ids are NOT
+        validated here — admission (``Scheduler.submit``) is the
+        validation boundary. The first generated token comes from the
+        next :meth:`step`."""
+        faults.point("serve.prefill")
+        n = len(tokens)
+        if not 1 <= n < self.cfg.max_len:
+            raise ValueError(
+                f"prompt length {n} not in [1, max_len-1="
+                f"{self.cfg.max_len - 1}]")
+        # The device budget is what stops a row mid-block; capping it at
+        # the slot's remaining KV capacity means a block can never write
+        # past max_len even for budget-less direct engine callers.
+        cap = self.cfg.max_len - n
+        budget = cap if max_new_tokens is None else min(max_new_tokens,
+                                                        cap)
+        tokens = np.asarray(tokens, np.int32)
+        start = 0
+        if self.paged:
+            # Prefix reuse: take references on cached blocks covering
+            # the prompt's full-block prefix (capped at n-1 — the last
+            # token always re-runs so its logits seed decoding), then
+            # bind/COW everything the planned chunks will write.
+            start = self.pool.bind_for_prompt(slot, tokens.tolist())
+        chunks = self._plan_chunks(n, start)
+        if self.paged:
+            try:
+                self.pool.prepare_write(
+                    slot, min(off for off, _, _ in chunks),
+                    max(off + width for off, _, width in chunks))
+            except KVBlocksExhausted:
+                if start == 0:
+                    raise
+                # Tight-pool edge: the hit's own references pinned the
+                # evictable blocks its copy-on-write then needed. Fall
+                # back to a COLD prefill — releasing our references
+                # makes those blocks reclaimable again, and admission
+                # sized its budget for exactly this no-hit footprint.
+                self.pool.release_blocks(slot)
+                start = 0
+                chunks = self._plan_chunks(n, 0)
+                self.pool.prepare_write(
+                    slot, 0,
+                    max(off + width for off, _, width in chunks))
+            if start > 0:
+                # Count the hit only once its binding MATERIALIZED —
+                # the cold fallback above must not inflate cache wins.
+                self.pool.count_prefix_hit()
+            self.host_positions[slot] = n
+            self.host_budgets[slot] = budget
         obs.counter("serve.prefill.chunks_total").inc(len(chunks))
         for off, ln, width in chunks:
             obs.histogram("serve.prefill.bucket_len").observe(width)
             padded = np.zeros((1, width), np.int32)
             padded[0, :ln] = tokens[off:off + ln]
-            out = self.executor.run(
-                self._prefill_fns[width], self.variables, self.pool.caches,
-                jnp.asarray(padded),
-                np.int32(ln), np.int32(slot), np.int32(off),
-                np.int32(seed), np.float32(temperature),
-                np.int32(0 if top_k is None else top_k),
-                np.float32(1.0 if top_p is None else top_p),
-                np.int32(-1 if eos_id is None else eos_id),
-                np.int32(budget),
-                self.last_logits, self.positions, self.keys,
-                self.temps, self.top_ks, self.top_ps,
-                self.eos_ids, self.budgets)
+            scalars = (np.int32(ln), np.int32(slot), np.int32(off),
+                       np.int32(seed), np.float32(temperature),
+                       np.int32(0 if top_k is None else top_k),
+                       np.float32(1.0 if top_p is None else top_p),
+                       np.int32(-1 if eos_id is None else eos_id),
+                       np.int32(budget))
+            state = (self.last_logits, self.positions, self.keys,
+                     self.temps, self.top_ks, self.top_ps,
+                     self.eos_ids, self.budgets)
+            if self.paged:
+                out = self.executor.run(
+                    self._prefill_fns[width], self.variables,
+                    self.pool.caches,
+                    jnp.asarray(self.pool.tables_host),
+                    jnp.asarray(padded), *scalars, *state)
+            else:
+                out = self.executor.run(
+                    self._prefill_fns[width], self.variables,
+                    self.pool.caches, jnp.asarray(padded),
+                    *scalars, *state)
             (self.pool.caches, self.last_logits, self.positions, self.keys,
              self.temps, self.top_ks, self.top_ps,
              self.eos_ids, self.budgets) = out
+        if self.paged:
+            # Index this prompt's full blocks for future prefix hits
+            # (the trie takes its own references — the cache outlives
+            # this request's slot).
+            self.pool.register_prefix(slot, tokens.tolist())
         if faults.enabled():
             self.last_logits = faults.corrupt(
                 "serve.prefill.logits", self.last_logits, rows=(slot,))
@@ -347,12 +491,50 @@ class Engine:
         pre-burst tokens are still counted in ``emitted``."""
         faults.point("serve.step")
         self.step_calls += 1
-        out = self.executor.run(
-            self._step_fn, self.variables, self.pool.caches,
-            self.last_logits, self.positions,
-            jnp.asarray(active, bool), self.keys,
-            self.temps, self.top_ks, self.top_ps,
-            self.eos_ids, self.budgets)
+        if self.paged:
+            # Lazy binding: make every active row's write window for
+            # this block ([pos, pos+H), clamped to capacity — done rows'
+            # frozen pad write included) exclusively owned BEFORE the
+            # dispatch. A bind that finds no block (genuine exhaustion
+            # or an injected serve.kv.bind fault) surfaces as the typed
+            # KVBlocksExhausted carrying the victim slot — the
+            # scheduler retires that one request and redials; the batch
+            # never crashes.
+            h = self.cfg.decode_horizon
+            for slot in np.flatnonzero(np.asarray(active, bool)):
+                pos_h = int(self.host_positions[slot])
+                # The row writes real K/V only while it still emits:
+                # min(horizon, remaining budget) positions. Once done
+                # (or for a degenerate budget-0 row) its non-emitting
+                # scan steps route pad writes to the scratch block, so
+                # nothing past the budget needs binding — a row one
+                # token from finishing must never be retired for
+                # blocks it would never write.
+                need = min(h, max(int(self.host_budgets[slot]), 0))
+                if need == 0:
+                    continue
+                start = min(pos_h, self.cfg.max_len - 1)
+                end = max(min(pos_h + need, self.cfg.max_len),
+                          start + 1)
+                try:
+                    self.pool.prepare_write(int(slot), start, end)
+                except faults.InjectedFault as e:
+                    raise KVBlocksExhausted(str(e), slot=int(slot)) \
+                        from e
+            out = self.executor.run(
+                self._step_fn, self.variables, self.pool.caches,
+                jnp.asarray(self.pool.tables_host),
+                self.last_logits, self.positions,
+                jnp.asarray(active, bool), self.keys,
+                self.temps, self.top_ks, self.top_ps,
+                self.eos_ids, self.budgets)
+        else:
+            out = self.executor.run(
+                self._step_fn, self.variables, self.pool.caches,
+                self.last_logits, self.positions,
+                jnp.asarray(active, bool), self.keys,
+                self.temps, self.top_ks, self.top_ps,
+                self.eos_ids, self.budgets)
         tok, emitted, ok, caches, last, pos, keys, budgets = out
         # Start the block's device->host transfers NOW, before any host
         # bookkeeping (state rebinds here, retire/admit/stream in the
@@ -370,7 +552,16 @@ class Engine:
         self.last_logits, self.positions, self.keys = last, pos, keys
         self.budgets = budgets
         self.step_ok = np.asarray(ok)
-        return np.asarray(tok), np.asarray(emitted)
+        tok_h, emitted_h = np.asarray(tok), np.asarray(emitted)
+        if self.paged:
+            # Advance the host position/budget mirrors by the block's
+            # emitted counts (positions advance and budgets decay on
+            # device exactly once per emitted token; a NaN-frozen row
+            # may lag by one — it is retired this iteration, so its
+            # window is never grown).
+            self.host_positions += emitted_h.astype(np.int64)
+            self.host_budgets -= emitted_h.astype(np.int64)
+        return tok_h, emitted_h
 
     def compile_stats(self) -> dict:
         """Executor cache stats — steady state is ``entries ==
@@ -380,27 +571,45 @@ class Engine:
         return self.executor.stats()
 
 
-def _build_prefill(model, width: int):
-    def prefill(variables, caches, tokens, length, slot, pos, seed,
-                temperature, top_k, top_p, eos_id, budget,
-                last_logits, positions, keys, temps, top_ks, top_ps,
-                eos_ids, budgets):
+def _build_prefill(model, width: int, paged: bool = False):
+    def core(variables, caches, tables, tokens, length, slot, pos,
+             seed, temperature, top_k, top_p, eos_id, budget,
+             last_logits, positions, keys, temps, top_ks, top_ps,
+             eos_ids, budgets):
         # One prompt chunk, padded to this bucket's static `width`, runs
-        # against the SLOT'S OWN cache rows at a traced offset: the
+        # against the slot's own cache storage at a traced offset: the
         # masked attention path sees the prefix earlier chunks wrote
         # (pos > 0) or nothing (pos == 0), so the same program serves
         # first chunks, middle chunks, and bucketed tails. Rows past
         # `length` are pad — their K/V lands above the prompt and is
-        # overwritten by decode before any mask attends it.
-        rows = [{"k": read_slot(pool["k"], slot),
-                 "v": read_slot(pool["v"], slot)} for pool in caches]
+        # overwritten by decode before any mask attends it. Dense: the
+        # slot's pooled rows are sliced out (read_slot) and written
+        # back (write_slot). Paged: the chunk runs against the slot's
+        # TABLE ROW (one [1, M] slice of the uploaded tables) — the
+        # model scatters K/V through it into the shared block pools and
+        # attends the gathered prefix, so a shared-prefix request
+        # starting at a nonzero `pos` sees the cached blocks it
+        # referenced instead of recomputing them.
+        if paged:
+            zero = jnp.zeros((), jnp.int32)
+            tab_row = lax.dynamic_slice(
+                tables, (slot, zero), (1, tables.shape[1]))
+            rows = [{"k": pool["k"], "v": pool["v"], "tables": tab_row}
+                    for pool in caches]
+        else:
+            rows = [{"k": read_slot(pool["k"], slot),
+                     "v": read_slot(pool["v"], slot)}
+                    for pool in caches]
         logits, states = model.apply(variables, tokens, training=False,
                                      cache=rows, pos=pos)
         new_rows = _caches_from_states(model, states, rows)
-        new_caches = [
-            {"k": write_slot(pool["k"], rk["k"], slot),
-             "v": write_slot(pool["v"], rk["v"], slot)}
-            for pool, rk in zip(caches, new_rows)]
+        if paged:
+            new_caches = [{"k": r["k"], "v": r["v"]} for r in new_rows]
+        else:
+            new_caches = [
+                {"k": write_slot(pool["k"], rk["k"], slot),
+                 "v": write_slot(pool["v"], rk["v"], slot)}
+                for pool, rk in zip(caches, new_rows)]
         row = lax.dynamic_slice(
             logits, (0, length - 1, jnp.zeros((), jnp.int32)),
             (1, 1, logits.shape[-1]))[:, 0, :]          # [1, V] last REAL row
@@ -425,12 +634,22 @@ def _build_prefill(model, width: int):
                 set_row(eos_ids, eos_id),
                 set_row(budgets, budget))
 
+    # One source for both layouts; only the operand list differs (the
+    # paged variant takes the uploaded block tables after the caches).
+    if paged:
+        def prefill(variables, caches, tables, tokens, *rest):
+            return core(variables, caches, tables, tokens, *rest)
+    else:
+        def prefill(variables, caches, tokens, *rest):
+            return core(variables, caches, None, tokens, *rest)
+
     return prefill
 
 
-def _build_step(model, k_max: int, pad_id: int, horizon: int):
+def _build_step(model, k_max: int, pad_id: int, horizon: int,
+                paged: bool = False):
     def body(active, temps, top_ks, top_ps, eos_ids, budgets,
-             variables, carry):
+             variables, tables, carry):
         """One fused decode step: the single-token body the horizon scan
         iterates. Everything request-terminating happens on device:
 
@@ -451,6 +670,12 @@ def _build_step(model, k_max: int, pad_id: int, horizon: int):
           fallback ignores it; garbage rows are masked below either
           way). Keys advance only on emit — a request's RNG stream is a
           function of (seed, emitted count), horizon-invariant.
+
+        ONE source for both KV layouts: with ``paged`` the per-slot
+        block tables thread into each layer's cache dict — the model
+        scatters emitted tokens' K/V through them (non-emitting rows
+        write the scratch block) and the flash-decode kernel gathers KV
+        blocks via the table with the per-row length skip intact.
         """
         caches, last_logits, positions, keys, done, ok, emitted = carry
         ok = ok & finite_rows(last_logits)
@@ -462,10 +687,19 @@ def _build_step(model, k_max: int, pad_id: int, horizon: int):
         next_keys, tok = split_and_sample(keys, last_logits, temps,
                                           top_ks, top_ps, k_max)
         tok = jnp.where(emit, tok, pad_id)
+        if paged:
+            rows = [{"k": c["k"], "v": c["v"], "tables": tables}
+                    for c in caches]
+        else:
+            rows = caches
         logits, states = model.apply(variables, tok[:, None],
-                                     training=False, cache=caches,
+                                     training=False, cache=rows,
                                      pos=positions, active=emit)
-        new_caches = _caches_from_states(model, states, caches)
+        new_rows = _caches_from_states(model, states, rows)
+        if paged:
+            new_caches = [{"k": r["k"], "v": r["v"]} for r in new_rows]
+        else:
+            new_caches = new_rows
         row_logits = logits[:, -1, :]
         ok = jnp.where(emit, ok & finite_rows(row_logits), ok)
         counted = emit & ok
@@ -479,8 +713,8 @@ def _build_step(model, k_max: int, pad_id: int, horizon: int):
                 jnp.where(act, next_keys, keys),
                 done, ok, emitted), tok
 
-    def step(variables, caches, last_logits, positions, active, keys,
-             temps, top_ks, top_ps, eos_ids, budgets):
+    def core(variables, caches, tables, last_logits, positions, active,
+             keys, temps, top_ks, top_ps, eos_ids, budgets):
         b = positions.shape[0]
         init = (caches, last_logits, positions, keys,
                 jnp.zeros((b,), bool),        # done (within this block)
@@ -489,7 +723,7 @@ def _build_step(model, k_max: int, pad_id: int, horizon: int):
 
         def scan_body(carry, _):
             return body(active, temps, top_ks, top_ps, eos_ids, budgets,
-                        variables, carry)
+                        variables, tables, carry)
 
         if horizon == 1:
             # Inline, not a length-1 scan: the default must stay
@@ -502,5 +736,12 @@ def _build_step(model, k_max: int, pad_id: int, horizon: int):
         caches, last_logits, positions, keys, done, ok, emitted = carry
         return (tok_block, emitted, ok, caches, last_logits, positions,
                 keys, jnp.maximum(budgets - emitted, 0))
+
+    if paged:
+        def step(variables, caches, tables, *rest):
+            return core(variables, caches, tables, *rest)
+    else:
+        def step(variables, caches, *rest):
+            return core(variables, caches, None, *rest)
 
     return step

@@ -57,6 +57,7 @@ import numpy as np
 
 from nezha_tpu import obs
 from nezha_tpu.serve.engine import Engine
+from nezha_tpu.serve.slots import KVBlocksExhausted
 
 
 class QueueFull(Exception):
@@ -128,6 +129,13 @@ def register_serve_instruments() -> None:
     # (0 when no plan is active) so chaos runs and clean runs share one
     # schema — dashboards can divide errors by injections.
     obs.counter("faults.injected_total")
+    # Paged-KV instruments (schema-pinned for every serving run so the
+    # summary shape is layout-invariant — a dense run reports 0s):
+    # blocks resident, requests that took cached prefix references
+    # instead of re-prefilling, and copy-on-write block copies.
+    obs.counter("serve.kv.prefix_hits_total")
+    obs.counter("serve.kv.cow_copies_total")
+    obs.gauge("serve.kv.blocks_used")
     obs.gauge("serve.queue_depth")
     obs.gauge("serve.batch_occupancy")
     obs.histogram("serve.ttft_s")
@@ -193,6 +201,21 @@ class Scheduler:
             raise ValueError(
                 f"prompt ({n}) + max_new_tokens ({req.max_new_tokens}) "
                 f"exceeds max_len {cfg.max_len}")
+        if self.engine.paged:
+            # A request whose prefill span (or full resident footprint)
+            # needs more blocks than the pool could EVER free can never
+            # be served — bounce it here, before it wedges the queue
+            # head forever waiting for blocks that cannot exist.
+            pool = self.engine.pool
+            need = max(self.engine.prefill_blocks_needed(n),
+                       pool.blocks_for_span(n + req.max_new_tokens))
+            if need > pool.max_request_blocks:
+                raise ValueError(
+                    f"request needs {need} KV blocks "
+                    f"(block_size {pool.block_size}) but the pool can "
+                    f"bind at most {pool.max_request_blocks} per "
+                    f"request — raise kv_num_blocks or lower the "
+                    f"request's footprint")
         vocab = self.engine.vocab
         if not all(0 <= t < vocab for t in req.prompt):
             # Admission IS the validation boundary (the engine trusts its
@@ -231,6 +254,8 @@ class Scheduler:
             obs.gauge("serve.queue_depth").set(len(self._queue))
             obs.gauge("serve.batch_occupancy").set(
                 self.engine.pool.occupancy)
+            obs.gauge("serve.kv.blocks_used").set(
+                self.engine.pool.blocks_used)
             return emitted
 
     def run_until_idle(self, max_iters: Optional[int] = None) -> int:
@@ -273,6 +298,34 @@ class Scheduler:
     def _admit(self) -> None:
         pool = self.engine.pool
         while self._queue and pool.num_free:
+            if self.engine.paged:
+                # Admission budget is FREE BLOCKS, not free slots: only
+                # admit the queue head if its worst-case (no prefix
+                # hit) prefill binding fits the free list plus what
+                # cache eviction could reclaim. Otherwise wait — live
+                # rows retire and release blocks, and FIFO order holds
+                # (skipping ahead would starve long prompts).
+                need = self.engine.prefill_blocks_needed(
+                    len(self._queue[0].req.prompt))
+                if pool.available_blocks() < need:
+                    if not self._live:
+                        # Nothing in flight will EVER free more blocks
+                        # (with kv_eviction="none" the prefix cache
+                        # pins its blocks permanently): waiting would
+                        # livelock, so retire the head with a typed
+                        # error instead — later, smaller requests may
+                        # still be servable.
+                        live = self._queue.popleft()
+                        obs.counter("serve.errors_total").inc()
+                        self._finish(
+                            live, FinishReason.ERROR,
+                            error=f"kv blocks exhausted: need {need}, "
+                                  f"{pool.available_blocks()} "
+                                  f"reclaimable, {pool.blocks_used} "
+                                  f"in use (kv_eviction="
+                                  f"{pool.eviction!r})")
+                        continue
+                    break
             live = self._queue.popleft()
             slot = pool.alloc()
             req = live.req
@@ -318,9 +371,32 @@ class Scheduler:
             # the per-dispatch cost a horizon > 1 spreads over H tokens.
             obs.histogram("serve.host_gap_s").observe(
                 t0 - self._host_gap_t)
+        def _dispatch():
+            # KV block exhaustion (genuine, or an injected serve.kv.bind
+            # fault) is TYPED BACKPRESSURE, not an engine failure: retire
+            # only the victim row — freeing its blocks — and redial with
+            # the survivors. Convergence is guaranteed (every retirement
+            # releases blocks); None means the block retired everyone.
+            while True:
+                try:
+                    return self.engine.step(active)
+                except KVBlocksExhausted as e:
+                    slot = e.slot
+                    if slot is None or slot not in self._live:
+                        raise
+                    victim = self._live.pop(slot)
+                    self.engine.pool.free(slot)
+                    active[slot] = False
+                    obs.counter("serve.errors_total").inc()
+                    obs.counter("serve.retired_total").inc()
+                    self._finish(victim, FinishReason.ERROR,
+                                 error=f"kv blocks exhausted: {e}")
+                    if not self._live:
+                        return None
+
         with obs.span("serve.decode_attention", rows=len(self._live)):
             try:
-                tokens, block_emitted = self.engine.step(active)
+                out = _dispatch()
             except Exception:
                 # One bounded retry with backoff: a transient step crash
                 # (preempted device, injected fault) must not retire
@@ -331,7 +407,11 @@ class Scheduler:
                 # donation error and surfaces the same way.)
                 obs.counter("serve.step_retries_total").inc()
                 time.sleep(self.step_retry_backoff_s)
-                tokens, block_emitted = self.engine.step(active)
+                out = _dispatch()
+            if out is None:
+                self._host_gap_t = None
+                return 0
+            tokens, block_emitted = out
         now = time.monotonic()
         dt = now - t0
         self._host_gap_t = now
@@ -455,4 +535,6 @@ class Scheduler:
             obs.gauge("serve.queue_depth").set(0)
             obs.gauge("serve.batch_occupancy").set(
                 self.engine.pool.occupancy)
+            obs.gauge("serve.kv.blocks_used").set(
+                self.engine.pool.blocks_used)
             return n

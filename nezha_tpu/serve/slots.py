@@ -1,55 +1,93 @@
-"""Fixed-capacity KV slot pool.
+"""KV pools for the serving engine: dense slots and ref-counted pages.
 
-The pool owns the serving layer's only large buffers: per-layer K/V
-caches shaped ``[B_max, H, L_max, D]`` (the same layout
-``models/generate.init_cache`` builds, with the batch dim reinterpreted
-as SLOTS). A slot is one in-flight request's cache rows; slots are
-allocated host-side (plain free list — allocation must not touch the
-device) and their contents are written device-side:
+Two layouts share one slot-level contract (``alloc``/``free``/
+``num_free``/``occupancy`` — the scheduler's whole view):
 
-- prefill slices a slot's rows out of the pool (:func:`read_slot`), runs
-  the prompt chunk against them at its traced offset, and writes the
-  updated rows back at ``(slot, 0, 0, 0)`` (:func:`write_slot`;
-  engine.py builds the jitted bucket programs),
-- decode blocks append one position per EMITTING row per scan step via
-  the model's per-row-position cache path (models/gpt2.py): with a
-  decode horizon the engine's ``active ∧ ¬done ∧ ok`` emit mask plays
-  the role ``active`` played for single-token steps, so a row that hit
-  EOS / its budget / a NaN freeze mid-block stops appending exactly
-  like an empty slot does.
+- :class:`SlotPool` — the dense layout: per-layer K/V buffers shaped
+  ``[B_max, H, L_max, D]``, one worst-case ``max_len`` reservation per
+  admitted request. Simple, but memory occupancy (not compute) caps
+  concurrency: a 10-token request holds the same rows as a full one.
+- :class:`PagedSlotPool` — the block-paged layout
+  (``ServeConfig.kv_layout="paged"``, the default): per-layer K/V
+  buffers shaped ``[num_blocks, H, block_size, D]``, a host-side free
+  list of blocks with REF COUNTS, and a per-slot block table
+  (``[max_blocks_per_row]`` int32) threaded into the compiled programs.
+  Admission binds only the blocks the prompt needs and decode binds
+  further blocks lazily as positions advance, so resident memory tracks
+  tokens actually written, not ``B_max * max_len``. Block 0 is a
+  reserved SCRATCH block: freed slots' table rows reset to it and
+  non-emitting rows' pad writes are routed to it in-program, so a
+  retired slot can never scribble on a block that was rebound to a new
+  request.
 
-Freeing a slot is bookkeeping only — stale K/V stays in the buffers.
-That is safe by construction: a new occupant's prefill chunks overwrite
-``[0, prompt_len)`` in order, and attention only ever covers positions
-the request itself has written first — each chunk attends the prefix
-earlier chunks wrote plus its own causal window, and the decode path
-(mask or flash-decode ``lengths``) stops at ``pos``. Bucket pads beyond
-the prompt write garbage K/V above ``prompt_len`` that the first decode
-writes overwrite before any mask reaches them. Non-emitting rows in a
-decode block (inactive slots, rows done mid-horizon) write one pad
-token's K/V at their FROZEN position each scan step — always one past
-the row's real content, at most at ``max_len - 1`` via the update-slice
-clamp on a row that filled its capacity (such a row is always done →
-retired), and never attended: the row's own ``lengths`` stop at its
-content, and the next occupant rebuilds everything it will ever attend.
+On top of the ref counts the paged pool keeps a **prefix-reuse trie**
+(:class:`PrefixTrie`) keyed on full blocks of prompt tokens: a request
+whose prompt prefix matches cached blocks takes REFERENCES on them
+instead of re-prefilling (TTFT collapses for templated traffic), and
+the trie itself holds one reference per cached block so the cache
+survives its donor's retirement. Writes go through
+:meth:`PagedSlotPool.prepare_write`, which enforces the single
+invariant everything else leans on: **a block is only ever written
+while its ref count is exactly 1**. A write into a shared block
+(ref > 1 — a cached prefix, or a donor's block another request now
+references) first COPIES it to a fresh block and swaps the writer's
+table entry (copy-on-write, counted in ``serve.kv.cow_copies_total``).
+When the free list runs dry, trie-only blocks (ref == 1, held by the
+cache alone) are evicted LRU-first; past that, binding raises the typed
+:class:`KVBlocksExhausted` — the scheduler's backpressure signal, never
+a crash. The ``serve.kv.bind`` fault point arms the same path for
+chaos plans.
+
+Stale-KV reuse invariant (regression-tested for both layouts): freeing
+a slot/block is bookkeeping only — stale K/V stays in the buffers, and
+that is safe by construction because a new occupant's prefill
+overwrites ``[0, prompt_len)`` (or takes references to blocks holding
+EXACTLY the tokens it would have written) before attention ever covers
+those positions, and the decode path (mask or flash-decode ``lengths``)
+stops at ``pos``. Bucket pads beyond the prompt write garbage K/V above
+``prompt_len`` that the first decode writes overwrite before any mask
+reaches them. Non-emitting rows in a decode block write one pad token's
+K/V at their FROZEN position each scan step (dense: their own slot row;
+paged: their own bound block, or scratch when inactive) — never
+attended, because the row's own ``lengths`` stop at its content.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional
+import itertools
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
+import jax
 import jax.numpy as jnp
 from jax import lax
 
+from nezha_tpu import faults, obs
+
+
+class KVBlocksExhausted(RuntimeError):
+    """Typed backpressure: a KV block bind found no free block (after
+    eviction). Carries the ``slot`` that was being grown (None during
+    admission binds). The scheduler retires/requeues instead of
+    crashing the decode loop."""
+
+    def __init__(self, msg: str, slot: Optional[int] = None):
+        super().__init__(msg)
+        self.slot = slot
+
 
 class SlotPool:
-    """Host-side slot bookkeeping + the pooled device cache buffers.
+    """Host-side slot bookkeeping + the pooled dense cache buffers.
 
     ``caches`` is the per-layer list of ``{"k", "v"}`` dicts the model's
     cache path consumes. The pool hands out slot INDICES; the engine
     threads the cache pytree through its jitted programs (functional
     updates — the pool re-binds ``caches`` to each program's output).
     """
+
+    paged = False
 
     def __init__(self, model, capacity: int, max_len: int,
                  dtype=jnp.bfloat16):
@@ -91,6 +129,13 @@ class SlotPool:
         """Active fraction in [0, 1] — the batch-occupancy gauge value."""
         return self.num_active / self.capacity
 
+    @property
+    def blocks_used(self) -> int:
+        """Dense pools have no block granularity; report reserved rows
+        in slot units so the ``serve.kv.blocks_used`` gauge stays
+        meaningful across layouts."""
+        return self.num_active
+
 
 def read_slot(pool_leaf, slot):
     """Slice one slot's rows out of a pooled cache leaf:
@@ -109,3 +154,449 @@ def write_slot(pool_leaf, chunk_leaf, slot):
     return lax.dynamic_update_slice(
         pool_leaf, chunk_leaf.astype(pool_leaf.dtype),
         (slot, zero, zero, zero))
+
+
+# --------------------------------------------------------------- paged
+class _TrieNode:
+    __slots__ = ("tokens", "block", "children", "parent", "tick")
+
+    def __init__(self, tokens: Tuple[int, ...], block: int,
+                 parent: Optional["_TrieNode"], tick: int):
+        self.tokens = tokens
+        self.block = block
+        self.children: Dict[Tuple[int, ...], "_TrieNode"] = {}
+        self.parent = parent
+        self.tick = tick
+
+
+class PrefixTrie:
+    """Prefix-reuse index over FULL blocks of prompt tokens.
+
+    Each node is one cached block keyed by the exact ``block_size``
+    token tuple it holds, childed under the node for the preceding
+    block — so a root-to-node path spells a prompt prefix. Only full
+    blocks are indexed: a full block is never written again (writes
+    happen at positions past it), so cached content is immutable by
+    construction and lookups never race writers. The trie holds ONE
+    pool reference per node; eviction (leaf-first, LRU by touch tick)
+    drops that reference, freeing the block once no request holds it.
+    """
+
+    def __init__(self, block_size: int):
+        self.block_size = block_size
+        self.root: Dict[Tuple[int, ...], _TrieNode] = {}
+        self._nodes: set = set()
+        # Leaves maintained incrementally: eviction candidates are
+        # found in O(|leaves|) instead of scanning every node — the
+        # reclaim path runs under memory pressure on the per-dispatch
+        # binding path, where an O(nodes) scan per freed block would
+        # bite exactly when the pool is fullest.
+        self._leaves: set = set()
+        self._tick = itertools.count()
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    @property
+    def blocks(self) -> List[int]:
+        return [n.block for n in self._nodes]
+
+    def match(self, tokens: Sequence[int]) -> List[int]:
+        """-> block ids of the longest cached full-block prefix of
+        ``tokens`` (possibly empty). Touches matched nodes (LRU)."""
+        bs = self.block_size
+        out: List[int] = []
+        children = self.root
+        i = 0
+        while i + bs <= len(tokens):
+            node = children.get(tuple(int(t) for t in tokens[i:i + bs]))
+            if node is None:
+                break
+            node.tick = next(self._tick)
+            out.append(node.block)
+            children = node.children
+            i += bs
+        return out
+
+    def insert(self, tokens: Sequence[int], blocks: Sequence[int],
+               take_ref) -> int:
+        """Index the full-block prefix of ``tokens`` under ``blocks``
+        (the slot's bound block ids, one per block of the prompt).
+        ``take_ref(block)`` is called once per NEWLY inserted node (the
+        trie's own reference). Existing nodes (same token path) are
+        kept — first writer wins, later identical content just
+        refreshes the LRU tick. -> number of nodes inserted."""
+        bs = self.block_size
+        children = self.root
+        parent: Optional[_TrieNode] = None
+        inserted = 0
+        for bi in range(len(tokens) // bs):
+            key = tuple(int(t) for t in tokens[bi * bs:(bi + 1) * bs])
+            node = children.get(key)
+            if node is None:
+                node = _TrieNode(key, int(blocks[bi]), parent,
+                                 next(self._tick))
+                children[key] = node
+                self._nodes.add(node)
+                self._leaves.add(node)
+                if parent is not None:
+                    self._leaves.discard(parent)
+                take_ref(node.block)
+                inserted += 1
+            else:
+                node.tick = next(self._tick)
+            parent = node
+            children = node.children
+        return inserted
+
+    def evict(self, want: int, release, only=None) -> int:
+        """Drop up to ``want`` cached blocks, leaf-first and LRU-first
+        within the leaves (a parent only becomes evictable once its
+        children are gone — evicting an interior node would orphan the
+        path below it). ``only(block)``, when given, filters the
+        candidates — the pool passes "ref count is exactly 1" so
+        eviction only ever destroys entries whose release actually
+        FREES a block (a leaf still bound by a live prefix-hit request
+        would free nothing). ``release(block)`` drops the trie's
+        reference. -> nodes actually evicted."""
+        evicted = 0
+        while evicted < want:
+            leaves = [n for n in self._leaves
+                      if only is None or only(n.block)]
+            if not leaves:
+                break
+            victim = min(leaves, key=lambda n: n.tick)
+            self._remove(victim)
+            release(victim.block)
+            evicted += 1
+        return evicted
+
+    def clear(self, release) -> int:
+        """Drop every cached block (the ``prefix_cache`` off-switch /
+        test teardown). -> count dropped."""
+        n = len(self._nodes)
+        for node in self._nodes:
+            release(node.block)
+        self.root = {}
+        self._nodes = set()
+        self._leaves = set()
+        return n
+
+    def _remove(self, node: _TrieNode) -> None:
+        siblings = node.parent.children if node.parent else self.root
+        siblings.pop(node.tokens, None)
+        self._nodes.discard(node)
+        self._leaves.discard(node)
+        if node.parent is not None and not node.parent.children:
+            self._leaves.add(node.parent)
+
+
+def _copy_block(caches: list, src, dst) -> list:
+    """Device-side block copy across every layer's K and V pool:
+    ``caches[l][kv] [N, H, bs, D]`` with block ``src`` copied over
+    block ``dst``. The COW move. Jitted once per pool shape (src/dst
+    cross as 0-d arrays so indices never recompile); donation makes it
+    an in-place rewrite of one block, not a pool copy. Deliberately NOT
+    routed through the engine executor: the frozen-program contract
+    ("1 step + len(prefill_buckets) entries") is pinned on the
+    executor's cache, and COW is pool maintenance, not a serving
+    program."""
+    def leaf(x):
+        blk = lax.dynamic_slice_in_dim(x, src, 1, axis=0)
+        return lax.dynamic_update_slice_in_dim(x, blk, dst, axis=0)
+
+    return jax.tree_util.tree_map(leaf, caches)
+
+
+_copy_block_jit = jax.jit(_copy_block, donate_argnums=(0,))
+
+
+class PagedSlotPool:
+    """Block-paged KV pool: ref-counted blocks + per-slot block tables.
+
+    Device state: ``caches`` (per-layer ``{"k", "v"}`` pools shaped
+    ``[num_blocks, H, block_size, D]``) and — uploaded per dispatch from
+    the host mirror — ``tables_host`` (``[capacity, blocks_per_slot]``
+    int32; entry ``[s, i]`` is the pool block holding slot ``s``'s
+    positions ``[i*bs, (i+1)*bs)``, or 0/scratch when unbound). Host
+    state: the block free list, per-block ref counts, per-slot bound
+    counts, and the prefix trie.
+
+    Invariants (the chaos tests' leak check asserts them):
+
+    - block 0 is scratch: never allocated, never ref-counted;
+    - a block is written only while its ref count is exactly 1
+      (:meth:`prepare_write` COWs shared blocks first);
+    - every non-free block's ref count equals (slots binding it) +
+      (1 if a trie node caches it);
+    - freeing the last reference returns the block to the free list.
+    """
+
+    paged = True
+
+    def __init__(self, model, capacity: int, max_len: int,
+                 dtype=jnp.bfloat16, *, block_size: int = 16,
+                 num_blocks: Optional[int] = None,
+                 prefix_cache: bool = True, eviction: str = "lru"):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if max_len < 1:
+            raise ValueError(f"max_len must be >= 1, got {max_len}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if eviction not in ("lru", "none"):
+            raise ValueError(
+                f"eviction must be 'lru' or 'none', got {eviction!r}")
+        self.capacity = capacity
+        self.max_len = max_len
+        self.dtype = dtype
+        self.block_size = block_size
+        # Table width: every slot must be able to reach max_len.
+        self.blocks_per_slot = math.ceil(max_len / block_size)
+        if num_blocks is None:
+            # Dense-equivalent capacity by default (+1 for scratch):
+            # paged-by-default must never serve LESS than dense did.
+            num_blocks = 1 + capacity * self.blocks_per_slot
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block 0 is scratch), got "
+                f"{num_blocks}")
+        self.num_blocks = num_blocks
+        self.prefix_cache_enabled = prefix_cache
+        self.eviction = eviction
+        cfg = model.cfg
+        d = cfg.hidden_size // cfg.num_heads
+        shape = (num_blocks, cfg.num_heads, block_size, d)
+        self.caches = [{"k": jnp.zeros(shape, dtype),
+                        "v": jnp.zeros(shape, dtype)}
+                       for _ in range(cfg.num_layers)]
+        self.tables_host = np.zeros((capacity, self.blocks_per_slot),
+                                    np.int32)
+        self._free_slots: List[int] = list(range(capacity - 1, -1, -1))
+        # Block 0 reserved as scratch (pad-write sink for non-emitting
+        # rows) — LIFO free list over the rest.
+        self._free_blocks: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._refs = np.zeros((num_blocks,), np.int64)
+        self._bound = np.zeros((capacity,), np.int32)   # per-slot entries
+        self.trie = PrefixTrie(block_size)
+        self.cow_copies = 0
+        self.prefix_hits = 0
+
+    # ------------------------------------------------------ slot layer
+    def alloc(self) -> Optional[int]:
+        """-> a free slot index, or None when every slot is occupied.
+        Blocks are bound separately (:meth:`bind_for_prompt` /
+        :meth:`prepare_write`) — a fresh slot holds none."""
+        return self._free_slots.pop() if self._free_slots else None
+
+    def free(self, slot: int) -> None:
+        """Release the slot and DROP ITS BLOCK REFERENCES in the same
+        call (the same-iteration contract the chaos suites pin): blocks
+        nobody else references return to the free list, the table row
+        resets to scratch so a stale dispatch mask can never write into
+        a rebound block."""
+        if not 0 <= slot < self.capacity:
+            raise ValueError(f"slot {slot} out of range [0, {self.capacity})")
+        if slot in self._free_slots:
+            raise ValueError(f"slot {slot} is already free (double free)")
+        self.release_blocks(slot)
+        self._free_slots.append(slot)
+
+    def release_blocks(self, slot: int) -> None:
+        """Drop the slot's block references (without freeing the slot):
+        the table row resets to scratch and blocks nobody else holds
+        return to the free list. Used by :meth:`free` and by the
+        engine's cold-prefill fallback when a prefix hit pinned the
+        very blocks its own copy-on-write then needed."""
+        for i in range(int(self._bound[slot])):
+            self._release(int(self.tables_host[slot, i]))
+        self.tables_host[slot, :] = 0
+        self._bound[slot] = 0
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free_slots)
+
+    @property
+    def num_active(self) -> int:
+        return self.capacity - len(self._free_slots)
+
+    @property
+    def occupancy(self) -> float:
+        return self.num_active / self.capacity
+
+    # ----------------------------------------------------- block layer
+    @property
+    def blocks_used(self) -> int:
+        """Non-free, non-scratch blocks (slot-bound + trie-cached) —
+        the ``serve.kv.blocks_used`` gauge value."""
+        return self.num_blocks - 1 - len(self._free_blocks)
+
+    @property
+    def trie_only_blocks(self) -> int:
+        """Blocks held ONLY by the prefix cache (ref == 1 via a trie
+        node) — the evictable count."""
+        return sum(1 for b in self.trie.blocks if self._refs[b] == 1)
+
+    def available_blocks(self) -> int:
+        """Free blocks plus what eviction could reclaim — the
+        scheduler's admission budget."""
+        n = len(self._free_blocks)
+        if self.eviction == "lru":
+            n += self.trie_only_blocks
+        return n
+
+    def blocks_for_span(self, end: int) -> int:
+        """Blocks covering positions ``[0, end)``."""
+        return math.ceil(min(end, self.max_len) / self.block_size)
+
+    @property
+    def max_request_blocks(self) -> int:
+        """The most blocks one request could ever bind."""
+        return min(self.blocks_per_slot, self.num_blocks - 1)
+
+    def _alloc_block(self, slot: Optional[int]) -> int:
+        """Pop a free block (evicting LRU trie-only cache blocks if the
+        list is dry). ``serve.kv.bind`` is the chaos point: an injected
+        error surfaces exactly like genuine exhaustion — typed
+        backpressure, request-scoped, never a crash."""
+        faults.point("serve.kv.bind")
+        if not self._free_blocks and self.eviction == "lru":
+            # Only evict entries whose release actually frees a block
+            # (ref == 1, trie-only): evicting a leaf a live request
+            # still binds would destroy cache value AND free nothing —
+            # exhaustion must only be raised once every reclaimable
+            # block has genuinely been reclaimed (the capacity
+            # available_blocks() promised admission).
+            self.trie.evict(1, self._release,
+                            only=lambda b: self._refs[b] == 1)
+        if not self._free_blocks:
+            raise KVBlocksExhausted(
+                f"no free KV blocks ({self.blocks_used}/"
+                f"{self.num_blocks - 1} in use, "
+                f"{len(self.trie)} cached)", slot=slot)
+        b = self._free_blocks.pop()
+        self._refs[b] = 1
+        return b
+
+    def _release(self, block: int) -> None:
+        if block == 0:
+            return
+        self._refs[block] -= 1
+        if self._refs[block] == 0:
+            self._free_blocks.append(block)
+        elif self._refs[block] < 0:
+            raise AssertionError(
+                f"block {block} ref count went negative (double release)")
+
+    # -------------------------------------------------- prompt binding
+    def bind_for_prompt(self, slot: int, tokens: Sequence[int]) -> int:
+        """Admission-time binding: match the prompt's full-block prefix
+        against the trie and take REFERENCES on the cached blocks
+        instead of re-prefilling them. -> ``shared_len``, the number of
+        leading positions whose K/V the slot now holds (block-aligned,
+        capped at ``len(tokens) - 1`` so the final prompt token is
+        always re-run — its logits seed decoding). The cap can land the
+        first write inside the last shared block; :meth:`prepare_write`
+        COWs it then."""
+        if self._bound[slot]:
+            raise ValueError(f"slot {slot} already holds blocks")
+        n = len(tokens)
+        shared_blocks: List[int] = []
+        if self.prefix_cache_enabled:
+            shared_blocks = self.trie.match(tokens)
+        if shared_blocks:
+            for i, b in enumerate(shared_blocks):
+                self._refs[b] += 1
+                self.tables_host[slot, i] = b
+            self._bound[slot] = len(shared_blocks)
+        return min(len(shared_blocks) * self.block_size, n - 1)
+
+    def count_prefix_hit(self) -> None:
+        """Account one MATERIALIZED prefix hit. Called by the engine
+        after the hit's write binding succeeded — not inside
+        :meth:`bind_for_prompt` — so a tight-pool hit that had to fall
+        back to a cold prefill never inflates the cache-win metrics."""
+        self.prefix_hits += 1
+        obs.counter("serve.kv.prefix_hits_total").inc()
+
+    def register_prefix(self, slot: int, tokens: Sequence[int]) -> int:
+        """Post-prefill: index the prompt's full blocks (now holding
+        exactly those tokens' K/V) in the trie, which takes its own
+        reference per newly cached block — the cache outlives the
+        donor. -> nodes inserted."""
+        if not self.prefix_cache_enabled:
+            return 0
+        nfull = len(tokens) // self.block_size
+        if nfull == 0 or self._bound[slot] < nfull:
+            return 0
+
+        def take_ref(block: int) -> None:
+            self._refs[block] += 1
+
+        return self.trie.insert(
+            list(tokens)[:nfull * self.block_size],
+            [int(b) for b in self.tables_host[slot, :nfull]], take_ref)
+
+    # ------------------------------------------------------ write path
+    def prepare_write(self, slot: int, start: int, end: int) -> None:
+        """Make positions ``[start, end)`` of ``slot`` writable before a
+        dispatch that will write them: bind fresh blocks past the bound
+        frontier, and copy-on-write any block in the span whose ref
+        count exceeds 1 (shared prefix, or a donor's block the cache /
+        another request references). Raises :class:`KVBlocksExhausted`
+        (typed backpressure) when no block can be found."""
+        bs = self.block_size
+        end = min(end, self.blocks_per_slot * bs)
+        first = min(start // bs, int(self._bound[slot]))
+        last = math.ceil(end / bs)
+        for bi in range(first, last):
+            if bi < self._bound[slot]:
+                b = int(self.tables_host[slot, bi])
+                if self._refs[b] > 1:
+                    nb = self._alloc_block(slot)
+                    self.caches = _copy_block_jit(
+                        self.caches, np.int32(b), np.int32(nb))
+                    self.tables_host[slot, bi] = nb
+                    self._release(b)
+                    self.cow_copies += 1
+                    obs.counter("serve.kv.cow_copies_total").inc()
+            else:
+                if bi != self._bound[slot]:
+                    raise AssertionError(
+                        f"non-contiguous bind: slot {slot} bound "
+                        f"{int(self._bound[slot])} blocks, write wants "
+                        f"block {bi}")
+                self.tables_host[slot, bi] = self._alloc_block(slot)
+                self._bound[slot] = bi + 1
+
+    # ------------------------------------------------------- accounting
+    def clear_prefix_cache(self) -> int:
+        """Drop every cached block (knob flips / tests). -> count."""
+        return self.trie.clear(self._release)
+
+    def leak_check(self) -> None:
+        """Assert the ref-count books balance: every non-free block is
+        explained by slot bindings + trie nodes, and freeing everything
+        would empty the pool. Chaos tests call this after drain."""
+        expect = np.zeros((self.num_blocks,), np.int64)
+        for slot in range(self.capacity):
+            if slot in self._free_slots:
+                continue
+            for i in range(int(self._bound[slot])):
+                expect[self.tables_host[slot, i]] += 1
+        for b in self.trie.blocks:
+            expect[b] += 1
+        expect[0] = 0
+        if not np.array_equal(expect, self._refs):
+            bad = np.flatnonzero(expect != self._refs)
+            raise AssertionError(
+                f"KV block ref-count leak at blocks {bad.tolist()}: "
+                f"expected {expect[bad].tolist()}, "
+                f"recorded {self._refs[bad].tolist()}")
+        n_free = len(self._free_blocks)
+        n_held = int(np.count_nonzero(self._refs))
+        if n_free + n_held != self.num_blocks - 1:
+            raise AssertionError(
+                f"KV block leak: {n_free} free + {n_held} held != "
+                f"{self.num_blocks - 1} allocatable")

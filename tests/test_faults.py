@@ -700,7 +700,8 @@ def test_fault_point_registry_pinned():
     documented in the RUNBOOK, covered by a test, and pinned in the
     validator's EXPECTED_POINTS — and the validator actually sees the
     full set, including the multi-replica points (router.route /
-    router.probe / supervisor.spawn / replica.exec)."""
+    router.probe / supervisor.spawn / replica.exec) and the paged-KV
+    bind point (serve.kv.bind)."""
     from check_fault_points import EXPECTED_POINTS, check, find_points
 
     assert check(_ROOT) == []
@@ -710,5 +711,6 @@ def test_fault_point_registry_pinned():
         "checkpoint.save", "dist.join",
         "router.route", "router.probe",
         "supervisor.spawn", "replica.exec",
+        "serve.kv.bind",
     }
     assert set(find_points(_ROOT)) == set(EXPECTED_POINTS)

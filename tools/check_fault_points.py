@@ -42,6 +42,10 @@ EXPECTED_POINTS = frozenset({
     "checkpoint.save", "dist.join",
     # Multi-replica serving (router/supervisor front end):
     "router.route", "router.probe", "supervisor.spawn", "replica.exec",
+    # Paged KV pool: armed at every block bind (admission, lazy decode
+    # growth, COW) — an injected error surfaces as the same typed
+    # KVBlocksExhausted backpressure genuine exhaustion produces.
+    "serve.kv.bind",
 })
 SOURCE_DIR = "nezha_tpu"
 # The faults package itself is excluded: its docstrings describe the API

@@ -42,8 +42,15 @@ _SERVE_COUNTERS = {"serve.admitted_total", "serve.rejected_total",
                    "serve.expired_total", "serve.retired_total",
                    "serve.tokens_total", "serve.prefill.chunks_total",
                    "serve.errors_total", "serve.step_retries_total",
-                   "faults.injected_total"}
-_SERVE_GAUGES = {"serve.queue_depth", "serve.batch_occupancy"}
+                   "faults.injected_total",
+                   # Paged-KV pool (PR 8): requests that took cached
+                   # prefix references instead of re-prefilling, and
+                   # copy-on-write block copies. Layout-invariant: a
+                   # dense-layout run reports 0s, never omits them.
+                   "serve.kv.prefix_hits_total",
+                   "serve.kv.cow_copies_total"}
+_SERVE_GAUGES = {"serve.queue_depth", "serve.batch_occupancy",
+                 "serve.kv.blocks_used"}
 _SERVE_HISTOGRAMS = {"serve.ttft_s", "serve.tpot_s",
                      "serve.prefill.bucket_len",
                      # Decode-horizon instruments (PR 5): host time
