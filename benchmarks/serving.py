@@ -96,6 +96,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "BELOW the dense equivalent to measure "
                         "block-budget admission: concurrency then "
                         "tracks resident tokens, not slots")
+    p.add_argument("--kv-dtype", choices=["bf16", "int8"],
+                   default="bf16",
+                   help="KV block storage: int8 stores blocks as int8 "
+                        "+ per-block fp32 scales (paged only) — the "
+                        "capacity-at-equal-memory knob; the record "
+                        "reports peak resident bytes so equal-byte "
+                        "budgets compare directly")
     p.add_argument("--prefix-cache", choices=["on", "off"], default="on",
                    help="paged: shared-prefix prefill reuse on/off")
     p.add_argument("--shared-prefix-frac", type=float, default=0.0,
@@ -261,7 +268,8 @@ def _run_one(args, model, variables, decode_horizon: int,
         decode_impl=args.decode_impl, decode_horizon=decode_horizon,
         kv_layout=args.kv_layout, kv_block_size=args.kv_block_size,
         kv_num_blocks=args.kv_num_blocks,
-        prefix_cache=args.prefix_cache == "on")
+        prefix_cache=args.prefix_cache == "on",
+        kv_dtype=args.kv_dtype)
     engine = Engine(model, variables, cfg)
     sched = Scheduler(engine)
     rng = random.Random(args.seed)
@@ -506,14 +514,24 @@ def _run_one(args, model, variables, decode_horizon: int,
         # count; paged at what the block budget admits).
         "kv": {
             "layout": args.kv_layout,
+            "dtype": args.kv_dtype,
             "block_size": args.kv_block_size,
             "num_blocks": (engine.pool.num_blocks if engine.paged
                            else None),
+            "bytes_per_block": (engine.pool.bytes_per_block
+                                if engine.paged else None),
             "prefix_cache": args.prefix_cache == "on",
             "prefix_hits": getattr(engine.pool, "prefix_hits", 0),
             "cow_copies": getattr(engine.pool, "cow_copies", 0),
             "peak_resident_requests": peak_resident,
             "peak_blocks_used": peak_blocks,
+            # Peak device bytes the resident KV held — the number the
+            # int8-vs-bf16 equal-memory comparison is actually about
+            # (blocks are not comparable across dtypes; bytes are).
+            "peak_bytes_resident": (
+                peak_blocks * engine.pool.bytes_per_block
+                if engine.paged else peak_resident
+                * engine.pool._slot_bytes),
         },
         "faults": {
             "rate": args.fault_rate,

@@ -9,8 +9,10 @@ entry point
 1. resolves the backend SELF-HEALINGLY (a dead TPU tunnel falls back to
    CPU instead of crashing — the bench.py fix, shared here),
 2. runs the closed-loop serving sweep (``benchmarks/serving.py``: the
-   decode-horizon sweep plus the paged-KV shared-prefix record) and the
-   decode-attention microbench (``benchmarks/decode_attention.py``),
+   decode-horizon sweep, the paged-KV shared-prefix record, the
+   paged-vs-dense and paged-int8-vs-paged-bf16 equal-memory occupancy
+   records) and the decode-attention microbench
+   (``benchmarks/decode_attention.py``),
 3. compares the headline numbers against the committed baselines
    (``BENCH_serving.json`` / ``BENCH_decode_attention.json``), keyed by
    platform family — a run on a platform with no baseline SEEDS one
@@ -157,6 +159,39 @@ def _run_serving(args, platform: str) -> dict:
         dense_argv + load))
     paged = serving_bench.run(serving_bench.build_parser().parse_args(
         paged_argv + load))
+    # Equal-memory int8 vs bf16 (ISSUE 9 acceptance): paged pools whose
+    # device KV budgets hold the same BYTES — an int8 block costs ~half
+    # a bf16 block (+ one fp32 scale per head: 4/(block_size*D) per
+    # element), so the same budget holds ~2x the blocks and resident-
+    # request capacity ~doubles while each request's footprint (1 block
+    # here) is unchanged. Block counts below keep the int8 budget AT OR
+    # UNDER the bf16 byte budget, so the capacity claim is never
+    # flattered by rounding.
+    if args.quick:
+        int8_budget = ("4 usable bf16 blocks vs 7 int8 "
+                       "(int8 bytes 11% UNDER the bf16 budget)")
+        bf16_argv = ["--max-batch-size", "16", "--max-len", "32",
+                     "--kv-num-blocks", "5"]
+        int8_argv = ["--max-batch-size", "16", "--max-len", "32",
+                     "--kv-num-blocks", "8", "--kv-dtype", "int8"]
+        iload = ["--requests", str(requests), "--concurrency", "8",
+                 "--prompt-len", "4", "--max-new-tokens", "4",
+                 "--max-prefill-len", "8", "--platform", platform]
+    else:
+        int8_budget = ("8 usable bf16 blocks vs 15 int8 "
+                       "(int8 bytes 4.8% UNDER the bf16 budget)")
+        bf16_argv = ["--max-batch-size", "16", "--max-len", "32",
+                     "--kv-num-blocks", "9"]
+        int8_argv = ["--max-batch-size", "16", "--max-len", "32",
+                     "--kv-num-blocks", "16", "--kv-dtype", "int8"]
+        iload = ["--requests", str(max(requests, 32)),
+                 "--concurrency", "16",
+                 "--prompt-len", "4", "--max-new-tokens", "8",
+                 "--max-prefill-len", "8", "--platform", platform]
+    kv_bf16 = serving_bench.run(serving_bench.build_parser().parse_args(
+        bf16_argv + iload))
+    kv_int8 = serving_bench.run(serving_bench.build_parser().parse_args(
+        int8_argv + iload))
     return {"closed_loop_horizon_sweep": sweep,
             "shared_prefix_0.8": shared,
             "paged_vs_dense_equal_memory": {
@@ -166,6 +201,28 @@ def _run_serving(args, platform: str) -> dict:
                     dense["kv"]["peak_resident_requests"],
                 "paged_peak_resident":
                     paged["kv"]["peak_resident_requests"],
+            },
+            "paged_int8_vs_bf16_equal_memory": {
+                "kv_budget": int8_budget,
+                "bf16": kv_bf16, "int8": kv_int8,
+                "bf16_peak_resident":
+                    kv_bf16["kv"]["peak_resident_requests"],
+                "int8_peak_resident":
+                    kv_int8["kv"]["peak_resident_requests"],
+                "bf16_peak_bytes":
+                    kv_bf16["kv"]["peak_bytes_resident"],
+                "int8_peak_bytes":
+                    kv_int8["kv"]["peak_bytes_resident"],
+                # TTFT/TPOT ride along so the capacity claim is
+                # checkable against its latency cost in one place
+                # (CPU records are noisy — the gate stays on the
+                # horizon-sweep tokens/sec, not on these).
+                "ttft_p50_ratio_int8_vs_bf16": (
+                    kv_int8["ttft_s"]["p50"]
+                    / max(kv_bf16["ttft_s"]["p50"], 1e-9)),
+                "tpot_p50_ratio_int8_vs_bf16": (
+                    kv_int8["tpot_s"]["p50"]
+                    / max(kv_bf16["tpot_s"]["p50"], 1e-9)),
             }}
 
 

@@ -120,6 +120,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "(1 + max_batch_size * ceil(max_len/block)); "
                         "smaller makes resident tokens, not slots, the "
                         "admission limit")
+    p.add_argument("--kv-dtype", choices=["bf16", "int8"],
+                   default="bf16",
+                   help="KV block storage: bf16 = store --cache-dtype "
+                        "(bit-identical to the classic engine); int8 = "
+                        "int8 blocks + per-block fp32 scales (paged "
+                        "layout only) — ~2x resident requests at the "
+                        "same device budget, dequantized inside the "
+                        "flash-decode kernel (docs/RUNBOOK.md §8)")
     p.add_argument("--prefix-cache", choices=["on", "off"], default="on",
                    help="paged layout: reuse cached blocks for "
                         "requests whose prompt prefix matches (TTFT "
@@ -224,7 +232,8 @@ def _build_stack(args):
         kv_block_size=args.kv_block_size,
         kv_num_blocks=args.kv_num_blocks,
         prefix_cache=args.prefix_cache == "on",
-        kv_eviction=args.kv_eviction)
+        kv_eviction=args.kv_eviction,
+        kv_dtype=args.kv_dtype)
     engine = Engine(model, variables, cfg)
     return Scheduler(engine), tokenizer, eos_id
 
@@ -743,6 +752,7 @@ def _worker_argv(args, rid: int, port: int) -> list:
              "--decode-horizon", str(args.decode_horizon),
              "--kv-layout", args.kv_layout,
              "--kv-block-size", str(args.kv_block_size),
+             "--kv-dtype", args.kv_dtype,
              "--prefix-cache", args.prefix_cache,
              "--kv-eviction", args.kv_eviction,
              "--drain-timeout", str(args.drain_timeout),

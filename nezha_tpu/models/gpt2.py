@@ -191,6 +191,74 @@ def _flash_auto_ok() -> bool:
     return jax.default_backend() == "tpu" and not under_auto_partitioner()
 
 
+def _quant_decode_write(pool, scales, blk, off, row):
+    """One decode token's K (or V) into an INT8 block pool at BLOCK
+    granularity: gather each row's target block, dequantize, zero the
+    stale positions past the write offset (a freshly-bound block holds
+    a previous occupant's int8 garbage — letting it into the absmax
+    would inflate the new scale and crush the real entries), insert the
+    new row, requantize with a fresh per-(block, head) scale, scatter
+    block + scale back. Positions below ``off`` are this row's own
+    earlier tokens: they re-round only if the block absmax moved
+    (unchanged scale round-trips int8 exactly), which is the bounded
+    re-quantization error the ``serve.kv.quant_error`` histogram
+    samples. ``pool [N,H,bs,D] int8``, ``scales [N,H] f32``,
+    ``blk``/``off [B]``, ``row [B,H,D]``."""
+    from nezha_tpu.ops import quant
+    bs = pool.shape[2]
+    qblk = pool[blk]                                     # [B, H, bs, D]
+    deq = qblk.astype(jnp.float32) * scales[blk][:, :, None, None]
+    idx = jnp.arange(bs)
+    keep = (idx[None, :] < off[:, None])[:, None, :, None]
+    sel = (idx[None, :] == off[:, None])[:, None, :, None]
+    deq = jnp.where(sel, row.astype(jnp.float32)[:, :, None, :],
+                    jnp.where(keep, deq, 0.0))
+    qn, sn = quant.quantize_kv_block(deq)
+    return pool.at[blk].set(qn), scales.at[blk].set(sn)
+
+
+def _quant_prefill_write(pool, scales, tab, pos, new, s):
+    """One prefill chunk's K (or V) into an INT8 block pool: the chunk
+    ``new [b,H,s,D]`` lands at traced offset ``pos`` through the block
+    table ``tab [b,M]``. The touched-block window is STATIC
+    (``ceil(s/bs)+1`` gathered blocks — ``s`` and ``bs`` are static,
+    only ``pos`` is traced); per touched block, positions before the
+    chunk keep their dequantized content (earlier chunks / COWed cached
+    prefix), chunk positions take the new values, and positions past
+    the chunk zero out (previous-occupant garbage must not set the
+    scale). Over-covered window rows (the +1 slack when ``pos`` is
+    block-aligned) are routed to the scratch block with zero content —
+    never to a data block, whose content a duplicate-index scatter
+    could otherwise clobber. Returns ``(pool, scales, err)`` with
+    ``err`` the max-abs dequant error over the written span — the
+    ``serve.kv.quant_error`` sample."""
+    from nezha_tpu.ops import quant
+    bs = pool.shape[2]
+    m = tab.shape[1]
+    t = min((s - 1) // bs + 2, m)
+    fb = pos // bs
+    tbi_raw = fb + jnp.arange(t)                         # [T]
+    touched = tbi_raw <= (pos + s - 1) // bs
+    blks = jnp.where(touched[None, :],
+                     tab[:, jnp.clip(tbi_raw, 0, m - 1)], 0)   # [b, T]
+    deq = (pool[blks].astype(jnp.float32)
+           * scales[blks][..., None, None])              # [b,T,H,bs,D]
+    wpos = tbi_raw[:, None] * bs + jnp.arange(bs)[None, :]     # [T, bs]
+    keep = (wpos < pos) & touched[:, None]
+    in_chunk = (wpos >= pos) & (wpos < pos + s) & touched[:, None]
+    neww = new.astype(jnp.float32)[
+        :, :, jnp.clip(wpos - pos, 0, s - 1), :]         # [b,H,T,bs,D]
+    neww = jnp.transpose(neww, (0, 2, 1, 3, 4))          # [b,T,H,bs,D]
+    deq = jnp.where(in_chunk[None, :, None, :, None], neww,
+                    jnp.where(keep[None, :, None, :, None], deq, 0.0))
+    qn, sn = quant.quantize_kv_block(deq)
+    err = jnp.max(jnp.abs(jnp.where(
+        (keep | in_chunk)[None, :, None, :, None],
+        quant.sanitize(deq) - qn.astype(jnp.float32)
+        * sn[..., None, None], 0.0)))
+    return pool.at[blks].set(qn), scales.at[blks].set(sn), err
+
+
 class Attention(Module):
     def __init__(self, cfg: GPT2Config, policy: Policy):
         h = cfg.hidden_size
@@ -394,10 +462,14 @@ class Attention(Module):
         b, s, h = x.shape
         d = h // cfg.num_heads
         kp, vp, tab = cache["k"], cache["v"], cache["tables"]
+        quant = "k_scale" in cache   # int8 pool: scales ride the cache
+        ks_pool = cache.get("k_scale")
+        vs_pool = cache.get("v_scale")
         bs_kv = kp.shape[2]
         m = tab.shape[1]
         L = m * bs_kv
         per_row = getattr(pos, "ndim", 0) == 1
+        qerr = None
         if per_row:
             # Decode: one token per row at its own depth. Clamp matches
             # the dense layout's update-slice clamp (a capacity-filled
@@ -410,40 +482,64 @@ class Attention(Module):
             if active is not None:
                 blk = jnp.where(active, blk, 0)
                 off = jnp.where(active, off, 0)
-            k_pool = kp.at[blk, :, off, :].set(
-                k[:, :, 0, :].astype(kp.dtype))
-            v_pool = vp.at[blk, :, off, :].set(
-                v[:, :, 0, :].astype(vp.dtype))
+            if quant:
+                # Block-granularity requant (see _quant_decode_write):
+                # the row's current block is rewritten whole so its
+                # per-(block, head) scale tracks the content absmax.
+                k_pool, ks_pool = _quant_decode_write(
+                    kp, ks_pool, blk, off, k[:, :, 0, :])
+                v_pool, vs_pool = _quant_decode_write(
+                    vp, vs_pool, blk, off, v[:, :, 0, :])
+            else:
+                k_pool = kp.at[blk, :, off, :].set(
+                    k[:, :, 0, :].astype(kp.dtype))
+                v_pool = vp.at[blk, :, off, :].set(
+                    v[:, :, 0, :].astype(vp.dtype))
         else:
             # Prefill chunk at a traced scalar offset: scatter the s
             # tokens through the table (pads beyond the prompt land in
             # the row's own bound blocks and are overwritten by decode
             # before any mask attends them — same argument as dense).
-            ppos = jnp.minimum(pos + jnp.arange(s), L - 1)
-            bi = jnp.clip(ppos // bs_kv, 0, m - 1)
-            blk = tab[:, bi]                                   # [b, s]
-            off = (ppos % bs_kv)[None, :]                      # [1, s]
-            k_pool = kp.at[blk, :, off, :].set(
-                k.transpose(0, 2, 1, 3).astype(kp.dtype))
-            v_pool = vp.at[blk, :, off, :].set(
-                v.transpose(0, 2, 1, 3).astype(vp.dtype))
+            if quant:
+                k_pool, ks_pool, ek = _quant_prefill_write(
+                    kp, ks_pool, tab, pos, k, s)
+                v_pool, vs_pool, ev = _quant_prefill_write(
+                    vp, vs_pool, tab, pos, v, s)
+                qerr = jnp.maximum(ek, ev)
+            else:
+                ppos = jnp.minimum(pos + jnp.arange(s), L - 1)
+                bi = jnp.clip(ppos // bs_kv, 0, m - 1)
+                blk = tab[:, bi]                               # [b, s]
+                off = (ppos % bs_kv)[None, :]                  # [1, s]
+                k_pool = kp.at[blk, :, off, :].set(
+                    k.transpose(0, 2, 1, 3).astype(kp.dtype))
+                v_pool = vp.at[blk, :, off, :].set(
+                    v.transpose(0, 2, 1, 3).astype(vp.dtype))
         use_decode_kernel = (not prefill and s == 1 and per_row
                              and _decode_flash_ok(cfg))
         if use_decode_kernel:
             # The kernel takes the POOLS + table directly (block-table
             # gather operand): rows only DMA table entries below their
-            # own length, inactive rows skip every block.
+            # own length, inactive rows skip every block. Int8 pools
+            # add the [N, H] scale operands and the kernel dequantizes
+            # inside its block loop — the int8 cache never round-trips
+            # through a dense bf16 view.
             from nezha_tpu.ops.pallas import flash_decode_attention
             lengths = pos + 1
             if active is not None:
                 lengths = jnp.where(active, lengths, 0)
-            out = flash_decode_attention(q, k_pool, v_pool, lengths,
-                                         block_tables=tab)
+            out = flash_decode_attention(
+                q, k_pool, v_pool, lengths, block_tables=tab,
+                block_scales=((ks_pool, vs_pool) if quant else None))
         else:
             # Composed path: gather the rows' blocks into the dense
             # [b, H, L, D] view and run the same masked attention the
             # dense layout uses (unbound table entries gather scratch —
             # always masked, since they sit at/past the row's length).
+            # Int8 pools dequantize the gathered blocks with the SAME
+            # expression as the kernel's in-loop dequant
+            # (ops.quant.dequantize_kv_block), so decode_impl="xla"
+            # stays a faithful escape hatch for the quantized cache.
             # Prefill cost note: the serve engine's chunks always reach
             # here (a traced pos can never take the static-pos-0 flash
             # branch — true for the DENSE engine too), and dense chunk
@@ -452,9 +548,17 @@ class Attention(Module):
             # new O(L) attention term. A diagonal-offset flash prefill
             # kernel (the engine docstring's "obvious next kernel")
             # would lift both layouts at once.
-            k_all = k_pool[tab].transpose(0, 2, 1, 3, 4).reshape(
+            if quant:
+                from nezha_tpu.ops.quant import dequantize_kv_block
+                k_all = dequantize_kv_block(k_pool[tab], ks_pool[tab],
+                                            q.dtype)
+                v_all = dequantize_kv_block(v_pool[tab], vs_pool[tab],
+                                            q.dtype)
+            else:
+                k_all, v_all = k_pool[tab], v_pool[tab]
+            k_all = k_all.transpose(0, 2, 1, 3, 4).reshape(
                 b, cfg.num_heads, L, d)
-            v_all = v_pool[tab].transpose(0, 2, 1, 3, 4).reshape(
+            v_all = v_all.transpose(0, 2, 1, 3, 4).reshape(
                 b, cfg.num_heads, L, d)
             if per_row:
                 abs_q = pos[:, None] + jnp.arange(s)[None, :]
@@ -467,7 +571,17 @@ class Attention(Module):
             out = ops.dot_product_attention(q, k_all.astype(q.dtype),
                                             v_all.astype(q.dtype),
                                             mask=mask)
-        states["cache"] = {"k": k_pool, "v": v_pool, "tables": tab}
+        new_cache = {"k": k_pool, "v": v_pool, "tables": tab}
+        if quant:
+            new_cache["k_scale"] = ks_pool
+            new_cache["v_scale"] = vs_pool
+            if qerr is not None:
+                # Per-chunk max-abs dequant error, harvested by the
+                # engine's prefill program into serve.kv.quant_error
+                # (a per-forward value, not running state — the engine
+                # strips it before rebinding caches).
+                new_cache["qerr"] = qerr
+        states["cache"] = new_cache
         out = out.transpose(0, 2, 1, 3).reshape(b, s, h)
         out = run_child(self.proj, "proj", variables, states, out,
                         training=training)

@@ -124,18 +124,35 @@ def render_serving_section(summary: Optional[dict]) -> List[str]:
             f"p90 {hg['p90'] * 1e3:.2f} ms  "
             f"p99 {hg['p99'] * 1e3:.2f} ms  (n={hg['count']}){hz}")
     if "serve.kv.prefix_hits_total" in counters:
-        # Paged-KV view (absent only in pre-paged captures): blocks
-        # resident at run end, prefix-cache hits (requests that took
-        # block references instead of re-prefilling), and
-        # copy-on-write block copies.
-        lines.append(
-            "  kv: "
+        # Paged-KV view (absent only in pre-paged captures): the KV
+        # storage dtype (from the quant_bits gauge; absent in
+        # pre-quantization captures), blocks + bytes resident at run
+        # end, prefix-cache hits (requests that took block references
+        # instead of re-prefilling), copy-on-write block copies, and —
+        # on int8 runs — the sampled per-block dequant error p99.
+        bits = gauges.get("serve.kv.quant_bits")
+        dtype = {8: "int8", 16: "bf16", 32: "f32"}.get(
+            int(bits) if bits else 0)
+        parts = ["  kv: "]
+        if dtype:
+            parts.append(f"dtype {dtype}  ")
+        parts.append(
             f"{gauges.get('serve.kv.blocks_used', 0):.0f} blocks "
-            f"resident  "
+            f"resident")
+        if "serve.kv.bytes_resident" in gauges:
+            parts.append(
+                f" ({gauges['serve.kv.bytes_resident'] / 1024:.1f} "
+                f"KiB)")
+        parts.append(
+            f"  "
             f"{counters.get('serve.kv.prefix_hits_total', 0):.0f} "
             f"prefix hits  "
             f"{counters.get('serve.kv.cow_copies_total', 0):.0f} "
             f"cow copies")
+        qe = hists.get("serve.kv.quant_error")
+        if qe and qe.get("count"):
+            parts.append(f"  quant err p99 {qe['p99']:.2e}")
+        lines.append("".join(parts))
     ph = hists.get("serve.prefill.bucket_len")
     if ph and ph.get("count"):
         # Bucket occupancy: how wide the static prefill programs

@@ -7,6 +7,7 @@ onto MXU/VPU, with Pallas TPU kernels for the hot fused ops in
 `nezha_tpu.ops.pallas`.
 """
 
+from nezha_tpu.ops import quant
 from nezha_tpu.ops.activations import relu, gelu, silu, softmax, log_softmax
 from nezha_tpu.ops.losses import (
     cross_entropy_with_logits,
@@ -25,6 +26,7 @@ from nezha_tpu.ops.attention import (
 )
 
 __all__ = [
+    "quant",
     "relu", "gelu", "silu", "softmax", "log_softmax",
     "cross_entropy_with_logits", "softmax_cross_entropy_with_integer_labels",
     "chunked_lm_cross_entropy", "lm_cross_entropy_from_hidden",

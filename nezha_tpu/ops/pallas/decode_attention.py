@@ -46,15 +46,54 @@ _NEG_BIG = -1e30
 _LANES = 128  # lengths ride lane-broadcast: [B, 128] int32
 
 
+def _scratch_init(m_scr, l_scr, acc_scr):
+    """Reset the online-softmax scratch at the first KV block — shared
+    by every decode-kernel variant."""
+    m_scr[:] = jnp.full_like(m_scr, _NEG_BIG)
+    l_scr[:] = jnp.zeros_like(l_scr)
+    acc_scr[:] = jnp.zeros_like(acc_scr)
+
+
+def _finalize(o_ref, l_scr, acc_scr):
+    """Write the normalized accumulator at the last KV block. The denom
+    guard keeps a zero-length (inactive) row — whose scratch never saw
+    a block — at an exact-zero output instead of 0/0."""
+    denom = jnp.maximum(l_scr[:, :1], 1e-30)
+    o_ref[0, 0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+def _block_step(q, k, v, length, ki, m_scr, l_scr, acc_scr, *,
+                scale: float, block_k: int):
+    """One KV block folded into the online-softmax scratch — the shared
+    math of every decode-kernel variant (dense, paged, paged-int8): the
+    variants differ only in WHERE ``k``/``v`` came from (BlockSpec
+    gather, in-kernel dequant), never in what happens to them."""
+    s = lax.dot_general(q.astype(k.dtype), k,
+                        (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+    kpos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(kpos < length, s, _NEG_BIG)            # partial block
+
+    m_prev = m_scr[:, :1]                                # [1, 1]
+    l_prev = l_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)                               # [1, bk]
+    corr = jnp.exp(m_prev - m_new)
+    l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * corr + lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+
 def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, m_scr, l_scr,
                    acc_scr, *, scale: float, block_k: int):
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
     def _init():
-        m_scr[:] = jnp.full_like(m_scr, _NEG_BIG)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
+        _scratch_init(m_scr, l_scr, acc_scr)
 
     length = len_ref[0, 0]
     # The block-skip that the dense masked path cannot see: blocks at or
@@ -65,31 +104,13 @@ def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, m_scr, l_scr,
 
     @pl.when(run)
     def _block():
-        q = q_ref[0, 0]                                      # [1, d]
-        k = k_ref[0, 0]                                      # [bk, d]
-        v = v_ref[0, 0]                                      # [bk, d]
-        s = lax.dot_general(q.astype(k.dtype), k,
-                            (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-        kpos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(kpos < length, s, _NEG_BIG)            # partial block
-
-        m_prev = m_scr[:, :1]                                # [1, 1]
-        l_prev = l_scr[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)                               # [1, bk]
-        corr = jnp.exp(m_prev - m_new)
-        l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * corr + lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        _block_step(q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], length, ki,
+                    m_scr, l_scr, acc_scr, scale=scale,
+                    block_k=block_k)
 
     @pl.when(ki == pl.num_programs(2) - 1)
-    def _finalize():
-        denom = jnp.maximum(l_scr[:, :1], 1e-30)
-        o_ref[0, 0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+    def _final():
+        _finalize(o_ref, l_scr, acc_scr)
 
 
 def _paged_decode_kernel(tab_ref, q_ref, k_ref, v_ref, len_ref, o_ref,
@@ -104,18 +125,62 @@ def _paged_decode_kernel(tab_ref, q_ref, k_ref, v_ref, len_ref, o_ref,
                    acc_scr, scale=scale, block_k=block_k)
 
 
-def _paged_call(q, k, v, lengths, block_tables, scale, interpret):
+def _paged_quant_decode_kernel(tab_ref, q_ref, k_ref, v_ref, ks_ref,
+                               vs_ref, len_ref, o_ref, m_scr, l_scr,
+                               acc_scr, *, scale: float, block_k: int):
+    """Paged kernel over an INT8 block pool: the per-(block, head) fp32
+    scale rides its own gathered (1, 1) operand and the dequant happens
+    right here in the block loop — int8 blocks never round-trip through
+    a dense bf16 cache. Dequantized tiles are cast to the query's dtype
+    (bf16 pools dot at the doubled MXU rate); softmax statistics and
+    the accumulator stay fp32, and the per-row length skip means a
+    skipped block never even DMAs its scale."""
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        _scratch_init(m_scr, l_scr, acc_scr)
+
+    length = len_ref[0, 0]
+    run = ki * block_k < length
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[0, 0]                                      # [1, d]
+        # THE dequant both attention paths share (see
+        # ops/quant.dequantize_kv_block): int8 * fp32 scale, cast to
+        # the compute dtype — the XLA gather fallback applies the same
+        # expression, so kernel and fallback see identical tiles.
+        k = (k_ref[0, 0].astype(jnp.float32)
+             * ks_ref[0, 0]).astype(q.dtype)                 # [bk, d]
+        v = (v_ref[0, 0].astype(jnp.float32)
+             * vs_ref[0, 0]).astype(q.dtype)                 # [bk, d]
+        _block_step(q, k, v, length, ki, m_scr, l_scr, acc_scr,
+                    scale=scale, block_k=block_k)
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _final():
+        _finalize(o_ref, l_scr, acc_scr)
+
+
+def _paged_call(q, k, v, lengths, block_tables, scale, interpret,
+                block_scales=None):
     """Paged layout: k/v are BLOCK POOLS ``[N, H, bs, D]`` and
     ``block_tables [B, M]`` maps row b's KV block ki to pool block
     ``block_tables[b, ki]``. The table rides as a SCALAR-PREFETCH
     operand (pltpu.PrefetchScalarGridSpec) so the grid's KV dimension
     gathers blocks through the table in its index map — the kernel body
-    is unchanged, per-row length skipping included."""
+    is unchanged, per-row length skipping included. With
+    ``block_scales`` (int8 pools) the per-(block, head) fp32 scales are
+    gathered through the SAME index map as (1, 1) operands and the
+    kernel dequantizes each tile in the block loop."""
     b, h, _, d = q.shape
     n_blocks, _, bs, _ = k.shape
     m = block_tables.shape[1]
-    kernel = functools.partial(_paged_decode_kernel, scale=scale,
-                               block_k=bs)
+    quant = block_scales is not None
+    kernel = functools.partial(
+        _paged_quant_decode_kernel if quant else _paged_decode_kernel,
+        scale=scale, block_k=bs)
     kwargs = {}
     if not interpret:
         kwargs["compiler_params"] = _compiler_params(
@@ -123,17 +188,28 @@ def _paged_call(q, k, v, lengths, block_tables, scale, interpret):
     len2d = jnp.broadcast_to(
         jnp.clip(jnp.asarray(lengths, jnp.int32), 0, m * bs)[:, None],
         (b, _LANES))
+    kv_spec = pl.BlockSpec((1, 1, bs, d),
+                           lambda b_, h_, ki, tab: (tab[b_, ki], h_, 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, 1, 1, d), lambda b_, h_, ki, tab: (b_, h_, 0, 0)),
+        kv_spec,
+        kv_spec,
+    ]
+    operands = [q, k, v]
+    if quant:
+        scale_spec = pl.BlockSpec(
+            (1, 1), lambda b_, h_, ki, tab: (tab[b_, ki], h_))
+        in_specs += [scale_spec, scale_spec]
+        ks, vs = block_scales
+        operands += [jnp.asarray(ks, jnp.float32),
+                     jnp.asarray(vs, jnp.float32)]
+    in_specs.append(
+        pl.BlockSpec((1, _LANES), lambda b_, h_, ki, tab: (b_, 0)))
+    operands.append(len2d)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b, h, m),
-        in_specs=[
-            pl.BlockSpec((1, 1, 1, d), lambda b_, h_, ki, tab: (b_, h_, 0, 0)),
-            pl.BlockSpec((1, 1, bs, d),
-                         lambda b_, h_, ki, tab: (tab[b_, ki], h_, 0, 0)),
-            pl.BlockSpec((1, 1, bs, d),
-                         lambda b_, h_, ki, tab: (tab[b_, ki], h_, 0, 0)),
-            pl.BlockSpec((1, _LANES), lambda b_, h_, ki, tab: (b_, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, 1, d),
                                lambda b_, h_, ki, tab: (b_, h_, 0, 0)),
         scratch_shapes=[pltpu.VMEM((1, _LANES), jnp.float32),
@@ -146,14 +222,14 @@ def _paged_call(q, k, v, lengths, block_tables, scale, interpret):
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
         **kwargs,
-    )(jnp.asarray(block_tables, jnp.int32), q, k, v, len2d)
+    )(jnp.asarray(block_tables, jnp.int32), *operands)
 
 
 def flash_decode_attention(q, k, v, lengths,
                            scale: Optional[float] = None,
                            block_k: Optional[int] = None,
                            interpret: Optional[bool] = None,
-                           block_tables=None):
+                           block_tables=None, block_scales=None):
     """q ``[B, H, 1, D]``, k/v ``[B, H, L, D]``, lengths ``[B]`` int32
     -> ``[B, H, 1, D]``.
 
@@ -174,6 +250,17 @@ def flash_decode_attention(q, k, v, lengths,
     own depth. ``block_k`` is ignored (the pool's block_size IS the KV
     block).
 
+    With ``block_scales`` (paged only — a ``(k_scales, v_scales)`` pair
+    of ``[num_blocks, H]`` fp32 arrays) the pools are INT8 and each
+    gathered tile is dequantized INSIDE the block loop
+    (``tile.astype(f32) * scale -> q.dtype`` — the exact expression of
+    ``ops.quant.dequantize_kv_block``, so the composed XLA fallback
+    dequantizes identically): dots run in the query's dtype over
+    dequantized tiles, softmax statistics and the accumulator stay
+    fp32, and skipped blocks never load data OR scales. (On real TPU
+    hardware int8 tiles want ``block_size * D`` at or above the int8
+    native tile — tiny test shapes run in interpret mode.)
+
     ``block_k`` defaults to the largest divisor of ``L`` that is <= 256
     (KV pools are padded to power-of-two-ish capacities, so real shapes
     get real blocks). ``interpret=None`` auto-selects: compiled on TPU,
@@ -186,6 +273,9 @@ def flash_decode_attention(q, k, v, lengths,
             f"s_q={s_q} (use flash_attention for prefill/training)")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if block_scales is not None and block_tables is None:
+        raise ValueError("block_scales requires block_tables (int8 is "
+                         "a paged-pool format)")
     if block_tables is not None:
         if k.shape != v.shape or k.shape[1] != h or k.shape[3] != d:
             raise ValueError(
@@ -195,9 +285,16 @@ def flash_decode_attention(q, k, v, lengths,
             raise ValueError(
                 f"block_tables {block_tables.shape} does not match "
                 f"batch {b}")
+        if block_scales is not None:
+            ks, vs = block_scales
+            want = (k.shape[0], h)
+            if tuple(ks.shape) != want or tuple(vs.shape) != want:
+                raise ValueError(
+                    f"block_scales {ks.shape}/{vs.shape} must be "
+                    f"[num_blocks, H] = {want}")
         scale = scale if scale is not None else 1.0 / (d ** 0.5)
         return _paged_call(q, k, v, lengths, block_tables, scale,
-                           interpret)
+                           interpret, block_scales=block_scales)
     if k.shape != v.shape or k.shape[:2] != (b, h) or k.shape[3] != d:
         raise ValueError(f"k/v {k.shape}/{v.shape} do not match q {q.shape}")
     L = k.shape[2]

@@ -36,25 +36,18 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-_QMAX = 127.0
+# The quantize/dequantize core lives in nezha_tpu.ops.quant — ONE
+# audited implementation shared with the int8 KV-cache path
+# (serve/slots.py), regression-pinned bit-identical to the
+# pre-extraction in-module version. The private aliases keep this
+# module's internal call sites (and any external ones) stable.
+from nezha_tpu.ops.quant import QMAX as _QMAX
+from nezha_tpu.ops.quant import dequantize as _dequantize
+from nezha_tpu.ops.quant import quantize_blocks as _quantize_blocks
 
 # Leaves below this ride the exact path (EQuARX-style size cutoff); shared
 # default for quantized_all_reduce_mean and its telemetry accounting.
 DEFAULT_MIN_NUMEL = 4096
-
-
-def _quantize_blocks(x: jax.Array, block: int):
-    """Symmetric per-block int8 quantization of ``x`` [..., k*block] ->
-    (int8 [..., k, block], fp32 scales [..., k, 1])."""
-    xb = x.reshape(*x.shape[:-1], x.shape[-1] // block, block)
-    amax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
-    scale = jnp.where(amax > 0, amax / _QMAX, 1.0).astype(jnp.float32)
-    q = jnp.clip(jnp.round(xb / scale), -_QMAX, _QMAX).astype(jnp.int8)
-    return q, scale
-
-
-def _dequantize(q: jax.Array, scale: jax.Array) -> jax.Array:
-    return q.astype(jnp.float32) * scale
 
 
 def quantize_roundtrip(x: jax.Array, block: int = 512) -> jax.Array:

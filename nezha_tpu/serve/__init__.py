@@ -13,9 +13,12 @@ serve stack replaces the batch lifecycle with a slot lifecycle:
   advance, and a prefix-reuse trie lets a request whose prompt prefix
   matches cached blocks take REFERENCES instead of re-prefilling
   (copy-on-write protects shared blocks; exhaustion is typed
-  backpressure, never a crash). ``SlotPool`` is the classic dense
-  ``[B_max, H, L_max, D]`` worst-case-reservation layout
-  (``ServeConfig.kv_layout="dense"``).
+  backpressure, never a crash). ``ServeConfig.kv_dtype="int8"`` stores
+  blocks as int8 with per-(block, head) fp32 absmax scales (the shared
+  ``ops/quant.py`` core): ~2x resident requests at the same device
+  budget, with the dequant fused into the flash-decode kernel's block
+  loop. ``SlotPool`` is the classic dense ``[B_max, H, L_max, D]``
+  worst-case-reservation layout (``ServeConfig.kv_layout="dense"``).
 - ``sampling``: per-row temperature / top-k / top-p as traced arrays, so
   one compiled program serves every mix of requests (top-k masks by
   per-row k under a static ``k_max`` cap — ``lax.top_k``'s k is static).

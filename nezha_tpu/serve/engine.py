@@ -168,6 +168,18 @@ class ServeConfig:
     kv_num_blocks: Optional[int] = None
     prefix_cache: bool = True
     kv_eviction: str = "lru"
+    # KV storage dtype. "bf16" (default) stores blocks in cache_dtype —
+    # bit-identical to the pre-quantization engine. "int8" (paged
+    # layout only) stores K/V blocks as int8 with one fp32 absmax
+    # scale per (block, head) (ops/quant.py — the EQuARX recipe the
+    # wire collectives already use): ~2x the resident blocks at the
+    # same device budget (scale overhead 4/(block_size*D) per
+    # element), at a bounded per-block dequant error the
+    # serve.kv.quant_error histogram samples. The dequant is fused
+    # into the flash-decode kernel's block loop (and applied
+    # identically on the gathered XLA fallback), so int8 blocks never
+    # round-trip through a dense bf16 cache.
+    kv_dtype: str = "bf16"
 
     def __post_init__(self):
         if self.max_batch_size < 1:
@@ -187,6 +199,14 @@ class ServeConfig:
             raise ValueError(
                 f"kv_eviction must be 'lru' or 'none', got "
                 f"{self.kv_eviction!r}")
+        if self.kv_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'bf16' or 'int8', got "
+                f"{self.kv_dtype!r}")
+        if self.kv_dtype == "int8" and self.kv_layout != "paged":
+            raise ValueError(
+                "kv_dtype='int8' requires kv_layout='paged' (scales "
+                "are per-block state; the dense pool has no blocks)")
         if self.decode_horizon < 1:
             raise ValueError(
                 f"decode_horizon must be >= 1, got {self.decode_horizon}")
@@ -258,12 +278,14 @@ class Engine:
         self.vocab = model.cfg.vocab_size
         self.k_max = min(cfg.k_max, self.vocab)
         self.paged = cfg.kv_layout == "paged"
+        self.kv_quant = cfg.kv_dtype == "int8"
         if self.paged:
             self.pool = PagedSlotPool(
                 model, cfg.max_batch_size, cfg.max_len, cfg.cache_dtype,
                 block_size=cfg.kv_block_size,
                 num_blocks=cfg.kv_num_blocks,
-                prefix_cache=cfg.prefix_cache, eviction=cfg.kv_eviction)
+                prefix_cache=cfg.prefix_cache, eviction=cfg.kv_eviction,
+                quantized=self.kv_quant)
             # Host mirrors of each row's next write position and
             # remaining token budget (set at prefill, advanced/decayed
             # by the block's emitted count): the lazy block binder must
@@ -313,7 +335,8 @@ class Engine:
         # — shapes are static, so the "1 step + len(prefill_buckets)
         # programs" contract is layout-invariant.
         self._prefill_fns = {w: _build_prefill(self.model, w,
-                                               paged=self.paged)
+                                               paged=self.paged,
+                                               quantized=self.kv_quant)
                              for w in cfg.prefill_buckets}
         self._step_fn = _build_step(self.model, self.k_max, cfg.pad_id,
                                     cfg.decode_horizon,
@@ -441,6 +464,7 @@ class Engine:
             self.host_positions[slot] = n
             self.host_budgets[slot] = budget
         obs.counter("serve.prefill.chunks_total").inc(len(chunks))
+        qerrs: List[Any] = []
         for off, ln, width in chunks:
             obs.histogram("serve.prefill.bucket_len").observe(width)
             padded = np.zeros((1, width), np.int32)
@@ -465,9 +489,21 @@ class Engine:
                     self._prefill_fns[width], self.variables,
                     self.pool.caches, jnp.asarray(padded),
                     *scalars, *state)
+            if self.kv_quant:
+                # The quantized prefill program's extra output: this
+                # chunk's max-abs dequant error. Collect the DEVICE
+                # scalar now, read after every chunk has been
+                # dispatched — the histogram observe must not serialize
+                # chunk k+1's dispatch behind chunk k's completion.
+                out, err = out[:-1], out[-1]
+                qerrs.append(err)
             (self.pool.caches, self.last_logits, self.positions, self.keys,
              self.temps, self.top_ks, self.top_ps,
              self.eos_ids, self.budgets) = out
+        if self.kv_quant:
+            hist = obs.histogram("serve.kv.quant_error")
+            for err in qerrs:
+                hist.observe(float(err))
         if self.paged:
             # Index this prompt's full blocks for future prefix hits
             # (the trie takes its own references — the cache outlives
@@ -571,7 +607,8 @@ class Engine:
         return self.executor.stats()
 
 
-def _build_prefill(model, width: int, paged: bool = False):
+def _build_prefill(model, width: int, paged: bool = False,
+                   quantized: bool = False):
     def core(variables, caches, tables, tokens, length, slot, pos,
              seed, temperature, top_k, top_p, eos_id, budget,
              last_logits, positions, keys, temps, top_ks, top_ps,
@@ -594,8 +631,10 @@ def _build_prefill(model, width: int, paged: bool = False):
             zero = jnp.zeros((), jnp.int32)
             tab_row = lax.dynamic_slice(
                 tables, (slot, zero), (1, tables.shape[1]))
-            rows = [{"k": pool["k"], "v": pool["v"], "tables": tab_row}
-                    for pool in caches]
+            # Dict-merge keeps every pool leaf (int8 pools carry
+            # k_scale/v_scale rows alongside k/v) riding into the model
+            # and back out — the scales are cache state like any other.
+            rows = [{**pool, "tables": tab_row} for pool in caches]
         else:
             rows = [{"k": read_slot(pool["k"], slot),
                      "v": read_slot(pool["v"], slot)}
@@ -604,7 +643,18 @@ def _build_prefill(model, width: int, paged: bool = False):
                                      cache=rows, pos=pos)
         new_rows = _caches_from_states(model, states, rows)
         if paged:
-            new_caches = [{"k": r["k"], "v": r["v"]} for r in new_rows]
+            keys_kept = tuple(caches[0].keys())
+            new_caches = [{kk: r[kk] for kk in keys_kept}
+                          for r in new_rows]
+            qerr = None
+            if quantized:
+                # Max-abs dequant error across layers (each attention
+                # write reported its chunk's error) — returned as one
+                # extra scalar output the engine host-observes into
+                # serve.kv.quant_error.
+                errs = [r["qerr"] for r in new_rows if "qerr" in r]
+                qerr = jnp.max(jnp.stack(errs)) if errs \
+                    else jnp.zeros((), jnp.float32)
         else:
             new_caches = [
                 {"k": write_slot(pool["k"], rk["k"], slot),
@@ -624,15 +674,18 @@ def _build_prefill(model, width: int, paged: bool = False):
         # Every chunk overwrites the whole per-slot state; only the final
         # chunk's values survive to decode (positions advances to the
         # running prefix length either way).
-        return (new_caches,
-                set_row(last_logits, row),
-                set_row(positions, pos + length),
-                set_row(keys, key),
-                set_row(temps, temperature),
-                set_row(top_ks, top_k),
-                set_row(top_ps, top_p),
-                set_row(eos_ids, eos_id),
-                set_row(budgets, budget))
+        out = (new_caches,
+               set_row(last_logits, row),
+               set_row(positions, pos + length),
+               set_row(keys, key),
+               set_row(temps, temperature),
+               set_row(top_ks, top_k),
+               set_row(top_ps, top_p),
+               set_row(eos_ids, eos_id),
+               set_row(budgets, budget))
+        if paged and quantized:
+            return out + (qerr,)
+        return out
 
     # One source for both layouts; only the operand list differs (the
     # paged variant takes the uploaded block tables after the caches).
@@ -688,8 +741,10 @@ def _build_step(model, k_max: int, pad_id: int, horizon: int,
                                           top_ks, top_ps, k_max)
         tok = jnp.where(emit, tok, pad_id)
         if paged:
-            rows = [{"k": c["k"], "v": c["v"], "tables": tables}
-                    for c in caches]
+            # Dict-merge: int8 pools' k_scale/v_scale leaves thread
+            # through with k/v (the model's quantized write returns
+            # updated scale buffers the scan must carry).
+            rows = [{**c, "tables": tables} for c in caches]
         else:
             rows = caches
         logits, states = model.apply(variables, tok[:, None],
@@ -697,7 +752,9 @@ def _build_step(model, k_max: int, pad_id: int, horizon: int,
                                      pos=positions, active=emit)
         new_rows = _caches_from_states(model, states, rows)
         if paged:
-            new_caches = [{"k": r["k"], "v": r["v"]} for r in new_rows]
+            keys_kept = tuple(caches[0].keys())
+            new_caches = [{kk: r[kk] for kk in keys_kept}
+                          for r in new_rows]
         else:
             new_caches = new_rows
         row_logits = logits[:, -1, :]

@@ -136,6 +136,15 @@ def register_serve_instruments() -> None:
     obs.counter("serve.kv.prefix_hits_total")
     obs.counter("serve.kv.cow_copies_total")
     obs.gauge("serve.kv.blocks_used")
+    # KV quantization instruments (schema-pinned, layout/dtype
+    # invariant): device bytes the resident KV actually holds (the
+    # capacity lever int8 moves), the storage width in bits (8 = int8,
+    # 16 = bf16, 32 = f32 — lets the report label the dtype), and the
+    # per-block max-abs dequant error sampled at each prefill-chunk
+    # write (empty on bf16 runs — nothing is quantized).
+    obs.gauge("serve.kv.bytes_resident")
+    obs.gauge("serve.kv.quant_bits")
+    obs.histogram("serve.kv.quant_error")
     obs.gauge("serve.queue_depth")
     obs.gauge("serve.batch_occupancy")
     obs.histogram("serve.ttft_s")
@@ -181,6 +190,10 @@ class Scheduler:
         # host gap WITHIN continuous decoding, never idle waits.
         self._host_gap_t: Optional[float] = None
         register_serve_instruments()
+        pool = engine.pool
+        obs.gauge("serve.kv.quant_bits").set(
+            8 if pool.quantized
+            else 8 * int(np.dtype(pool.dtype).itemsize))
 
     # ------------------------------------------------------- admission
     def submit(self, req: Request) -> str:
@@ -256,6 +269,8 @@ class Scheduler:
                 self.engine.pool.occupancy)
             obs.gauge("serve.kv.blocks_used").set(
                 self.engine.pool.blocks_used)
+            obs.gauge("serve.kv.bytes_resident").set(
+                self.engine.pool.bytes_resident)
             return emitted
 
     def run_until_idle(self, max_iters: Optional[int] = None) -> int:

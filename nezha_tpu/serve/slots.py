@@ -88,6 +88,7 @@ class SlotPool:
     """
 
     paged = False
+    quantized = False
 
     def __init__(self, model, capacity: int, max_len: int,
                  dtype=jnp.bfloat16):
@@ -99,6 +100,10 @@ class SlotPool:
         self.capacity = capacity
         self.max_len = max_len
         self.dtype = dtype
+        cfg = model.cfg
+        self._slot_bytes = (2 * cfg.num_layers * cfg.num_heads * max_len
+                            * (cfg.hidden_size // cfg.num_heads)
+                            * jnp.dtype(dtype).itemsize)
         self.caches = init_cache(model, capacity, max_len, dtype)
         # LIFO free list: the most-recently-freed slot is re-used first,
         # keeping the active rows clustered low (cheap occupancy reads).
@@ -135,6 +140,14 @@ class SlotPool:
         in slot units so the ``serve.kv.blocks_used`` gauge stays
         meaningful across layouts."""
         return self.num_active
+
+    @property
+    def bytes_resident(self) -> int:
+        """Device bytes the active reservations hold (the
+        ``serve.kv.bytes_resident`` gauge): dense reserves a worst-case
+        ``max_len`` K/V row pair per active slot, whatever was actually
+        written."""
+        return self.num_active * self._slot_bytes
 
 
 def read_slot(pool_leaf, slot):
@@ -294,7 +307,10 @@ class PrefixTrie:
 def _copy_block(caches: list, src, dst) -> list:
     """Device-side block copy across every layer's K and V pool:
     ``caches[l][kv] [N, H, bs, D]`` with block ``src`` copied over
-    block ``dst``. The COW move. Jitted once per pool shape (src/dst
+    block ``dst``. The COW move. Leading-axis tree_map means every
+    block-indexed leaf moves together — int8 pools' ``[N, H]`` scale
+    rows copy with their blocks in the same call (the "a block and its
+    scale row move together" invariant). Jitted once per pool shape (src/dst
     cross as 0-d arrays so indices never recompile); donation makes it
     an in-place rewrite of one block, not a pool copy. Deliberately NOT
     routed through the engine executor: the frozen-program contract
@@ -322,6 +338,20 @@ class PagedSlotPool:
     state: the block free list, per-block ref counts, per-slot bound
     counts, and the prefix trie.
 
+    With ``quantized=True`` (``ServeConfig.kv_dtype="int8"``) the K/V
+    pools store int8 and each layer carries ``k_scale``/``v_scale``
+    fp32 buffers shaped ``[num_blocks, H]`` — one absmax scale per
+    (block, head), written by the in-program block-granularity
+    quantizer (models/gpt2.py) and consumed by the flash-decode
+    kernel's in-loop dequant. Because scales are block-indexed leaves
+    of the SAME caches pytree, every lifecycle move is shared: COW
+    copies a block's scale row with it, freeing/rebinding a block
+    implicitly retires its stale scale (the next occupant's first
+    write recomputes it — stale positions are zeroed before the
+    block's absmax is taken, so a previous occupant can never inflate
+    the new scale), and the stale-KV poisoning regression covers scale
+    rows too.
+
     Invariants (the chaos tests' leak check asserts them):
 
     - block 0 is scratch: never allocated, never ref-counted;
@@ -337,7 +367,8 @@ class PagedSlotPool:
     def __init__(self, model, capacity: int, max_len: int,
                  dtype=jnp.bfloat16, *, block_size: int = 16,
                  num_blocks: Optional[int] = None,
-                 prefix_cache: bool = True, eviction: str = "lru"):
+                 prefix_cache: bool = True, eviction: str = "lru",
+                 quantized: bool = False):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         if max_len < 1:
@@ -364,12 +395,37 @@ class PagedSlotPool:
         self.num_blocks = num_blocks
         self.prefix_cache_enabled = prefix_cache
         self.eviction = eviction
+        self.quantized = quantized
         cfg = model.cfg
         d = cfg.hidden_size // cfg.num_heads
         shape = (num_blocks, cfg.num_heads, block_size, d)
-        self.caches = [{"k": jnp.zeros(shape, dtype),
-                        "v": jnp.zeros(shape, dtype)}
-                       for _ in range(cfg.num_layers)]
+        if quantized:
+            # ``ServeConfig.kv_dtype="int8"``: K/V blocks store int8
+            # plus one fp32 absmax scale per (block, head) — the
+            # ``[num_blocks, H]`` scale buffers ride IN the caches
+            # pytree, so everything that moves a block (program
+            # donation, COW copy, checkpoint of the tree structure)
+            # moves its scale row with it by construction. Zero-init:
+            # q = 0 with scale 0 dequantizes to exact zeros, same as
+            # the bf16 pool's zero init.
+            sshape = (num_blocks, cfg.num_heads)
+            self.caches = [{"k": jnp.zeros(shape, jnp.int8),
+                            "v": jnp.zeros(shape, jnp.int8),
+                            "k_scale": jnp.zeros(sshape, jnp.float32),
+                            "v_scale": jnp.zeros(sshape, jnp.float32)}
+                           for _ in range(cfg.num_layers)]
+        else:
+            self.caches = [{"k": jnp.zeros(shape, dtype),
+                            "v": jnp.zeros(shape, dtype)}
+                           for _ in range(cfg.num_layers)]
+        kv_bytes = (cfg.num_heads * block_size * d
+                    * (1 if quantized else jnp.dtype(dtype).itemsize))
+        scale_bytes = cfg.num_heads * 4 if quantized else 0
+        # Per-block device footprint (k + v + scales, all layers) — the
+        # serve.kv.bytes_resident gauge's unit and the equal-memory
+        # bench's conversion rate between int8 and bf16 block budgets.
+        self.bytes_per_block = 2 * cfg.num_layers * (kv_bytes
+                                                     + scale_bytes)
         self.tables_host = np.zeros((capacity, self.blocks_per_slot),
                                     np.int32)
         self._free_slots: List[int] = list(range(capacity - 1, -1, -1))
@@ -431,6 +487,15 @@ class PagedSlotPool:
         """Non-free, non-scratch blocks (slot-bound + trie-cached) —
         the ``serve.kv.blocks_used`` gauge value."""
         return self.num_blocks - 1 - len(self._free_blocks)
+
+    @property
+    def bytes_resident(self) -> int:
+        """Device bytes the resident blocks hold (K/V data + scale rows
+        when quantized) — the ``serve.kv.bytes_resident`` gauge. The
+        capacity lever in one number: at the same byte budget an int8
+        pool holds ~2x the blocks of a bf16 pool (scale overhead is
+        ``4 / (block_size * D)`` per element)."""
+        return self.blocks_used * self.bytes_per_block
 
     @property
     def trie_only_blocks(self) -> int:
@@ -578,7 +643,30 @@ class PagedSlotPool:
     def leak_check(self) -> None:
         """Assert the ref-count books balance: every non-free block is
         explained by slot bindings + trie nodes, and freeing everything
-        would empty the pool. Chaos tests call this after drain."""
+        would empty the pool. Chaos tests call this after drain.
+
+        Quantized pools additionally assert the scale buffers kept
+        their block-indexed shape: a block and its scale row share one
+        index into the same pytree, which is what makes "COW carries
+        scales" and "eviction frees scales" true by construction — a
+        shape drift here would mean some path rebuilt the caches tree
+        without them."""
+        if self.quantized:
+            for li, layer in enumerate(self.caches):
+                for kv in ("k", "v"):
+                    if jnp.dtype(layer[kv].dtype) != jnp.int8:
+                        raise AssertionError(
+                            f"layer {li} {kv} pool dtype drifted to "
+                            f"{layer[kv].dtype} (expected int8)")
+                    sc = layer.get(f"{kv}_scale")
+                    if sc is None or tuple(sc.shape) != (
+                            self.num_blocks, layer[kv].shape[1]):
+                        raise AssertionError(
+                            f"layer {li} {kv}_scale buffer missing or "
+                            f"mis-shaped: "
+                            f"{None if sc is None else sc.shape} "
+                            f"(expected [{self.num_blocks}, "
+                            f"{layer[kv].shape[1]}])")
         expect = np.zeros((self.num_blocks,), np.int64)
         for slot in range(self.capacity):
             if slot in self._free_slots:

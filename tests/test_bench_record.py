@@ -7,10 +7,17 @@ config benches stubbed out (their numerics are covered elsewhere; this
 file pins the record/baseline plumbing)."""
 
 import json
+import os
+import sys
 
 import pytest
 
 import bench
+
+_TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
 
 
 # ------------------------------------------------------- backend init
@@ -144,3 +151,65 @@ def test_bench_corrupt_baseline_never_overwritten(stubbed_bench,
     assert rec["vs_baseline"] == 1.0
     # the corrupt file was left for a human, not reset to this run
     assert path.read_text() == "{torn write"
+
+
+# ------------------------------------------- committed-record hygiene
+def test_committed_bench_records_pass_hygiene_check():
+    """THE tier-1 wire for tools/check_bench_record.py: every committed
+    BENCH_*.json in the repo root must be a platform-labeled, schema-
+    valid measurement — or be explicitly superseded in BENCH_NOTES.md
+    (the r03–r05 crash records). A future crash record fails here."""
+    import os
+
+    from check_bench_record import check_dir, superseded_records
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert check_dir(root) == []
+    # The known crash records are superseded, not silently valid.
+    assert {"BENCH_r03.json", "BENCH_r04.json",
+            "BENCH_r05.json"} <= superseded_records(root)
+
+
+def test_bench_record_checker_flags_crash_and_unlabeled(tmp_path):
+    """A crash record (rc != 0), an rc=0 run with no parsed metric, and
+    an unlabeled measurement all fail; listing the crash under the
+    notes' Superseded heading exempts exactly that file."""
+    from check_bench_record import check_dir, check_record
+    crash = tmp_path / "BENCH_r99.json"
+    crash.write_text(json.dumps(
+        {"n": 99, "cmd": "python bench.py", "rc": 1,
+         "tail": "RuntimeError: Unable to initialize backend 'axon'",
+         "parsed": None}))
+    assert any("CRASH RECORD" in e for e in check_record(str(crash)))
+
+    silent = tmp_path / "BENCH_s.json"
+    silent.write_text(json.dumps(
+        {"n": 1, "cmd": "x", "rc": 0, "tail": "", "parsed": None}))
+    assert any("no parsed metric" in e for e in check_record(str(silent)))
+
+    unlabeled = tmp_path / "BENCH_u.json"
+    unlabeled.write_text(json.dumps(
+        {"n": 1, "cmd": "x", "rc": 0, "tail": "",
+         "parsed": {"metric": "m", "value": 1.0}}))
+    assert any("no platform label" in e
+               for e in check_record(str(unlabeled)))
+
+    not_json = tmp_path / "BENCH_torn.json"
+    not_json.write_text("{torn")
+    assert any("not valid JSON" in e for e in check_record(str(not_json)))
+
+    good = tmp_path / "BENCH_ok.json"
+    good.write_text(json.dumps(
+        {"n": 1, "cmd": "x", "rc": 0, "tail": "",
+         "parsed": {"metric": "m", "value": 1.0}, "platform": "cpu"}))
+    assert check_record(str(good)) == []
+
+    # Directory sweep: everything flagged until the notes supersede the
+    # bad ones — and ONLY the listed files are exempted.
+    assert check_dir(str(tmp_path)) != []
+    (tmp_path / "BENCH_NOTES.md").write_text(
+        "# notes\n\n## Superseded records\n\n"
+        "- BENCH_r99.json — crash record\n"
+        "- BENCH_s.json — printed nothing\n"
+        "- BENCH_u.json — unlabeled legacy\n"
+        "- BENCH_torn.json — torn write\n")
+    assert check_dir(str(tmp_path)) == []

@@ -50,13 +50,22 @@ _SERVE_COUNTERS = {"serve.admitted_total", "serve.rejected_total",
                    "serve.kv.prefix_hits_total",
                    "serve.kv.cow_copies_total"}
 _SERVE_GAUGES = {"serve.queue_depth", "serve.batch_occupancy",
-                 "serve.kv.blocks_used"}
+                 "serve.kv.blocks_used",
+                 # KV quantization (PR 9): device bytes the resident KV
+                 # holds and the storage width in bits (8 = int8 blocks
+                 # + per-block scales, 16/32 = plain bf16/f32 pools).
+                 # Layout/dtype-invariant: every serving run reports
+                 # them.
+                 "serve.kv.bytes_resident", "serve.kv.quant_bits"}
 _SERVE_HISTOGRAMS = {"serve.ttft_s", "serve.tpot_s",
                      "serve.prefill.bucket_len",
                      # Decode-horizon instruments (PR 5): host time
                      # between consecutive step dispatches, and the
                      # tokens-per-dispatch ceiling each block ran at.
-                     "serve.host_gap_s", "serve.decode.horizon"}
+                     "serve.host_gap_s", "serve.decode.horizon",
+                     # Per-block max-abs dequant error sampled at each
+                     # prefill-chunk write (count 0 on bf16 runs).
+                     "serve.kv.quant_error"}
 
 # Router-run schema (nezha-serve --replicas N / benchmarks/serving.py
 # --replicas): the supervisor/router pair pre-registers this full set,
