@@ -1,0 +1,33 @@
+"""nezha_tpu.analysis — static invariant checking for this repo.
+
+Every performance and robustness claim the serving/training stack ships
+rests on contracts that used to be enforced only at runtime (or by three
+bespoke regex walkers in ``tools/``): the device-resident decode loop
+dies if a host sync sneaks into a program body, the paged pool dies if
+a donated caches pytree is touched after dispatch, the scheduler's free
+list corrupts if an unlocked thread writes it. This package checks
+those contracts AT ANALYSIS TIME — the same compile-it-and-verify-it
+move the related work applies to collectives programs (GC3,
+arXiv:2201.11840), applied to the codebase itself.
+
+Architecture: one :class:`~nezha_tpu.analysis.index.SourceIndex` (every
+file parsed once) + a pluggable rule registry
+(:mod:`~nezha_tpu.analysis.core`) + a committed suppression baseline
+(:mod:`~nezha_tpu.analysis.baseline`). The ``nezha-lint`` CLI
+(``nezha_tpu/cli/lint.py``) and the tier-1 suite drive it; the legacy
+``tools/check_*.py`` entry points are shims over the same rules.
+
+Stdlib-only: rules parse source, they never import it — fixture trees
+in tests lint fine without jax, and the whole repo lints in ~1 s.
+"""
+
+from nezha_tpu.analysis.baseline import (BaselineError, apply_baseline,
+                                         load_baseline, write_baseline)
+from nezha_tpu.analysis.core import (Finding, Rule, RULES, load_rules,
+                                     run_rules)
+from nezha_tpu.analysis.index import SourceIndex
+
+__all__ = [
+    "SourceIndex", "Finding", "Rule", "RULES", "load_rules", "run_rules",
+    "BaselineError", "load_baseline", "apply_baseline", "write_baseline",
+]

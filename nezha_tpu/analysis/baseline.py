@@ -1,0 +1,117 @@
+"""The suppression baseline: accepted findings, each with a reason.
+
+``tools/lint_baseline.json`` is the committed ledger of findings the
+repo has LOOKED AT and decided to keep — never a mute button. Shape:
+
+    {"version": 1,
+     "suppressions": [
+       {"key": "<rule>:<file>:<symbol>:<detail>",
+        "justification": "one line on why this is intentionally kept"}]}
+
+Keys are line-free (see :class:`~nezha_tpu.analysis.core.Finding`), so
+unrelated edits don't churn the file — but the key dies with the code
+it describes, and a STALE entry (key matching no current finding) fails
+the lint: a suppression must never outlive its violation, or the next
+identical violation would be silently pre-forgiven.
+
+An entry with an empty/placeholder justification is invalid: the whole
+point is that every accepted finding carries its one-line why."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Sequence, Tuple
+
+from nezha_tpu.analysis.core import Finding
+
+DEFAULT_BASELINE = os.path.join("tools", "lint_baseline.json")
+VERSION = 1
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file — fails the lint with its message."""
+
+
+PLACEHOLDER_JUSTIFICATION = ("TODO: justify (the baseline will not "
+                             "load until this is a real reason)")
+
+
+def load_baseline(path: str, strict: bool = True) -> Dict[str, str]:
+    """-> {key: justification}. A missing file is an empty baseline;
+    a malformed one raises :class:`BaselineError`. ``strict=False``
+    accepts placeholder/empty justifications (still rejecting
+    structural damage) — ONLY for regeneration, which must read the
+    existing entries' text to preserve it, never for suppression."""
+    if not os.path.isfile(path):
+        return {}
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except ValueError as e:
+        raise BaselineError(f"{path}: not valid JSON ({e})")
+    if not isinstance(data, dict) or data.get("version") != VERSION:
+        raise BaselineError(
+            f"{path}: expected an object with version == {VERSION}")
+    sups = data.get("suppressions")
+    if not isinstance(sups, list):
+        raise BaselineError(f"{path}: 'suppressions' must be a list")
+    out: Dict[str, str] = {}
+    for i, s in enumerate(sups):
+        if not isinstance(s, dict) or not isinstance(s.get("key"), str):
+            raise BaselineError(
+                f"{path}: suppressions[{i}] must be an object with a "
+                f"string 'key'")
+        just = s.get("justification")
+        if not isinstance(just, str):
+            raise BaselineError(
+                f"{path}: suppressions[{i}] ({s['key']!r}) "
+                f"'justification' must be a string")
+        if strict and (not just.strip()
+                       or just.strip().lower().startswith("todo")):
+            raise BaselineError(
+                f"{path}: suppressions[{i}] ({s['key']!r}) needs a real "
+                f"one-line justification (empty/TODO is not one)")
+        if s["key"] in out:
+            raise BaselineError(
+                f"{path}: duplicate suppression key {s['key']!r}")
+        out[s["key"]] = just.strip()
+    return out
+
+
+def apply_baseline(findings: Sequence[Finding],
+                   baseline: Dict[str, str]
+                   ) -> Tuple[List[Finding], List[str]]:
+    """-> (unsuppressed findings, stale baseline keys). Stale keys are
+    violations in their own right (the caller reports them)."""
+    present = {f.key for f in findings}
+    kept = [f for f in findings if f.key not in baseline]
+    stale = sorted(k for k in baseline if k not in present)
+    return kept, stale
+
+
+def write_baseline(findings: Sequence[Finding], path: str,
+                   justifications: Dict[str, str] = None,
+                   default_justification: str = PLACEHOLDER_JUSTIFICATION
+                   ) -> None:
+    """Write a baseline accepting exactly ``findings``. Existing
+    justifications (pass the loaded map) are preserved per key; new
+    keys get ``default_justification``, which DEFAULTS to the
+    placeholder a strict load rejects — a regenerated baseline cannot
+    silently launder unreviewed findings into accepted ones."""
+    justifications = justifications or {}
+    entries = []
+    for f in sorted(findings):
+        if f.key in {e["key"] for e in entries}:
+            continue
+        entries.append({
+            "key": f.key,
+            "justification": justifications.get(
+                f.key, default_justification),
+            # Context for the human editing the file; never matched.
+            "note": f"{f.file}:{f.line} {f.message}"[:200],
+        })
+    data = {"version": VERSION, "suppressions": entries}
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=False)
+        f.write("\n")

@@ -1,0 +1,94 @@
+"""Findings, the rule registry, and the runner.
+
+A rule is a named check over the :class:`~nezha_tpu.analysis.index.
+SourceIndex` that returns :class:`Finding`s. Registration is one
+decorator; ``nezha-lint --list-rules`` and the RUNBOOK rule table are
+generated from the same registry, so a rule cannot exist without a
+name and a one-line contract.
+
+Finding keys are deliberately LINE-FREE: ``rule:file:symbol:detail``
+(symbol = enclosing def/class qualname, detail = the flagged name or a
+short discriminator). A suppression in ``tools/lint_baseline.json``
+therefore survives unrelated edits that shift line numbers, but dies
+with the code it describes — moving the violation to another function
+or renaming the flagged state invalidates the key, and the stale entry
+fails the lint until the baseline is updated deliberately.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence
+
+from nezha_tpu.analysis.index import SourceIndex
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    file: str             # repo-relative path
+    line: int             # 1-based; 0 = whole-file finding
+    rule: str
+    message: str
+    symbol: str = ""      # enclosing def/class qualname ("" = module)
+    detail: str = ""      # stable discriminator within the symbol
+
+    @property
+    def key(self) -> str:
+        """Line-free suppression key for the baseline."""
+        return f"{self.rule}:{self.file}:{self.symbol}:{self.detail}"
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "file": self.file, "line": self.line,
+                "symbol": self.symbol, "detail": self.detail,
+                "message": self.message, "key": self.key}
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    name: str
+    contract: str       # one line: the invariant this rule enforces
+    check: Callable[[SourceIndex], List[Finding]]
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(name: str, contract: str):
+    """Register a rule: ``@rule("lock-discipline", "writes to ...")``
+    over a ``check(index) -> List[Finding]`` function."""
+    def deco(fn):
+        if name in RULES:
+            raise ValueError(f"duplicate rule name {name!r}")
+        RULES[name] = Rule(name=name, contract=contract, check=fn)
+        return fn
+    return deco
+
+
+def run_rules(index: SourceIndex,
+              names: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the selected rules (default: all) over one index. Files that
+    failed to parse surface as ``syntax`` findings regardless of the
+    selection — a rule cannot vouch for source it never saw."""
+    unknown = [n for n in (names or []) if n not in RULES]
+    if unknown:
+        raise KeyError(
+            f"unknown rule(s) {unknown}; available: {sorted(RULES)}")
+    findings = [Finding(file=rel, line=0, rule="syntax",
+                        message=f"file does not parse: {msg}",
+                        detail="parse")
+                for rel, msg in index.parse_errors]
+    for name in sorted(names if names is not None else RULES):
+        findings.extend(RULES[name].check(index))
+    return sorted(findings)
+
+
+def load_rules() -> None:
+    """Import every built-in rule module (each registers itself)."""
+    from nezha_tpu.analysis.rules import (  # noqa: F401
+        bench_records, donation, fault_points, host_sync, locks,
+        telemetry, traced_branch)

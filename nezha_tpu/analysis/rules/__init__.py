@@ -1,0 +1,4 @@
+"""Built-in ``nezha-lint`` rules. Each module registers itself via
+``@rule(name, contract)``; :func:`nezha_tpu.analysis.core.load_rules`
+imports them all, and adding a rule is adding a module here plus the
+RUNBOOK table row."""

@@ -1,0 +1,260 @@
+"""Rule ``use-after-donate``: a donated buffer is dead after dispatch.
+
+The serve engine donates the pooled KV caches into every compiled
+program (``Executor(donate_argnums=(1,))``), the COW block copy donates
+the caches pytree (``jax.jit(_copy_block, donate_argnums=(0,))``), and
+the train/parallel wires donate optimizer state. Donation is the reason
+decode doesn't copy the whole pool per token — and it makes the passed
+buffer INVALID the moment the call returns. Touching it afterwards is a
+``RuntimeError: Array has been deleted`` in the best case and a silent
+read of freed storage in the paged pool's worst case (PR 7's stale-KV
+invariant exists because of exactly this class of bug).
+
+The rule resolves three donation-site shapes statically:
+
+1. ``f = jax.jit(fn, donate_argnums=(i, ...))`` then ``f(a, b, ...)``
+   — positional args at the donated indices;
+2. ``self.X = Executor(donate_argnums=(i, ...))`` then
+   ``self.X.run(fn, a0, a1, ...)`` — ``run``'s first arg is the
+   function, so donated positions shift by one;
+3. ``jax.jit(fn, donate_argnums=...)(args...)`` called inline.
+
+After a donating call, any later LOAD of the exact argument expression
+(a plain name or a dotted ``self.pool.caches`` path) in the same
+function is flagged, unless a STORE to that path (or a prefix of it)
+re-bound it first — the engine's ``self.pool.caches = out[...]``
+rebind is the blessed pattern. Aliased reads (``c = self.pool.caches``
+before the call) are out of scope; the rule catches the shapes the
+repo actually writes."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from nezha_tpu.analysis.core import Finding, rule
+from nezha_tpu.analysis.index import (Module, SourceIndex, dotted_name)
+
+_FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """The literal donate_argnums of a jax.jit/Executor call, None when
+    absent/empty. A conditional ``(0,) if donate else ()`` counts as
+    donating (the lint must hold in the donating configuration)."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        node = kw.value
+        if isinstance(node, ast.IfExp):
+            node = (node.body if isinstance(node.body, ast.Tuple)
+                    and node.body.elts else node.orelse)
+        if isinstance(node, ast.Tuple):
+            out = tuple(e.value for e in node.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, int))
+            return out or None
+    return None
+
+
+def _collect_sites(mod: Module) -> Tuple[Dict[str, Tuple[int, ...]],
+                                         Dict[str, Tuple[int, ...]]]:
+    """-> (jitted var name -> donated positions,
+           executor attr name -> donated fn-arg positions).
+
+    Jitted names are module/class/function locals assigned from
+    ``jax.jit(..., donate_argnums=...)``; executor attrs come from
+    ``self.X = Executor(donate_argnums=...)`` anywhere in the module."""
+    jitted: Dict[str, Tuple[int, ...]] = {}
+    executors: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        call = node.value
+        cn = dotted_name(call.func) or ""
+        donated = _donated_positions(call)
+        if donated is None:
+            continue
+        if cn.rsplit(".", 1)[-1] == "jit":
+            for t in node.targets:
+                name = dotted_name(t)
+                if name:
+                    jitted[name] = donated
+        elif cn.rsplit(".", 1)[-1] == "Executor":
+            for t in node.targets:
+                name = dotted_name(t)
+                if name and name.startswith("self."):
+                    executors[name[len("self."):]] = donated
+    return jitted, executors
+
+
+def _donating_call(call: ast.Call, jitted, executors
+                   ) -> Optional[List[ast.AST]]:
+    """The donated argument expressions of this call, None if it is not
+    a known donating call."""
+    cn = dotted_name(call.func)
+    if cn in jitted:
+        idxs = jitted[cn]
+        return [call.args[i] for i in idxs if i < len(call.args)]
+    if cn and cn.startswith("self.") and cn.endswith(".run"):
+        attr = cn[len("self."):-len(".run")]
+        if attr in executors:
+            idxs = executors[attr]
+            # run(fn, *args): fn-arg i is run's positional i + 1.
+            return [call.args[i + 1] for i in idxs
+                    if i + 1 < len(call.args)]
+    # Inline jax.jit(f, donate_argnums=...)(args...)
+    if isinstance(call.func, ast.Call):
+        inner = dotted_name(call.func.func) or ""
+        if inner.rsplit(".", 1)[-1] == "jit":
+            donated = _donated_positions(call.func)
+            if donated:
+                return [call.args[i] for i in donated
+                        if i < len(call.args)]
+    return None
+
+
+def _pos(node: ast.AST) -> Tuple[int, int]:
+    return (getattr(node, "end_lineno", node.lineno),
+            getattr(node, "end_col_offset", 0))
+
+
+def _field_of(parent: ast.AST, child: ast.AST) -> Optional[str]:
+    for field, value in ast.iter_fields(parent):
+        if value is child:
+            return field
+        if isinstance(value, list) and any(v is child for v in value):
+            return field
+    return None
+
+
+def _branch_exclusive(mod: Module, a: ast.AST, b: ast.AST) -> bool:
+    """True when ``a`` and ``b`` sit in opposite arms of the same
+    ``if``/``else`` — one can never execute after the other in a single
+    pass, so a donation in one arm does not kill a read in the sibling
+    (the engine's paged/dense dispatch pairs)."""
+    a_chain: dict = {}
+    cur, parent = a, mod.parents.get(a)
+    while parent is not None:
+        a_chain[id(parent)] = cur
+        cur, parent = parent, mod.parents.get(parent)
+    cur, parent = b, mod.parents.get(b)
+    while parent is not None:
+        if id(parent) in a_chain:
+            # Lowest common ancestor: exclusivity is decided here and
+            # only here (above it the two share every branch arm).
+            if isinstance(parent, ast.If):
+                fa = _field_of(parent, a_chain[id(parent)])
+                fb = _field_of(parent, cur)
+                return {fa, fb} == {"body", "orelse"}
+            return False
+        cur, parent = parent, mod.parents.get(parent)
+    return False
+
+
+@rule("use-after-donate",
+      "arguments passed at donate_argnums positions (Executor caches, "
+      "jitted COW/step donations) must not be read after the call "
+      "until re-bound")
+def check(index: SourceIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    seen = set()
+    for mod in index:
+        jitted, executors = _collect_sites(mod)
+        if not jitted and not executors:
+            continue
+        for fn in index.functions(mod):
+            for f in _check_function(index, mod, fn, jitted, executors):
+                # A nested def is walked by its own pass AND its
+                # enclosing function's (ast.walk descends) — keep one
+                # finding per location.
+                loc = (f.file, f.line, f.detail)
+                if loc not in seen:
+                    seen.add(loc)
+                    findings.append(f)
+    return findings
+
+
+def _check_function(index, mod, fn, jitted, executors) -> List[Finding]:
+    # Every donating call inside this function, with the dotted paths it
+    # kills and the source position it happens at.
+    kills: List[Tuple[Tuple[int, int], ast.Call, str]] = []
+    rebinds: List[Tuple[Tuple[int, int], str]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            donated = _donating_call(node, jitted, executors)
+            if not donated:
+                continue
+            for arg in donated:
+                path = dotted_name(arg)
+                if path:
+                    kills.append((_pos(node), node, path))
+            # `self.caches = jitted(self.caches, ...)`: the enclosing
+            # assignment's target STORES after the call returns, even
+            # though it lexically precedes it — synthesize the store
+            # just past the call so the same-statement rebind revives
+            # the path.
+            stmt = mod.parents.get(node)
+            while stmt is not None and not isinstance(
+                    stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                           ast.FunctionDef, ast.AsyncFunctionDef)):
+                stmt = mod.parents.get(stmt)
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign,
+                                 ast.AugAssign)):
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                after = (_pos(node)[0], _pos(node)[1] + 1)
+                for t in targets:
+                    for sub in ast.walk(t):
+                        tpath = dotted_name(sub)
+                        if tpath:
+                            rebinds.append((after, tpath))
+    if not kills:
+        return []
+
+    # All loads/stores of dotted paths in the function, in source order.
+    events: List[Tuple[Tuple[int, int], str, str, ast.AST]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            path = dotted_name(node)
+            if path is None:
+                continue
+            ctx = getattr(node, "ctx", None)
+            if isinstance(ctx, (ast.Store, ast.Del)):
+                events.append(((node.lineno, node.col_offset), "store",
+                               path, node))
+            elif isinstance(ctx, ast.Load):
+                events.append(((node.lineno, node.col_offset), "load",
+                               path, node))
+    for rpos, rpath in rebinds:
+        events.append((rpos, "store", rpath, fn))
+    events.sort(key=lambda e: e[0])
+
+    findings: List[Finding] = []
+    qual = index.qualname(mod, fn)
+    for kpos, kcall, path in kills:
+        for epos, kind, epath, enode in events:
+            if epos <= kpos:
+                continue
+            if enode is not fn and _branch_exclusive(mod, kcall, enode):
+                # The sibling `if`/`else` arm: this event can never
+                # execute after the donation in one pass — it neither
+                # violates nor revives.
+                continue
+            if kind == "store" and (path == epath
+                                    or path.startswith(epath + ".")):
+                break        # re-bound: the donated path is live again
+            if kind == "load" and (epath == path
+                                   or epath.startswith(path + ".")):
+                findings.append(Finding(
+                    file=mod.rel, line=enode.lineno,
+                    rule="use-after-donate",
+                    symbol=qual, detail=path,
+                    message=(f"`{epath}` read after being donated at "
+                             f"line {kcall.lineno} (donate_argnums) — "
+                             f"the buffer is invalidated by dispatch; "
+                             f"re-bind it from the program output "
+                             f"before any further use")))
+                break        # one finding per donation site
+    return findings

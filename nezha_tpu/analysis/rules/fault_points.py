@@ -1,0 +1,145 @@
+"""Rule ``fault-points``: the chaos-knob registry stays pinned.
+
+The AST port of ``tools/check_fault_points.py`` (which is now a shim
+over this module): every ``faults.point("...")`` / ``faults.corrupt(
+"...")`` call site under ``nezha_tpu/`` must be **unique** (one site
+per name — hit counts and plan rules stay unambiguous), **documented**
+(the RUNBOOK fault-point table), **tested** (named somewhere under
+``tests/``), and **pinned** (the discovered set equals
+:data:`EXPECTED_POINTS` exactly, so a point cannot appear or vanish
+without this file changing deliberately).
+
+The AST form is strictly better than the old regex: only genuine
+``Call`` nodes with literal names register, so docstring examples can
+never count as call sites (the old walker had to exclude the whole
+faults package for that). The exclusion stays anyway — the injector's
+own internals are plumbing, not registered points."""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional
+
+from nezha_tpu.analysis.core import Finding, rule
+from nezha_tpu.analysis.index import (SourceIndex, call_name, str_arg)
+
+# The frozen registry: every faults.point()/corrupt() call site in the
+# tree, by name. Adding a fault point means adding it HERE (and to the
+# RUNBOOK table + a test) in the same change.
+EXPECTED_POINTS = frozenset({
+    "serve.prefill", "serve.prefill.logits",
+    "serve.step", "serve.step.logits",
+    "checkpoint.save", "dist.join",
+    # Multi-replica serving (router/supervisor front end):
+    "router.route", "router.probe", "supervisor.spawn", "replica.exec",
+    # Paged KV pool: armed at every block bind (admission, lazy decode
+    # growth, COW) — an injected error surfaces as the same typed
+    # KVBlocksExhausted backpressure genuine exhaustion produces.
+    "serve.kv.bind",
+})
+SOURCE_PREFIX = "nezha_tpu/"
+EXCLUDE_PREFIX = "nezha_tpu/faults/"
+RUNBOOK = os.path.join("docs", "RUNBOOK.md")
+TESTS_DIR = "tests"
+
+
+def find_points_in_index(index: SourceIndex) -> Dict[str, List[str]]:
+    """-> {point name: [repo-relative files registering it]}."""
+    points: Dict[str, List[str]] = {}
+    for mod in index:
+        if not mod.rel.startswith(SOURCE_PREFIX) \
+                or mod.rel.startswith(EXCLUDE_PREFIX):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if call_name(node) in ("faults.point", "faults.corrupt"):
+                name = str_arg(node)
+                if name is not None:
+                    points.setdefault(name, []).append(mod.rel)
+    return points
+
+
+def _tests_blob(index: SourceIndex) -> str:
+    chunks: List[str] = []
+    tests_root = os.path.join(index.root, TESTS_DIR)
+    for dirpath, _, files in os.walk(tests_root):
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                rel = os.path.relpath(os.path.join(dirpath, fn),
+                                      index.root)
+                text = index.read_text(rel)
+                if text:
+                    chunks.append(text)
+    return "\n".join(chunks)
+
+
+def check_index(index: SourceIndex,
+                expected: Optional[frozenset] = None) -> List[Finding]:
+    """The rule body; ``expected`` overrides the pinned set (fixture
+    trees in tests pin their own)."""
+    expected = EXPECTED_POINTS if expected is None else expected
+    findings: List[Finding] = []
+
+    def add(name: str, msg: str, file: str = RUNBOOK.replace(os.sep, "/"),
+            line: int = 0) -> None:
+        findings.append(Finding(file=file, line=line, rule="fault-points",
+                                symbol="registry", detail=name,
+                                message=msg))
+
+    points = find_points_in_index(index)
+    if not points:
+        add("<none>", f"no faults.point()/faults.corrupt() call sites "
+                      f"found under {SOURCE_PREFIX}")
+        return findings
+    for name, files in sorted(points.items()):
+        if len(files) > 1:
+            add(name, f"fault point {name!r} registered at "
+                      f"{len(files)} call sites ({', '.join(files)}) — "
+                      f"names must be unique", file=files[0])
+    for name in sorted(set(points) - expected):
+        add(name, f"fault point {name!r} is not in EXPECTED_POINTS — "
+                  f"add it to the pinned registry (and the RUNBOOK "
+                  f"table) deliberately", file=points[name][0])
+    for name in sorted(expected - set(points)):
+        add(name, f"pinned fault point {name!r} has no faults.point()/"
+                  f"corrupt() call site under {SOURCE_PREFIX} — the "
+                  f"registry lost a point")
+    runbook = index.read_text(RUNBOOK.replace(os.sep, "/")) or ""
+    tests_blob = _tests_blob(index)
+    for name in sorted(points):
+        # Boundary-anchored match: a point whose name prefixes another's
+        # ("serve.step" vs "serve.step.logits") must NOT pass vacuously
+        # via its sibling's mentions.
+        exact = re.compile(
+            rf"(?<![A-Za-z0-9_.]){re.escape(name)}(?![A-Za-z0-9_.])")
+        if not exact.search(runbook):
+            add(name, f"fault point {name!r} is not documented in "
+                      f"{RUNBOOK}", file=points[name][0])
+        if not exact.search(tests_blob):
+            add(name, f"fault point {name!r} is not covered by any test "
+                      f"under {TESTS_DIR}/", file=points[name][0])
+    return findings
+
+
+@rule("fault-points",
+      "every faults.point()/corrupt() site is unique, RUNBOOK-"
+      "documented, test-covered, and matches the pinned EXPECTED_POINTS")
+def check_rule(index: SourceIndex) -> List[Finding]:
+    return check_index(index)
+
+
+# ------------------------------------------------- legacy shim surface
+def find_points(root: str) -> Dict[str, List[str]]:
+    """Standalone-compatible entry (tools/check_fault_points.py)."""
+    return find_points_in_index(SourceIndex(root, roots=("nezha_tpu",),
+                                            extra_files=()))
+
+
+def check(root: str) -> List[str]:
+    """-> list of violation strings (empty = registry is clean) — the
+    exact contract the legacy checker exposed to tests."""
+    index = SourceIndex(root, roots=("nezha_tpu",), extra_files=())
+    return [f.message for f in check_index(index)]

@@ -1,0 +1,106 @@
+"""Rule ``host-sync-in-hot-path``: no host synchronization inside
+traced program bodies.
+
+The contract this enforces is the one PR 5's whole design rests on: the
+decode hot path is device-resident, and its throughput claim
+(``serve.host_gap_s``) dies the moment someone reintroduces a host
+round-trip inside a compiled program body — a ``.block_until_ready()``,
+an ``.item()`` / ``float()`` on a device value, an ``np.asarray``
+materialization, a ``print``, a file open, a ``time.sleep``. Inside a
+traced function those either crash at trace time (concretization),
+silently execute at TRACE time only (print/time — a misleading no-op in
+steady state), or force a sync. All of them are wrong; none should wait
+for a chaos test to flake three PRs later.
+
+Scope: every function :mod:`nezha_tpu.analysis.traced` identifies as
+traced — jit-decorated, handed to scan/while_loop/pallas_call, the
+serve engine's ``_build_*`` program closures, and their in-module
+helpers."""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from nezha_tpu.analysis.core import Finding, rule
+from nezha_tpu.analysis.index import SourceIndex, dotted_name
+from nezha_tpu.analysis.traced import device_tainted, traced_functions
+
+_FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def walk_own(fn: ast.AST, skip: set):
+    """Walk ``fn``'s body, pruning nested defs in ``skip`` (they are
+    traced functions in their own right and get their own pass —
+    without pruning every violation inside them would be reported
+    twice, once per enclosing symbol, destabilizing baseline keys)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _FuncDef) and node in skip:
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+# Method calls that synchronize (or concretize) a device value.
+_SYNC_METHODS = {"block_until_ready", "item", "tolist",
+                 "copy_to_host_async", "__array__"}
+# Bare-name calls that are host effects inside a traced body.
+_HOST_CALLS = {"print", "open", "input", "breakpoint"}
+# `module.attr` calls that are host effects / host materialization.
+_HOST_DOTTED = {
+    "np.asarray", "np.array", "np.copy", "np.frombuffer", "np.save",
+    "np.load", "numpy.asarray", "numpy.array", "numpy.copy",
+    "jax.device_get", "jax.block_until_ready", "jax.debug.breakpoint",
+    "time.sleep", "time.time", "time.monotonic", "time.perf_counter",
+    "time.process_time", "os.system", "subprocess.run",
+}
+# Builtins that concretize — flagged only when their argument is a
+# device-tainted value (float(0.5) literals and closure scalars stay
+# legal inside traced code).
+_CONCRETIZERS = {"float", "int", "bool", "complex"}
+
+
+@rule("host-sync-in-hot-path",
+      "no host sync/IO (block_until_ready, .item(), float()/np.asarray "
+      "on device values, print/open/time) inside traced program bodies")
+def check(index: SourceIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in index:
+        traced = traced_functions(mod)
+        for fn, reason in traced.items():
+            # Params excluded from taint: positional params of traced
+            # helpers are often static config, and float()/int() on
+            # those is legal trace specialization. jnp/lax-produced
+            # values are the certain tracers.
+            tainted = device_tainted(fn, include_params=False)
+            qual = index.qualname(mod, fn)
+            for node in walk_own(fn, set(traced)):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                flag = None
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _SYNC_METHODS:
+                    flag = f".{node.func.attr}()"
+                elif name in _HOST_DOTTED:
+                    flag = f"{name}()"
+                elif name in _HOST_CALLS:
+                    flag = f"{name}()"
+                elif name in _CONCRETIZERS and node.args:
+                    arg = node.args[0]
+                    arg_is_tainted = any(
+                        isinstance(s, ast.Name) and s.id in tainted
+                        for s in ast.walk(arg))
+                    if arg_is_tainted:
+                        flag = f"{name}() on a traced value"
+                if flag is not None:
+                    findings.append(Finding(
+                        file=mod.rel, line=node.lineno,
+                        rule="host-sync-in-hot-path",
+                        symbol=qual, detail=flag,
+                        message=(f"{flag} inside traced function "
+                                 f"{qual or '<module>'} ({reason}) — "
+                                 f"host sync/IO on the compiled hot "
+                                 f"path")))
+    return findings

@@ -1,0 +1,184 @@
+"""Rule ``lock-discipline``: declared cross-thread state mutates only
+under its declared lock.
+
+The serve layer is deliberately multi-threaded — HTTP handler threads
+call ``Scheduler.submit`` against the decode loop, the router's prober
+and forwards race the supervisor's monitor tick — and the free list,
+block tables, in-flight ledgers, and replica records are all mutated
+from more than one thread. The convention this rule enforces is
+EXPLICIT declaration:
+
+- a class declares its guarded state in a ``_LOCK_GUARDED`` class
+  attribute: ``{"_queue": "_lock", "retries": "_ledger_lock", ...}``
+  (attribute name -> the ``self.<lock>`` that must be held);
+- every write to a declared attribute (assignment, augmented
+  assignment, ``del``, subscript store, or a state-advancing method
+  call — ``append``/``pop``/``update``/``add``/``random``/... ) must
+  happen lexically inside ``with self.<lock>:`` in the same method;
+- a method whose whole body runs with a lock already held by its
+  caller says so in its docstring with the marker ``[holds: <lock>]``
+  (the scheduler's ``_admit``/``_decode`` internals — the marker is
+  the documentation the contract always deserved);
+- ``__init__`` is exempt (construction happens-before publication).
+
+Nested functions inherit the held set of their enclosing ``with``
+block — right for the repo's ``_dispatch``-style immediately-called
+closures; a closure stashed and called later from another thread would
+need its own declaration."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from nezha_tpu.analysis.core import Finding, rule
+from nezha_tpu.analysis.index import Module, SourceIndex, dotted_name
+
+_FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# Method names that advance state on the receiver. Collection mutators
+# plus the instrument/RNG state-advancers the serve layer guards
+# (``self._rng.random()`` consumes the shared stream).
+MUTATORS: Set[str] = {
+    "append", "appendleft", "extend", "extendleft", "insert", "pop",
+    "popleft", "popitem", "remove", "discard", "clear", "update", "add",
+    "setdefault", "sort", "reverse", "set", "inc",
+    "random", "randint", "randrange", "choice", "shuffle", "sample",
+    "seed", "getrandbits", "uniform",
+}
+
+_HOLDS_RE = re.compile(r"\[holds:\s*([A-Za-z0-9_,\s]+)\]")
+
+
+def _declared_guards(cls: ast.ClassDef) -> Optional[Dict[str, str]]:
+    """The class's ``_LOCK_GUARDED`` dict literal, None when absent."""
+    for node in cls.body:
+        if isinstance(node, ast.Assign):
+            names = [dotted_name(t) for t in node.targets]
+            if "_LOCK_GUARDED" not in names:
+                continue
+            if isinstance(node.value, ast.Dict):
+                out: Dict[str, str] = {}
+                for k, v in zip(node.value.keys, node.value.values):
+                    if (isinstance(k, ast.Constant)
+                            and isinstance(v, ast.Constant)):
+                        out[str(k.value)] = str(v.value)
+                return out
+    return None
+
+
+def _marker_locks(fn: ast.AST) -> Set[str]:
+    doc = ast.get_docstring(fn) or ""
+    locks: Set[str] = set()
+    for m in _HOLDS_RE.finditer(doc):
+        for name in m.group(1).split(","):
+            locks.add(name.strip())
+    return locks
+
+
+def _with_locks(item: ast.withitem) -> Optional[str]:
+    """The ``self.<lock>`` name a with-item acquires, else None."""
+    expr = item.context_expr
+    name = dotted_name(expr)
+    if name and name.startswith("self."):
+        return name[len("self."):]
+    return None
+
+
+def _mutated_attr(node: ast.AST) -> Optional[str]:
+    """The ``self.<attr>`` a statement/expression mutates, else None.
+
+    Any store/del whose access chain is rooted at ``self.<attr>``
+    counts (``self._replicas[rid].in_flight += 1`` mutates
+    ``_replicas``-reachable state), as does a MUTATORS method call on
+    such a chain."""
+    target: Optional[ast.AST] = None
+    if isinstance(node, (ast.Assign,)):
+        for t in node.targets:
+            root = _self_root(t)
+            if root:
+                return root
+        return None
+    if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        target = node.target
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            root = _self_root(t)
+            if root:
+                return root
+        return None
+    elif isinstance(node, ast.Call) and isinstance(node.func,
+                                                   ast.Attribute):
+        if node.func.attr in MUTATORS:
+            target = node.func.value
+    if target is None:
+        return None
+    return _self_root(target)
+
+
+def _self_root(node: ast.AST) -> Optional[str]:
+    """``_attr`` when the expression chain bottoms out at
+    ``self._attr``, else None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return node.attr
+        node = node.value
+    return None
+
+
+@rule("lock-discipline",
+      "writes to state declared in a class's _LOCK_GUARDED map happen "
+      "inside `with self.<lock>:` (or a method marked `[holds: lock]`)")
+def check(index: SourceIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in index:
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            guards = _declared_guards(cls)
+            if not guards:
+                continue
+            for item in cls.body:
+                if not isinstance(item, _FuncDef):
+                    continue
+                if item.name == "__init__":
+                    continue
+                held = _marker_locks(item)
+                for stmt in item.body:
+                    _visit(stmt, held, mod, cls, item, guards, findings)
+    return findings
+
+
+def _visit(node: ast.AST, held: Set[str], mod: Module,
+           cls: ast.ClassDef, method: ast.AST,
+           guards: Dict[str, str], findings: List[Finding]) -> None:
+    """Recursive walk carrying the held-lock set; ``with self.<lock>:``
+    bodies (wherever they nest) extend it."""
+    if isinstance(node, ast.With):
+        acquired = {l for l in (_with_locks(i) for i in node.items)
+                    if l is not None}
+        for item in node.items:
+            _visit(item.context_expr, held, mod, cls, method, guards,
+                   findings)
+        for child in node.body:
+            _visit(child, held | acquired, mod, cls, method, guards,
+                   findings)
+        return
+    attr = _mutated_attr(node)
+    if attr is not None and attr in guards:
+        need = guards[attr]
+        if need not in held:
+            findings.append(Finding(
+                file=mod.rel, line=node.lineno, rule="lock-discipline",
+                symbol=f"{cls.name}.{method.name}",
+                detail=attr,
+                message=(f"write to lock-guarded `self.{attr}` outside "
+                         f"`with self.{need}` in {cls.name}."
+                         f"{method.name} — declared cross-thread state "
+                         f"(add the with-block, or mark the method "
+                         f"`[holds: {need}]` if the caller holds it)")))
+    for child in ast.iter_child_nodes(node):
+        _visit(child, held, mod, cls, method, guards, findings)

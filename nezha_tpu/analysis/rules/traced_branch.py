@@ -1,0 +1,105 @@
+"""Rule ``traced-value-branch``: no Python control flow on traced values.
+
+``if`` / ``while`` / ``assert`` (and ternary ``x if c else y``) on a
+value produced by ``jnp``/``lax`` inside a jit/scan/pallas body is a
+``TracerBoolConversionError`` at a distance: it traces fine in the
+author's quick test (concrete inputs), then explodes — or worse, bakes
+one branch in silently — the first time the function is actually
+compiled. The in-program idiom is ``jnp.where`` / ``lax.cond`` /
+``lax.select``; this rule points there the moment the Python keyword
+lands.
+
+Taint is conservative-by-construction (:func:`nezha_tpu.analysis.
+traced.device_tainted`): positional parameters of a traced function and
+anything assigned from device namespaces are traced; keyword-only
+params (the ``functools.partial``-bound statics of the Pallas kernels)
+and ``.shape``/``.dtype`` metadata are not."""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from nezha_tpu.analysis.core import Finding, rule
+from nezha_tpu.analysis.index import SourceIndex, dotted_name
+from nezha_tpu.analysis.rules.host_sync import walk_own
+from nezha_tpu.analysis.traced import (_is_device_call, device_tainted,
+                                       only_static_use,
+                                       traced_functions)
+
+
+# Predicates that are static even though they live in a device
+# namespace (dtype classification happens at trace time).
+_STATIC_PREDICATES = {"jnp.issubdtype", "jnp.isdtype", "jnp.result_type",
+                      "jnp.promote_types", "jax.numpy.issubdtype"}
+
+
+def _test_tainted(test: ast.AST, tainted: set) -> bool:
+    # Identity tests never convert to bool — `x is None` on a tracer is
+    # legal (and idiomatic for optional-operand plumbing); recurse
+    # through and/or/not so compound identity guards stay legal too.
+    if isinstance(test, ast.BoolOp):
+        return any(_test_tainted(v, tainted) for v in test.values)
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _test_tainted(test.operand, tainted)
+    if isinstance(test, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+        return False
+    if isinstance(test, ast.Call):
+        cn = dotted_name(test.func) or ""
+        if cn in ("isinstance", "callable", "hasattr", "len") \
+                or cn in _STATIC_PREDICATES:
+            return False
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Call) and _is_device_call(sub):
+            return True
+        if (isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load)
+                and sub.id in tainted):
+            # `.shape`-style metadata reads are static (the SAME set
+            # the taint propagation uses — traced.only_static_use); a
+            # bare tainted name in a test is a bool() on a tracer.
+            if not only_static_use(test, sub):
+                return True
+    return False
+
+
+@rule("traced-value-branch",
+      "no Python if/while/assert on jnp/lax-produced values inside "
+      "traced function bodies (TracerBoolConversionError at a distance)")
+def check(index: SourceIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in index:
+        traced = traced_functions(mod)
+        for fn, reason in traced.items():
+            # Parameters are NOT tainted: traced helpers routinely take
+            # static config through positional params (`op`, `causal`,
+            # `interpret`), and branching on those is how a trace
+            # specializes. Only values PRODUCED by device namespaces in
+            # this body are certain tracers.
+            tainted = device_tainted(fn, include_params=False)
+            qual = index.qualname(mod, fn)
+            for node in walk_own(fn, set(traced)):
+                kind = None
+                test = None
+                if isinstance(node, ast.If):
+                    kind, test = "if", node.test
+                elif isinstance(node, ast.While):
+                    kind, test = "while", node.test
+                elif isinstance(node, ast.Assert):
+                    kind, test = "assert", node.test
+                elif isinstance(node, ast.IfExp):
+                    kind, test = "ternary if", node.test
+                if test is None or not _test_tainted(test, tainted):
+                    continue
+                snippet = ast.unparse(test)
+                if len(snippet) > 40:
+                    snippet = snippet[:37] + "..."
+                findings.append(Finding(
+                    file=mod.rel, line=node.lineno,
+                    rule="traced-value-branch",
+                    symbol=qual, detail=f"{kind} {snippet}",
+                    message=(f"Python `{kind}` on traced value "
+                             f"`{snippet}` inside traced function "
+                             f"{qual or '<module>'} ({reason}) — use "
+                             f"jnp.where / lax.cond instead")))
+    return findings

@@ -1,0 +1,317 @@
+"""Frozen-schema validation for telemetry: pinned names + run-dir checks.
+
+Two consumers share the pinned sets below:
+
+- :func:`check_run_dir` validates a CAPTURED run directory
+  (metrics.jsonl / spans.jsonl / summary.json) against schema v1 — the
+  runtime side, called from tier-1 tests on real captures and from the
+  ``tools/check_telemetry_schema.py`` shim standalone;
+- the ``telemetry-schema`` lint rule
+  (:mod:`nezha_tpu.analysis.rules.telemetry`) validates the SOURCE —
+  every literal instrument name under a pinned namespace must be a
+  member of these sets, so a typo'd or unregistered name fails the
+  lint when the code changes, not when a dashboard goes blank.
+
+The run-dir contract (obs/sink.py) is an interface other tooling reads
+— dashboards, the ``nezha-telemetry`` report, downstream analysis — so
+drift must fail fast. Schema v1:
+
+    metrics.jsonl   one JSON object per line; "step" int >= 0, "ts"
+                    float; other values JSON scalars
+    spans.jsonl     one JSON object per line; "name" str, "t0"/"t1"
+                    floats with t1 >= t0, "dur_s" float, "attrs" object
+    summary.json    schema_version == 1; counters/gauges/histograms/
+                    collectives objects; compile_cache with int
+                    hits/misses; slowest_spans list of span records
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List
+
+SCHEMA_VERSION = 1
+_HIST_KEYS = {"count", "sum", "min", "max", "mean", "p50", "p90", "p99"}
+_SUMMARY_KEYS = {"schema_version", "counters", "gauges", "histograms",
+                 "collectives", "compile_cache", "num_spans",
+                 "slowest_spans"}
+
+# Serving-run schema (nezha-serve / benchmarks/serving.py): the scheduler
+# pre-registers this full instrument set, so a summary that carries the
+# marker counter must carry ALL of them — dashboards key on the names
+# (ttft, tpot, queue_depth, batch_occupancy, rejected_total, errors, ...).
+_SERVE_MARKER = "serve.admitted_total"
+_SERVE_COUNTERS = {"serve.admitted_total", "serve.rejected_total",
+                   "serve.expired_total", "serve.retired_total",
+                   "serve.tokens_total", "serve.prefill.chunks_total",
+                   "serve.errors_total", "serve.step_retries_total",
+                   "faults.injected_total",
+                   # Paged-KV pool (PR 8): requests that took cached
+                   # prefix references instead of re-prefilling, and
+                   # copy-on-write block copies. Layout-invariant: a
+                   # dense-layout run reports 0s, never omits them.
+                   "serve.kv.prefix_hits_total",
+                   "serve.kv.cow_copies_total"}
+_SERVE_GAUGES = {"serve.queue_depth", "serve.batch_occupancy",
+                 "serve.kv.blocks_used",
+                 # KV quantization (PR 9): device bytes the resident KV
+                 # holds and the storage width in bits (8 = int8 blocks
+                 # + per-block scales, 16/32 = plain bf16/f32 pools).
+                 # Layout/dtype-invariant: every serving run reports
+                 # them.
+                 "serve.kv.bytes_resident", "serve.kv.quant_bits"}
+_SERVE_HISTOGRAMS = {"serve.ttft_s", "serve.tpot_s",
+                     "serve.prefill.bucket_len",
+                     # Decode-horizon instruments (PR 5): host time
+                     # between consecutive step dispatches, and the
+                     # tokens-per-dispatch ceiling each block ran at.
+                     "serve.host_gap_s", "serve.decode.horizon",
+                     # Per-block max-abs dequant error sampled at each
+                     # prefill-chunk write (count 0 on bf16 runs).
+                     "serve.kv.quant_error"}
+
+# Router-run schema (nezha-serve --replicas N / benchmarks/serving.py
+# --replicas): the supervisor/router pair pre-registers this full set,
+# so a summary carrying the marker counter must carry ALL of it — a run
+# with zero failovers still reports failovers_total = 0.
+_ROUTER_MARKER = "router.retries_total"
+_ROUTER_COUNTERS = {"router.retries_total", "router.failovers_total",
+                    "router.replica_restarts_total"}
+_ROUTER_GAUGES = {"router.replicas_live"}
+_ROUTER_HISTOGRAMS = {"router.route_s"}
+
+# Dist-run schema: any run that touched the coordinator (any dist.*
+# counter present — join() pre-registers the pair) must carry the full
+# failure-accounting set, so a world that never retried still reports
+# join_retries_total = 0.
+_DIST_COUNTERS = {"dist.join_retries_total", "dist.heartbeat_lost_total"}
+
+# Checkpoint-layer counters: pinned for the SOURCE rule only (run-dir
+# summaries carry them ad hoc — a training run that never saw a corrupt
+# checkpoint reports nothing, so there is no marker-counter contract to
+# validate in a capture).
+_CHECKPOINT_COUNTERS = {"checkpoint.corrupt_total"}
+
+# Span-name registry for the namespaces this module owns: spans under
+# serve./checkpoint./dist./router. are an interface (reports and
+# dashboards key on them), so an unknown name in those namespaces is
+# drift — add new spans HERE (and to the emitting layer's docs)
+# deliberately.
+_PINNED_SPAN_PREFIXES = ("serve.", "checkpoint.", "dist.", "router.")
+_PINNED_SPANS = {
+    "serve.prefill", "serve.decode_attention", "serve.drain",
+    "checkpoint.save", "checkpoint.verify",
+    "dist.join", "dist.barrier", "dist.failure", "dist.leave",
+    "router.drain",
+}
+
+# Namespaces whose METRIC names (counter/gauge/histogram) the source
+# rule pins, with the full membership per instrument kind.
+PINNED_METRIC_PREFIXES = ("serve.", "router.", "dist.", "checkpoint.")
+PINNED_COUNTERS = (_SERVE_COUNTERS | _ROUTER_COUNTERS | _DIST_COUNTERS
+                   | _CHECKPOINT_COUNTERS)
+PINNED_GAUGES = _SERVE_GAUGES | _ROUTER_GAUGES
+PINNED_HISTOGRAMS = _SERVE_HISTOGRAMS | _ROUTER_HISTOGRAMS
+PINNED_SPANS = _PINNED_SPANS
+PINNED_SPAN_PREFIXES = _PINNED_SPAN_PREFIXES
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _check_span(rec, where: str, errors: List[str]) -> None:
+    if not isinstance(rec, dict):
+        errors.append(f"{where}: span record is not an object")
+        return
+    if not isinstance(rec.get("name"), str):
+        errors.append(f"{where}: span 'name' must be a string")
+    for k in ("t0", "t1", "dur_s"):
+        if not _is_num(rec.get(k)):
+            errors.append(f"{where}: span '{k}' must be a number")
+    if (_is_num(rec.get("t0")) and _is_num(rec.get("t1"))
+            and rec["t1"] < rec["t0"]):
+        errors.append(f"{where}: span t1 < t0")
+    if not isinstance(rec.get("attrs"), dict):
+        errors.append(f"{where}: span 'attrs' must be an object")
+    name = rec.get("name")
+    if (isinstance(name, str) and name.startswith(_PINNED_SPAN_PREFIXES)
+            and name not in _PINNED_SPANS):
+        errors.append(f"{where}: span name {name!r} is not in the pinned "
+                      f"span registry (_PINNED_SPANS) for its namespace")
+
+
+def check_metrics_jsonl(path: str, errors: List[str]) -> None:
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                errors.append(f"metrics.jsonl:{i}: not valid JSON")
+                continue
+            if not isinstance(rec, dict):
+                errors.append(f"metrics.jsonl:{i}: not an object")
+                continue
+            step = rec.get("step")
+            if not (isinstance(step, int) and not isinstance(step, bool)
+                    and step >= 0):
+                errors.append(f"metrics.jsonl:{i}: 'step' must be an int "
+                              f">= 0, got {step!r}")
+            if not _is_num(rec.get("ts")):
+                errors.append(f"metrics.jsonl:{i}: 'ts' must be a number")
+            for k, v in rec.items():
+                if not isinstance(v, (int, float, str, bool, type(None))):
+                    errors.append(f"metrics.jsonl:{i}: value for {k!r} is "
+                                  f"not a JSON scalar")
+
+
+def check_spans_jsonl(path: str, errors: List[str]) -> None:
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                errors.append(f"spans.jsonl:{i}: not valid JSON")
+                continue
+            _check_span(rec, f"spans.jsonl:{i}", errors)
+
+
+def check_summary_json(path: str, errors: List[str]) -> None:
+    try:
+        with open(path) as f:
+            summary = json.load(f)
+    except ValueError:
+        errors.append("summary.json: not valid JSON")
+        return
+    if not isinstance(summary, dict):
+        errors.append("summary.json: not an object")
+        return
+    if summary.get("schema_version") != SCHEMA_VERSION:
+        errors.append(f"summary.json: schema_version must be "
+                      f"{SCHEMA_VERSION}, got "
+                      f"{summary.get('schema_version')!r}")
+    missing = _SUMMARY_KEYS - set(summary)
+    if missing:
+        errors.append(f"summary.json: missing key(s) {sorted(missing)}")
+    for section in ("counters", "gauges"):
+        vals = summary.get(section)
+        if not isinstance(vals, dict):
+            errors.append(f"summary.json: '{section}' must be an object")
+            continue
+        for k, v in vals.items():
+            if not _is_num(v):
+                errors.append(f"summary.json: {section}[{k!r}] must be a "
+                              f"number")
+    hists = summary.get("histograms")
+    if isinstance(hists, dict):
+        for k, h in hists.items():
+            if not isinstance(h, dict) or not _HIST_KEYS <= set(h):
+                errors.append(f"summary.json: histograms[{k!r}] must "
+                              f"carry {sorted(_HIST_KEYS)}")
+    else:
+        errors.append("summary.json: 'histograms' must be an object")
+    coll = summary.get("collectives")
+    if isinstance(coll, dict):
+        for op, row in coll.items():
+            if not isinstance(row, dict):
+                errors.append(f"summary.json: collectives[{op!r}] must be "
+                              f"an object")
+                continue
+            for field in ("calls", "payload_bytes"):
+                if field in row and not _is_num(row[field]):
+                    errors.append(f"summary.json: collectives[{op!r}]"
+                                  f".{field} must be a number")
+    else:
+        errors.append("summary.json: 'collectives' must be an object")
+    cc = summary.get("compile_cache")
+    if isinstance(cc, dict):
+        for field in ("hits", "misses"):
+            v = cc.get(field)
+            if not (isinstance(v, int) and not isinstance(v, bool)):
+                errors.append(f"summary.json: compile_cache.{field} must "
+                              f"be an int")
+    else:
+        errors.append("summary.json: 'compile_cache' must be an object")
+    slowest = summary.get("slowest_spans")
+    if isinstance(slowest, list):
+        for j, rec in enumerate(slowest):
+            _check_span(rec, f"summary.json: slowest_spans[{j}]", errors)
+    else:
+        errors.append("summary.json: 'slowest_spans' must be a list")
+    _check_serving(summary, errors)
+    _check_router(summary, errors)
+    _check_dist(summary, errors)
+
+
+def _check_serving(summary: dict, errors: List[str]) -> None:
+    """Serving-run summaries (marker: serve.admitted_total) must carry
+    the complete pinned serve instrument set."""
+    counters = summary.get("counters")
+    if not isinstance(counters, dict) or _SERVE_MARKER not in counters:
+        return
+    for name in sorted(_SERVE_COUNTERS - set(counters)):
+        errors.append(f"summary.json: serving run missing counter "
+                      f"{name!r}")
+    gauges = summary.get("gauges")
+    gauges = gauges if isinstance(gauges, dict) else {}
+    for name in sorted(_SERVE_GAUGES - set(gauges)):
+        errors.append(f"summary.json: serving run missing gauge {name!r}")
+    hists = summary.get("histograms")
+    hists = hists if isinstance(hists, dict) else {}
+    for name in sorted(_SERVE_HISTOGRAMS - set(hists)):
+        errors.append(f"summary.json: serving run missing histogram "
+                      f"{name!r}")
+
+
+def _check_router(summary: dict, errors: List[str]) -> None:
+    """Router-run summaries (marker: router.retries_total) must carry
+    the complete pinned router instrument set."""
+    counters = summary.get("counters")
+    if not isinstance(counters, dict) or _ROUTER_MARKER not in counters:
+        return
+    for name in sorted(_ROUTER_COUNTERS - set(counters)):
+        errors.append(f"summary.json: router run missing counter "
+                      f"{name!r}")
+    gauges = summary.get("gauges")
+    gauges = gauges if isinstance(gauges, dict) else {}
+    for name in sorted(_ROUTER_GAUGES - set(gauges)):
+        errors.append(f"summary.json: router run missing gauge {name!r}")
+    hists = summary.get("histograms")
+    hists = hists if isinstance(hists, dict) else {}
+    for name in sorted(_ROUTER_HISTOGRAMS - set(hists)):
+        errors.append(f"summary.json: router run missing histogram "
+                      f"{name!r}")
+
+
+def _check_dist(summary: dict, errors: List[str]) -> None:
+    """Runs that touched the coordinator (any ``dist.*`` counter) must
+    carry the complete failure-accounting counter set."""
+    counters = summary.get("counters")
+    if not isinstance(counters, dict):
+        return
+    if not any(k.startswith("dist.") for k in counters):
+        return
+    for name in sorted(_DIST_COUNTERS - set(counters)):
+        errors.append(f"summary.json: dist run missing counter {name!r}")
+
+
+def check_run_dir(run_dir: str) -> List[str]:
+    """-> list of schema violations (empty = valid). All three artifacts
+    are required — a run dir missing one is itself a violation."""
+    errors: List[str] = []
+    for name, checker in (("metrics.jsonl", check_metrics_jsonl),
+                          ("spans.jsonl", check_spans_jsonl),
+                          ("summary.json", check_summary_json)):
+        path = os.path.join(run_dir, name)
+        if not os.path.isfile(path):
+            errors.append(f"{name}: missing from {run_dir}")
+            continue
+        checker(path, errors)
+    return errors

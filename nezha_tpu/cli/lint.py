@@ -1,0 +1,172 @@
+"""nezha-lint — run the repo's static invariant rules.
+
+Usage::
+
+    nezha-lint [--root DIR] [--rule NAME ...] [--json] [--list-rules]
+               [--baseline PATH | --no-baseline] [--update-baseline]
+
+Exit codes: 0 clean (all findings suppressed by the baseline), 1 when
+unsuppressed findings / stale baseline entries / parse failures exist,
+2 on usage errors. ``--json`` emits one machine-readable object on
+stdout (findings, suppressed count, stale keys) for CI annotation.
+
+``--update-baseline`` rewrites the baseline to accept exactly the
+CURRENT findings, preserving existing justifications and stamping new
+entries with a placeholder the next load will REJECT until a human
+writes the real one-line reason — regenerating the file can never
+silently launder new violations into accepted ones.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+from nezha_tpu.analysis import (BaselineError, RULES, SourceIndex,
+                                apply_baseline, load_baseline, load_rules,
+                                run_rules, write_baseline)
+from nezha_tpu.analysis.baseline import DEFAULT_BASELINE
+
+
+def _find_root(start: str) -> str:
+    """Walk up from ``start`` to the repo root (the dir holding
+    pyproject.toml with a nezha_tpu/ package); fall back to start."""
+    cur = os.path.abspath(start)
+    while True:
+        if os.path.isfile(os.path.join(cur, "pyproject.toml")) \
+                and os.path.isdir(os.path.join(cur, "nezha_tpu")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.path.abspath(start)
+        cur = parent
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="nezha-lint",
+        description="AST-based invariant checker for the nezha-tpu "
+                    "tree (tracing, donation, host-sync, lock, and "
+                    "registry contracts).")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: walk up from cwd to the "
+                        "dir holding pyproject.toml + nezha_tpu/)")
+    p.add_argument("--rule", action="append", default=None,
+                   metavar="NAME",
+                   help="run only this rule (repeatable; default all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule registry and exit")
+    p.add_argument("--json", action="store_true",
+                   help="emit one JSON object instead of text lines")
+    p.add_argument("--baseline", default=None, metavar="PATH",
+                   help=f"suppression baseline (default "
+                        f"<root>/{DEFAULT_BASELINE})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: report every finding")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline to accept the current "
+                        "findings (new entries get a placeholder "
+                        "justification you must edit before it loads)")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    load_rules()
+    if args.list_rules:
+        for name in sorted(RULES):
+            print(f"{name:24s} {RULES[name].contract}")
+        return 0
+    root = args.root or _find_root(os.getcwd())
+    if args.update_baseline and args.rule:
+        # A partial regeneration would rewrite the file to ONLY the
+        # selected rules' findings, deleting every other rule's
+        # suppressions (and their justifications) — refuse.
+        print("nezha-lint: --update-baseline cannot be combined with "
+              "--rule (it would drop every other rule's suppressions)",
+              file=sys.stderr)
+        return 2
+    t0 = time.monotonic()
+    index = SourceIndex(root)
+    try:
+        findings = run_rules(index, args.rule)
+    except KeyError as e:
+        print(f"nezha-lint: {e.args[0]}", file=sys.stderr)
+        return 2
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
+    if args.update_baseline:
+        # Lenient read: regeneration must PRESERVE the human-written
+        # justifications even when the file currently holds placeholder
+        # entries a strict load rejects. Structural damage aborts —
+        # never rewrite what could not be read.
+        try:
+            existing = load_baseline(baseline_path, strict=False)
+        except BaselineError as e:
+            print(f"nezha-lint: refusing to rewrite a baseline that "
+                  f"cannot be read: {e}", file=sys.stderr)
+            return 2
+        write_baseline(findings, baseline_path, justifications=existing)
+        print(f"nezha-lint: wrote {len(findings)} suppression(s) to "
+              f"{baseline_path}", file=sys.stderr)
+        return 0
+    baseline = {}
+    baseline_error = None
+    if not args.no_baseline:
+        try:
+            baseline = load_baseline(baseline_path)
+        except BaselineError as e:
+            baseline_error = str(e)
+        if args.rule:
+            # Single-rule runs only PRODUCE the selected rules'
+            # findings (plus syntax), so only those rules' suppressions
+            # can be judged stale — an unselected rule's valid entry
+            # must not fail the run.
+            selected = set(args.rule) | {"syntax"}
+            baseline = {k: v for k, v in baseline.items()
+                        if k.split(":", 1)[0] in selected}
+    kept, stale = apply_baseline(findings, baseline)
+    dt = time.monotonic() - t0
+    rc = 1 if (kept or stale or baseline_error) else 0
+    if args.json:
+        print(json.dumps({
+            "version": 1, "root": root,
+            "rules": sorted(args.rule) if args.rule else sorted(RULES),
+            "files_indexed": len(index.modules),
+            "elapsed_s": round(dt, 3),
+            "findings": [f.to_json() for f in kept],
+            "suppressed": len(findings) - len(kept),
+            "stale_baseline_keys": stale,
+            "baseline_error": baseline_error,
+            "exit_code": rc,
+        }, indent=2))
+        return rc
+    if baseline_error:
+        print(f"nezha-lint: BASELINE ERROR: {baseline_error}",
+              file=sys.stderr)
+    for f in kept:
+        print(f.render())
+    for k in stale:
+        print(f"nezha-lint: stale baseline entry {k!r} matches no "
+              f"current finding — remove it (the violation it excused "
+              f"is gone)", file=sys.stderr)
+    n_rules = len(args.rule) if args.rule else len(RULES)
+    if rc:
+        print(f"nezha-lint: FAIL — {len(kept)} finding(s), "
+              f"{len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} "
+              f"({n_rules} rules, {len(index.modules)} files, "
+              f"{dt:.2f}s)", file=sys.stderr)
+    else:
+        print(f"nezha-lint: OK — {n_rules} rules over "
+              f"{len(index.modules)} files in {dt:.2f}s "
+              f"({len(findings) - len(kept)} baseline-suppressed)",
+              file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -101,6 +101,12 @@ class Histogram:
     __slots__ = ("name", "count", "total", "min", "max", "_samples",
                  "_stride", "_skip", "_cap", "_lock")
 
+    # observe() is a multi-field read-modify-write hit from concurrent
+    # recorder threads — declared for nezha-lint's lock-discipline rule.
+    _LOCK_GUARDED = {"count": "_lock", "total": "_lock", "min": "_lock",
+                     "max": "_lock", "_samples": "_lock",
+                     "_stride": "_lock", "_skip": "_lock"}
+
     def __init__(self, name: str, cap: int = 4096):
         self.name = name
         self.count = 0
@@ -221,6 +227,11 @@ class Registry:
     get-or-create (instrument mutation itself is GIL-atomic enough for
     counters/gauges; histograms carry their own lock, spans take the
     registry's)."""
+
+    # Get-or-create maps and the span log, shared by every recording
+    # thread — declared for nezha-lint's lock-discipline rule.
+    _LOCK_GUARDED = {"_counters": "_lock", "_gauges": "_lock",
+                     "_histograms": "_lock", "spans": "_lock"}
 
     def __init__(self, max_spans: int = 10000):
         self._lock = threading.Lock()

@@ -85,6 +85,13 @@ class Router:
     replica failure. Thread-safe: HTTP handler threads call it
     concurrently."""
 
+    # Cross-thread state -> guarding lock (enforced by nezha-lint's
+    # lock-discipline rule): handler threads bump the ledgers
+    # concurrently, and the backoff RNG's stream advance is a mutation.
+    _LOCK_GUARDED = {"retries": "_ledger_lock",
+                     "failovers": "_ledger_lock",
+                     "_rng": "_rng_lock"}
+
     def __init__(self, supervisor, cfg: Optional[RouterConfig] = None):
         self.sup = supervisor
         self.cfg = cfg if cfg is not None else supervisor.cfg

@@ -173,6 +173,14 @@ class Scheduler:
 
     step_retry_backoff_s = 0.05
 
+    # Cross-thread state and the lock that guards it — the declaration
+    # nezha-lint's lock-discipline rule enforces: every write to these
+    # outside `with self._lock` (or a method marked `[holds: _lock]`,
+    # meaning the caller already holds it) fails the build. submit()
+    # runs on HTTP handler threads against the decode loop's step().
+    _LOCK_GUARDED = {"_queue": "_lock", "_live": "_lock",
+                     "results": "_lock", "_host_gap_t": "_lock"}
+
     def __init__(self, engine: Engine,
                  on_token: Optional[Callable[[str, int], None]] = None,
                  on_finish: Optional[Callable[[RequestResult], None]] = None):
@@ -300,6 +308,7 @@ class Scheduler:
 
     # -------------------------------------------------------- internals
     def _expire_queued(self) -> None:
+        """[holds: _lock] — step() calls this inside the lock."""
         now = time.monotonic()
         kept: Deque[_Live] = collections.deque()
         for live in self._queue:
@@ -311,6 +320,7 @@ class Scheduler:
         self._queue = kept
 
     def _admit(self) -> None:
+        """[holds: _lock] — step() calls this inside the lock."""
         pool = self.engine.pool
         while self._queue and pool.num_free:
             if self.engine.paged:
@@ -369,6 +379,7 @@ class Scheduler:
             obs.counter("serve.admitted_total").inc()
 
     def _decode(self) -> int:
+        """[holds: _lock] — step() calls this inside the lock."""
         horizon = self.engine.cfg.decode_horizon
         active = np.zeros((self.engine.cfg.max_batch_size,), bool)
         for slot in self._live:
@@ -508,6 +519,8 @@ class Scheduler:
 
     def _finish(self, live: _Live, reason: str,
                 error: Optional[str] = None) -> None:
+        """[holds: _lock] — every caller (admission, decode, drain)
+        already holds the lock; ``results`` is read by waiter threads."""
         result = RequestResult(
             request_id=live.request_id, tokens=live.tokens,
             finish_reason=reason, ttft_s=live.ttft_s,

@@ -284,6 +284,10 @@ class _ThreadWorker:
     threads, abrupt socket severing) — the properties an OS process
     gets for free and a thread has to engineer."""
 
+    # Handler threads, the decode loop, and kill() all touch these —
+    # declared for nezha-lint's lock-discipline rule.
+    _LOCK_GUARDED = {"_events": "_events_lock", "_conns": "_conns_lock"}
+
     def __init__(self, worker_args, rid: int, drain_timeout_s: float):
         from http.server import ThreadingHTTPServer
 
@@ -523,6 +527,12 @@ class Supervisor:
 
     tick_interval_s = 0.05
 
+    # Cross-thread state -> guarding lock (enforced by nezha-lint's
+    # lock-discipline rule): the monitor tick, the router's prober, and
+    # HTTP handler threads all touch the replica records and ledgers.
+    _LOCK_GUARDED = {"_replicas": "_lock", "_draining": "_lock",
+                     "restarts": "_lock", "_rng": "_lock"}
+
     def __init__(self, backend, cfg: RouterConfig):
         self.backend = backend
         self.cfg = cfg
@@ -589,7 +599,7 @@ class Supervisor:
 
     # ------------------------------------------------------- internals
     def _spawn(self, r: Replica) -> None:
-        """Lock held. Raises on spawn failure (callers route the
+        """[holds: _lock] Raises on spawn failure (callers route the
         exception into the backoff/breaker accounting)."""
         faults.point("supervisor.spawn")
         port = free_port()
@@ -602,6 +612,7 @@ class Supervisor:
         r.error = None
 
     def _spawn_failed(self, r: Replica, e: Exception, now: float) -> None:
+        """[holds: _lock]"""
         r.restart_failures += 1
         r.error = f"spawn failed: {type(e).__name__}: {e}"
         if r.restart_failures >= self.cfg.max_restart_failures:
@@ -615,6 +626,7 @@ class Supervisor:
                 r.restart_failures)
 
     def _note_death(self, r: Replica, now: float, why: str) -> None:
+        """[holds: _lock]"""
         # Only deaths that never reached a healthy probe count toward
         # the breaker: a replica that serves and then gets killed is
         # RECOVERING each time, not failing to start.
@@ -634,6 +646,7 @@ class Supervisor:
         self._update_live_gauge()
 
     def _restart(self, r: Replica, now: float) -> None:
+        """[holds: _lock] — tick() performs restarts inside the lock."""
         try:
             self._spawn(r)
         except Exception as e:
@@ -643,6 +656,7 @@ class Supervisor:
         obs.counter("router.replica_restarts_total").inc()
 
     def _restart_backoff(self, failures: int) -> float:
+        """[holds: _lock] — the seeded RNG stream is shared state."""
         base = min(self.cfg.restart_backoff_base_s * (2 ** failures),
                    self.cfg.restart_backoff_max_s)
         return base * (0.5 + self._rng.random())   # ±50% seeded jitter

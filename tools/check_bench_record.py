@@ -1,29 +1,15 @@
 #!/usr/bin/env python3
-"""Sanity-check committed BENCH_*.json perf records.
+"""Sanity-check committed BENCH_*.json perf records — shim over
+``nezha_tpu.analysis``.
 
-BENCH_r03–r05 taught the lesson: a bench run that DIED (the axon TPU
-tunnel was down, ``jax.devices()`` raised) was committed as if it were
-a measurement, and the perf trajectory silently carried three crash
-records until a reader noticed the ``rc: 1``. This checker makes that
-impossible to repeat: it runs in tier-1 (tests/test_bench_record.py)
-over every committed ``BENCH_*.json`` and fails the build unless each
-record is
+The validation core lives in ``nezha_tpu/analysis/bench_records.py``
+(whose docstring tells the BENCH_r03–r05 crash-record story), shared
+between this standalone checker and the ``bench-records`` lint rule:
+every committed record must be valid JSON, a real measurement, and
+platform-labeled — or explicitly superseded in BENCH_NOTES.md.
 
-- **valid JSON**, and
-- a **real measurement** — either a driver round record (``rc == 0``
-  with a non-null parsed metric) or a ``nezha-bench`` baseline
-  (non-empty ``by_platform`` slots), and
-- **platform-labeled** — a top-level ``platform``/``backend`` field, a
-  platform inside ``parsed``, or ``by_platform`` keys — so a CPU
-  fallback number can never masquerade as (or overwrite) a TPU anchor,
-
-UNLESS the file is explicitly listed in ``BENCH_NOTES.md`` under a
-``## Superseded records`` heading (one ``- FILENAME — reason`` bullet
-per record). Superseding is the ONLY way to keep a bad record
-committed: the crash stays visible as history, the notes say why, and
-a NEW crash record fails tier-1 the moment it lands.
-
-Stdlib-only. Standalone::
+This file keeps the standalone entry point and the API tier-1 tests
+import (``check_dir`` / ``check_record`` / ``superseded_records``)::
 
     python tools/check_bench_record.py            # repo root
     python tools/check_bench_record.py /some/dir
@@ -31,120 +17,30 @@ Stdlib-only. Standalone::
 
 from __future__ import annotations
 
-import glob
-import json
 import os
-import re
 import sys
-from typing import List, Set
 
-_NOTES = "BENCH_NOTES.md"
-_SUPERSEDED_HEADING = "superseded records"
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+try:
+    import nezha_tpu  # noqa: F401 — the full package, when jax exists
+except Exception:
+    # Stdlib-only fallback (see check_fault_points.py): load the
+    # analysis subpackage under a namespace stub so this checker keeps
+    # working on boxes without jax.
+    import types
+    _pkg = types.ModuleType("nezha_tpu")
+    _pkg.__path__ = [os.path.join(_ROOT, "nezha_tpu")]
+    sys.modules["nezha_tpu"] = _pkg
 
-
-def superseded_records(root: str) -> Set[str]:
-    """Filenames listed under BENCH_NOTES.md's ``## Superseded
-    records`` heading (empty set when the file or heading is absent)."""
-    path = os.path.join(root, _NOTES)
-    try:
-        with open(path) as f:
-            text = f.read()
-    except OSError:
-        return set()
-    out: Set[str] = set()
-    in_section = False
-    for line in text.splitlines():
-        if line.lstrip().startswith("#"):
-            in_section = (_SUPERSEDED_HEADING
-                          in line.lstrip("#").strip().lower())
-            continue
-        if in_section:
-            m = re.search(r"(BENCH_\w+\.json)", line)
-            if m:
-                out.add(m.group(1))
-    return out
-
-
-def _platform_label(rec: dict) -> str:
-    """The record's platform label, '' when unlabeled."""
-    for key in ("platform", "backend"):
-        v = rec.get(key)
-        if isinstance(v, str) and v:
-            return v
-    parsed = rec.get("parsed")
-    if isinstance(parsed, dict):
-        for key in ("platform", "backend"):
-            v = parsed.get(key)
-            if isinstance(v, str) and v:
-                return v
-    by = rec.get("by_platform")
-    if isinstance(by, dict) and by:
-        return ",".join(sorted(str(k) for k in by))
-    return ""
-
-
-def check_record(path: str) -> List[str]:
-    """-> violations for one committed record file (empty = valid)."""
-    name = os.path.basename(path)
-    try:
-        with open(path) as f:
-            rec = json.load(f)
-    except OSError as e:
-        return [f"{name}: unreadable ({e})"]
-    except ValueError:
-        return [f"{name}: not valid JSON"]
-    if not isinstance(rec, dict):
-        return [f"{name}: record must be a JSON object"]
-    errors: List[str] = []
-    if "rc" in rec:
-        # Driver round record: {n, cmd, rc, tail, parsed}.
-        if rec.get("rc") != 0:
-            errors.append(
-                f"{name}: CRASH RECORD (rc={rec.get('rc')!r}) — not a "
-                f"measurement; mark it superseded in {_NOTES} or drop "
-                f"it")
-        elif not isinstance(rec.get("parsed"), dict) \
-                or "value" not in rec["parsed"]:
-            errors.append(
-                f"{name}: rc=0 but no parsed metric — the run printed "
-                f"nothing measurable")
-    elif "by_platform" in rec:
-        by = rec.get("by_platform")
-        if not isinstance(by, dict) or not by:
-            errors.append(f"{name}: 'by_platform' must be a non-empty "
-                          f"object of per-platform slots")
-    else:
-        errors.append(
-            f"{name}: unrecognized record shape (neither a driver "
-            f"round record with 'rc' nor a nezha-bench 'by_platform' "
-            f"baseline)")
-    if not errors and not _platform_label(rec):
-        errors.append(
-            f"{name}: no platform label (top-level 'platform'/"
-            f"'backend', parsed.platform, or by_platform keys) — "
-            f"unlabeled numbers cannot be gated per-platform")
-    return errors
-
-
-def check_dir(root: str) -> List[str]:
-    """Validate every committed BENCH_*.json under ``root`` (skipping
-    records superseded in BENCH_NOTES.md). -> violations."""
-    errors: List[str] = []
-    skip = superseded_records(root)
-    paths = sorted(glob.glob(os.path.join(root, "BENCH_*.json")))
-    if not paths:
-        return [f"no BENCH_*.json records found under {root}"]
-    for path in paths:
-        if os.path.basename(path) in skip:
-            continue
-        errors.extend(check_record(path))
-    return errors
+from nezha_tpu.analysis.bench_records import (  # noqa: E402,F401
+    check_dir, check_record, superseded_records)
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
-    root = argv[0] if argv else os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))
+    root = argv[0] if argv else _ROOT
     errors = check_dir(root)
     for e in errors:
         print(e, file=sys.stderr)

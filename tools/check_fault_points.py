@@ -1,25 +1,16 @@
 #!/usr/bin/env python3
-"""Fault-point registry validator.
+"""Fault-point registry validator — shim over ``nezha_tpu.analysis``.
 
-The fault-injection layer (``nezha_tpu.faults``) only earns its keep if
-every registered point stays discoverable, documented, and actually
-exercised — an undocumented point is a chaos knob nobody can use, and an
-untested one is a resilience claim nobody has proven. This validator
-walks the source tree for ``faults.point("...")`` / ``faults.corrupt(
-"...")`` literals and asserts each name is
+The real implementation is the ``fault-points`` lint rule
+(``nezha_tpu/analysis/rules/fault_points.py``): every
+``faults.point("...")`` / ``faults.corrupt("...")`` call site must be
+unique, RUNBOOK-documented, test-covered, and pinned in
+``EXPECTED_POINTS`` — see that module's docstring. It now walks real
+AST ``Call`` nodes through the shared source index instead of
+regexing, so docstring examples can never register as call sites.
 
-1. **unique** — one call site per name, so hit counts and plan rules
-   are unambiguous;
-2. **documented** — the name appears in docs/RUNBOOK.md (the fault-point
-   table in the "Failure modes & recovery" section);
-3. **tested** — the name appears in at least one file under tests/
-   (a plan rule string or a direct reference);
-4. **pinned** — the discovered set matches ``EXPECTED_POINTS`` exactly,
-   so a point can neither appear nor vanish without this file (and the
-   RUNBOOK table) being updated deliberately.
-
-Stdlib-only, same pattern as check_telemetry_schema.py: run from the
-tier-1 suite (tests/test_faults.py) or standalone:
+This file keeps the standalone entry point and the exact API tier-1
+tests import (``EXPECTED_POINTS`` / ``find_points`` / ``check``)::
 
     python tools/check_fault_points.py [REPO_ROOT]
 """
@@ -27,101 +18,31 @@ tier-1 suite (tests/test_faults.py) or standalone:
 from __future__ import annotations
 
 import os
-import re
 import sys
-from typing import Dict, List
 
-POINT_RE = re.compile(
-    r"""faults\.(?:point|corrupt)\(\s*["']([A-Za-z0-9_.]+)["']""")
-# The frozen registry: every faults.point()/corrupt() call site in the
-# tree, by name. Adding a fault point means adding it HERE (and to the
-# RUNBOOK table + a test) in the same change.
-EXPECTED_POINTS = frozenset({
-    "serve.prefill", "serve.prefill.logits",
-    "serve.step", "serve.step.logits",
-    "checkpoint.save", "dist.join",
-    # Multi-replica serving (router/supervisor front end):
-    "router.route", "router.probe", "supervisor.spawn", "replica.exec",
-    # Paged KV pool: armed at every block bind (admission, lazy decode
-    # growth, COW) — an injected error surfaces as the same typed
-    # KVBlocksExhausted backpressure genuine exhaustion produces.
-    "serve.kv.bind",
-})
-SOURCE_DIR = "nezha_tpu"
-# The faults package itself is excluded: its docstrings describe the API
-# with example call patterns, which are not registered points.
-EXCLUDE_PREFIX = os.path.join("nezha_tpu", "faults")
-RUNBOOK = os.path.join("docs", "RUNBOOK.md")
-TESTS_DIR = "tests"
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+try:
+    import nezha_tpu  # noqa: F401 — the full package, when jax exists
+except Exception:
+    # Stdlib-only fallback (the checkers' original no-dependencies
+    # promise): `import nezha_tpu.analysis` would execute the package
+    # __init__, which imports the whole jax-backed framework. On a box
+    # without jax, register a bare namespace stub instead — the
+    # analysis subpackage itself is stdlib-only and loads fine alone.
+    import types
+    _pkg = types.ModuleType("nezha_tpu")
+    _pkg.__path__ = [os.path.join(_ROOT, "nezha_tpu")]
+    sys.modules["nezha_tpu"] = _pkg
 
-
-def find_points(root: str) -> Dict[str, List[str]]:
-    """-> {point name: [repo-relative files registering it]}."""
-    points: Dict[str, List[str]] = {}
-    for dirpath, _, files in os.walk(os.path.join(root, SOURCE_DIR)):
-        for fn in sorted(files):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, root)
-            if rel.startswith(EXCLUDE_PREFIX):
-                continue
-            with open(path) as f:
-                for name in POINT_RE.findall(f.read()):
-                    points.setdefault(name, []).append(rel)
-    return points
-
-
-def check(root: str) -> List[str]:
-    """-> list of violations (empty = registry is clean)."""
-    errors: List[str] = []
-    points = find_points(root)
-    if not points:
-        errors.append(f"no faults.point()/faults.corrupt() call sites "
-                      f"found under {SOURCE_DIR}/")
-        return errors
-    for name, files in sorted(points.items()):
-        if len(files) > 1:
-            errors.append(
-                f"fault point {name!r} registered at {len(files)} call "
-                f"sites ({', '.join(files)}) — names must be unique")
-    for name in sorted(set(points) - EXPECTED_POINTS):
-        errors.append(f"fault point {name!r} is not in EXPECTED_POINTS "
-                      f"— add it to the pinned registry (and the "
-                      f"RUNBOOK table) deliberately")
-    for name in sorted(EXPECTED_POINTS - set(points)):
-        errors.append(f"pinned fault point {name!r} has no "
-                      f"faults.point()/corrupt() call site under "
-                      f"{SOURCE_DIR}/ — the registry lost a point")
-    with open(os.path.join(root, RUNBOOK)) as f:
-        runbook = f.read()
-    tests_text = []
-    tests_root = os.path.join(root, TESTS_DIR)
-    for dirpath, _, files in os.walk(tests_root):
-        for fn in sorted(files):
-            if fn.endswith(".py"):
-                with open(os.path.join(dirpath, fn)) as f:
-                    tests_text.append(f.read())
-    tests_blob = "\n".join(tests_text)
-    for name in sorted(points):
-        # Boundary-anchored match: a point whose name prefixes another's
-        # ("serve.step" vs "serve.step.logits") must NOT pass vacuously
-        # via its sibling's mentions.
-        exact = re.compile(
-            rf"(?<![A-Za-z0-9_.]){re.escape(name)}(?![A-Za-z0-9_.])")
-        if not exact.search(runbook):
-            errors.append(f"fault point {name!r} is not documented in "
-                          f"{RUNBOOK}")
-        if not exact.search(tests_blob):
-            errors.append(f"fault point {name!r} is not covered by any "
-                          f"test under {TESTS_DIR}/")
-    return errors
+from nezha_tpu.analysis.rules.fault_points import (  # noqa: E402,F401
+    EXPECTED_POINTS, check, find_points)
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
-    root = argv[0] if argv else os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))
+    root = argv[0] if argv else _ROOT
     errors = check(root)
     for e in errors:
         print(e, file=sys.stderr)
