@@ -131,14 +131,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "(supervisor + N in-process replicas, each its "
                         "own engine, reached over real HTTP) instead "
                         "of one scheduler — closed loop only")
+    p.add_argument("--disaggregate", action="store_true",
+                   help="drive the DISAGGREGATED router: "
+                        "--prefill-replicas role=prefill workers take "
+                        "admissions and park prompt KV, "
+                        "--decode-replicas role=decode workers pull "
+                        "the migrated blocks (int8+scales wire) and "
+                        "stream — the record gains migration GB/s and "
+                        "the prefill-wait/decode-wait queueing split "
+                        "(closed loop only)")
+    p.add_argument("--prefill-replicas", type=int, default=1,
+                   help="disaggregated: prefill-tier size")
+    p.add_argument("--decode-replicas", type=int, default=1,
+                   help="disaggregated: decode-tier size")
     p.add_argument("--kill-rate", type=float, default=0.0,
                    help="expected replica kills per second (seeded "
                         "Poisson schedule) while the measured load "
-                        "runs — requires --replicas > 1; killed "
-                        "replicas are restarted by the supervisor and "
-                        "the record reports kills / restarts / "
-                        "failovers / typed errors next to the "
-                        "clean-finish percentiles")
+                        "runs — requires --replicas > 1 (or "
+                        "--disaggregate, where kills are AIMED AT THE "
+                        "PREFILL TIER: the mid-migration crash drill); "
+                        "killed replicas are restarted by the "
+                        "supervisor and the record reports kills / "
+                        "restarts / failovers / typed errors next to "
+                        "the clean-finish percentiles")
     p.add_argument("--model-preset", choices=["tiny", "full"],
                    default="tiny")
     p.add_argument("--seed", type=int, default=0)
@@ -174,14 +189,18 @@ def run(args) -> dict:
     if args.kill_rate < 0:
         raise SystemExit(f"--kill-rate must be >= 0, got "
                          f"{args.kill_rate}")
-    if args.kill_rate > 0 and args.replicas < 2:
+    if args.disaggregate:
+        if args.prefill_replicas < 1 or args.decode_replicas < 1:
+            raise SystemExit("--disaggregate needs --prefill-replicas "
+                             "and --decode-replicas both >= 1")
+    elif args.kill_rate > 0 and args.replicas < 2:
         raise SystemExit("--kill-rate needs --replicas > 1 (killing "
                          "the only replica measures a blackout, not "
                          "failover)")
     from nezha_tpu.cli.common import setup_jax
     setup_jax(args)
 
-    if args.replicas > 1:
+    if args.replicas > 1 or args.disaggregate:
         if len(horizons) != 1:
             raise SystemExit("--replicas > 1 takes a single "
                              "--decode-horizon value, not a sweep")
@@ -194,6 +213,11 @@ def run(args) -> dict:
             print(json.dumps(record, indent=2, sort_keys=True))
         else:
             lat = record["latency_s"]
+            mig = record.get("migration") or {}
+            mig_s = (f", {mig['count']} migrations "
+                     f"{mig['gb_per_s'] * 1e3:.2f} MB/s "
+                     f"({mig['fallbacks']} fallbacks)"
+                     if mig.get("count") is not None else "")
             print(f"replicas={record['replicas']} closed load "
                   f"{record['offered']}: "
                   f"{record['finished_clean']}/{record['requests']} "
@@ -203,7 +227,7 @@ def run(args) -> dict:
                   f"restarts {record['failovers']} failovers "
                   f"{record['retries']} retries, "
                   f"latency p50 {lat['p50'] * 1e3:.1f} ms "
-                  f"p99 {lat['p99'] * 1e3:.1f} ms")
+                  f"p99 {lat['p99'] * 1e3:.1f} ms{mig_s}")
         return record
 
     import jax
@@ -574,7 +598,15 @@ def _run_replicas(args, decode_horizon: int) -> dict:
     kills / restarts / failovers / retries and clean-finish
     percentiles. Replicas are thread-backed (each its own engine,
     reached over real HTTP sockets, killable mid-decode) so the bench
-    pays one process."""
+    pays one process.
+
+    With ``--disaggregate`` the topology is ``--prefill-replicas``
+    role=prefill members + ``--decode-replicas`` role=decode members:
+    admissions park prompt KV on the prefill tier, finished prompts
+    migrate over the int8+scales wire, and the record adds migration
+    GB/s, the prefill-wait/decode-wait queueing split, and fallback
+    counts; ``--kill-rate`` then AIMS at the prefill tier — the
+    SIGKILL-mid-migration chaos drill."""
     import threading
 
     from nezha_tpu import faults, obs
@@ -599,11 +631,19 @@ def _run_replicas(args, decode_horizon: int) -> dict:
     if args.platform:
         wargv += ["--platform", args.platform]
     wargs = serve_parser().parse_args(wargv)
+    roles: tuple = ()
+    total = args.replicas
+    if args.disaggregate:
+        roles = (("prefill",) * args.prefill_replicas
+                 + ("decode",) * args.decode_replicas)
+        total = len(roles)
     cfg = RouterConfig(
-        replicas=args.replicas, probe_interval_s=0.1, probe_misses=3,
+        replicas=total, roles=roles,
+        probe_interval_s=0.1, probe_misses=3,
         restart_backoff_base_s=0.05, restart_backoff_max_s=0.5,
         drain_timeout_s=5.0, seed=args.seed)
-    sup = Supervisor(ThreadBackend(wargs, drain_timeout_s=5.0), cfg)
+    sup = Supervisor(ThreadBackend(wargs, drain_timeout_s=5.0,
+                                   roles=roles), cfg)
     router = Router(sup, cfg)
 
     rng = random.Random(args.seed)
@@ -626,7 +666,7 @@ def _run_replicas(args, decode_horizon: int) -> dict:
     try:
         sup.start()
         router.start()
-        if not router.wait_live(args.replicas, timeout_s=600):
+        if not router.wait_live(total, timeout_s=600):
             raise SystemExit(f"replicas never became live: "
                              f"{sup.describe()}")
         # Warm EVERY replica's programs off the clock — every prompt
@@ -669,7 +709,8 @@ def _run_replicas(args, decode_horizon: int) -> dict:
         if args.run_dir:
             sink = obs.start_run(args.run_dir, meta={
                 "kind": "serve_router_bench", "mode": "closed",
-                "replicas": args.replicas, "kill_rate": args.kill_rate,
+                "replicas": total, "kill_rate": args.kill_rate,
+                "roles": ",".join(roles) if roles else "both",
                 "requests": args.requests,
                 "decode_horizon": decode_horizon,
                 "offered": args.concurrency})
@@ -677,6 +718,9 @@ def _run_replicas(args, decode_horizon: int) -> dict:
             register_serve_instruments()
         retries0, failovers0 = router.retries, router.failovers
         restarts0 = sup.restarts
+        migrations0, mig_bytes0 = router.migrations, router.migration_bytes
+        mig_secs0 = router.migration_seconds
+        fallbacks0 = router.migrate_fallbacks
 
         lock = threading.Lock()
         next_idx = {"n": 0}
@@ -700,15 +744,22 @@ def _run_replicas(args, decode_horizon: int) -> dict:
 
         def killer():
             # Seeded Poisson kill schedule; never kills the LAST live
-            # replica (that measures a blackout, not failover).
+            # replica (that measures a blackout, not failover). On a
+            # disaggregated topology the kills are AIMED at the
+            # prefill tier — the SIGKILL-mid-migration drill the
+            # acceptance pins (decode members survive to prove the
+            # failover; the local-decode fallback covers the window
+            # where the whole prefill tier is down).
             krng = random.Random(args.seed + 1)
             while not stop_kill.is_set():
                 if stop_kill.wait(min(krng.expovariate(args.kill_rate),
                                       5.0)):
                     return
                 live = sup.live_replicas()
-                if len(live) >= 2:
-                    victim = live[krng.randrange(len(live))].rid
+                pool = ([r for r in live if r.role == "prefill"]
+                        if args.disaggregate else live)
+                if len(live) >= 2 and pool:
+                    victim = pool[krng.randrange(len(pool))].rid
                     sup.kill(victim)
                     kills.append(victim)
 
@@ -729,7 +780,7 @@ def _run_replicas(args, decode_horizon: int) -> dict:
         wall = time.monotonic() - t0
         # Recovery check: the supervisor should restart every kill;
         # give backoff a moment before reading the final live count.
-        router.wait_live(args.replicas, timeout_s=120)
+        router.wait_live(total, timeout_s=120)
         recovered_live = sup.live_count()
     finally:
         faults.install(prev_plan)
@@ -748,9 +799,40 @@ def _run_replicas(args, decode_horizon: int) -> dict:
                     else None) or f"http_{c}"
             errors_typed[kind] = errors_typed.get(kind, 0) + 1
     tokens = sum(len(o.get("tokens", [])) for _, _, o, _ in ok)
+    # Per-token decode latency from the SERVING replica's own clock
+    # (worker-reported latency_s/ttft_s pair — route latency would
+    # fold admission hops and the migration transfer into "decode"
+    # time): the decode-tier steady-state number the disaggregation
+    # acceptance compares against the co-located baseline. Falls back
+    # to the route latency for stub replicas that report none.
+    tpots = [((o["latency_s"] if o.get("latency_s") is not None
+               else lat) - o["ttft_s"])
+             / max(len(o.get("tokens", [])) - 1, 1)
+             for _, _, o, lat in clean if o.get("ttft_s") is not None]
+    migs = [o["migration"] for _, _, o, _ in ok
+            if isinstance(o.get("migration"), dict)]
+    mig_secs = router.migration_seconds - mig_secs0
+    mig_bytes = router.migration_bytes - mig_bytes0
+    record_mig = None
+    if args.disaggregate:
+        record_mig = {
+            "count": router.migrations - migrations0,
+            "bytes": mig_bytes,
+            "seconds": mig_secs,
+            # Mean PER-PULL wire rate: total bytes over the SUM of the
+            # individual pull windows (export + install + ACK each) —
+            # what one migration sustains on the wire. Concurrent pulls
+            # overlap, so this deliberately is NOT aggregate fleet
+            # throughput; divide `bytes` by the record's `wall_s` for
+            # a (load-diluted) aggregate bound.
+            "gb_per_s": (mig_bytes / mig_secs / 1e9) if mig_secs else 0.0,
+            "fallbacks": router.migrate_fallbacks - fallbacks0,
+        }
     return {
         "mode": "closed",
-        "replicas": args.replicas,
+        "replicas": total,
+        "disaggregate": bool(args.disaggregate),
+        "roles": list(roles),
         "kill_rate": args.kill_rate,
         "decode_horizon": decode_horizon,
         "offered": args.concurrency,
@@ -776,6 +858,17 @@ def _run_replicas(args, decode_horizon: int) -> dict:
         "ttft_s": _percentiles(
             [o["ttft_s"] for _, _, o, _ in clean
              if o.get("ttft_s") is not None] or [0.0]),
+        "tpot_s": _percentiles(tpots or [0.0]),
+        "migration": record_mig,
+        # The queueing-delay split per tier (disaggregated runs only:
+        # time to the parked prefill answer vs the decode replica's
+        # TTFT for the migrated request).
+        "prefill_wait_s": _percentiles(
+            [m["prefill_wait_s"] for m in migs
+             if m.get("prefill_wait_s") is not None] or [0.0]),
+        "decode_wait_s": _percentiles(
+            [m["decode_wait_s"] for m in migs
+             if m.get("decode_wait_s") is not None] or [0.0]),
         "faults": {"rate": args.fault_rate,
                    "injected": plan.num_injected if plan else 0,
                    "errored": sum(1 for _, _, o, _ in ok

@@ -38,6 +38,12 @@ EXPECTED_POINTS = frozenset({
     # growth, COW) — an injected error surfaces as the same typed
     # KVBlocksExhausted backpressure genuine exhaustion produces.
     "serve.kv.bind",
+    # Disaggregated prefill/decode migration (serve/migrate.py): the
+    # router's orchestration entry, the source-side block export, and
+    # the destination-side install — each failure surfaces typed
+    # (injected_fault / migration_failed) and is retried, fallen back,
+    # or restarted by the router, never silently dropped.
+    "router.migrate", "replica.kv_export", "replica.kv_install",
 })
 SOURCE_PREFIX = "nezha_tpu/"
 EXCLUDE_PREFIX = "nezha_tpu/faults/"

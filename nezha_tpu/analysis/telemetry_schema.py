@@ -52,7 +52,13 @@ _SERVE_COUNTERS = {"serve.admitted_total", "serve.rejected_total",
                    # copy-on-write block copies. Layout-invariant: a
                    # dense-layout run reports 0s, never omits them.
                    "serve.kv.prefix_hits_total",
-                   "serve.kv.cow_copies_total"}
+                   "serve.kv.cow_copies_total",
+                   # Cross-replica KV migration (PR 11, disaggregated
+                   # prefill/decode tiers): committed installs and
+                   # their int8-wire bytes. Topology-invariant: a
+                   # homogeneous run reports 0s, never omits them.
+                   "serve.kv.migrations_total",
+                   "serve.kv.migration_bytes"}
 _SERVE_GAUGES = {"serve.queue_depth", "serve.batch_occupancy",
                  "serve.kv.blocks_used",
                  # KV quantization (PR 9): device bytes the resident KV
@@ -77,9 +83,18 @@ _SERVE_HISTOGRAMS = {"serve.ttft_s", "serve.tpot_s",
 # with zero failovers still reports failovers_total = 0.
 _ROUTER_MARKER = "router.retries_total"
 _ROUTER_COUNTERS = {"router.retries_total", "router.failovers_total",
-                    "router.replica_restarts_total"}
+                    "router.replica_restarts_total",
+                    # Disaggregated topologies: local-decode (and
+                    # no-prefill-tier) degradations — typed fallbacks,
+                    # 0 on homogeneous runs.
+                    "router.migrate_fallbacks_total"}
 _ROUTER_GAUGES = {"router.replicas_live"}
-_ROUTER_HISTOGRAMS = {"router.route_s"}
+_ROUTER_HISTOGRAMS = {"router.route_s",
+                      # The queueing-delay split of the disaggregated
+                      # pipeline: time to the parked prefill answer vs
+                      # the decode replica's TTFT for the migrated
+                      # request (both empty on homogeneous runs).
+                      "router.prefill_wait_s", "router.decode_wait_s"}
 
 # Dist-run schema: any run that touched the coordinator (any dist.*
 # counter present — join() pre-registers the pair) must carry the full
@@ -104,6 +119,10 @@ _PINNED_SPANS = {
     "checkpoint.save", "checkpoint.verify",
     "dist.join", "dist.barrier", "dist.failure", "dist.leave",
     "router.drain",
+    # One span per disaggregated-pipeline orchestration: prefill
+    # dispatch -> KV migration -> decode answer (attrs carry src/dst
+    # rids, wire bytes, and any degradation taken).
+    "router.migrate",
 }
 
 # Namespaces whose METRIC names (counter/gauge/histogram) the source

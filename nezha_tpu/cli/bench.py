@@ -192,7 +192,48 @@ def _run_serving(args, platform: str) -> dict:
         bf16_argv + iload))
     kv_int8 = serving_bench.run(serving_bench.build_parser().parse_args(
         int8_argv + iload))
+    # Disaggregated prefill/decode tiers vs co-located (ISSUE 11
+    # acceptance): a LONG-PROMPT mix (the traffic shape whose bursty
+    # prefill stalls co-located TPOT) at EQUAL TOTAL HARDWARE — a
+    # 1-prefill + 2-decode router vs a 3-replica co-located one, same
+    # closed-loop load. TPOT is the worker-local decode cadence
+    # (benchmarks/serving.py), so the ratio isolates what the decode
+    # tier gains by never interleaving prefill. The record carries
+    # migration GB/s and the prefill-wait/decode-wait queueing split
+    # (recorded, not gated — CPU latency numbers are noisy; the gate
+    # stays on the horizon-sweep tokens/sec).
+    if args.quick:
+        dis_load = ["--requests", str(requests), "--concurrency", "4",
+                    "--prompt-len-mix", "6,20", "--max-new-tokens", "6",
+                    "--max-batch-size", "2", "--max-len", "48",
+                    "--max-prefill-len", "8", "--kv-block-size", "4",
+                    "--platform", platform]
+    else:
+        dis_load = ["--requests", str(requests), "--concurrency", "6",
+                    "--prompt-len-mix", "8,56,56",
+                    "--max-new-tokens", "16",
+                    "--max-batch-size", "4", "--max-len", "96",
+                    "--max-prefill-len", "16", "--kv-block-size", "16",
+                    "--platform", platform]
+    tiers = ["--prefill-replicas", "1", "--decode-replicas",
+             "1" if args.quick else "2"]
+    disagg = serving_bench.run(serving_bench.build_parser().parse_args(
+        ["--disaggregate"] + tiers + dis_load))
+    coloc = serving_bench.run(serving_bench.build_parser().parse_args(
+        ["--replicas", "2" if args.quick else "3"] + dis_load))
     return {"closed_loop_horizon_sweep": sweep,
+            "disaggregated_prefill_decode": {
+                "load": "long-prompt mix "
+                        + dis_load[dis_load.index("--prompt-len-mix") + 1],
+                "disaggregated": disagg, "colocated": coloc,
+                "migration_gb_per_s":
+                    (disagg.get("migration") or {}).get("gb_per_s"),
+                "prefill_wait_p50_s": disagg["prefill_wait_s"]["p50"],
+                "decode_wait_p50_s": disagg["decode_wait_s"]["p50"],
+                "tpot_p50_ratio_disagg_vs_colocated": (
+                    disagg["tpot_s"]["p50"]
+                    / max(coloc["tpot_s"]["p50"], 1e-9)),
+            },
             "shared_prefix_0.8": shared,
             "paged_vs_dense_equal_memory": {
                 "kv_budget": budget_note,

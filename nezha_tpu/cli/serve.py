@@ -159,6 +159,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "'deadline'")
     p.add_argument("--http", type=int, default=None, metavar="PORT",
                    help="serve HTTP on PORT instead of stdio JSONL")
+    p.add_argument("--role", choices=["prefill", "decode", "both"],
+                   default="both",
+                   help="this replica's serving tier (surfaced in "
+                        "/healthz and the router's replica table): "
+                        "'prefill' members take admissions and park "
+                        "prompt KV for migration, 'decode' members "
+                        "pull migrated KV and stream tokens, 'both' "
+                        "(default) does everything — the role is "
+                        "routing metadata; every worker keeps the full "
+                        "engine so degraded topologies still serve")
+    p.add_argument("--prefill-replicas", type=int, default=0,
+                   help="with --decode-replicas: run a DISAGGREGATED "
+                        "front end of this many role=prefill workers "
+                        "plus the decode tier (overrides --replicas; "
+                        "requires --http) — admissions land on the "
+                        "prefill tier and finished prompts' KV "
+                        "migrates to the decode tier "
+                        "(docs/RUNBOOK.md §10)")
+    p.add_argument("--decode-replicas", type=int, default=0,
+                   help="number of role=decode workers of the "
+                        "disaggregated front end (see "
+                        "--prefill-replicas)")
     p.add_argument("--replicas", type=int, default=1,
                    help="N > 1 turns this process into a router/"
                         "supervisor front end over N engine worker "
@@ -282,7 +304,10 @@ def _parse_request(obj: dict, args, tokenizer, eos_id, vocab: int):
         eos_id=num("eos_id", int, eos_id),
         seed=num("seed", int, args.seed),
         deadline_s=num("deadline_s", float),
-        request_id=obj.get("id"))
+        request_id=obj.get("id"),
+        # Disaggregation: prefill and PARK for migration (the router's
+        # phase-one dispatch) instead of decoding here.
+        prefill_only=bool(obj.get("prefill_only", False)))
 
 
 def _decode_text(tokens, tokenizer):
@@ -532,18 +557,46 @@ def run_http(scheduler, args, tokenizer, eos_id, port: int,
                 "active": pool.num_active,
                 "capacity": pool.capacity,
                 "queued": scheduler.queue_depth,
-                "occupancy": pool.occupancy})
+                "occupancy": pool.occupancy,
+                "role": getattr(args, "role", "both"),
+                "parked": scheduler.parked_count})
 
         def do_POST(self):
+            from nezha_tpu.serve import migrate
+            if self.path in ("/kv_export", "/kv_ack"):
+                # Migration endpoints (docs/RUNBOOK.md §10): the source
+                # side of the pull and the two-phase ACK. Allowed
+                # during drain — an in-flight migration finishing is
+                # strictly better than its park being swept.
+                n = int(self.headers.get("Content-Length", 0))
+                return self._send(*migrate.dispatch_kv_endpoint(
+                    scheduler, self.path, self.rfile.read(n)))
             if self.path != "/generate":
                 return self._send(404, {"error": "unknown path"})
             if drain.is_set():   # admission is closed for good
                 return self._send(503, {"error": "draining"})
             try:
                 n = int(self.headers.get("Content-Length", 0))
-                req = _parse_request(json.loads(self.rfile.read(n)),
-                                     args, tokenizer, eos_id, vocab)
+                obj = json.loads(self.rfile.read(n))
             except (ValueError, json.JSONDecodeError) as e:
+                return self._send(400, {"error": str(e)})
+            if isinstance(obj, dict) and obj.get("resume"):
+                return self._handle_resume(str(obj["resume"]))
+            mig_meta = None
+            if isinstance(obj, dict) and obj.get("pull_from") is not None:
+                # Decode side of a migration: pull + install + ACK
+                # BEFORE admission so the submit below prefix-hits the
+                # installed blocks; failure is the typed 424 the router
+                # retries on.
+                try:
+                    mig_meta = migrate.pull_into(scheduler,
+                                                 obj["pull_from"])
+                except migrate.MigrationError as e:
+                    return self._send(424, {
+                        "error": str(e), "error_type": e.kind})
+            try:
+                req = _parse_request(obj, args, tokenizer, eos_id, vocab)
+            except ValueError as e:
                 return self._send(400, {"error": str(e)})
             if stop.is_set():
                 return self._send(503, {"error": "decode loop stopped"})
@@ -592,6 +645,40 @@ def run_http(scheduler, args, tokenizer, eos_id, port: int,
                 return self._send(500, {"error": "decode loop failed"})
             out = _result_obj(res, tokenizer)
             out.pop("event")
+            if mig_meta is not None:
+                out["migration"] = mig_meta
+            self._send(200, out)
+
+        def _handle_resume(self, rid: str):
+            """Local-decode fallback: move a parked request into the
+            live set and answer with its finished result (the
+            ``role=both`` degradation)."""
+            ev = threading.Event()
+            with events_lock:
+                if rid in events:
+                    return self._send(409, {
+                        "error": f"request id {rid!r} already in "
+                                 f"flight"})
+                events[rid] = ev
+            if not scheduler.resume_parked(rid):
+                with events_lock:
+                    events.pop(rid, None)
+                return self._send(404, {
+                    "error": f"request {rid!r} is not parked here",
+                    "error_type": "migration_failed"})
+            if stop.is_set():
+                with events_lock:
+                    events.pop(rid, None)
+                return self._send(503, {"error": "draining"})
+            ev.wait()
+            with events_lock:
+                events.pop(rid, None)
+            res = scheduler.results.pop(rid, None)
+            if res is None:
+                return self._send(500, {"error": "decode loop failed"})
+            out = _result_obj(res, tokenizer)
+            out.pop("event")
+            out["resumed"] = True
             self._send(200, out)
 
     class Server(ThreadingHTTPServer):
@@ -730,11 +817,14 @@ def run_worker(args, stdin=None, stdout=None, ready_cb=None,
 
 
 # ------------------------------------------------------- multi-replica
-def _worker_argv(args, rid: int, port: int) -> list:
+def _worker_argv(args, rid: int, port: int, role: Optional[str] = None
+                 ) -> list:
     """The argv for one spawned worker process: the front end's own
-    flags minus the router-only ones, plus the worker's port (and a
-    per-replica run-dir subdirectory when telemetry is on)."""
-    argv = [sys.executable, "-m", "nezha_tpu.cli.serve"]
+    flags minus the router-only ones, plus the worker's port, its tier
+    role (disaggregated topologies), and a per-replica run-dir
+    subdirectory when telemetry is on."""
+    argv = [sys.executable, "-m", "nezha_tpu.cli.serve",
+            "--role", role or getattr(args, "role", "both")]
     if args.random_init:
         argv.append("--random-init")
     elif args.ckpt_dir:
@@ -794,13 +884,31 @@ def run_multi(args, ready_cb=None, drain_event=None) -> int:
     from nezha_tpu.serve.supervisor import (ProcessBackend, RouterConfig,
                                             Supervisor, ThreadBackend)
     if args.http is None:
-        raise SystemExit("--replicas N > 1 requires --http PORT "
+        raise SystemExit("--replicas N > 1 (or --prefill-replicas/"
+                         "--decode-replicas) requires --http PORT "
                          "(the router is an HTTP front end)")
     prev_plan = faults.active()
     faults.install_from_env()
 
+    roles: tuple = ()
+    total = args.replicas
+    if args.prefill_replicas or args.decode_replicas:
+        # Disaggregated tiers: N prefill workers + M decode workers;
+        # admissions land on the prefill tier and finished prompts'
+        # KV migrates to the decode tier (RUNBOOK §10).
+        if args.prefill_replicas < 1 or args.decode_replicas < 1:
+            raise SystemExit("--prefill-replicas and --decode-replicas "
+                             "must both be >= 1 for a disaggregated "
+                             "front end")
+        roles = (("prefill",) * args.prefill_replicas
+                 + ("decode",) * args.decode_replicas)
+        total = len(roles)
+
+    def role_of(rid: int) -> str:
+        return roles[rid] if roles else args.role
+
     cfg = RouterConfig(
-        replicas=args.replicas,
+        replicas=total, roles=roles,
         probe_interval_s=args.probe_interval,
         probe_misses=args.probe_misses,
         route_retries=args.route_retries,
@@ -813,18 +921,22 @@ def run_multi(args, ready_cb=None, drain_event=None) -> int:
         from nezha_tpu import obs
         from nezha_tpu.serve.router import register_router_instruments
         sink = obs.start_run(args.run_dir, meta={
-            "kind": "serve_router", "replicas": args.replicas,
+            "kind": "serve_router", "replicas": total,
+            "roles": ",".join(roles) if roles else "both",
             "backend": args.replica_backend})
         register_router_instruments()
     if args.replica_backend == "thread":
         wargs = copy.copy(args)
         wargs.replicas, wargs.http, wargs.run_dir = 1, None, None
+        wargs.prefill_replicas = wargs.decode_replicas = 0
         backend = ThreadBackend(wargs,
-                                drain_timeout_s=args.drain_timeout)
+                                drain_timeout_s=args.drain_timeout,
+                                roles=roles)
     else:
         import os
         backend = ProcessBackend(
-            lambda rid, port: _worker_argv(args, rid, port),
+            lambda rid, port: _worker_argv(args, rid, port,
+                                           role_of(rid)),
             log_dir=(os.path.join(args.run_dir, "logs")
                      if args.run_dir else None))
     sup = Supervisor(backend, cfg)
@@ -859,7 +971,9 @@ def run_multi(args, ready_cb=None, drain_event=None) -> int:
 
 def run(args, stdin=None, stdout=None, ready_cb=None,
         drain_event=None) -> int:
-    if getattr(args, "replicas", 1) > 1:
+    if (getattr(args, "replicas", 1) > 1
+            or getattr(args, "prefill_replicas", 0)
+            or getattr(args, "decode_replicas", 0)):
         return run_multi(args, ready_cb=ready_cb,
                          drain_event=drain_event)
     return run_worker(args, stdin=stdin, stdout=stdout,

@@ -207,6 +207,22 @@ def render_replicas_section(summary: Optional[dict]) -> List[str]:
             f"  route: p50 {h['p50'] * 1e3:.1f} ms  "
             f"p90 {h['p90'] * 1e3:.1f} ms  "
             f"p99 {h['p99'] * 1e3:.1f} ms  (n={h['count']})")
+    # Disaggregated tiers: migration volume and the per-tier queueing
+    # split (present only when the run actually migrated / split).
+    mig = counters.get("serve.kv.migrations_total", 0)
+    if mig:
+        lines.append(
+            f"  migration: {mig:.0f} pulls  "
+            f"{counters.get('serve.kv.migration_bytes', 0) / 2**20:.2f} "
+            f"MiB moved  "
+            f"{counters.get('router.migrate_fallbacks_total', 0):.0f} "
+            f"fallbacks")
+    pw, dw = (hists.get("router.prefill_wait_s"),
+              hists.get("router.decode_wait_s"))
+    if pw and pw.get("count") and dw and dw.get("count"):
+        lines.append(
+            f"  queue split: prefill wait p50 {pw['p50'] * 1e3:.1f} ms  "
+            f"decode wait p50 {dw['p50'] * 1e3:.1f} ms")
     return lines
 
 

@@ -1,11 +1,18 @@
-"""shard_map shim across JAX versions.
+"""shard_map / axis_size shims across JAX versions.
 
 Newer JAX enforces static "varying-over-mesh-axes" (vma) inference; outputs
 produced by all_gather are mathematically replicated but the checker can't
 prove it, so we disable the check here (kwarg name differs across versions).
+
+``lax.axis_size`` only exists on newer JAX; older versions (0.4.x) spell
+the same static lookup ``lax.psum(1, axis_name)`` — under shard_map a
+constant-int psum folds to a plain Python int at trace time, so call
+sites may still use the result in shape arithmetic and ``range()``.
 """
 
 import inspect
+
+from jax import lax
 
 try:  # jax >= 0.6-ish exposes it at top level
     from jax import shard_map as _shard_map  # type: ignore[attr-defined]
@@ -25,4 +32,16 @@ def shard_map(f, *, mesh, in_specs, out_specs):
                       **_kwargs)
 
 
-__all__ = ["shard_map"]
+if hasattr(lax, "axis_size"):
+    def axis_size(axis_name):
+        """Number of devices along ``axis_name`` (static int)."""
+        return lax.axis_size(axis_name)
+else:  # pragma: no cover — exercised on jax < 0.6 installs
+    def axis_size(axis_name):
+        """Number of devices along ``axis_name``. ``psum`` of a constant
+        int folds to a plain Python int at trace time, so this is the
+        same static value newer JAX's ``lax.axis_size`` returns."""
+        return lax.psum(1, axis_name)
+
+
+__all__ = ["shard_map", "axis_size"]

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from nezha_tpu import obs
+from nezha_tpu.parallel._compat import axis_size
 
 
 def record_traced_collective(op: str, tree: Any) -> None:
@@ -68,7 +69,7 @@ def reduce_scatter(tree: Any, axis_name: str, axis: int = 0) -> Any:
 def ring_permute(x, axis_name: str, shift: int = 1):
     """Send to the next rank on the ring (ring attention / pipeline edges)."""
     record_traced_collective("ppermute", x)
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm)
 

@@ -35,7 +35,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from nezha_tpu.optim.optimizers import Optimizer, apply_updates
-from nezha_tpu.parallel._compat import shard_map
+from nezha_tpu.parallel._compat import axis_size, shard_map
 
 PyTree = Any
 
@@ -100,7 +100,7 @@ def pipeline_blocks(stage_params: PyTree, x: jax.Array, rng=None, *,
     shards — bubble-tick applications draw keys too but their outputs are
     masked away, so they cost nothing and corrupt nothing.
     """
-    world = lax.axis_size(axis_name)
+    world = axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     m = num_microbatches
     b_local = x.shape[0]

@@ -42,6 +42,7 @@ from jax import lax
 # pre-extraction in-module version. The private aliases keep this
 # module's internal call sites (and any external ones) stable.
 from nezha_tpu.ops.quant import QMAX as _QMAX
+from nezha_tpu.parallel._compat import axis_size
 from nezha_tpu.ops.quant import dequantize as _dequantize
 from nezha_tpu.ops.quant import quantize_blocks as _quantize_blocks
 
@@ -83,7 +84,7 @@ def split_quantized_leaves(tree: Any, min_numel: int):
 def _qar_mean(x: jax.Array, axis_name: str, block: int) -> jax.Array:
     """int8-wire all-reduce-mean of one array (inside shard_map): the ring
     decomposition reduce_scatter + all_gather, each phase quantized."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     flat = jnp.asarray(x, jnp.float32).reshape(-1)
     per = -(-flat.size // (n * block)) * block  # chunk per rank, block-aligned
     flat = jnp.pad(flat, (0, n * per - flat.size))
@@ -111,7 +112,7 @@ def quantized_reduce_scatter_mean(flat: jax.Array, axis_name: str,
     rank's mean chunk [chunk] (the ZeRO-1 gradient phase; ZeRO++'s qgZ in
     XLA-collective form). Row padding to the block size happens internally,
     so callers keep the exact-path layout (chunk = size/world)."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     rows = jnp.asarray(flat, jnp.float32).reshape(n, -1)
     chunk = rows.shape[1]
     rows = jnp.pad(rows, ((0, 0), (0, (-chunk) % block)))
@@ -126,7 +127,7 @@ def quantized_all_gather(chunk_arr: jax.Array, axis_name: str,
                          block: int = 512) -> jax.Array:
     """int8-wire tiled all-gather of a per-rank [chunk] array ->
     [world*chunk] fp32 (the ZeRO-1 weight/update broadcast phase)."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     chunk = chunk_arr.size
     x = jnp.pad(jnp.asarray(chunk_arr, jnp.float32).reshape(-1),
                 (0, (-chunk) % block))

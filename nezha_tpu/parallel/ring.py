@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from nezha_tpu.parallel._compat import axis_size
+
 _NEG_BIG = -1e30  # finite "-inf" so fully-masked rows stay NaN-free
 
 
@@ -43,7 +45,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
         # scale=None passes through: the kernel layer owns the 1/sqrt(d)
         # default (flash_attention._flash_call), one place only.
         return _ring_flash(q, k, v, axis_name, causal, scale)
-    world = lax.axis_size(axis_name)
+    world = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, h, s_local, d = q.shape
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
@@ -137,7 +139,7 @@ def _ring_flash(q, k, v, axis_name: str, causal: bool,
 def _ring_flash_fwd(q, k, v, axis_name, causal, scale):
     from nezha_tpu.ops.pallas.flash_attention import flash_block_fwd
 
-    world = lax.axis_size(axis_name)
+    world = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, h, s_local, d = q.shape
     perm = [(i, (i + 1) % world) for i in range(world)]
@@ -176,7 +178,7 @@ def _ring_flash_bwd(axis_name, causal, scale, residuals, g):
     from nezha_tpu.ops.pallas.flash_attention import flash_block_bwd
 
     q, k, v, out, lse = residuals
-    world = lax.axis_size(axis_name)
+    world = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % world) for i in range(world)]
     g = g.astype(out.dtype)

@@ -26,6 +26,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from nezha_tpu.ops.attention import causal_mask, dot_product_attention
+from nezha_tpu.parallel._compat import axis_size
 
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
@@ -38,7 +39,7 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
     the flash path (the kernel runs in interpret mode there) — this is how CI
     executes the TPU branch's plumbing without a chip.
     """
-    world = lax.axis_size(axis_name)
+    world = axis_size(axis_name)
     b, h, s_local, d = q.shape
     if h % world:
         raise ValueError(f"heads {h} not divisible by sequence world {world}")
@@ -118,7 +119,7 @@ def make_sp_train_step(model, optimizer, mesh: Mesh,
     def per_shard(state, batch):
         variables, opt_state = state["variables"], state["opt_state"]
         rng, next_rng = jax.random.split(state["rng"])
-        shard_id = (lax.axis_index(dp_axis) * lax.axis_size(sp_axis)
+        shard_id = (lax.axis_index(dp_axis) * axis_size(sp_axis)
                     + lax.axis_index(sp_axis))
         step_rng = jax.random.fold_in(rng, shard_id)
         inputs, targets = batch["inputs"], batch["targets"]

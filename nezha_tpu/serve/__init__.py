@@ -75,6 +75,7 @@ into the same run-dir telemetry artifacts training writes
 """
 
 from nezha_tpu.serve.engine import Engine, ServeConfig
+from nezha_tpu.serve.migrate import MigrationError
 from nezha_tpu.serve.router import Router, register_router_instruments
 from nezha_tpu.serve.sampling import sample_tokens
 from nezha_tpu.serve.scheduler import (
@@ -98,5 +99,5 @@ __all__ = [
     "KVBlocksExhausted", "sample_tokens",
     "Scheduler", "Request", "RequestResult", "QueueFull", "FinishReason",
     "Router", "RouterConfig", "Supervisor", "ProcessBackend",
-    "ThreadBackend", "register_router_instruments",
+    "ThreadBackend", "register_router_instruments", "MigrationError",
 ]

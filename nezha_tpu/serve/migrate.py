@@ -1,0 +1,266 @@
+"""Fault-tolerant paged-block migration between serving replicas.
+
+The disaggregated prefill/decode topology (serve/router.py roles) moves
+a finished prompt's KV from the prefill replica that computed it to the
+decode replica that will stream from it. This module is the wire: the
+int8+scales block payload (ops/quant.py — the EQuARX recipe the wire
+collectives already use, ~4x fewer bytes than shipping bf16), the HTTP
+pull client the decode side runs, and the ``/kv_export`` / ``/kv_ack``
+handler bodies both replica front ends (``cli/serve.run_http`` and the
+supervisor's thread worker) mount.
+
+The protocol is PULL-BASED and TWO-PHASE, designed so a crash at any
+point leaves exactly one owner of the request — or a typed, retryable
+failure — never a leak and never a double-free:
+
+1. **park** — the router admits the request onto a prefill replica with
+   ``prefill_only``; the scheduler prefills the prompt and PARKS the
+   slot (blocks held, refs untouched) under a TTL instead of decoding.
+2. **pull** — the decode replica (handed ``pull_from`` by the router)
+   POSTs ``/kv_export`` to the source: the source exports the parked
+   prompt's full-block prefix through the wire format — a read-only
+   gather; source refs are NOT released.
+3. **install** — the decode replica allocates fresh blocks (ref == 1,
+   the write invariant by construction), scatters the payload in, and
+   indexes the blocks in its own prefix trie. The request it then
+   submits locally takes cache references through ``bind_for_prompt``
+   and prefills only the uncached tail — migration reuses the exact
+   shared-prefix machinery PR 7 proved out, including copy-on-write.
+4. **ACK** — only now does the decode side POST ``/kv_ack``; the source
+   frees the parked slot and its refs. A lost ACK (or a decode replica
+   that died after pulling) is absorbed by the park TTL: the source
+   reclaims the blocks itself, so at worst the prompt's KV briefly
+   exists twice — the REQUEST is still decoded exactly once, by
+   whoever holds it.
+
+Failure is typed end to end: any pull/install failure raises
+:class:`MigrationError`, which the replica front end answers as HTTP
+424 (``error_type: "migration_failed"``) — the router's signal to retry
+the migration against another decode replica, fall back to local decode
+on the source (``resume``), or re-run the whole request. Chaos enters
+through the pinned fault points ``replica.kv_export`` and
+``replica.kv_install`` (scheduler-side) and ``router.migrate``
+(router-side); ``serve.kv.migrations_total`` / ``serve.kv.
+migration_bytes`` count committed installs (schema-pinned).
+"""
+
+from __future__ import annotations
+
+import base64
+import http.client
+import json
+import time
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from nezha_tpu import faults
+from nezha_tpu.serve.slots import KVBlocksExhausted
+
+WIRE_VERSION = 1
+
+# Wire dtypes per payload key — the int8+scales block layout.
+_WIRE_DTYPES = {"k": np.int8, "v": np.int8,
+                "k_scale": np.float32, "v_scale": np.float32}
+
+
+class MigrationError(RuntimeError):
+    """Typed migration failure (source gone, payload mismatch, pool
+    exhausted, injected fault). The replica front end answers it as
+    HTTP 424 with ``error_type = kind`` — ``"migration_failed"``
+    (retryable: the router tries another decode member, then the
+    local-decode fallback) or ``"park_lost"`` (the source answered
+    but no longer holds the park — TTL expired, drained, or already
+    ACKed to a puller that then died: every further pull or resume is
+    doomed, so the router restarts from prefill immediately). Never a
+    silent drop and never a crash of the decode loop."""
+
+    def __init__(self, msg: str, kind: str = "migration_failed"):
+        super().__init__(msg)
+        self.kind = kind
+
+
+# ------------------------------------------------------------ wire codec
+def encode_wire(tokens: Sequence[int],
+                layers: List[Dict[str, np.ndarray]],
+                block_size: int) -> dict:
+    """Block payload -> JSON-safe wire object (arrays as base64 of raw
+    bytes + explicit geometry, so the installer can validate before it
+    touches its pool)."""
+
+    def b64(a: np.ndarray) -> str:
+        return base64.b64encode(
+            np.ascontiguousarray(a).tobytes()).decode("ascii")
+
+    nbytes = sum(a.nbytes for layer in layers for a in layer.values())
+    if layers:
+        n, heads, bs, d = layers[0]["k"].shape
+    else:
+        n, heads, bs, d = 0, 0, block_size, 0
+    return {"v": WIRE_VERSION,
+            "tokens": [int(t) for t in tokens],
+            "block_size": int(block_size), "nblocks": int(n),
+            "heads": int(heads), "head_dim": int(d),
+            "num_layers": len(layers), "nbytes": int(nbytes),
+            "layers": [{k: b64(layer[k]) for k in _WIRE_DTYPES}
+                       for layer in layers]}
+
+
+def decode_wire(obj: dict) -> Tuple[List[int],
+                                    List[Dict[str, np.ndarray]], int]:
+    """Wire object -> (tokens, per-layer host arrays, payload bytes).
+    Raises :class:`MigrationError` on anything malformed — a corrupt
+    payload must fail typed BEFORE any pool state is touched."""
+    try:
+        if obj.get("v") != WIRE_VERSION:
+            raise ValueError(f"wire version {obj.get('v')!r} != "
+                             f"{WIRE_VERSION}")
+        tokens = [int(t) for t in obj["tokens"]]
+        n, heads = int(obj["nblocks"]), int(obj["heads"])
+        bs, d = int(obj["block_size"]), int(obj["head_dim"])
+        layers: List[Dict[str, np.ndarray]] = []
+        for entry in obj["layers"]:
+            layer = {}
+            for key, dtype in _WIRE_DTYPES.items():
+                raw = base64.b64decode(entry[key])
+                shape = ((n, heads, bs, d) if dtype == np.int8
+                         else (n, heads))
+                arr = np.frombuffer(raw, dtype=dtype)
+                if arr.size != int(np.prod(shape)):
+                    raise ValueError(
+                        f"payload {key!r} carries {arr.size} elements, "
+                        f"geometry says {shape}")
+                layer[key] = arr.reshape(shape)
+            layers.append(layer)
+        if len(layers) != int(obj["num_layers"]):
+            raise ValueError(f"{len(layers)} layer(s) decoded, header "
+                             f"says {obj['num_layers']}")
+        return tokens, layers, int(obj["nbytes"])
+    except MigrationError:
+        raise
+    except Exception as e:
+        raise MigrationError(
+            f"malformed migration payload: {type(e).__name__}: {e}")
+
+
+# -------------------------------------------------------- handler bodies
+def handle_kv_export(scheduler, obj) -> Tuple[int, dict]:
+    """POST ``/kv_export`` body: the source side of the pull. Returns
+    the parked request's wire payload; every failure is typed. The
+    parked slot's refs are NOT released — that is ``/kv_ack``."""
+    rid = obj.get("request_id") if isinstance(obj, dict) else None
+    if not isinstance(rid, str) or not rid:
+        return 400, {"error": "request_id (string) required",
+                     "error_type": "bad_request"}
+    try:
+        wire = scheduler.export_parked(rid)
+    except KeyError:
+        return 404, {"error": f"request {rid!r} is not parked here",
+                     "error_type": "migration_failed"}
+    except faults.InjectedFault as e:
+        return 500, {"error": str(e), "error_type": "injected_fault"}
+    except MigrationError as e:
+        return 409, {"error": str(e), "error_type": "migration_failed"}
+    return 200, wire
+
+
+def handle_kv_ack(scheduler, obj) -> Tuple[int, dict]:
+    """POST ``/kv_ack`` body: the COMMIT of the two-phase handoff — the
+    decode side holds its own copy, so the source releases the parked
+    slot and its block refs. Idempotent: acking an already-released
+    (or TTL-expired) park answers ``released: false`` rather than
+    erroring, so a duplicate ACK can never double-free."""
+    rid = obj.get("request_id") if isinstance(obj, dict) else None
+    if not isinstance(rid, str) or not rid:
+        return 400, {"error": "request_id (string) required",
+                     "error_type": "bad_request"}
+    return 200, {"id": rid, "released": scheduler.ack_parked(rid)}
+
+
+def dispatch_kv_endpoint(scheduler, path: str,
+                         raw_body: bytes) -> Tuple[int, dict]:
+    """One shared body-parse + route for the migration endpoints —
+    both replica front ends (``cli/serve.run_http`` and the
+    supervisor's thread worker) mount ``/kv_export`` / ``/kv_ack``
+    through this, so the wire protocol cannot drift between them."""
+    try:
+        obj = json.loads(raw_body)
+    except ValueError as e:
+        return 400, {"error": str(e)}
+    handler = (handle_kv_export if path == "/kv_export"
+               else handle_kv_ack)
+    return handler(scheduler, obj)
+
+
+# ---------------------------------------------------------- pull client
+def _post_json(host: str, port: int, path: str, obj: dict,
+               timeout_s: float) -> Tuple[int, dict]:
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    try:
+        conn.request("POST", path, body=json.dumps(obj).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        raw = resp.read()
+        try:
+            return resp.status, json.loads(raw)
+        except ValueError:
+            return resp.status, {"error": "non-JSON response"}
+    finally:
+        conn.close()
+
+
+def pull_into(scheduler, pull: dict, timeout_s: float = 120.0) -> dict:
+    """The decode side's whole migration: pull the span from the source
+    named by ``pull`` (``{"port": ..., "request_id": ...}``), install it
+    into this replica's pool + prefix trie, then ACK the source. ->
+    meta ``{"bytes", "blocks", "installed", "seconds", "acked"}`` for
+    the response's ``migration`` block (the bench's GB/s numerator).
+    Raises :class:`MigrationError` on any failure — by the install
+    invariants nothing is leaked on either side (the source still owns
+    its parked blocks until the ACK; a failed install released every
+    block it allocated)."""
+    if not isinstance(pull, dict):
+        raise MigrationError("pull_from must be an object")
+    try:
+        port = int(pull["port"])
+        rid = str(pull["request_id"])
+    except (KeyError, TypeError, ValueError):
+        raise MigrationError(
+            "pull_from requires integer 'port' and string 'request_id'")
+    host = str(pull.get("host", "127.0.0.1"))
+    t0 = time.monotonic()
+    try:
+        status, wire = _post_json(host, port, "/kv_export",
+                                  {"request_id": rid}, timeout_s)
+    except Exception as e:
+        raise MigrationError(f"kv_export pull from {host}:{port} "
+                             f"failed: {type(e).__name__}: {e}")
+    if status != 200:
+        raise MigrationError(
+            f"kv_export from {host}:{port} answered {status}: "
+            f"{wire.get('error') if isinstance(wire, dict) else wire}",
+            # A live source answering 404 means the park itself is
+            # gone (TTL / drain / already committed elsewhere) — no
+            # other decode member's pull can succeed either.
+            kind="park_lost" if status == 404 else "migration_failed")
+    tokens, layers, nbytes = decode_wire(wire)
+    try:
+        installed = scheduler.install_migrated(tokens, layers, nbytes)
+    except faults.InjectedFault as e:
+        raise MigrationError(f"kv_install injected fault: {e}")
+    except KVBlocksExhausted as e:
+        raise MigrationError(f"kv_install found no free blocks: {e}")
+    except ValueError as e:
+        raise MigrationError(f"kv_install rejected the payload: {e}")
+    # COMMIT: the copy is ours — release the source. Best-effort: a
+    # lost ACK costs the source nothing but its park TTL (it reclaims
+    # the blocks itself); the request is already safe here.
+    try:
+        status, _ = _post_json(host, port, "/kv_ack",
+                               {"request_id": rid}, timeout_s)
+        acked = status == 200
+    except Exception:
+        acked = False
+    nblocks = int(layers[0]["k"].shape[0]) if layers else 0
+    return {"bytes": nbytes, "blocks": nblocks, "installed": installed,
+            "seconds": time.monotonic() - t0, "acked": acked}

@@ -29,8 +29,27 @@ the service:
   ``error_type``: ``no_live_replicas`` / ``queue_full`` (503, nothing
   could take the request), ``replica_lost`` (502), ``replica_timeout``
   (504), ``draining`` (503), ``bad_request`` (400, passthrough),
-  ``injected_fault`` (500, chaos drills). A request is NEVER silently
-  dropped — the replica-kill chaos acceptance pins that.
+  ``injected_fault`` (500, chaos drills), ``migration_failed`` (502,
+  disaggregated topologies only — every migration avenue AND the
+  local-decode fallback failed). A request is NEVER silently dropped —
+  the replica-kill chaos acceptance pins that.
+- **disaggregation** — with ``RouterConfig.roles`` naming a prefill
+  tier (``role=prefill`` members), admission lands on the least-loaded
+  prefill replica with ``prefill_only`` (the replica prefills and
+  PARKS the slot), then the router hands a decode-tier replica
+  (``role=decode``/``both``) a ``pull_from`` reference: the decode
+  side pulls the prompt's KV blocks over the int8+scales wire
+  (serve/migrate.py), installs them into its own pool, ACKs the
+  source (which only then releases its refs — two-phase handoff), and
+  decodes. A prefill replica lost mid-migration restarts the whole
+  pipeline on another prefill replica (nothing was delivered); a
+  decode replica lost before answering retries the migration against
+  another decode member; a dead/full decode tier degrades to LOCAL
+  decode on the source (``resume`` — the ``role=both`` degradation),
+  all under the same bounded seeded-backoff envelope. The
+  ``router.migrate`` span brackets each orchestration;
+  ``router.prefill_wait_s`` / ``router.decode_wait_s`` split the
+  queueing delay per tier (schema-pinned).
 
 Telemetry (schema-pinned by tools/check_telemetry_schema.py, rendered
 as the report's "replicas" section): ``router.replicas_live`` gauge,
@@ -66,14 +85,28 @@ def register_router_instruments() -> None:
     tools/check_telemetry_schema.py pins). Called at Supervisor/Router
     construction; call again after a registry reset (a benchmark that
     starts its run AFTER warmup)."""
-    for c in ("retries", "failovers", "replica_restarts"):
+    for c in ("retries", "failovers", "replica_restarts",
+              "migrate_fallbacks"):
         obs.counter(f"router.{c}_total")
     obs.gauge("router.replicas_live")
     obs.histogram("router.route_s")
+    # Disaggregated-tier queueing split: time to the PARKED prefill
+    # answer (queue wait + prefill at the source) vs the decode
+    # replica's reported TTFT for the migrated request (queue wait +
+    # tail prefill + first block slice at the destination). Both empty
+    # on homogeneous topologies.
+    obs.histogram("router.prefill_wait_s")
+    obs.histogram("router.decode_wait_s")
 
 
 def _typed(status: int, kind: str, msg: str) -> Tuple[int, dict]:
     return status, {"error": msg, "error_type": kind}
+
+
+# The park receipt's finish_reason (scheduler.FinishReason.PREFILLED —
+# spelled locally so the router stays importable without the engine
+# stack, matching run_multi's no-jax-compile contract).
+FR_PREFILLED = "prefilled"
 
 
 class Router:
@@ -90,6 +123,10 @@ class Router:
     # concurrently, and the backoff RNG's stream advance is a mutation.
     _LOCK_GUARDED = {"retries": "_ledger_lock",
                      "failovers": "_ledger_lock",
+                     "migrations": "_ledger_lock",
+                     "migration_bytes": "_ledger_lock",
+                     "migration_seconds": "_ledger_lock",
+                     "migrate_fallbacks": "_ledger_lock",
                      "_rng": "_rng_lock"}
 
     def __init__(self, supervisor, cfg: Optional[RouterConfig] = None):
@@ -102,6 +139,16 @@ class Router:
         # Plain ledgers: obs counters only count inside a telemetry run.
         self.retries = 0
         self.failovers = 0
+        # Migration ledgers (disaggregated topologies): committed
+        # migrations, wire bytes moved, the SUM of per-pull transfer
+        # windows (bytes / seconds = the bench's mean per-pull wire
+        # rate — overlapping pulls each contribute their own window),
+        # and local-decode fallbacks (typed degradation, not an
+        # error).
+        self.migrations = 0
+        self.migration_bytes = 0
+        self.migration_seconds = 0.0
+        self.migrate_fallbacks = 0
         self._ledger_lock = threading.Lock()
         register_router_instruments()
 
@@ -166,12 +213,16 @@ class Router:
     # ---------------------------------------------------------- routing
     def route(self, payload: dict) -> Tuple[int, dict]:
         """Dispatch one request: pick the least-loaded live replica,
-        forward, fail over on uncommitted replica loss. Always returns
-        ``(status, object)`` — see the module docstring for the error
-        taxonomy."""
+        forward, fail over on uncommitted replica loss. On a
+        disaggregated topology (``cfg.roles`` names a prefill tier)
+        the dispatch is the two-phase prefill -> migrate -> decode
+        pipeline instead. Always returns ``(status, object)`` — see
+        the module docstring for the error taxonomy."""
         t0 = time.monotonic()
         try:
             faults.point("router.route")
+            if self.cfg.disaggregated:
+                return self._route_disagg(payload)
             return self._route_inner(json.dumps(payload).encode())
         except InjectedFault as e:
             return _typed(500, "injected_fault", str(e))
@@ -193,53 +244,313 @@ class Router:
                                   f"{retries} dispatch(es) failed")
                 return _typed(503, "no_live_replicas",
                               "no live replicas")
-            full: set = set()
-            while True:
-                cand = [r for r in usable if r.rid not in full]
-                if not cand:
-                    return _typed(
-                        503, "queue_full",
-                        f"all {len(usable)} live replica(s) at "
-                        f"capacity")
-                r = min(cand, key=lambda x: (
-                    x.in_flight, x.last_health.get("queued", 0), x.rid))
-                outcome, detail = self._forward(r, body)
-                if outcome == "ok":
-                    if failed_over:
-                        with self._ledger_lock:
-                            self.failovers += 1
-                        obs.counter("router.failovers_total").inc()
-                    return 200, detail
-                if outcome == "pass":       # the replica's own 4xx
-                    return detail
-                if outcome == "full":
-                    full.add(r.rid)
-                    continue
-                if outcome == "timeout":
-                    return _typed(504, "replica_timeout", detail)
-                if outcome == "committed":
-                    # The response had begun: the stream is committed
-                    # and a retry could double-serve — typed error.
-                    return _typed(502, "replica_lost",
-                                  f"replica {r.rid} lost after its "
-                                  f"response began: {detail}")
-                # outcome == "lost": died before any response byte —
-                # provably delivered nothing, safe to fail over.
-                failed_over = True
-                excluded.add(r.rid)
-                self.sup.note_forward_failure(r.rid)
-                if retries >= self.cfg.route_retries:
-                    return _typed(502, "replica_lost",
-                                  f"replica {r.rid} died before the "
-                                  f"first token; {retries} retr"
-                                  f"{'y' if retries == 1 else 'ies'} "
-                                  f"exhausted: {detail}")
-                retries += 1
+            outcome, detail, r = self._dispatch_tier(usable, body)
+            if outcome == "all_full":
+                return _typed(503, "queue_full",
+                              f"all {detail} live replica(s) at "
+                              f"capacity")
+            if outcome == "ok":
+                if failed_over:
+                    self._count_failover()
+                return 200, detail
+            if outcome == "pass":           # the replica's own 4xx
+                return detail
+            if outcome == "timeout":
+                return _typed(504, "replica_timeout", detail)
+            if outcome == "committed":
+                # The response had begun: the stream is committed and
+                # a retry could double-serve — typed error.
+                return _typed(502, "replica_lost",
+                              f"replica {r.rid} lost after its "
+                              f"response began: {detail}")
+            # outcome == "lost": died before any response byte —
+            # provably delivered nothing, safe to fail over.
+            failed_over = True
+            excluded.add(r.rid)
+            self.sup.note_forward_failure(r.rid)
+            if retries >= self.cfg.route_retries:
+                return _typed(502, "replica_lost",
+                              f"replica {r.rid} died before the "
+                              f"first token; {retries} retr"
+                              f"{'y' if retries == 1 else 'ies'} "
+                              f"exhausted: {detail}")
+            retries += 1
+            self._count_retry(retries)
+            # loop: rebuild the live set — it may have changed
+
+    # ------------------------------------- shared dispatch + ledgers
+    def _count_retry(self, attempt: int) -> None:
+        with self._ledger_lock:
+            self.retries += 1
+        obs.counter("router.retries_total").inc()
+        time.sleep(self._retry_backoff(attempt))
+
+    def _count_failover(self) -> None:
+        with self._ledger_lock:
+            self.failovers += 1
+        obs.counter("router.failovers_total").inc()
+
+    def _dispatch_tier(self, cand, body: bytes):
+        """Least-loaded sweep over one tier: forward to the best
+        member, skipping 503-full members for this request. ->
+        ``(outcome, detail, replica)`` with :meth:`_forward`'s outcomes
+        plus ``("all_full", tier size, None)`` when every member
+        refused."""
+        full: set = set()
+        while True:
+            usable = [r for r in cand if r.rid not in full]
+            if not usable:
+                return "all_full", len(cand), None
+            r = min(usable, key=lambda x: (
+                x.in_flight, x.last_health.get("queued", 0), x.rid))
+            outcome, detail = self._forward(r, body)
+            if outcome == "full":
+                full.add(r.rid)
+                continue
+            return outcome, detail, r
+
+    def _src_live(self, src) -> bool:
+        return any(r.rid == src.rid for r in self.sup.live_replicas())
+
+    # ---------------------------------------------- disaggregated tiers
+
+    def _route_disagg(self, payload: dict) -> Tuple[int, dict]:
+        """The disaggregated pipeline: admit onto the prefill tier
+        (``prefill_only`` parks the prompt's KV at the source), migrate
+        the parked blocks to a decode-tier replica, return its answer.
+        Crash-safe by phase: before the prefill answer nothing exists
+        (plain failover); between park and a committed decode answer
+        the request has delivered NOTHING to the client, so a lost
+        source restarts the whole pipeline elsewhere and a lost decode
+        replica retries the migration — bounded by ``route_retries``
+        with the seeded-backoff envelope; a dead/full decode tier
+        degrades to local decode on the source. The whole orchestration
+        is one ``router.migrate`` span."""
+        with obs.span("router.migrate") as sp:
+            faults.point("router.migrate")
+            rid = payload.get("id") if isinstance(payload, dict) else None
+            if not rid:
+                import uuid
+                rid = f"mig-{uuid.uuid4().hex[:12]}"
+            payload = {**payload, "id": rid}
+            status, obj = self._disagg_pipeline(payload, rid, sp)
+            sp.set(status=status)
+            return status, obj
+
+    def _disagg_pipeline(self, payload: dict, rid: str,
+                         sp) -> Tuple[int, dict]:
+        pf_body = json.dumps({**payload, "prefill_only": True}).encode()
+        attempts = 0          # whole-pipeline restarts (source lost)
+        excluded: set = set()
+        failed_over = False
+        while True:
+            # The prefill tier is the role=prefill members ONLY:
+            # role=both replicas belong to the decode tier (and the
+            # local-decode degradation) — admitting onto them would
+            # put prefill bursts back on decode hardware, the exact
+            # interleaving disaggregation exists to prevent.
+            prefill_live = [r for r in self.sup.live_replicas()
+                            if r.role == "prefill"
+                            and r.rid not in excluded]
+            if not prefill_live:
+                # No prefill tier left: degrade to classic routing over
+                # whatever is live (typed telemetry — the decode/both
+                # tier serves the request end to end).
                 with self._ledger_lock:
-                    self.retries += 1
-                obs.counter("router.retries_total").inc()
-                time.sleep(self._retry_backoff(retries))
-                break     # rebuild the live set — it may have changed
+                    self.migrate_fallbacks += 1
+                obs.counter("router.migrate_fallbacks_total").inc()
+                sp.set(degraded="no_prefill_tier")
+                return self._route_inner(json.dumps(payload).encode())
+            t_pf = time.monotonic()
+            outcome, detail, src = self._dispatch_tier(prefill_live,
+                                                       pf_body)
+            if outcome == "all_full":
+                return _typed(503, "queue_full",
+                              f"all {detail} live prefill replica(s) "
+                              f"at capacity")
+            if outcome == "pass":
+                return detail
+            if outcome == "timeout":
+                return _typed(504, "replica_timeout", detail)
+            if outcome == "committed":
+                return _typed(502, "replica_lost",
+                              f"prefill replica {src.rid} lost after "
+                              f"its response began: {detail}")
+            if outcome == "lost":
+                self.sup.note_forward_failure(src.rid)
+                excluded.add(src.rid)
+                failed_over = True
+                if attempts >= self.cfg.route_retries:
+                    return _typed(502, "replica_lost",
+                                  f"prefill dispatch failed and "
+                                  f"{attempts} restart(s) exhausted: "
+                                  f"{detail}")
+                attempts += 1
+                self._count_retry(attempts)
+                continue
+            # outcome == "ok": the prompt is parked at `src`.
+            if detail.get("finish_reason") != FR_PREFILLED:
+                # A pre-roles worker served it whole — still a valid
+                # answer (rolling upgrades must not 500).
+                return 200, detail
+            pf_wait = time.monotonic() - t_pf
+            obs.histogram("router.prefill_wait_s").observe(pf_wait)
+            status, obj = self._decode_phase(payload, rid, src, sp,
+                                             pf_wait)
+            if status is None:
+                # Source lost mid-migration with nothing delivered:
+                # restart the pipeline on another prefill replica.
+                excluded.add(src.rid)
+                failed_over = True
+                if attempts >= self.cfg.route_retries:
+                    return _typed(502, "migration_failed",
+                                  f"migration source replica "
+                                  f"{src.rid} lost and {attempts} "
+                                  f"restart(s) exhausted: {obj}")
+                attempts += 1
+                self._count_retry(attempts)
+                continue
+            if status == 200 and failed_over:
+                self._count_failover()
+            return status, obj
+
+    def _decode_phase(self, payload: dict, rid: str, src, sp,
+                      pf_wait: float):
+        """Phase two: hand the parked span to a decode-tier replica.
+        -> ``(status, obj)``, or ``(None, why)`` to signal the caller
+        to restart from prefill (the source is gone and the client has
+        been handed nothing — a rerun cannot double-serve)."""
+        pull = {"port": src.port, "request_id": rid}
+        body = json.dumps({**payload, "pull_from": pull}).encode()
+        mig_retries = 0
+        excluded: set = set()
+        while True:
+            decode_live = [r for r in self.sup.live_replicas()
+                           if r.role != "prefill"
+                           and r.rid not in excluded
+                           and r.rid != src.rid]
+            if not decode_live:
+                return self._local_decode(rid, src, sp, pf_wait,
+                                          "no live decode replica")
+            t_dec = time.monotonic()
+            outcome, detail, dst = self._dispatch_tier(decode_live, body)
+            if outcome == "all_full":
+                return self._local_decode(
+                    rid, src, sp, pf_wait,
+                    f"all {detail} decode replica(s) at capacity")
+            if outcome == "timeout":
+                return _typed(504, "replica_timeout", detail)
+            if outcome == "committed":
+                return _typed(502, "replica_lost",
+                              f"decode replica {dst.rid} lost after "
+                              f"its response began: {detail}")
+            if outcome == "lost":
+                # Died before answering: the parked span survives at
+                # the source (or was ACKed away, which the next pull
+                # surfaces as a typed 424) — retry the migration on
+                # another decode member.
+                self.sup.note_forward_failure(dst.rid)
+                excluded.add(dst.rid)
+                if mig_retries >= self.cfg.route_retries:
+                    return self._local_decode(
+                        rid, src, sp, pf_wait,
+                        f"{mig_retries} migration retr"
+                        f"{'y' if mig_retries == 1 else 'ies'} "
+                        f"exhausted: {detail}")
+                mig_retries += 1
+                self._count_retry(mig_retries)
+                continue
+            if outcome == "pass":
+                status, obj = detail
+                if status != 424:
+                    return status, obj
+                # Migration dependency failed. A dead source — or a
+                # live one whose PARK is gone (typed park_lost: TTL,
+                # drain, or an ACK to a puller that then died) — means
+                # every further pull/resume is doomed: restart from
+                # prefill now instead of sweeping the tier. Otherwise
+                # retry the pull through another decode member, then
+                # fall back.
+                if (obj.get("error_type") == "park_lost"
+                        or not self._src_live(src)):
+                    return None, obj.get("error", "source lost")
+                excluded.add(dst.rid)
+                if mig_retries >= self.cfg.route_retries:
+                    return self._local_decode(
+                        rid, src, sp, pf_wait,
+                        f"migration failed after {mig_retries} "
+                        f"retr{'y' if mig_retries == 1 else 'ies'}: "
+                        f"{obj.get('error')}")
+                mig_retries += 1
+                self._count_retry(mig_retries)
+                continue
+            # outcome == "ok"
+            obj = detail
+            dec_wait = (float(obj["ttft_s"])
+                        if obj.get("ttft_s") is not None
+                        else time.monotonic() - t_dec)
+            obs.histogram("router.decode_wait_s").observe(dec_wait)
+            mig = obj.get("migration")
+            if isinstance(mig, dict):
+                with self._ledger_lock:
+                    self.migrations += 1
+                    self.migration_bytes += int(mig.get("bytes", 0))
+                    self.migration_seconds += float(
+                        mig.get("seconds", 0.0))
+                # The per-request queueing split rides in the response
+                # (benchmarks read it client-side; the histograms above
+                # carry the same numbers for run-dir artifacts).
+                mig["prefill_wait_s"] = pf_wait
+                mig["decode_wait_s"] = dec_wait
+                sp.set(bytes=int(mig.get("bytes", 0)),
+                       blocks=int(mig.get("blocks", 0)),
+                       src=src.rid, dst=dst.rid)
+            return 200, obj
+
+    def _local_decode(self, rid: str, src, sp, pf_wait: float,
+                      why: str):
+        """The ``role=both`` degradation: no decode replica could take
+        the migration, so the SOURCE resumes the parked request and
+        decodes it locally. -> ``(status, obj)``, or ``(None, why)``
+        when the source is gone / the park vanished — the caller
+        restarts from prefill (nothing was delivered)."""
+        with self._ledger_lock:
+            self.migrate_fallbacks += 1
+        obs.counter("router.migrate_fallbacks_total").inc()
+        sp.set(degraded=why)
+        outcome, detail = self._forward(
+            src, json.dumps({"resume": rid}).encode())
+        if outcome == "ok":
+            obj = detail
+            dec_wait = (float(obj["ttft_s"])
+                        if obj.get("ttft_s") is not None else 0.0)
+            obs.histogram("router.decode_wait_s").observe(dec_wait)
+            obj["migration"] = {"bytes": 0, "blocks": 0, "seconds": 0.0,
+                                "fallback": why,
+                                "prefill_wait_s": pf_wait,
+                                "decode_wait_s": dec_wait}
+            return 200, obj
+        if outcome == "timeout":
+            return _typed(504, "replica_timeout", detail)
+        if outcome == "committed":
+            return _typed(502, "replica_lost",
+                          f"replica {src.rid} lost after its resumed "
+                          f"response began: {detail}")
+        if outcome == "pass":
+            status, obj = detail
+            if status in (404, 424):
+                # The park vanished (TTL, drain) before the resume:
+                # nothing was delivered — restart from prefill.
+                return None, obj.get("error", "park lost")
+            return status, obj
+        if outcome == "full":
+            return _typed(503, "queue_full",
+                          f"source replica {src.rid} refused the "
+                          f"local-decode fallback: "
+                          f"{detail.get('error') if isinstance(detail, dict) else detail}")
+        # outcome == "lost": the source died — restart from prefill.
+        self.sup.note_forward_failure(src.rid)
+        return None, f"local-decode fallback failed: {detail}"
 
     def _retry_backoff(self, attempt: int) -> float:
         base = min(self.cfg.retry_backoff_base_s * (2 ** (attempt - 1)),

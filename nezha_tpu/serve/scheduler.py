@@ -55,7 +55,7 @@ from typing import Callable, Deque, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from nezha_tpu import obs
+from nezha_tpu import faults, obs
 from nezha_tpu.serve.engine import Engine
 from nezha_tpu.serve.slots import KVBlocksExhausted
 
@@ -73,6 +73,10 @@ class FinishReason:
     ERROR = "error"            # prefill failure or non-finite logits —
                                # the request is retired, its slot freed,
                                # and the batch keeps decoding
+    PREFILLED = "prefilled"    # prefill_only request: prompt KV computed
+                               # and PARKED for migration — not an end
+                               # state for the request, which decodes on
+                               # whichever replica pulls (or resumes) it
 
 
 @dataclasses.dataclass
@@ -90,6 +94,12 @@ class Request:
     seed: int = 0
     deadline_s: Optional[float] = None
     request_id: Optional[str] = None
+    # Disaggregated serving (serve/migrate.py): prefill the prompt and
+    # PARK the slot (blocks held under a TTL) instead of decoding — the
+    # admission half of the two-phase KV handoff. The request finishes
+    # with FinishReason.PREFILLED; decoding happens wherever the parked
+    # KV is pulled to (or locally via resume_parked).
+    prefill_only: bool = False
 
 
 @dataclasses.dataclass
@@ -135,6 +145,12 @@ def register_serve_instruments() -> None:
     # instead of re-prefilling, and copy-on-write block copies.
     obs.counter("serve.kv.prefix_hits_total")
     obs.counter("serve.kv.cow_copies_total")
+    # Cross-replica migration (disaggregated prefill/decode tiers,
+    # serve/migrate.py): committed installs and their wire bytes —
+    # migration GB/s is bytes / the router.migrate span durations.
+    # Layout-invariant 0s on runs that never migrate.
+    obs.counter("serve.kv.migrations_total")
+    obs.counter("serve.kv.migration_bytes")
     obs.gauge("serve.kv.blocks_used")
     # KV quantization instruments (schema-pinned, layout/dtype
     # invariant): device bytes the resident KV actually holds (the
@@ -173,13 +189,23 @@ class Scheduler:
 
     step_retry_backoff_s = 0.05
 
+    # How long a parked (prefill_only) slot waits for its migration
+    # pull / ACK / resume before the scheduler reclaims it — the
+    # leak-proofing backstop of the two-phase handoff: a decode replica
+    # that pulled and died, or an ACK lost on the wire, costs the
+    # source at most this window of held blocks.
+    parked_ttl_s = 60.0
+
     # Cross-thread state and the lock that guards it — the declaration
     # nezha-lint's lock-discipline rule enforces: every write to these
     # outside `with self._lock` (or a method marked `[holds: _lock]`,
     # meaning the caller already holds it) fails the build. submit()
-    # runs on HTTP handler threads against the decode loop's step().
+    # runs on HTTP handler threads against the decode loop's step(),
+    # and the migration endpoints (export/ack/resume) run on handler
+    # threads too.
     _LOCK_GUARDED = {"_queue": "_lock", "_live": "_lock",
-                     "results": "_lock", "_host_gap_t": "_lock"}
+                     "results": "_lock", "_host_gap_t": "_lock",
+                     "_parked": "_lock"}
 
     def __init__(self, engine: Engine,
                  on_token: Optional[Callable[[str, int], None]] = None,
@@ -190,6 +216,11 @@ class Scheduler:
         self.queue_capacity = engine.cfg.queue_capacity
         self._queue: Deque[_Live] = collections.deque()
         self._live: Dict[int, _Live] = {}          # slot -> request state
+        # Parked prefill_only requests awaiting their migration pull
+        # (or a local-decode resume): request_id -> (slot, live,
+        # expires_t). Slots here hold their prompt blocks but never
+        # decode; step() reclaims entries past their TTL.
+        self._parked: Dict[str, tuple] = {}
         self._lock = threading.RLock()
         self._ids = itertools.count()
         self.results: Dict[str, RequestResult] = {}
@@ -265,6 +296,7 @@ class Scheduler:
         (0 when fully idle)."""
         with self._lock:
             self._expire_queued()
+            self._expire_parked()
             self._admit()
             if self._live:
                 emitted = self._decode()
@@ -297,6 +329,11 @@ class Scheduler:
             return bool(self._queue or self._live)
 
     @property
+    def parked_count(self) -> int:
+        with self._lock:
+            return len(self._parked)
+
+    @property
     def queue_depth(self) -> int:
         """Current admission-queue length. Pacing clients (the stdio
         reader, closed-loop benchmarks) should wait for room here
@@ -318,6 +355,22 @@ class Scheduler:
             else:
                 kept.append(live)
         self._queue = kept
+
+    def _expire_parked(self) -> None:
+        """[holds: _lock] — step() calls this inside the lock. The park
+        TTL is what makes the two-phase handoff leak-proof against a
+        decode replica that pulled and died before ACKing (or an ACK
+        lost on the wire): the source reclaims the slot and its blocks
+        itself. The request's "prefilled" answer was already delivered;
+        this is resource reclamation, counted like any other deadline
+        miss."""
+        now = time.monotonic()
+        for rid in [r for r, (_, _, exp) in self._parked.items()
+                    if now >= exp]:
+            slot, _, _ = self._parked.pop(rid)
+            self.engine.pool.free(slot)
+            obs.counter("serve.expired_total").inc()
+            obs.counter("serve.retired_total").inc()
 
     def _admit(self) -> None:
         """[holds: _lock] — step() calls this inside the lock."""
@@ -375,8 +428,26 @@ class Scheduler:
                              error=f"prefill failed: "
                                    f"{type(e).__name__}: {e}")
                 continue
-            self._live[slot] = live
             obs.counter("serve.admitted_total").inc()
+            if req.prefill_only:
+                # Disaggregation: park the prefilled slot for the
+                # migration pull instead of decoding. The request
+                # finishes PREFILLED (its waiter gets the handle); the
+                # slot holds its prompt blocks until kv_ack / resume /
+                # TTL. A duplicate id would orphan the first park's
+                # slot, so it is a typed error.
+                if live.request_id in self._parked:
+                    pool.free(slot)
+                    obs.counter("serve.errors_total").inc()
+                    self._finish(live, FinishReason.ERROR,
+                                 error=f"request {live.request_id!r} "
+                                       f"already parked")
+                    continue
+                self._parked[live.request_id] = (
+                    slot, live, time.monotonic() + self.parked_ttl_s)
+                self._finish(live, FinishReason.PREFILLED)
+                continue
+            self._live[slot] = live
 
     def _decode(self) -> int:
         """[holds: _lock] — step() calls this inside the lock."""
@@ -529,6 +600,101 @@ class Scheduler:
         if self.on_finish is not None:
             self.on_finish(result)
 
+    # ------------------------------------------------------- migration
+    def export_parked(self, request_id: str) -> dict:
+        """The source half of the migration pull (``/kv_export``):
+        export the parked request's full-block prompt prefix as the
+        int8+scales wire object (serve/migrate.py). Read-only — the
+        parked refs survive until :meth:`ack_parked` (the two-phase
+        commit) or the TTL. Raises ``KeyError`` for an unknown/expired
+        park and :class:`~nezha_tpu.serve.migrate.MigrationError` when
+        this engine's layout cannot export. Runs under the scheduler
+        lock: the gather must not race a decode dispatch that donates
+        the cache buffers."""
+        from nezha_tpu.serve import migrate
+        faults.point("replica.kv_export")
+        with self._lock:
+            if request_id not in self._parked:
+                raise KeyError(request_id)
+            slot, live, _ = self._parked[request_id]
+            pool = self.engine.pool
+            if not self.engine.paged:
+                raise migrate.MigrationError(
+                    "kv_layout 'dense' has no blocks to export — "
+                    "migration requires the paged pool")
+            tokens = [int(t) for t in live.req.prompt]
+            nfull = min(len(tokens) // pool.block_size,
+                        int(pool._bound[slot]))
+            if nfull == 0:
+                # Sub-block prompt: nothing reusable to ship — a legal,
+                # empty payload (the decode side just prefills cold).
+                return migrate.encode_wire([], [], pool.block_size)
+            layers, _ = pool.export_block_payload(slot, nfull)
+            return migrate.encode_wire(
+                tokens[:nfull * pool.block_size], layers,
+                pool.block_size)
+
+    def ack_parked(self, request_id: str) -> bool:
+        """Commit of the two-phase handoff (``/kv_ack``): the decode
+        side holds its own copy, so release the parked slot and its
+        block refs. -> False (idempotently) when the park is unknown —
+        already acked, TTL-reclaimed, or drained."""
+        with self._lock:
+            parked = self._parked.pop(request_id, None)
+            if parked is None:
+                return False
+            slot, _, _ = parked
+            self.engine.pool.free(slot)
+            obs.counter("serve.retired_total").inc()
+            return True
+
+    def resume_parked(self, request_id: str) -> bool:
+        """Local-decode fallback (``role=both`` degradation): move a
+        parked request into the live set and decode it HERE — the path
+        the router takes when no decode-tier replica is live or every
+        migration attempt failed. The parked prompt KV is already in
+        this pool, so decoding starts immediately. -> False when the
+        park is unknown (expired / acked away)."""
+        with self._lock:
+            parked = self._parked.pop(request_id, None)
+            if parked is None:
+                return False
+            slot, live, _ = parked
+            # The "prefilled" result was this request's park receipt,
+            # not its answer — drop it so the real retirement's result
+            # is the one waiters read.
+            self.results.pop(request_id, None)
+            self._live[slot] = live
+            return True
+
+    def install_migrated(self, tokens: Sequence[int], layers: list,
+                         nbytes: int) -> int:
+        """The destination half of the pull: install a decoded wire
+        payload into this replica's pool + prefix trie (fresh blocks at
+        ref == 1 — the write invariant by construction). The request
+        submitted afterwards takes prefix-cache references through the
+        ordinary admission path. Counts committed installs into the
+        schema-pinned ``serve.kv.migrations_total`` /
+        ``serve.kv.migration_bytes``."""
+        from nezha_tpu.serve import migrate
+        faults.point("replica.kv_install")
+        with self._lock:
+            if not self.engine.paged:
+                raise migrate.MigrationError(
+                    "kv_layout 'dense' cannot install migrated blocks")
+            installed = self.engine.pool.install_block_payload(tokens,
+                                                               layers)
+            if installed > 0:
+                # Committed installs only: an empty sub-block payload,
+                # a disabled prefix cache, or an already-cached prefix
+                # installs nothing and must not inflate the ledger
+                # ("N pulls, 0 bytes moved" would misread as cache
+                # wins). The router's plain ledgers count every
+                # successful PULL separately.
+                obs.counter("serve.kv.migrations_total").inc()
+                obs.counter("serve.kv.migration_bytes").inc(nbytes)
+            return installed
+
     # ----------------------------------------------------------- drain
     def cancel_remaining(self, reason: str = FinishReason.DEADLINE,
                          error: Optional[str] = None) -> int:
@@ -560,6 +726,14 @@ class Scheduler:
                 _count()
                 self._finish(live, reason, error=error)
                 n += 1
+            # Parked migrations: their "prefilled" answers were already
+            # delivered, so this is pure resource release — a drained
+            # source simply stops being pullable (the router's next
+            # /kv_export gets a typed 404 and retries elsewhere).
+            for rid in list(self._parked):
+                slot, _, _ = self._parked.pop(rid)
+                self.engine.pool.free(slot)
+                obs.counter("serve.retired_total").inc()
             obs.gauge("serve.queue_depth").set(0)
             obs.gauge("serve.batch_occupancy").set(
                 self.engine.pool.occupancy)

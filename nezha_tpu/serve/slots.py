@@ -327,6 +327,73 @@ def _copy_block(caches: list, src, dst) -> list:
 _copy_block_jit = jax.jit(_copy_block, donate_argnums=(0,))
 
 
+# ------------------------------------------------- migration device ops
+# Block export/install for cross-replica KV migration (serve/migrate.py
+# carries the wire; the router orchestrates). Like _copy_block these are
+# pool maintenance, deliberately NOT routed through the engine executor:
+# the frozen-program contract is pinned on the executor's cache, and a
+# migration is not a serving program. jax.jit keys on the index shape,
+# so one program per distinct block count — block counts are small and
+# bounded by blocks_per_slot.
+def _gather_blocks_quantized(caches, idx):
+    """int8 pool -> wire: the blocks ARE the wire format already (int8
+    data + fp32 per-(block, head) scales), so export is a pure gather —
+    a migrated block lands on the destination bit-identical."""
+    return [{k: jnp.take(layer[k], idx, axis=0)
+             for k in ("k", "v", "k_scale", "v_scale")}
+            for layer in caches]
+
+
+def _gather_quantize_blocks(caches, idx):
+    """bf16/f32 pool -> wire: gather the blocks and quantize them to
+    the int8+scales wire format (ops/quant.py — the EQuARX recipe the
+    wire collectives use, ~4x fewer bytes than bf16). Lossy at the
+    quantizer's amax/254 per-block bound; int8 pools take the lossless
+    path above."""
+    from nezha_tpu.ops import quant
+    out = []
+    for layer in caches:
+        entry = {}
+        for kv in ("k", "v"):
+            q, s = quant.quantize_kv_block(
+                jnp.take(layer[kv], idx, axis=0))
+            entry[kv] = q
+            entry[f"{kv}_scale"] = s
+        out.append(entry)
+    return out
+
+
+def _scatter_blocks_quantized(caches, idx, payload):
+    """Wire -> int8 pool: write int8 blocks + scale rows verbatim at
+    the freshly allocated (ref == 1) indices."""
+    return [{k: layer[k].at[idx].set(pay[k].astype(layer[k].dtype))
+             for k in layer}
+            for layer, pay in zip(caches, payload)]
+
+
+def _scatter_blocks_dequant(caches, idx, payload):
+    """Wire -> bf16/f32 pool: dequantize the int8 blocks to the pool
+    dtype and write them at the freshly allocated indices."""
+    from nezha_tpu.ops import quant
+    out = []
+    for layer, pay in zip(caches, payload):
+        new = dict(layer)
+        for kv in ("k", "v"):
+            blk = quant.dequantize_kv_block(
+                pay[kv], pay[f"{kv}_scale"], layer[kv].dtype)
+            new[kv] = layer[kv].at[idx].set(blk)
+        out.append(new)
+    return out
+
+
+_gather_blocks_quantized_jit = jax.jit(_gather_blocks_quantized)
+_gather_quantize_blocks_jit = jax.jit(_gather_quantize_blocks)
+_scatter_blocks_quantized_jit = jax.jit(_scatter_blocks_quantized,
+                                        donate_argnums=(0,))
+_scatter_blocks_dequant_jit = jax.jit(_scatter_blocks_dequant,
+                                      donate_argnums=(0,))
+
+
 class PagedSlotPool:
     """Block-paged KV pool: ref-counted blocks + per-slot block tables.
 
@@ -634,6 +701,96 @@ class PagedSlotPool:
                         f"block {bi}")
                 self.tables_host[slot, bi] = self._alloc_block(slot)
                 self._bound[slot] = bi + 1
+
+    # ------------------------------------------------------- migration
+    def export_block_payload(self, slot: int, nblocks: int
+                             ) -> Tuple[List[Dict[str, np.ndarray]], int]:
+        """Export the first ``nblocks`` bound blocks of ``slot`` in the
+        int8+scales wire layout: -> (per-layer ``{"k", "k_scale", "v",
+        "v_scale"}`` host arrays, total payload bytes). int8 pools
+        export their blocks verbatim (a migrated block is
+        bit-identical on the destination); bf16/f32 pools quantize to
+        the wire format on device first (lossy at the quantizer's
+        per-block amax/254 bound — the same bound
+        ``serve.kv.quant_error`` samples). Export is read-only: the
+        source's refs are untouched — release is the ACK's job
+        (two-phase handoff, serve/migrate.py)."""
+        if not 1 <= nblocks <= int(self._bound[slot]):
+            raise ValueError(
+                f"cannot export {nblocks} block(s) from slot {slot}: "
+                f"{int(self._bound[slot])} bound")
+        idx = jnp.asarray(self.tables_host[slot, :nblocks].copy())
+        if self.quantized:
+            layers = _gather_blocks_quantized_jit(self.caches, idx)
+        else:
+            layers = _gather_quantize_blocks_jit(self.caches, idx)
+        host = [{k: np.asarray(v) for k, v in layer.items()}
+                for layer in layers]
+        nbytes = sum(a.nbytes for layer in host for a in layer.values())
+        return host, nbytes
+
+    def install_block_payload(self, tokens: Sequence[int],
+                              layers: List[Dict[str, np.ndarray]]) -> int:
+        """Install a migrated block payload into the PREFIX CACHE:
+        allocate fresh blocks (ref == 1 — the write invariant holds by
+        construction, these indices are owned by nobody), scatter the
+        wire data in (dequantized to the pool dtype, or verbatim into
+        an int8 pool), and index the blocks in the trie keyed on
+        ``tokens``' full-block prefix. The installing request then
+        takes prefix-cache REFERENCES through the ordinary
+        ``bind_for_prompt`` path — migration reuses the exact reuse
+        machinery prefix hits already proved out. -> blocks newly
+        referenced by the trie (0 when the prefix was already cached,
+        the payload is empty, or the prefix cache is disabled — the
+        request simply prefills cold). Raises
+        :class:`KVBlocksExhausted` (typed, retryable — nothing is
+        leaked) when the pool cannot hold the span, and ``ValueError``
+        on a payload whose geometry does not match this pool."""
+        nblocks = int(layers[0]["k"].shape[0]) if layers else 0
+        if nblocks == 0 or not self.prefix_cache_enabled:
+            return 0
+        bs = self.block_size
+        if len(tokens) < nblocks * bs:
+            raise ValueError(
+                f"payload carries {nblocks} block(s) but only "
+                f"{len(tokens)} token(s) key them "
+                f"(block_size {bs})")
+        shape = tuple(self.caches[0]["k"].shape[1:])
+        got = tuple(layers[0]["k"].shape[1:])
+        if len(layers) != len(self.caches) or got != shape:
+            raise ValueError(
+                f"payload geometry mismatch: {len(layers)} layer(s) of "
+                f"blocks shaped {got}, pool has {len(self.caches)} "
+                f"layer(s) shaped {shape}")
+        blocks: List[int] = []
+        try:
+            for _ in range(nblocks):
+                blocks.append(self._alloc_block(None))
+        except KVBlocksExhausted:
+            for b in blocks:
+                self._release(b)
+            raise
+        idx = jnp.asarray(np.asarray(blocks, np.int32))
+        payload = [{k: jnp.asarray(v) for k, v in layer.items()}
+                   for layer in layers]
+        if self.quantized:
+            self.caches = _scatter_blocks_quantized_jit(
+                self.caches, idx, payload)
+        else:
+            self.caches = _scatter_blocks_dequant_jit(
+                self.caches, idx, payload)
+
+        def take_ref(block: int) -> None:
+            self._refs[block] += 1
+
+        inserted = self.trie.insert(
+            list(int(t) for t in tokens)[:nblocks * bs], blocks, take_ref)
+        # Drop our allocation refs: blocks the trie took stay cached at
+        # ref 1 (the trie's); blocks it already had under the same
+        # token path return to the free list (first writer won).
+        for b in blocks:
+            self._release(b)
+        return inserted
 
     # ------------------------------------------------------- accounting
     def clear_prefix_cache(self) -> int:

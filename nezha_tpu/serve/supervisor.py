@@ -53,7 +53,7 @@ import subprocess
 import sys
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from nezha_tpu import faults, obs
 
@@ -84,9 +84,19 @@ class RouterConfig:
     dead one, and re-dispatching its request would double-serve it).
     ``max_restart_failures`` consecutive spawn/startup failures open a
     replica's circuit breaker. ``drain_timeout_s`` is the per-replica
-    budget of the rolling drain."""
+    budget of the rolling drain.
+
+    ``roles`` assigns each replica a serving role for the disaggregated
+    prefill/decode topology: ``()`` (default) makes every replica
+    ``"both"`` (the classic homogeneous pool); otherwise it must name
+    one of ``prefill`` / ``decode`` / ``both`` per replica. With at
+    least one ``prefill`` member the router admits new requests onto
+    the prefill tier and MIGRATES the finished prompt's KV to a decode
+    replica (serve/migrate.py); ``both`` members belong to the decode
+    tier and double as the local-decode degradation target."""
 
     replicas: int = 2
+    roles: Tuple[str, ...] = ()
     probe_interval_s: float = 0.5
     probe_timeout_s: float = 5.0
     probe_misses: int = 3
@@ -110,6 +120,32 @@ class RouterConfig:
             raise ValueError("route_retries must be >= 0")
         if self.max_restart_failures < 1:
             raise ValueError("max_restart_failures must be >= 1")
+        roles = tuple(self.roles)
+        if roles:
+            if len(roles) != self.replicas:
+                raise ValueError(
+                    f"roles names {len(roles)} replica(s), "
+                    f"replicas={self.replicas}")
+            bad = sorted(set(roles) - {"prefill", "decode", "both"})
+            if bad:
+                raise ValueError(
+                    f"roles must be 'prefill'/'decode'/'both', got {bad}")
+            if "prefill" in roles and not any(
+                    r in ("decode", "both") for r in roles):
+                raise ValueError(
+                    "a prefill tier needs at least one decode-capable "
+                    "replica (role 'decode' or 'both')")
+        object.__setattr__(self, "roles", roles)
+
+    def role_of(self, rid: int) -> str:
+        return self.roles[rid] if self.roles else "both"
+
+    @property
+    def disaggregated(self) -> bool:
+        """True when the topology has a dedicated prefill tier — the
+        router then admits onto it and migrates KV to the decode
+        tier."""
+        return "prefill" in self.roles
 
 
 def replica_exec_point() -> None:
@@ -139,6 +175,7 @@ class Replica:
     supervisor lock so the two layers can't disagree."""
 
     rid: int
+    role: str = "both"          # prefill | decode | both (routing tier)
     state: str = STARTING
     handle: Optional[object] = None
     port: int = 0
@@ -259,14 +296,21 @@ class ThreadBackend:
 
     kind = "thread"
 
-    def __init__(self, worker_args, drain_timeout_s: float = 30.0):
+    def __init__(self, worker_args, drain_timeout_s: float = 30.0,
+                 roles: Optional[Sequence[str]] = None):
         self.worker_args = worker_args
         self.drain_timeout_s = drain_timeout_s
+        self.roles = tuple(roles) if roles else ()
 
     def spawn(self, rid: int, port: int) -> ThreadHandle:
         # port is ignored: the worker binds port 0 and reports the real
         # one via the handle — no bind race to absorb.
-        worker = _ThreadWorker(self.worker_args, rid,
+        args = self.worker_args
+        if self.roles:
+            import copy
+            args = copy.copy(args)
+            args.role = self.roles[rid]
+        worker = _ThreadWorker(args, rid,
                                drain_timeout_s=self.drain_timeout_s)
         worker.start()
         return ThreadHandle(worker)
@@ -349,10 +393,12 @@ class _ThreadWorker:
                     "status": "ok", "active": pool.num_active,
                     "capacity": pool.capacity,
                     "queued": sched.queue_depth,
-                    "occupancy": pool.occupancy})
+                    "occupancy": pool.occupancy,
+                    "role": getattr(worker.args, "role", "both"),
+                    "parked": sched.parked_count})
 
             def do_POST(self):
-                worker._handle_generate(self)
+                worker._handle_post(self)
 
         class Server(ThreadingHTTPServer):
             # Handlers are daemons here, unlike run_http: a killed
@@ -373,6 +419,21 @@ class _ThreadWorker:
         self._thread.start()
 
     # ---------------------------------------------------- request path
+    def _handle_post(self, h) -> None:
+        """Route one POST: ``/generate`` (plain, ``prefill_only``,
+        ``pull_from``, or ``resume``) plus the migration endpoints
+        ``/kv_export`` / ``/kv_ack`` — the same surface
+        ``cli/serve.run_http`` mounts, so the router sees ONE replica
+        protocol regardless of backend."""
+        if h.path in ("/kv_export", "/kv_ack"):
+            if not self._ready.is_set():
+                return h._send(503, {"error": "starting"})
+            from nezha_tpu.serve import migrate
+            n = int(h.headers.get("Content-Length", 0))
+            return h._send(*migrate.dispatch_kv_endpoint(
+                self._sched, h.path, h.rfile.read(n)))
+        self._handle_generate(h)
+
     def _handle_generate(self, h) -> None:
         if h.path != "/generate":
             return h._send(404, {"error": "unknown path"})
@@ -381,14 +442,30 @@ class _ThreadWorker:
         if self._drain_evt.is_set() or self._killed.is_set():
             return h._send(503, {"error": "draining"})
         from nezha_tpu.cli.serve import _parse_request, _result_obj
-        from nezha_tpu.serve import QueueFull
+        from nezha_tpu.serve import QueueFull, migrate
         sched = self._sched
         try:
             n = int(h.headers.get("Content-Length", 0))
-            req = _parse_request(json.loads(h.rfile.read(n)), self.args,
+            obj = json.loads(h.rfile.read(n))
+        except (ValueError, json.JSONDecodeError) as e:
+            return h._send(400, {"error": str(e)})
+        if isinstance(obj, dict) and obj.get("resume"):
+            return self._handle_resume(h, str(obj["resume"]))
+        mig_meta = None
+        if isinstance(obj, dict) and obj.get("pull_from") is not None:
+            # Decode side of a migration: pull + install + ACK before
+            # admission, so the submit below prefix-hits the installed
+            # blocks. Failure is HTTP 424 — the router's retry signal.
+            try:
+                mig_meta = migrate.pull_into(sched, obj["pull_from"])
+            except migrate.MigrationError as e:
+                return h._send(424, {"error": str(e),
+                                     "error_type": e.kind})
+        try:
+            req = _parse_request(obj, self.args,
                                  self._tokenizer, self._eos_id,
                                  sched.engine.vocab)
-        except (ValueError, json.JSONDecodeError) as e:
+        except ValueError as e:
             return h._send(400, {"error": str(e)})
         import uuid
         rid = req.request_id or f"r{self.rid}-{uuid.uuid4().hex[:12]}"
@@ -427,6 +504,44 @@ class _ThreadWorker:
             return h._send(503, {"error": "replica stopped"})
         out = _result_obj(res, self._tokenizer)
         out.pop("event")
+        if mig_meta is not None:
+            out["migration"] = mig_meta
+        h._send(200, out)
+
+    def _handle_resume(self, h, rid: str) -> None:
+        """Local-decode fallback: move a parked request into this
+        replica's live set and answer with its finished result — the
+        ``role=both`` degradation the router takes when the decode
+        tier is gone or every migration attempt failed."""
+        from nezha_tpu.cli.serve import _result_obj
+        if self._drain_evt.is_set() or self._killed.is_set():
+            return h._send(503, {"error": "draining"})
+        sched = self._sched
+        ev = threading.Event()
+        with self._events_lock:
+            if rid in self._events:
+                return h._send(409, {"error": f"request id {rid!r} "
+                                              f"already in flight"})
+            self._events[rid] = ev
+        if not sched.resume_parked(rid):
+            with self._events_lock:
+                self._events.pop(rid, None)
+            return h._send(404, {"error": f"request {rid!r} is not "
+                                          f"parked here",
+                                 "error_type": "migration_failed"})
+        if self.dead.is_set():
+            with self._events_lock:
+                self._events.pop(rid, None)
+            return h._send(503, {"error": "draining"})
+        ev.wait()
+        with self._events_lock:
+            self._events.pop(rid, None)
+        res = sched.results.pop(rid, None)
+        if res is None:
+            return h._send(503, {"error": "replica stopped"})
+        out = _result_obj(res, self._tokenizer)
+        out.pop("event")
+        out["resumed"] = True
         h._send(200, out)
 
     # ------------------------------------------------------ worker body
@@ -536,7 +651,8 @@ class Supervisor:
     def __init__(self, backend, cfg: RouterConfig):
         self.backend = backend
         self.cfg = cfg
-        self._replicas = [Replica(rid=i) for i in range(cfg.replicas)]
+        self._replicas = [Replica(rid=i, role=cfg.role_of(i))
+                          for i in range(cfg.replicas)]
         self._lock = threading.RLock()
         self._rng = random.Random(cfg.seed)
         self._draining = False
@@ -680,8 +796,9 @@ class Supervisor:
 
     def describe(self) -> List[dict]:
         with self._lock:
-            return [{"rid": r.rid, "port": r.port, "state": r.state,
-                     "healthy": r.healthy, "in_flight": r.in_flight,
+            return [{"rid": r.rid, "role": r.role, "port": r.port,
+                     "state": r.state, "healthy": r.healthy,
+                     "in_flight": r.in_flight,
                      "restart_failures": r.restart_failures}
                     for r in self._replicas]
 

@@ -14,6 +14,7 @@ import time
 from typing import Any, Callable, Dict, Iterator, Optional
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 
 from nezha_tpu import obs
@@ -236,6 +237,23 @@ class Trainer:
             else:
                 restored, step = ckpt.try_restore(self.checkpoint_dir, state)
             if restored is not None:
+                # One device-side copy so XLA is the SOLE owner of the
+                # bytes: the dense restore returns numpy leaves, and on
+                # CPU the implicit (or explicit) device transfer may
+                # zero-copy ALIAS the host buffer — the next DONATING
+                # train step then has XLA free memory numpy still owns
+                # (NaN state, then a glibc heap abort; reproduced on
+                # jax 0.4.37 by the elastic-rejoin reload in
+                # tests/test_cli.py). jnp.asarray may alias; .copy()
+                # allocates an XLA-owned buffer the alias is read from.
+                # numpy leaves only: the sharded restore already hands
+                # back XLA-owned copies (sharded_checkpoint.py), and a
+                # second whole-state copy would transiently double
+                # restore memory.
+                restored = jax.tree_util.tree_map(
+                    lambda a: jnp.asarray(a).copy()
+                    if isinstance(a, np.ndarray) else a,
+                    restored)
                 state, self.global_step = restored, step
         self.state = state
         return state

@@ -248,6 +248,15 @@ def restore_sharded(ckpt_dir: str, template: Any,
                 arr = jax.make_array_from_callback(
                     gshape, leaf.sharding,
                     lambda idx, k=key, dt=dtype: store.read(k, idx).astype(dt))
+                # One device-side copy so XLA is the SOLE owner of the
+                # bytes: on CPU, make_array_from_callback may zero-copy
+                # ALIAS the callback's host buffer, and the first
+                # DONATING train step after resume then has XLA free
+                # memory numpy still owns — glibc aborts with
+                # "corrupted double-linked list" (reproduced on jax
+                # 0.4.37 by the gspmd resume composition in
+                # tests/test_cli.py).
+                arr = arr.copy()
             else:
                 full = (slice(None),) * len(gshape)
                 arr = store.read(key, full).astype(dtype)

@@ -700,8 +700,9 @@ def test_fault_point_registry_pinned():
     documented in the RUNBOOK, covered by a test, and pinned in the
     validator's EXPECTED_POINTS — and the validator actually sees the
     full set, including the multi-replica points (router.route /
-    router.probe / supervisor.spawn / replica.exec) and the paged-KV
-    bind point (serve.kv.bind)."""
+    router.probe / supervisor.spawn / replica.exec), the paged-KV
+    bind point (serve.kv.bind), and the migration points
+    (router.migrate / replica.kv_export / replica.kv_install)."""
     from check_fault_points import EXPECTED_POINTS, check, find_points
 
     assert check(_ROOT) == []
@@ -712,5 +713,6 @@ def test_fault_point_registry_pinned():
         "router.route", "router.probe",
         "supervisor.spawn", "replica.exec",
         "serve.kv.bind",
+        "router.migrate", "replica.kv_export", "replica.kv_install",
     }
     assert set(find_points(_ROOT)) == set(EXPECTED_POINTS)
