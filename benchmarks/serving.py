@@ -586,6 +586,13 @@ def _run_one(args, model, variables, decode_horizon: int,
         }
     if sink is not None:
         obs.end_run()
+        # The stitched-trace block (ISSUE 12): per-segment TTFT
+        # decomposition percentiles from this run's own spans — every
+        # measured request carried a trace id (the scheduler mints at
+        # submit while the run is active), so nezha-bench can gate
+        # each timeline segment, not just the total.
+        from nezha_tpu.obs.report import trace_summary
+        record["trace"] = trace_summary(run_dir)
     return record
 
 
@@ -828,9 +835,18 @@ def _run_replicas(args, decode_horizon: int) -> dict:
             "gb_per_s": (mig_bytes / mig_secs / 1e9) if mig_secs else 0.0,
             "fallbacks": router.migrate_fallbacks - fallbacks0,
         }
+    trace_block = None
+    if args.run_dir:
+        # Stitched fleet traces: with the thread backend every
+        # replica's fragments land in this one capture, so the
+        # decomposition covers the router hop, the migration transfer,
+        # and both tiers' queue waits.
+        from nezha_tpu.obs.report import trace_summary
+        trace_block = trace_summary(args.run_dir)
     return {
         "mode": "closed",
         "replicas": total,
+        "trace": trace_block,
         "disaggregate": bool(args.disaggregate),
         "roles": list(roles),
         "kill_rate": args.kill_rate,

@@ -44,6 +44,11 @@ def check(index: SourceIndex) -> List[Finding]:
             if not cn.startswith("obs."):
                 continue
             kind = cn[len("obs."):]
+            # The retroactive (emit_span) and trace-gated (traced_span)
+            # forms record into the same span stream — their literal
+            # names face the identical pinned-registry contract.
+            if kind in ("emit_span", "traced_span"):
+                kind = "span"
             name = str_arg(node)
             if name is None:
                 continue

@@ -19,10 +19,17 @@ drift must fail fast. Schema v1:
     metrics.jsonl   one JSON object per line; "step" int >= 0, "ts"
                     float; other values JSON scalars
     spans.jsonl     one JSON object per line; "name" str, "t0"/"t1"
-                    floats with t1 >= t0, "dur_s" float, "attrs" object
+                    floats with t1 >= t0, "dur_s" float, "attrs" object;
+                    optionally the trace record fields "trace_id"/
+                    "span_id"/"parent_id" (non-empty strings — the
+                    distributed-tracing stitch key)
     summary.json    schema_version == 1; counters/gauges/histograms/
                     collectives objects; compile_cache with int
                     hits/misses; slowest_spans list of span records
+
+This module also pins the LIVE ``GET /stats`` payload
+(:func:`check_stats_payload`, stats schema v1): the replica shape
+(``obs.stats_snapshot()``) and the router's fleet aggregate.
 """
 
 from __future__ import annotations
@@ -123,6 +130,19 @@ _PINNED_SPANS = {
     # dispatch -> KV migration -> decode answer (attrs carry src/dst
     # rids, wire bytes, and any degradation taken).
     "router.migrate",
+    # Distributed request tracing (PR 12): the per-request lifecycle
+    # fragments nezha-telemetry --trace stitches into one timeline.
+    # Every one carries trace_id/span_id (and usually a request_id
+    # attr); emitted ONLY for traced requests, so volume follows
+    # --trace-sample.
+    "router.request",        # the root fragment, minted at the router
+    "serve.queue_wait",      # submit -> admission
+    "serve.prefill.chunk",   # one per prefill bucket dispatch
+    "serve.park",            # prefill_only park -> ack/resume/TTL/drain
+    "serve.kv_export",       # source side of the migration pull
+    "serve.kv_install",      # decode side: export POST+install+ACK
+    "serve.decode_window",   # one per decode dispatch the request rode
+    "serve.decode",          # decode residency + first-token milestone
 }
 
 # Namespaces whose METRIC names (counter/gauge/histogram) the source
@@ -154,6 +174,20 @@ def _check_span(rec, where: str, errors: List[str]) -> None:
         errors.append(f"{where}: span t1 < t0")
     if not isinstance(rec.get("attrs"), dict):
         errors.append(f"{where}: span 'attrs' must be an object")
+    # Trace record fields (distributed tracing, PR 12): optional — an
+    # untraced span carries none of them — but when present they must
+    # be non-empty strings, a trace_id never rides without its span_id,
+    # and a parent link never rides without a trace (the stitcher keys
+    # on exactly this shape).
+    for k in ("trace_id", "span_id", "parent_id"):
+        if k in rec and not (isinstance(rec[k], str) and rec[k]):
+            errors.append(f"{where}: span {k!r} must be a non-empty "
+                          f"string when present")
+    if "trace_id" in rec and "span_id" not in rec:
+        errors.append(f"{where}: span carries trace_id without span_id")
+    if "parent_id" in rec and "trace_id" not in rec:
+        errors.append(f"{where}: span carries parent_id without "
+                      f"trace_id")
     name = rec.get("name")
     if (isinstance(name, str) and name.startswith(_PINNED_SPAN_PREFIXES)
             and name not in _PINNED_SPANS):
@@ -319,6 +353,108 @@ def _check_dist(summary: dict, errors: List[str]) -> None:
         return
     for name in sorted(_DIST_COUNTERS - set(counters)):
         errors.append(f"summary.json: dist run missing counter {name!r}")
+
+
+# --------------------------------------------------- live /stats schema
+# The GET /stats payload contract (stats schema v1). Two shapes share
+# it: a REPLICA payload (obs.stats_snapshot() — one registry's live
+# counters/gauges/histogram summaries) and the router's FLEET payload
+# (its own snapshot + every replica's, + a summed roll-up). Extra keys
+# are allowed (a replica may add its role); the pinned core may not
+# drift — dashboards curl this mid-run.
+STATS_SCHEMA_VERSION = 1
+
+
+def _check_stats_metrics(obj: dict, where: str,
+                         errors: List[str]) -> None:
+    for section in ("counters", "gauges"):
+        vals = obj.get(section)
+        if not isinstance(vals, dict):
+            errors.append(f"{where}: '{section}' must be an object")
+            continue
+        for k, v in vals.items():
+            if not _is_num(v):
+                errors.append(f"{where}: {section}[{k!r}] must be a "
+                              f"number")
+
+
+def _check_stats_replica(obj: dict, where: str,
+                         errors: List[str]) -> None:
+    if obj.get("stats_schema_version") != STATS_SCHEMA_VERSION:
+        errors.append(f"{where}: stats_schema_version must be "
+                      f"{STATS_SCHEMA_VERSION}, got "
+                      f"{obj.get('stats_schema_version')!r}")
+    if not _is_num(obj.get("ts")):
+        errors.append(f"{where}: 'ts' must be a number")
+    if not isinstance(obj.get("enabled"), bool):
+        errors.append(f"{where}: 'enabled' must be a bool")
+    _check_stats_metrics(obj, where, errors)
+    hists = obj.get("histograms")
+    if isinstance(hists, dict):
+        for k, h in hists.items():
+            if not isinstance(h, dict) or not _HIST_KEYS <= set(h):
+                errors.append(f"{where}: histograms[{k!r}] must carry "
+                              f"{sorted(_HIST_KEYS)}")
+    else:
+        errors.append(f"{where}: 'histograms' must be an object")
+
+
+def check_stats_payload(obj) -> List[str]:
+    """-> schema violations of one ``GET /stats`` response body (empty
+    = valid). Accepts both the replica shape and the router's fleet
+    shape, dispatching on ``kind``."""
+    errors: List[str] = []
+    if not isinstance(obj, dict):
+        return ["stats payload is not an object"]
+    kind = obj.get("kind")
+    if kind == "replica":
+        _check_stats_replica(obj, "stats", errors)
+    elif kind == "fleet":
+        if obj.get("stats_schema_version") != STATS_SCHEMA_VERSION:
+            errors.append(f"stats: stats_schema_version must be "
+                          f"{STATS_SCHEMA_VERSION}, got "
+                          f"{obj.get('stats_schema_version')!r}")
+        if not _is_num(obj.get("ts")):
+            errors.append("stats: 'ts' must be a number")
+        router = obj.get("router")
+        if isinstance(router, dict):
+            _check_stats_replica(router, "stats.router", errors)
+        else:
+            errors.append("stats: 'router' must be an object")
+        replicas = obj.get("replicas")
+        if isinstance(replicas, list):
+            for i, row in enumerate(replicas):
+                where = f"stats.replicas[{i}]"
+                if not isinstance(row, dict):
+                    errors.append(f"{where}: must be an object")
+                    continue
+                if not _is_num(row.get("rid")):
+                    errors.append(f"{where}: 'rid' must be a number")
+                for k in ("role", "state"):
+                    if not isinstance(row.get(k), str):
+                        errors.append(f"{where}: {k!r} must be a "
+                                      f"string")
+                if not isinstance(row.get("healthy"), bool):
+                    errors.append(f"{where}: 'healthy' must be a bool")
+                stats = row.get("stats")
+                if stats is not None:      # None = member unreachable
+                    if isinstance(stats, dict):
+                        _check_stats_replica(stats, where + ".stats",
+                                             errors)
+                    else:
+                        errors.append(f"{where}: 'stats' must be an "
+                                      f"object or null")
+        else:
+            errors.append("stats: 'replicas' must be a list")
+        fleet = obj.get("fleet")
+        if isinstance(fleet, dict):
+            _check_stats_metrics(fleet, "stats.fleet", errors)
+        else:
+            errors.append("stats: 'fleet' must be an object")
+    else:
+        errors.append(f"stats: 'kind' must be 'replica' or 'fleet', "
+                      f"got {kind!r}")
+    return errors
 
 
 def check_run_dir(run_dir: str) -> List[str]:

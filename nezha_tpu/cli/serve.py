@@ -19,7 +19,13 @@ events per stdout line::
 
 HTTP (--http PORT, stdlib http.server) — POST /generate with the same
 request object (response once finished; queue-full = 503), GET /healthz
-for liveness + occupancy.
+for liveness + occupancy, GET /stats for the LIVE telemetry registry
+snapshot (stats schema v1 — counters/gauges/histogram summaries you can
+curl mid-run; the router's version aggregates the whole fleet).
+Requests may carry a distributed ``trace_id`` (field or X-Nezha-Trace
+header; minted automatically per --trace-sample when a --run-dir run is
+active) — ``nezha-telemetry RUN_DIR --trace`` stitches the resulting
+per-replica span fragments into per-request timelines.
 
 Lifecycle: SIGTERM/SIGINT triggers a GRACEFUL DRAIN — admission closes
 immediately (stdio stops reading stdin; HTTP answers 503 "draining" on
@@ -210,6 +216,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="consecutive startup failures after which a "
                         "replica's circuit breaker opens (the "
                         "supervisor stops restarting it)")
+    p.add_argument("--trace-sample", type=float, default=1.0,
+                   help="fraction of requests that carry a distributed "
+                        "trace id (per-request lifecycle spans stitched "
+                        "by 'nezha-telemetry RUN_DIR --trace'): 1.0 "
+                        "traces every request, 0.0 disables minting — "
+                        "the load knob for high-traffic fleets. Only "
+                        "meaningful with --run-dir (no run = no spans)")
     p.add_argument("--run-dir", default=None,
                    help="write telemetry artifacts (metrics.jsonl / "
                         "spans.jsonl / summary.json) here")
@@ -297,6 +310,9 @@ def _parse_request(obj: dict, args, tokenizer, eos_id, vocab: int):
     # operator's bound on how long one request may monopolize a slot.
     max_new = min(num("max_new_tokens", int, args.max_new_tokens),
                   args.max_new_tokens)
+    trace_id = obj.get("trace_id")
+    if trace_id is not None and not isinstance(trace_id, str):
+        raise ValueError(f"trace_id must be a string, got {trace_id!r}")
     return Request(
         prompt=prompt, max_new_tokens=max_new,
         temperature=num("temperature", float, 0.0),
@@ -307,7 +323,12 @@ def _parse_request(obj: dict, args, tokenizer, eos_id, vocab: int):
         request_id=obj.get("id"),
         # Disaggregation: prefill and PARK for migration (the router's
         # phase-one dispatch) instead of decoding here.
-        prefill_only=bool(obj.get("prefill_only", False)))
+        prefill_only=bool(obj.get("prefill_only", False)),
+        # Distributed tracing: the router-minted id this request's
+        # lifecycle spans carry. "" is a real verdict — "routed and
+        # sampled out" — which the scheduler honors by NOT minting;
+        # only an absent field (None) lets it mint for itself.
+        trace_id=trace_id)
 
 
 def _decode_text(tokens, tokenizer):
@@ -541,6 +562,15 @@ def run_http(scheduler, args, tokenizer, eos_id, port: int,
             self.wfile.write(body)
 
         def do_GET(self):
+            if self.path == "/stats":
+                # Live registry snapshot (stats schema v1): the
+                # counters/gauges/histogram summaries RIGHT NOW,
+                # curl-able mid-run without waiting for the run-dir
+                # flush. Answered even while draining.
+                from nezha_tpu import obs
+                payload = obs.stats_snapshot()
+                payload["role"] = getattr(args, "role", "both")
+                return self._send(200, payload)
             if self.path != "/healthz":
                 return self._send(404, {"error": "unknown path"})
             pool = scheduler.engine.pool
@@ -580,6 +610,8 @@ def run_http(scheduler, args, tokenizer, eos_id, port: int,
                 obj = json.loads(self.rfile.read(n))
             except (ValueError, json.JSONDecodeError) as e:
                 return self._send(400, {"error": str(e)})
+            from nezha_tpu import obs
+            obs.adopt_trace_header(self.headers, obj)
             if isinstance(obj, dict) and obj.get("resume"):
                 return self._handle_resume(str(obj["resume"]))
             mig_meta = None
@@ -781,9 +813,13 @@ def run_worker(args, stdin=None, stdout=None, ready_cb=None,
     drain = drain_event if drain_event is not None else threading.Event()
     old_handlers = {}
 
+    from nezha_tpu import obs
+    try:
+        obs.set_trace_sample(getattr(args, "trace_sample", 1.0))
+    except ValueError as e:
+        raise SystemExit(f"--trace-sample: {e}")
     sink = None
     if args.run_dir:
-        from nezha_tpu import obs
         sink = obs.start_run(args.run_dir, meta={
             "kind": "serve", "mode": "http" if args.http else "stdio"})
     try:
@@ -846,6 +882,7 @@ def _worker_argv(args, rid: int, port: int, role: Optional[str] = None
              "--prefix-cache", args.prefix_cache,
              "--kv-eviction", args.kv_eviction,
              "--drain-timeout", str(args.drain_timeout),
+             "--trace-sample", str(getattr(args, "trace_sample", 1.0)),
              "--seed", str(args.seed),
              "--http", str(port)]
     if args.kv_num_blocks is not None:
@@ -916,9 +953,16 @@ def run_multi(args, ready_cb=None, drain_event=None) -> int:
         max_restart_failures=args.max_restart_failures,
         drain_timeout_s=args.drain_timeout,
         seed=args.seed)
+    from nezha_tpu import obs
+    try:
+        # The router is the trace-minting edge: the sample knob lives
+        # here (workers inherit it via argv passthrough so a replica
+        # minting for a direct request agrees with the router).
+        obs.set_trace_sample(getattr(args, "trace_sample", 1.0))
+    except ValueError as e:
+        raise SystemExit(f"--trace-sample: {e}")
     sink = None
     if args.run_dir:
-        from nezha_tpu import obs
         from nezha_tpu.serve.router import register_router_instruments
         sink = obs.start_run(args.run_dir, meta={
             "kind": "serve_router", "replicas": total,

@@ -9,6 +9,14 @@ Reads the artifacts the run sink wrote (``metrics.jsonl``,
 streams) and prints step-rate percentiles, per-chip throughput, the
 per-collective payload/bandwidth table, compile-cache hit ratio, and the
 slowest spans. ``--json`` dumps the raw summary instead, for scripting.
+
+``--trace`` switches to the DISTRIBUTED-TRACE view: walk this run dir
+plus the per-replica subdirectories a ``--replicas`` serve run writes,
+stitch every replica's span fragments by trace id, and render the
+per-request timelines — the TTFT decomposition (router queue ->
+prefill wait -> prefill compute -> migration transfer -> decode wait ->
+first token) and the slowest-requests table with critical-path
+attribution (docs/RUNBOOK.md "Tracing a slow request").
 """
 
 from __future__ import annotations
@@ -29,7 +37,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--json", action="store_true",
                    help="print the raw summary.json (recomputed from the "
                         "streams when the file is missing) instead of the "
-                        "rendered report")
+                        "rendered report; with --trace, the stitched "
+                        "timelines as JSON")
+    p.add_argument("--trace", action="store_true",
+                   help="stitch the run's distributed trace fragments "
+                        "(this dir + per-replica subdirs) into "
+                        "per-request timelines and render the TTFT "
+                        "decomposition + slowest-requests table instead "
+                        "of the metrics report")
     p.add_argument("--check", action="store_true",
                    help="also validate the artifacts against the frozen "
                         "telemetry schema (exit 1 on drift)")
@@ -42,9 +57,21 @@ def main(argv=None) -> int:
         print(f"no such run directory: {args.run_dir}", file=sys.stderr)
         return 2
     # Deferred so `--help` stays instant (repo convention for CLI entries).
-    from nezha_tpu.obs.report import load_run, render_report, summarize_streams
+    from nezha_tpu.obs.report import (load_run, render_report,
+                                      render_trace_report,
+                                      stitch_run_dir, summarize_streams)
 
-    if args.json:
+    if args.trace:
+        # The fleet view: walk this dir plus the per-replica subdirs a
+        # --replicas run writes, stitch fragments by trace id, render
+        # per-request timelines (docs/RUNBOOK.md "Tracing a slow
+        # request").
+        if args.json:
+            print(json.dumps(stitch_run_dir(args.run_dir), indent=2,
+                             sort_keys=True))
+        else:
+            print(render_trace_report(args.run_dir))
+    elif args.json:
         run = load_run(args.run_dir)
         summary = run["summary"]
         if summary is None:

@@ -26,15 +26,26 @@ from nezha_tpu.obs.registry import (
     REGISTRY,
     Registry,
     Span,
+    TRACE_HEADER,
+    adopt_trace_header,
     counter,
+    current_trace,
     disable,
+    emit_span,
     enable,
     enabled,
     gauge,
     histogram,
+    mint_trace_id,
+    new_span_id,
     record_collective,
     record_metrics,
+    set_trace_sample,
     span,
+    stats_snapshot,
+    trace_context,
+    trace_sample,
+    traced_span,
 )
 from nezha_tpu.obs.sink import (
     METRICS_FILE,
@@ -51,6 +62,9 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "Span", "REGISTRY",
     "NULL_SPAN", "counter", "gauge", "histogram", "span", "enabled",
     "enable", "disable", "record_metrics", "record_collective",
+    "trace_context", "current_trace", "mint_trace_id", "new_span_id",
+    "set_trace_sample", "trace_sample", "traced_span", "emit_span",
+    "stats_snapshot", "TRACE_HEADER", "adopt_trace_header",
     "RunSink", "start_run", "end_run", "current_sink",
     "METRICS_FILE", "SPANS_FILE", "SUMMARY_FILE",
     "MetricsLogger", "StepTimer", "read_metrics",

@@ -19,9 +19,14 @@ a ``--run-dir``; ``snapshot()`` renders everything into the
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
+import random
 import threading
 import time
-from typing import Any, Dict, List, Optional
+import uuid
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 # One mutable cell shared by every instrument: ``enabled`` is THE fast-path
 # check. Instruments cache a reference to this object, so toggling it flips
@@ -40,6 +45,107 @@ _state = _State()
 
 def enabled() -> bool:
     return _state.enabled
+
+
+# --------------------------------------------------------- trace context
+# Distributed request tracing: a request admitted anywhere in the fleet
+# carries one ``trace_id`` across processes (router -> prefill replica ->
+# migration -> decode replica), and every span recorded while the ambient
+# trace context is set adopts it, so per-replica spans.jsonl fragments can
+# be stitched back into one per-request timeline (obs/report.py). The
+# context is a contextvar — it follows the handler thread that owns the
+# request, never leaks across threads, and costs nothing while telemetry
+# is disabled (``Registry.span`` short-circuits to NULL_SPAN before ever
+# reading it).
+_TRACE: contextvars.ContextVar = contextvars.ContextVar(
+    "nezha_trace", default=None)          # (trace_id, parent_span_id)
+
+# Sampling knob for load (``nezha-serve --trace-sample P``): minting rolls
+# a seeded RNG once per request; a sampled-out request gets NO trace id,
+# so none of its per-request spans are emitted — tracing cost scales with
+# P, not with traffic.
+_trace_lock = threading.Lock()
+_trace_sample = 1.0
+_trace_rng = random.Random(0x7ace)
+
+
+def set_trace_sample(p: float) -> None:
+    """Set the fraction of minted traces kept (0.0 disables minting
+    entirely, 1.0 traces every request)."""
+    global _trace_sample
+    p = float(p)
+    if not 0.0 <= p <= 1.0:
+        raise ValueError(f"trace sample must be in [0, 1], got {p}")
+    with _trace_lock:
+        _trace_sample = p
+
+
+def trace_sample() -> float:
+    return _trace_sample
+
+
+def mint_trace_id() -> Optional[str]:
+    """A fresh trace id for one request — or ``None`` when telemetry is
+    disabled (the branch-only no-op contract: no run, no tracing) or the
+    request lost the ``set_trace_sample`` coin flip. The minting site is
+    the fleet's admission edge (the router; a router-less scheduler mints
+    for itself at submit)."""
+    if not _state.enabled:
+        return None
+    with _trace_lock:
+        if _trace_sample <= 0.0:
+            return None
+        if _trace_sample < 1.0 and _trace_rng.random() >= _trace_sample:
+            return None
+    return uuid.uuid4().hex[:16]
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+#: The HTTP twin of the ``trace_id`` payload field — every serving
+#: front end (replica, thread worker, router) honors the same pair.
+TRACE_HEADER = "X-Nezha-Trace"
+
+
+def adopt_trace_header(headers, payload) -> None:
+    """Merge a ``TRACE_HEADER`` value into ``payload["trace_id"]`` —
+    THE header-adoption rule, shared by every HTTP front end so the
+    header/field precedence can never diverge between them. The header
+    fills ``trace_id`` only when the payload doesn't already carry a
+    non-empty one (the router sends both; either carries the trace).
+    Non-dict payloads are left for the caller's validation to reject.
+    """
+    if not isinstance(payload, dict):
+        return
+    hdr = headers.get(TRACE_HEADER)
+    if hdr and not payload.get("trace_id"):
+        payload["trace_id"] = hdr
+
+
+def current_trace() -> Tuple[Optional[str], Optional[str]]:
+    """-> ``(trace_id, parent_span_id)`` of the ambient trace context
+    (``(None, None)`` outside any)."""
+    cur = _TRACE.get()
+    return cur if cur is not None else (None, None)
+
+
+@contextlib.contextmanager
+def trace_context(trace_id: Optional[str],
+                  parent_id: Optional[str] = None):
+    """Run the enclosed block under ``trace_id``: every span opened (or
+    ``emit_span``-recorded) inside adopts it. ``trace_id=None`` is a
+    cheap no-op, so call sites can pass an unconditionally-threaded
+    (possibly absent) id without branching."""
+    if not trace_id:
+        yield
+        return
+    token = _TRACE.set((trace_id, parent_id))
+    try:
+        yield
+    finally:
+        _TRACE.reset(token)
 
 
 def percentile_of(sorted_values: List[float], q: float) -> float:
@@ -95,17 +201,24 @@ class Gauge:
 
 class Histogram:
     """Value distribution with streaming min/max/sum and a bounded sample
-    reservoir for percentiles (run-scale cardinality: decimate by 2 when
-    the reservoir fills, keeping a uniform stride over the stream)."""
+    RESERVOIR for percentiles (Vitter's Algorithm R): once the reservoir
+    is full, observation ``n`` replaces a random slot with probability
+    ``cap/n``, so at any point the samples are a uniform draw over the
+    WHOLE stream so far — long-run percentiles are unbiased, unlike the
+    old stride decimation whose kept set was anchored to the startup
+    prefix of the stream. The replacement RNG is seeded from the
+    instrument name, so a given observation stream always yields the same
+    summary (reproducible captures)."""
 
     __slots__ = ("name", "count", "total", "min", "max", "_samples",
-                 "_stride", "_skip", "_cap", "_lock")
+                 "_rng", "_cap", "_lock")
 
     # observe() is a multi-field read-modify-write hit from concurrent
-    # recorder threads — declared for nezha-lint's lock-discipline rule.
+    # recorder threads (the reservoir RNG's stream advance included) —
+    # declared for nezha-lint's lock-discipline rule.
     _LOCK_GUARDED = {"count": "_lock", "total": "_lock", "min": "_lock",
                      "max": "_lock", "_samples": "_lock",
-                     "_stride": "_lock", "_skip": "_lock"}
+                     "_rng": "_lock"}
 
     def __init__(self, name: str, cap: int = 4096):
         self.name = name
@@ -114,11 +227,13 @@ class Histogram:
         self.min: Optional[float] = None
         self.max: Optional[float] = None
         self._samples: List[float] = []
-        self._stride = 1   # keep every _stride'th observation
-        self._skip = 0
+        # crc32, not hash(): hash() is salted per process, and the
+        # reservoir must decimate identically across runs of the same
+        # stream for captures to be reproducible.
+        self._rng = random.Random(zlib.crc32(name.encode("utf-8")))
         self._cap = cap
         # Per-instrument lock: observe() is a multi-field read-modify-write
-        # (count/total/reservoir decimation) that concurrent recorders
+        # (count/total/reservoir replacement) that concurrent recorders
         # (e.g. two Executor threads timing compiles) would corrupt.
         self._lock = threading.Lock()
 
@@ -133,13 +248,12 @@ class Histogram:
                 self.min = v
             if self.max is None or v > self.max:
                 self.max = v
-            self._skip += 1
-            if self._skip >= self._stride:
-                self._skip = 0
+            if len(self._samples) < self._cap:
                 self._samples.append(v)
-                if len(self._samples) >= self._cap:
-                    self._samples = self._samples[::2]
-                    self._stride *= 2
+            else:
+                j = self._rng.randrange(self.count)
+                if j < self._cap:
+                    self._samples[j] = v
 
     def percentile(self, q: float) -> Optional[float]:
         with self._lock:
@@ -191,25 +305,44 @@ UNFOLDED_METRIC_KEYS = frozenset({"step", "ts"})
 
 
 class Span:
-    """Live wall-clock span; records itself into the registry on exit."""
+    """Live wall-clock span; records itself into the registry on exit.
 
-    __slots__ = ("name", "attrs", "t0", "t1", "_registry")
+    A span opened inside a ``trace_context`` adopts the ambient trace:
+    it carries ``trace_id`` / a fresh ``span_id`` / the ambient
+    ``parent_id``, and while entered it IS the ambient parent, so nested
+    spans chain. The trace fields ride in the span record only when a
+    trace is present — untraced captures are byte-identical to the
+    pre-tracing schema."""
 
-    def __init__(self, name: str, registry: "Registry", attrs: dict):
+    __slots__ = ("name", "attrs", "t0", "t1", "_registry",
+                 "trace_id", "span_id", "parent_id", "_token")
+
+    def __init__(self, name: str, registry: "Registry", attrs: dict,
+                 trace_id: Optional[str] = None,
+                 parent_id: Optional[str] = None):
         self.name = name
         self.attrs = attrs
         self.t0 = time.time()
         self.t1: Optional[float] = None
         self._registry = registry
+        self.trace_id = trace_id
+        self.span_id = new_span_id() if trace_id else None
+        self.parent_id = parent_id
+        self._token = None
 
     def set(self, **attrs) -> "Span":
         self.attrs.update(attrs)
         return self
 
     def __enter__(self) -> "Span":
+        if self.trace_id:
+            self._token = _TRACE.set((self.trace_id, self.span_id))
         return self
 
     def __exit__(self, exc_type, *exc) -> bool:
+        if self._token is not None:
+            _TRACE.reset(self._token)
+            self._token = None
         self.t1 = time.time()
         if exc_type is not None:
             self.attrs["error"] = exc_type.__name__
@@ -218,8 +351,14 @@ class Span:
 
     def to_record(self) -> dict:
         t1 = self.t1 if self.t1 is not None else time.time()
-        return {"name": self.name, "t0": self.t0, "t1": t1,
-                "dur_s": t1 - self.t0, "attrs": self.attrs}
+        rec = {"name": self.name, "t0": self.t0, "t1": t1,
+               "dur_s": t1 - self.t0, "attrs": self.attrs}
+        if self.trace_id:
+            rec["trace_id"] = self.trace_id
+            rec["span_id"] = self.span_id
+            if self.parent_id:
+                rec["parent_id"] = self.parent_id
+        return rec
 
 
 class Registry:
@@ -267,7 +406,39 @@ class Registry:
     def span(self, name: str, **attrs):
         if not _state.enabled:
             return NULL_SPAN
-        return Span(name, self, attrs)
+        tid, parent = current_trace()
+        return Span(name, self, attrs, trace_id=tid, parent_id=parent)
+
+    def traced_span(self, name: str, **attrs):
+        """A span recorded ONLY inside an ambient trace context — the
+        per-request instrumentation form: a sampled-out (or untraced)
+        request pays a single contextvar read and records nothing, so
+        trace volume scales with the sample rate, not with traffic."""
+        if not _state.enabled:
+            return NULL_SPAN
+        tid, parent = current_trace()
+        if tid is None:
+            return NULL_SPAN
+        return Span(name, self, attrs, trace_id=tid, parent_id=parent)
+
+    def emit_span(self, name: str, t0: float, t1: float,
+                  trace_id: Optional[str] = None,
+                  parent_id: Optional[str] = None, **attrs) -> None:
+        """Record an already-measured interval as a span — the
+        retroactive form lifecycle call sites use when the boundary
+        times are only known after the fact (queue wait is measured at
+        admission, a park's span at its release). No-op while telemetry
+        is disabled."""
+        if not _state.enabled:
+            return
+        rec = {"name": name, "t0": float(t0), "t1": float(t1),
+               "dur_s": float(t1) - float(t0), "attrs": attrs}
+        if trace_id:
+            rec["trace_id"] = trace_id
+            rec["span_id"] = new_span_id()
+            if parent_id:
+                rec["parent_id"] = parent_id
+        self.record_span(rec)
 
     def record_span(self, rec: dict) -> None:
         if not _state.enabled:
@@ -348,6 +519,26 @@ class Registry:
             "slowest_spans": slowest,
         }
 
+    def stats(self) -> dict:
+        """The live ``/stats`` payload (stats schema v1, pinned by
+        analysis/telemetry_schema.check_stats_payload): the registry's
+        counters/gauges/histogram summaries RIGHT NOW, without touching
+        (or requiring) a run dir — what a replica front end answers so
+        an operator can curl the fleet mid-run. Spans are excluded: the
+        live view is the aggregate state, traces are the run-dir
+        artifact."""
+        with self._lock:
+            counters = {k: c.value for k, c in self._counters.items()}
+            gauges = {k: g.value for k, g in self._gauges.items()}
+            hists = list(self._histograms.values())
+        return {"stats_schema_version": 1,
+                "kind": "replica",
+                "ts": time.time(),
+                "enabled": _state.enabled,
+                "counters": counters,
+                "gauges": gauges,
+                "histograms": {h.name: h.summary() for h in hists}}
+
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
@@ -375,6 +566,21 @@ def histogram(name: str) -> Histogram:
 
 def span(name: str, **attrs):
     return REGISTRY.span(name, **attrs)
+
+
+def traced_span(name: str, **attrs):
+    return REGISTRY.traced_span(name, **attrs)
+
+
+def emit_span(name: str, t0: float, t1: float,
+              trace_id: Optional[str] = None,
+              parent_id: Optional[str] = None, **attrs) -> None:
+    REGISTRY.emit_span(name, t0, t1, trace_id=trace_id,
+                       parent_id=parent_id, **attrs)
+
+
+def stats_snapshot() -> dict:
+    return REGISTRY.stats()
 
 
 def record_metrics(step: int, metrics: Dict[str, Any]) -> None:

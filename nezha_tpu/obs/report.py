@@ -6,6 +6,14 @@ and renders the operator's first-read view: step-rate percentiles,
 per-chip throughput, the per-collective payload/bandwidth table, compile-
 cache behavior, and the slowest spans. Pure stdlib + the JSONL reader, so
 the report works on any machine the run dir is copied to.
+
+This module also owns DISTRIBUTED-TRACE stitching (``--trace``): walk a
+run dir plus the per-replica subdirectories a multi-replica serve run
+writes, group every replica's span fragments by their ``trace_id``, and
+rebuild each request's cross-fleet timeline — the TTFT decomposition
+over :data:`TRACE_SEGMENTS` whose pieces tile the measured TTFT exactly,
+plus partial-trace accounting for requests whose fragments a killed
+replica took with it.
 """
 
 from __future__ import annotations
@@ -224,6 +232,263 @@ def render_replicas_section(summary: Optional[dict]) -> List[str]:
             f"  queue split: prefill wait p50 {pw['p50'] * 1e3:.1f} ms  "
             f"decode wait p50 {dw['p50'] * 1e3:.1f} ms")
     return lines
+
+
+# ------------------------------------------------- distributed traces
+# The stitched-timeline segments of the TTFT decomposition, in wall
+# order. Each is the interval between two consecutive milestones of a
+# request's cross-replica lifecycle, so for a complete trace they TILE
+# [router arrival, first token] exactly — the segment sum IS the
+# end-to-end TTFT (tests pin this).
+TRACE_SEGMENTS = ("router_queue", "prefill_wait", "prefill_compute",
+                  "migration_transfer", "decode_wait", "first_token")
+
+
+def load_fleet_spans(run_dir: str) -> List[dict]:
+    """Every span record reachable from ``run_dir`` — its own
+    spans.jsonl plus any immediate subdirectory's (the per-replica
+    ``replica<N>/`` layout ``nezha-serve --replicas --run-dir`` writes,
+    and the per-horizon ``h<N>/`` layout of bench sweeps) — each tagged
+    with its source directory under ``_src`` so stitched timelines can
+    say which replica a fragment came from."""
+    sources = [(".", run_dir)]
+    try:
+        names = sorted(os.listdir(run_dir))
+    except OSError:
+        names = []
+    for name in names:
+        sub = os.path.join(run_dir, name)
+        if os.path.isdir(sub):
+            sources.append((name, sub))
+    out: List[dict] = []
+    for src, d in sources:
+        path = os.path.join(d, SPANS_FILE)
+        if not os.path.isfile(path):
+            continue
+        for rec in read_metrics(path):
+            if isinstance(rec, dict):
+                rec = dict(rec)
+                rec["_src"] = src
+                out.append(rec)
+    return out
+
+
+def stitch_traces(spans: List[dict]) -> Dict[str, List[dict]]:
+    """Group span fragments by ``trace_id`` (records without one are
+    not part of any request timeline), each trace's fragments sorted by
+    start time — all fragments carry epoch wall clocks, so one host's
+    replicas order correctly across processes."""
+    traces: Dict[str, List[dict]] = {}
+    for rec in spans:
+        tid = rec.get("trace_id")
+        if isinstance(tid, str) and tid:
+            traces.setdefault(tid, []).append(rec)
+    for frags in traces.values():
+        frags.sort(key=lambda r: (r.get("t0", 0.0), r.get("t1", 0.0)))
+    return traces
+
+
+def trace_timeline(trace_id: str, frags: List[dict]) -> dict:
+    """One stitched per-request timeline: the TTFT decomposition
+    (:data:`TRACE_SEGMENTS`) computed from the trace's milestone
+    boundaries. Milestones are clamped monotone, so for a ``complete``
+    timeline ``sum(segments) == ttft_s`` EXACTLY — no gap hides between
+    segments. A trace missing milestones (killed replica mid-migration,
+    request still in flight at capture end, expired in queue) comes
+    back ``complete=False`` with the absent pieces named in
+    ``missing`` — partial timelines render, they just don't decompose.
+    """
+    by_name: Dict[str, List[dict]] = {}
+    for f in frags:
+        by_name.setdefault(str(f.get("name")), []).append(f)
+
+    def attrs_of(f) -> dict:
+        a = f.get("attrs")
+        return a if isinstance(a, dict) else {}
+
+    root = (by_name.get("router.request") or [None])[0]
+    qws = by_name.get("serve.queue_wait", [])
+    prefills = by_name.get("serve.prefill", [])
+    # Only SUCCESSFUL installs count as a migration: a failed pull
+    # (source lost mid-transfer, kv blocks exhausted) records its
+    # serve.kv_install fragment with an ``error`` attr and the router
+    # degrades — retry on another replica or local decode on the
+    # source. Counting it would report migrated=true with a positive
+    # transfer segment for a migration that never delivered, masking
+    # exactly the degradation this report exists to surface.
+    pulls = [p for p in by_name.get("serve.kv_install", [])
+             if "error" not in attrs_of(p)]
+    # The LAST decode fragment wins: a resumed (local-decode fallback)
+    # request parks one aborted residency behind the real one.
+    decodes = by_name.get("serve.decode", [])
+    decode = decodes[-1] if decodes else None
+
+    request_id = None
+    for f in frags:
+        rid = attrs_of(f).get("request_id")
+        if rid:
+            request_id = rid
+            break
+
+    qw0 = qws[0] if qws else None
+    pull_t0 = pulls[0].get("t0") if pulls else None
+    pre = [p for p in prefills
+           if pull_t0 is None or p.get("t0", 0.0) <= pull_t0]
+    first_token = attrs_of(decode).get("first_token") if decode else None
+
+    milestones = [
+        ("router.request", root.get("t0") if root else
+         (qw0.get("t0") if qw0 else None)),
+        ("serve.queue_wait", qw0.get("t0") if qw0 else None),
+        ("admitted", qw0.get("t1") if qw0 else None),
+        ("prefill done", max((p.get("t1", 0.0) for p in pre),
+                             default=None) if pre else None),
+        ("migration done", max((p.get("t1", 0.0) for p in pulls),
+                               default=None) if pulls
+         else (max((p.get("t1", 0.0) for p in pre), default=None)
+               if pre else None)),
+        ("serve.decode", decode.get("t0") if decode else None),
+        ("first token", float(first_token)
+         if first_token is not None else None),
+    ]
+    missing = [name for name, t in milestones if t is None]
+    out = {
+        "trace_id": trace_id,
+        "request_id": request_id,
+        "fragments": len(frags),
+        "span_names": sorted(by_name),
+        "replicas": sorted({str(f.get("_src", ".")) for f in frags}),
+        "complete": not missing,
+        "missing": missing,
+        "migrated": bool(pulls),
+        "t0": milestones[0][1],
+    }
+    if decode is not None:
+        a = attrs_of(decode)
+        out["finish_reason"] = a.get("finish_reason")
+        out["tokens"] = a.get("tokens")
+    if missing:
+        return out
+    # Clamp monotone, then difference: consecutive intervals tile
+    # [arrival, first token], so the segment sum equals ttft_s exactly.
+    times = []
+    run = None
+    for _, t in milestones:
+        run = t if run is None else max(run, t)
+        times.append(run)
+    out["segments"] = {seg: times[i + 1] - times[i]
+                       for i, seg in enumerate(TRACE_SEGMENTS)}
+    out["ttft_s"] = times[-1] - times[0]
+    return out
+
+
+def stitch_run_dir(run_dir: str) -> List[dict]:
+    """-> every stitched timeline of a (possibly multi-replica) run
+    dir, slowest-complete first, partial timelines at the tail."""
+    traces = stitch_traces(load_fleet_spans(run_dir))
+    timelines = [trace_timeline(tid, frags)
+                 for tid, frags in traces.items()]
+    timelines.sort(key=lambda t: (not t["complete"],
+                                  -(t.get("ttft_s") or 0.0)))
+    return timelines
+
+
+def trace_summary(run_dir: str) -> Optional[dict]:
+    """The per-segment percentile record of a run's stitched traces —
+    what ``benchmarks/serving.py`` embeds as the record's ``trace``
+    block so ``nezha-bench`` can gate each piece of the TTFT
+    decomposition, not just the total. None when the run produced no
+    traces at all."""
+    timelines = stitch_run_dir(run_dir)
+    if not timelines:
+        return None
+    complete = [t for t in timelines if t["complete"]]
+    out = {"count": len(timelines), "complete": len(complete),
+           "partial": len(timelines) - len(complete)}
+
+    def pcts(vals: List[float]) -> dict:
+        s = sorted(vals)
+        return {"n": len(s), "p50": percentile_of(s, 50),
+                "p90": percentile_of(s, 90),
+                "p99": percentile_of(s, 99)}
+
+    if complete:
+        out["ttft_s"] = pcts([t["ttft_s"] for t in complete])
+        out["segments"] = {
+            seg: pcts([t["segments"][seg] for t in complete])
+            for seg in TRACE_SEGMENTS}
+    return out
+
+
+def _critical_path(timeline: dict) -> str:
+    segs = timeline.get("segments") or {}
+    if not segs:
+        return "-"
+    seg, dur = max(segs.items(), key=lambda kv: kv[1])
+    total = sum(segs.values())
+    share = dur / total if total else 0.0
+    return f"{seg} {share:.0%}"
+
+
+def render_trace_report(run_dir: str, top: int = 10) -> str:
+    """The ``nezha-telemetry RUN_DIR --trace`` view: the fleet's
+    stitched per-request timelines — TTFT decomposition percentiles per
+    segment, the slowest requests with critical-path attribution, and
+    the partial traces (a killed replica mid-migration leaves exactly
+    this shape) listed rather than silently dropped."""
+    timelines = stitch_run_dir(run_dir)
+    lines = [f"trace report: {os.path.abspath(run_dir)}"]
+    if not timelines:
+        lines.append("(no trace fragments found — was the run captured "
+                     "with --run-dir and tracing not sampled out?)")
+        return "\n".join(lines)
+    complete = [t for t in timelines if t["complete"]]
+    partial = [t for t in timelines if not t["complete"]]
+    lines.append(f"traces: {len(timelines)} stitched "
+                 f"({len(complete)} complete, {len(partial)} partial)")
+    if complete:
+        lines.append("")
+        lines.append(f"ttft decomposition over {len(complete)} "
+                     f"complete request(s):")
+        lines.append(f"  {'segment':<20}{'p50 ms':>10}{'p90 ms':>10}"
+                     f"{'p99 ms':>10}")
+        seg_series = {seg: sorted(t["segments"][seg] for t in complete)
+                      for seg in TRACE_SEGMENTS}
+        for seg in TRACE_SEGMENTS:
+            s = seg_series[seg]
+            lines.append(
+                f"  {seg:<20}"
+                f"{percentile_of(s, 50) * 1e3:>10.1f}"
+                f"{percentile_of(s, 90) * 1e3:>10.1f}"
+                f"{percentile_of(s, 99) * 1e3:>10.1f}")
+        totals = sorted(t["ttft_s"] for t in complete)
+        lines.append(
+            f"  {'total (ttft)':<20}"
+            f"{percentile_of(totals, 50) * 1e3:>10.1f}"
+            f"{percentile_of(totals, 90) * 1e3:>10.1f}"
+            f"{percentile_of(totals, 99) * 1e3:>10.1f}")
+        lines.append("")
+        lines.append(f"slowest requests (top {min(top, len(complete))}):")
+        lines.append(f"  {'ttft ms':>10}  {'request':<20}"
+                     f"{'replicas':<20}  critical path")
+        for t in complete[:top]:
+            lines.append(
+                f"  {t['ttft_s'] * 1e3:>10.1f}  "
+                f"{str(t.get('request_id') or t['trace_id']):<20}"
+                f"{','.join(t['replicas']):<20}  "
+                f"{_critical_path(t)}")
+    if partial:
+        lines.append("")
+        lines.append(f"partial traces ({len(partial)} — request still "
+                     f"in flight at capture end, expired unadmitted, "
+                     f"or a replica died holding its fragments):")
+        for t in partial[:top]:
+            lines.append(
+                f"  {str(t.get('request_id') or t['trace_id']):<22}"
+                f"{t['fragments']} fragment(s) from "
+                f"{','.join(t['replicas'])}; missing "
+                f"{', '.join(t['missing'])}")
+    return "\n".join(lines)
 
 
 def render_report(run_dir: str) -> str:

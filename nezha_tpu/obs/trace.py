@@ -1,12 +1,22 @@
-"""Tracing and profiling on top of jax.profiler.
+"""Tracing and profiling on top of jax.profiler — plus the distributed
+request-trace context re-exports.
 
-Absorbed from ``utils/profiling.py`` into the telemetry subsystem (the
-public names stay importable from ``nezha_tpu.utils``). The reference had
-no attested profiler subsystem (SURVEY.md §5); on TPU
-the platform tool is the XLA profiler — ``jax.profiler`` captures device
-traces (MXU occupancy, HBM traffic, per-op timing) viewable in
-TensorBoard/XProf. This module wraps it with context managers that are
-no-ops when disabled, so call sites can stay annotated permanently.
+Two kinds of tracing meet here:
+
+- **device tracing** (this module's own code): absorbed from
+  ``utils/profiling.py`` (the public names stay importable from
+  ``nezha_tpu.utils``). The reference had no attested profiler subsystem
+  (SURVEY.md §5); on TPU the platform tool is the XLA profiler —
+  ``jax.profiler`` captures device traces (MXU occupancy, HBM traffic,
+  per-op timing) viewable in TensorBoard/XProf. The context managers are
+  no-ops when disabled, so call sites can stay annotated permanently.
+- **distributed request tracing** (re-exported from ``obs.registry``,
+  where the Span machinery lives): ``trace_context(trace_id)`` sets the
+  ambient trace a request carries across the serving fleet,
+  ``mint_trace_id()`` mints one at the admission edge (sampled by
+  ``set_trace_sample``), ``traced_span`` / ``emit_span`` record
+  per-request lifecycle fragments that ``nezha-telemetry RUN_DIR
+  --trace`` stitches back into per-request timelines (obs/report.py).
 """
 
 from __future__ import annotations
@@ -16,6 +26,17 @@ import os
 from typing import Iterator, Optional
 
 import jax
+
+from nezha_tpu.obs.registry import (  # noqa: F401 — re-exported API
+    current_trace,
+    emit_span,
+    mint_trace_id,
+    new_span_id,
+    set_trace_sample,
+    trace_context,
+    trace_sample,
+    traced_span,
+)
 
 
 @contextlib.contextmanager

@@ -467,39 +467,47 @@ class Engine:
         qerrs: List[Any] = []
         for off, ln, width in chunks:
             obs.histogram("serve.prefill.bucket_len").observe(width)
-            padded = np.zeros((1, width), np.int32)
-            padded[0, :ln] = tokens[off:off + ln]
-            scalars = (np.int32(ln), np.int32(slot), np.int32(off),
-                       np.int32(seed), np.float32(temperature),
-                       np.int32(0 if top_k is None else top_k),
-                       np.float32(1.0 if top_p is None else top_p),
-                       np.int32(-1 if eos_id is None else eos_id),
-                       np.int32(budget))
-            state = (self.last_logits, self.positions, self.keys,
-                     self.temps, self.top_ks, self.top_ps,
-                     self.eos_ids, self.budgets)
-            if self.paged:
-                out = self.executor.run(
-                    self._prefill_fns[width], self.variables,
-                    self.pool.caches,
-                    jnp.asarray(self.pool.tables_host),
-                    jnp.asarray(padded), *scalars, *state)
-            else:
-                out = self.executor.run(
-                    self._prefill_fns[width], self.variables,
-                    self.pool.caches, jnp.asarray(padded),
-                    *scalars, *state)
-            if self.kv_quant:
-                # The quantized prefill program's extra output: this
-                # chunk's max-abs dequant error. Collect the DEVICE
-                # scalar now, read after every chunk has been
-                # dispatched — the histogram observe must not serialize
-                # chunk k+1's dispatch behind chunk k's completion.
-                out, err = out[:-1], out[-1]
-                qerrs.append(err)
-            (self.pool.caches, self.last_logits, self.positions, self.keys,
-             self.temps, self.top_ks, self.top_ps,
-             self.eos_ids, self.budgets) = out
+            # Per-chunk trace fragment: recorded only when the scheduler
+            # wrapped this prefill in the request's trace context (it
+            # nests under the serve.prefill span), so the stitched
+            # timeline shows which bucket/offset each chunk DISPATCHED
+            # at — untraced requests pay one contextvar read per chunk.
+            with obs.traced_span("serve.prefill.chunk", width=width,
+                                 offset=off, tokens=ln):
+                padded = np.zeros((1, width), np.int32)
+                padded[0, :ln] = tokens[off:off + ln]
+                scalars = (np.int32(ln), np.int32(slot), np.int32(off),
+                           np.int32(seed), np.float32(temperature),
+                           np.int32(0 if top_k is None else top_k),
+                           np.float32(1.0 if top_p is None else top_p),
+                           np.int32(-1 if eos_id is None else eos_id),
+                           np.int32(budget))
+                state = (self.last_logits, self.positions, self.keys,
+                         self.temps, self.top_ks, self.top_ps,
+                         self.eos_ids, self.budgets)
+                if self.paged:
+                    out = self.executor.run(
+                        self._prefill_fns[width], self.variables,
+                        self.pool.caches,
+                        jnp.asarray(self.pool.tables_host),
+                        jnp.asarray(padded), *scalars, *state)
+                else:
+                    out = self.executor.run(
+                        self._prefill_fns[width], self.variables,
+                        self.pool.caches, jnp.asarray(padded),
+                        *scalars, *state)
+                if self.kv_quant:
+                    # The quantized prefill program's extra output: this
+                    # chunk's max-abs dequant error. Collect the DEVICE
+                    # scalar now, read after every chunk has been
+                    # dispatched — the histogram observe must not
+                    # serialize chunk k+1's dispatch behind chunk k's
+                    # completion.
+                    out, err = out[:-1], out[-1]
+                    qerrs.append(err)
+                (self.pool.caches, self.last_logits, self.positions,
+                 self.keys, self.temps, self.top_ks, self.top_ps,
+                 self.eos_ids, self.budgets) = out
         if self.kv_quant:
             hist = obs.histogram("serve.kv.quant_error")
             for err in qerrs:

@@ -54,7 +54,7 @@ from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
-from nezha_tpu import faults
+from nezha_tpu import faults, obs
 from nezha_tpu.serve.slots import KVBlocksExhausted
 
 WIRE_VERSION = 1
@@ -228,39 +228,59 @@ def pull_into(scheduler, pull: dict, timeout_s: float = 120.0) -> dict:
         raise MigrationError(
             "pull_from requires integer 'port' and string 'request_id'")
     host = str(pull.get("host", "127.0.0.1"))
-    t0 = time.monotonic()
-    try:
-        status, wire = _post_json(host, port, "/kv_export",
-                                  {"request_id": rid}, timeout_s)
-    except Exception as e:
-        raise MigrationError(f"kv_export pull from {host}:{port} "
-                             f"failed: {type(e).__name__}: {e}")
-    if status != 200:
-        raise MigrationError(
-            f"kv_export from {host}:{port} answered {status}: "
-            f"{wire.get('error') if isinstance(wire, dict) else wire}",
-            # A live source answering 404 means the park itself is
-            # gone (TTL / drain / already committed elsewhere) — no
-            # other decode member's pull can succeed either.
-            kind="park_lost" if status == 404 else "migration_failed")
-    tokens, layers, nbytes = decode_wire(wire)
-    try:
-        installed = scheduler.install_migrated(tokens, layers, nbytes)
-    except faults.InjectedFault as e:
-        raise MigrationError(f"kv_install injected fault: {e}")
-    except KVBlocksExhausted as e:
-        raise MigrationError(f"kv_install found no free blocks: {e}")
-    except ValueError as e:
-        raise MigrationError(f"kv_install rejected the payload: {e}")
-    # COMMIT: the copy is ours — release the source. Best-effort: a
-    # lost ACK costs the source nothing but its park TTL (it reclaims
-    # the blocks itself); the request is already safe here.
-    try:
-        status, _ = _post_json(host, port, "/kv_ack",
-                               {"request_id": rid}, timeout_s)
-        acked = status == 200
-    except Exception:
-        acked = False
-    nblocks = int(layers[0]["k"].shape[0]) if layers else 0
-    return {"bytes": nbytes, "blocks": nblocks, "installed": installed,
-            "seconds": time.monotonic() - t0, "acked": acked}
+    # The pull reference carries the request's trace id (the router put
+    # it there): the whole transfer hop — export POST, install, ACK —
+    # is ONE serve.kv_install fragment of the stitched timeline (the
+    # "migration transfer" segment of the TTFT decomposition), and the
+    # id is forwarded to the source on both kv endpoints so its export
+    # fragment cross-references. Untraced pulls record nothing.
+    tid = pull.get("trace_id")
+    kv_body = {"request_id": rid}
+    if tid:
+        kv_body["trace_id"] = tid
+    with obs.trace_context(tid):
+        with obs.traced_span("serve.kv_install", request_id=rid) as sp:
+            t0 = time.monotonic()
+            try:
+                status, wire = _post_json(host, port, "/kv_export",
+                                          kv_body, timeout_s)
+            except Exception as e:
+                raise MigrationError(f"kv_export pull from {host}:{port} "
+                                     f"failed: {type(e).__name__}: {e}")
+            if status != 200:
+                raise MigrationError(
+                    f"kv_export from {host}:{port} answered {status}: "
+                    f"{wire.get('error') if isinstance(wire, dict) else wire}",
+                    # A live source answering 404 means the park itself
+                    # is gone (TTL / drain / already committed
+                    # elsewhere) — no other decode member's pull can
+                    # succeed either.
+                    kind="park_lost" if status == 404
+                    else "migration_failed")
+            tokens, layers, nbytes = decode_wire(wire)
+            try:
+                installed = scheduler.install_migrated(tokens, layers,
+                                                       nbytes)
+            except faults.InjectedFault as e:
+                raise MigrationError(f"kv_install injected fault: {e}")
+            except KVBlocksExhausted as e:
+                raise MigrationError(
+                    f"kv_install found no free blocks: {e}")
+            except ValueError as e:
+                raise MigrationError(
+                    f"kv_install rejected the payload: {e}")
+            # COMMIT: the copy is ours — release the source.
+            # Best-effort: a lost ACK costs the source nothing but its
+            # park TTL (it reclaims the blocks itself); the request is
+            # already safe here.
+            try:
+                status, _ = _post_json(host, port, "/kv_ack", kv_body,
+                                       timeout_s)
+                acked = status == 200
+            except Exception:
+                acked = False
+            nblocks = int(layers[0]["k"].shape[0]) if layers else 0
+            sp.set(bytes=nbytes, blocks=nblocks, acked=acked)
+            return {"bytes": nbytes, "blocks": nblocks,
+                    "installed": installed,
+                    "seconds": time.monotonic() - t0, "acked": acked}

@@ -61,6 +61,17 @@ Plain attribute ledgers (:attr:`Router.retries`,
 for callers outside a telemetry run (obs counters are branch-only
 no-ops while disabled). Fault points ``router.route`` and
 ``router.probe`` make both paths chaos-drillable.
+
+Fleet observability (PR 12): the router MINTS a distributed trace id
+per admission (sampled by ``obs.set_trace_sample`` / ``nezha-serve
+--trace-sample``) and forwards it on every hop — the ``trace_id``
+payload field + ``X-Nezha-Trace`` header on ``/generate``, the pull
+reference on ``/kv_export``/``/kv_ack`` — so each replica's lifecycle
+spans become fragments of one per-request timeline
+(``nezha-telemetry RUN_DIR --trace`` stitches them; the
+``router.request`` span is the root fragment). ``GET /stats`` answers
+the LIVE fleet snapshot: the router's registry, every replica's
+``/stats`` payload, and a summed roll-up — no run-dir flush needed.
 """
 
 from __future__ import annotations
@@ -178,26 +189,90 @@ class Router:
             ok, payload = self._probe(r)
             self.sup.mark_probe(r.rid, ok, payload)
 
-    def _probe(self, r) -> Tuple[bool, Optional[dict]]:
+    def _get_json(self, r, path: str) -> Optional[dict]:
+        """GET one replica endpoint -> the parsed JSON object, or None
+        on ANY failure (refused/reset/timeout/non-200/non-object) —
+        the one fetch primitive the prober and the stats view share,
+        so a transport fix can never land in one and miss the other."""
         conn = None
         try:
-            faults.point("router.probe")
             conn = http.client.HTTPConnection(
                 "127.0.0.1", r.port, timeout=self.cfg.probe_timeout_s)
-            conn.request("GET", "/healthz")
+            conn.request("GET", path)
             resp = conn.getresponse()
             body = resp.read()
             if resp.status != 200:
-                return False, None
-            return True, json.loads(body)
+                return None
+            obj = json.loads(body)
+            return obj if isinstance(obj, dict) else None
         except Exception:
-            # Connection refused, reset, timeout, bad JSON, or an
-            # injected router.probe fault: all the same verdict — this
-            # probe was MISSED.
-            return False, None
+            return None
         finally:
             if conn is not None:
                 conn.close()
+
+    def _probe(self, r) -> Tuple[bool, Optional[dict]]:
+        try:
+            faults.point("router.probe")
+        except Exception:
+            # An injected router.probe fault reads as a MISSED probe.
+            return False, None
+        payload = self._get_json(r, "/healthz")
+        return payload is not None, payload
+
+    # ------------------------------------------------------- live stats
+    def fleet_stats(self) -> dict:
+        """The live fleet snapshot ``GET /stats`` answers (stats schema
+        v1, pinned by analysis/telemetry_schema.check_stats_payload):
+        the router's own registry snapshot, every routable replica's
+        ``/stats`` payload fetched live (None for a member that did not
+        answer), and a ``fleet`` roll-up summing the replicas' counters
+        and gauges — one curl shows live occupancy, migration rate, and
+        the queue split without touching a run dir. With the thread
+        replica backend all replicas share this process's registry, so
+        their payloads are identical and the roll-up over-counts by the
+        member count; per-replica rows (and the production process
+        backend) are exact."""
+        reps = self.sup.replicas()
+        # Fetch every member CONCURRENTLY under one shared deadline: a
+        # wedged replica (exactly what an operator curls /stats to
+        # diagnose) costs the view one probe window, not one window
+        # PER wedged member; a fetch that misses the deadline reports
+        # that member's stats as null.
+        fetched: Dict[int, Optional[dict]] = {}
+        threads = []
+        for r in reps:
+            if r.state in (STARTING, LIVE) and r.port:
+                def fetch(rep=r):
+                    fetched[rep.rid] = self._fetch_stats(rep)
+                t = threading.Thread(target=fetch, daemon=True)
+                threads.append(t)
+                t.start()
+        deadline = time.monotonic() + self.cfg.probe_timeout_s
+        for t in threads:
+            t.join(max(deadline - time.monotonic(), 0.0))
+        replicas = []
+        fleet_counters: Dict[str, float] = {}
+        fleet_gauges: Dict[str, float] = {}
+        for r in reps:
+            stats = fetched.get(r.rid)
+            if isinstance(stats, dict):
+                for k, v in (stats.get("counters") or {}).items():
+                    fleet_counters[k] = fleet_counters.get(k, 0) + v
+                for k, v in (stats.get("gauges") or {}).items():
+                    fleet_gauges[k] = fleet_gauges.get(k, 0) + v
+            replicas.append({"rid": r.rid, "role": r.role,
+                             "port": r.port, "state": r.state,
+                             "healthy": r.healthy, "stats": stats})
+        out = obs.stats_snapshot()
+        return {"stats_schema_version": 1, "kind": "fleet",
+                "ts": out["ts"], "enabled": out["enabled"],
+                "router": out, "replicas": replicas,
+                "fleet": {"counters": fleet_counters,
+                          "gauges": fleet_gauges}}
+
+    def _fetch_stats(self, r) -> Optional[dict]:
+        return self._get_json(r, "/stats")
 
     def wait_live(self, n: int, timeout_s: float = 300.0) -> bool:
         """Probe until ``n`` replicas are live (startup convenience for
@@ -217,20 +292,54 @@ class Router:
         disaggregated topology (``cfg.roles`` names a prefill tier)
         the dispatch is the two-phase prefill -> migrate -> decode
         pipeline instead. Always returns ``(status, object)`` — see
-        the module docstring for the error taxonomy."""
+        the module docstring for the error taxonomy.
+
+        The router is the fleet's TRACE-MINTING edge: each admission
+        mints a trace id (None while telemetry is disabled or the
+        ``obs.set_trace_sample`` knob rolls it out) and forwards it on
+        every hop — the ``trace_id`` payload field plus the
+        ``X-Nezha-Trace`` header on ``/generate``, and the pull
+        reference on ``/kv_export`` / ``/kv_ack`` — so every replica's
+        lifecycle spans land in one stitched per-request timeline. The
+        ``router.request`` span is the timeline's root fragment."""
         t0 = time.monotonic()
+        tid = None
+        if isinstance(payload, dict):
+            client_tid = payload.get("trace_id")
+            if not isinstance(client_tid, str) or not client_tid:
+                # A malformed (non-string) client trace_id must neither
+                # poison the pinned span schema nor crash _forward's
+                # header write — it is scrubbed and replaced by the
+                # router's own minting verdict.
+                client_tid = None
+            tid = client_tid or obs.mint_trace_id()
+            # ALWAYS rewrite the field: the minted id, or "" marking
+            # "routed and sampled out" — the replica scheduler treats
+            # "" as explicitly untraced and never re-mints, so the
+            # router stays the fleet's single sampling edge
+            # (--trace-sample P yields P, not P + (1-P)P).
+            payload = {**payload, "trace_id": tid or ""}
         try:
             faults.point("router.route")
-            if self.cfg.disaggregated:
-                return self._route_disagg(payload)
-            return self._route_inner(json.dumps(payload).encode())
+            with obs.trace_context(tid):
+                with obs.traced_span("router.request") as sp:
+                    if isinstance(payload, dict) and payload.get("id"):
+                        sp.set(request_id=payload["id"])
+                    if self.cfg.disaggregated:
+                        status, obj = self._route_disagg(payload)
+                    else:
+                        status, obj = self._route_inner(
+                            json.dumps(payload).encode(), trace_id=tid)
+                    sp.set(status=status)
+                    return status, obj
         except InjectedFault as e:
             return _typed(500, "injected_fault", str(e))
         finally:
             obs.histogram("router.route_s").observe(
                 time.monotonic() - t0)
 
-    def _route_inner(self, body: bytes) -> Tuple[int, dict]:
+    def _route_inner(self, body: bytes,
+                     trace_id: Optional[str] = None) -> Tuple[int, dict]:
         excluded: set = set()
         retries = 0
         failed_over = False
@@ -244,7 +353,8 @@ class Router:
                                   f"{retries} dispatch(es) failed")
                 return _typed(503, "no_live_replicas",
                               "no live replicas")
-            outcome, detail, r = self._dispatch_tier(usable, body)
+            outcome, detail, r = self._dispatch_tier(usable, body,
+                                                     trace_id=trace_id)
             if outcome == "all_full":
                 return _typed(503, "queue_full",
                               f"all {detail} live replica(s) at "
@@ -290,7 +400,8 @@ class Router:
             self.failovers += 1
         obs.counter("router.failovers_total").inc()
 
-    def _dispatch_tier(self, cand, body: bytes):
+    def _dispatch_tier(self, cand, body: bytes,
+                       trace_id: Optional[str] = None):
         """Least-loaded sweep over one tier: forward to the best
         member, skipping 503-full members for this request. ->
         ``(outcome, detail, replica)`` with :meth:`_forward`'s outcomes
@@ -303,7 +414,7 @@ class Router:
                 return "all_full", len(cand), None
             r = min(usable, key=lambda x: (
                 x.in_flight, x.last_health.get("queued", 0), x.rid))
-            outcome, detail = self._forward(r, body)
+            outcome, detail = self._forward(r, body, trace_id=trace_id)
             if outcome == "full":
                 full.add(r.rid)
                 continue
@@ -339,6 +450,7 @@ class Router:
 
     def _disagg_pipeline(self, payload: dict, rid: str,
                          sp) -> Tuple[int, dict]:
+        tid = payload.get("trace_id")
         pf_body = json.dumps({**payload, "prefill_only": True}).encode()
         attempts = 0          # whole-pipeline restarts (source lost)
         excluded: set = set()
@@ -360,10 +472,12 @@ class Router:
                     self.migrate_fallbacks += 1
                 obs.counter("router.migrate_fallbacks_total").inc()
                 sp.set(degraded="no_prefill_tier")
-                return self._route_inner(json.dumps(payload).encode())
+                return self._route_inner(json.dumps(payload).encode(),
+                                         trace_id=tid)
             t_pf = time.monotonic()
             outcome, detail, src = self._dispatch_tier(prefill_live,
-                                                       pf_body)
+                                                       pf_body,
+                                                       trace_id=tid)
             if outcome == "all_full":
                 return _typed(503, "queue_full",
                               f"all {detail} live prefill replica(s) "
@@ -420,7 +534,13 @@ class Router:
         -> ``(status, obj)``, or ``(None, why)`` to signal the caller
         to restart from prefill (the source is gone and the client has
         been handed nothing — a rerun cannot double-serve)."""
+        tid = payload.get("trace_id")
+        # The pull reference carries the trace too: the decode replica
+        # forwards it on its /kv_export + /kv_ack POSTs to the source,
+        # and its own install span adopts it.
         pull = {"port": src.port, "request_id": rid}
+        if tid:
+            pull["trace_id"] = tid
         body = json.dumps({**payload, "pull_from": pull}).encode()
         mig_retries = 0
         excluded: set = set()
@@ -433,7 +553,8 @@ class Router:
                 return self._local_decode(rid, src, sp, pf_wait,
                                           "no live decode replica")
             t_dec = time.monotonic()
-            outcome, detail, dst = self._dispatch_tier(decode_live, body)
+            outcome, detail, dst = self._dispatch_tier(decode_live, body,
+                                                       trace_id=tid)
             if outcome == "all_full":
                 return self._local_decode(
                     rid, src, sp, pf_wait,
@@ -518,8 +639,9 @@ class Router:
             self.migrate_fallbacks += 1
         obs.counter("router.migrate_fallbacks_total").inc()
         sp.set(degraded=why)
+        tid, _ = obs.current_trace()
         outcome, detail = self._forward(
-            src, json.dumps({"resume": rid}).encode())
+            src, json.dumps({"resume": rid}).encode(), trace_id=tid)
         if outcome == "ok":
             obj = detail
             dec_wait = (float(obj["ttft_s"])
@@ -558,7 +680,8 @@ class Router:
         with self._rng_lock:
             return base * (0.5 + self._rng.random())   # ±50% jitter
 
-    def _forward(self, r, body: bytes) -> Tuple[str, object]:
+    def _forward(self, r, body: bytes,
+                 trace_id: Optional[str] = None) -> Tuple[str, object]:
         """One dispatch to one replica -> (outcome, detail):
 
         - ``("ok", result)`` — 200, the finished generation
@@ -578,9 +701,14 @@ class Router:
         conn = http.client.HTTPConnection(
             "127.0.0.1", r.port, timeout=self.cfg.forward_timeout_s)
         committed = False
+        headers = {"Content-Type": "application/json"}
+        if trace_id:
+            # The header twin of the payload's trace_id field: replica
+            # front ends honor either, so a proxy that re-encodes the
+            # body cannot strand the trace.
+            headers[obs.TRACE_HEADER] = trace_id
         try:
-            conn.request("POST", "/generate", body=body,
-                         headers={"Content-Type": "application/json"})
+            conn.request("POST", "/generate", body=body, headers=headers)
             resp = conn.getresponse()
             committed = True
             raw = resp.read()
@@ -641,6 +769,10 @@ def run_front_end(router: Router, supervisor, port: int, *,
             self.wfile.write(body)
 
         def do_GET(self):
+            if self.path == "/stats":
+                # Live fleet view: answered even while draining — the
+                # operator watching a drain is exactly who curls this.
+                return self._send(200, router.fleet_stats())
             if self.path != "/healthz":
                 return self._send(404, {"error": "unknown path"})
             live = supervisor.live_count()
@@ -668,6 +800,11 @@ def run_front_end(router: Router, supervisor, port: int, *,
                 return self._send(*_typed(400, "bad_request",
                                           "request must be a JSON "
                                           "object"))
+            # The fleet entry point honors the same header/field pair
+            # the replica front ends do — an operator tagging a repro
+            # request at the router traces under THEIR id, not a
+            # freshly minted one.
+            obs.adopt_trace_header(self.headers, payload)
             code, obj = router.route(payload)
             self._send(code, obj)
 

@@ -35,6 +35,19 @@ counters, ``faults.injected_total`` (the chaos ledger), and a
 the names tools/check_telemetry_schema.py pins. With no run active
 every call site is the registry's branch-only no-op.
 
+Distributed tracing rides the same lifecycle: a request carrying a
+``trace_id`` (router-minted and forwarded on the wire, or minted at
+``submit`` when this scheduler is the admission edge) emits one
+per-request span fragment per lifecycle stage — ``serve.queue_wait``
+(submit -> admit), ``serve.prefill`` (+ the engine's per-chunk
+``serve.prefill.chunk``), ``serve.park`` / ``serve.kv_export`` (the
+migration handoff), ``serve.decode_window`` (each dispatch the request
+rode) and ``serve.decode`` (residency + the first-token milestone) —
+all stamped with the trace id so ``nezha-telemetry RUN_DIR --trace``
+can stitch the fleet's fragments into one per-request timeline.
+Untraced (or sampled-out) requests emit ZERO extra spans, and with
+telemetry disabled the whole layer stays branch-only no-op.
+
 Failure isolation is request-scoped by design: a prefill exception or a
 non-finite logit row retires ONLY the affected request
 (``FinishReason.ERROR``, slot freed the same iteration) while the loop
@@ -100,6 +113,13 @@ class Request:
     # with FinishReason.PREFILLED; decoding happens wherever the parked
     # KV is pulled to (or locally via resume_parked).
     prefill_only: bool = False
+    # Distributed tracing: the fleet-wide trace id this request carries
+    # (minted by the router at admission and forwarded on the wire, or
+    # minted at submit when the field is ABSENT and a telemetry run is
+    # active — subject to obs.set_trace_sample). "" = the router
+    # already sampled this request OUT: honored as untraced, never
+    # re-minted. Untraced requests' lifecycles emit ZERO extra spans.
+    trace_id: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -122,6 +142,15 @@ class _Live:
     deadline_t: Optional[float]
     tokens: List[int] = dataclasses.field(default_factory=list)
     ttft_s: Optional[float] = None
+    # Distributed-tracing state (None everywhere for untraced requests):
+    # the trace id plus the epoch-clock milestones the per-request
+    # lifecycle spans are emitted from. Wall (epoch) time, not monotonic
+    # — fragments from different processes must stitch on one clock.
+    trace_id: Optional[str] = None
+    submit_wall: Optional[float] = None     # submit()
+    decode_t0_wall: Optional[float] = None  # prefill done / resume
+    first_token_wall: Optional[float] = None
+    park_wall: Optional[float] = None       # prefill_only park
 
 
 def register_serve_instruments() -> None:
@@ -276,6 +305,22 @@ class Scheduler:
             # have allocated a slot first — instead of bouncing this
             # submit before any resource is held.
             raise ValueError(f"prompt ids must be in [0, {vocab})")
+        # Trace adoption: a request arriving with a router-minted trace
+        # id keeps it; the empty string means "routed, and the ROUTER's
+        # sample knob rolled it out" — the minting edge already
+        # decided, so re-minting here would double the effective
+        # sample rate and leave root-less traces. Only a request with
+        # NO verdict at all (trace_id None: direct submit, stdio, a
+        # pre-tracing client) makes this scheduler the admission edge
+        # that mints — None again when no run is active or the local
+        # sample knob rolls it out, in which case the whole lifecycle
+        # emits zero extra spans.
+        if req.trace_id == "":
+            trace_id = None
+        elif req.trace_id is not None:
+            trace_id = req.trace_id
+        else:
+            trace_id = obs.mint_trace_id()
         with self._lock:
             if len(self._queue) >= self.queue_capacity:
                 obs.counter("serve.rejected_total").inc()
@@ -286,7 +331,9 @@ class Scheduler:
             self._queue.append(_Live(
                 req=req, request_id=rid, submit_t=now,
                 deadline_t=None if req.deadline_s is None
-                else now + req.deadline_s))
+                else now + req.deadline_s,
+                trace_id=trace_id,
+                submit_wall=time.time() if trace_id else None))
             obs.gauge("serve.queue_depth").set(len(self._queue))
         return rid
 
@@ -367,8 +414,9 @@ class Scheduler:
         now = time.monotonic()
         for rid in [r for r, (_, _, exp) in self._parked.items()
                     if now >= exp]:
-            slot, _, _ = self._parked.pop(rid)
+            slot, live, _ = self._parked.pop(rid)
             self.engine.pool.free(slot)
+            self._emit_park_span(live, "expired")
             obs.counter("serve.expired_total").inc()
             obs.counter("serve.retired_total").inc()
 
@@ -407,14 +455,27 @@ class Scheduler:
             live = self._queue.popleft()
             slot = pool.alloc()
             req = live.req
+            if live.trace_id is not None:
+                # Queue wait is only measurable retroactively (submit ->
+                # this admission) — the first stitched-timeline segment
+                # after the router hop.
+                obs.emit_span("serve.queue_wait", live.submit_wall,
+                              time.time(), trace_id=live.trace_id,
+                              request_id=live.request_id)
             try:
-                with obs.span("serve.prefill", request_id=live.request_id,
-                              prompt_len=len(req.prompt)):
-                    self.engine.prefill(
-                        slot, req.prompt, seed=req.seed,
-                        temperature=req.temperature, top_k=req.top_k,
-                        top_p=req.top_p, eos_id=req.eos_id,
-                        max_new_tokens=req.max_new_tokens)
+                # The ambient trace context makes serve.prefill (and the
+                # engine's per-chunk serve.prefill.chunk spans beneath
+                # it) carry the request's trace id; a no-op for
+                # untraced requests.
+                with obs.trace_context(live.trace_id):
+                    with obs.span("serve.prefill",
+                                  request_id=live.request_id,
+                                  prompt_len=len(req.prompt)):
+                        self.engine.prefill(
+                            slot, req.prompt, seed=req.seed,
+                            temperature=req.temperature, top_k=req.top_k,
+                            top_p=req.top_p, eos_id=req.eos_id,
+                            max_new_tokens=req.max_new_tokens)
             except Exception as e:
                 # submit() pre-validates the request SHAPE, but runtime/
                 # XLA errors (OOM-ish transients, injected faults) can
@@ -443,10 +504,14 @@ class Scheduler:
                                  error=f"request {live.request_id!r} "
                                        f"already parked")
                     continue
+                if live.trace_id is not None:
+                    live.park_wall = time.time()
                 self._parked[live.request_id] = (
                     slot, live, time.monotonic() + self.parked_ttl_s)
                 self._finish(live, FinishReason.PREFILLED)
                 continue
+            if live.trace_id is not None:
+                live.decode_t0_wall = time.time()
             self._live[slot] = live
 
     def _decode(self) -> int:
@@ -461,6 +526,13 @@ class Scheduler:
         # keeps the final value, which is 0 for any drained server.
         obs.histogram("metric.batch_occupancy").observe(
             len(self._live) / self.engine.cfg.max_batch_size)
+        # Wall-clock twin of the monotonic dispatch window, taken only
+        # when a traced request is in the batch: per-request
+        # serve.decode_window spans and the first-token milestone are
+        # stitched on the epoch clock across processes.
+        traced_batch = obs.enabled() and any(
+            l.trace_id is not None for l in self._live.values())
+        t0_wall = time.time() if traced_batch else None
         t0 = time.monotonic()
         if self._host_gap_t is not None:
             # Host time since the previous block came back: the
@@ -511,6 +583,7 @@ class Scheduler:
             tokens, block_emitted = out
         now = time.monotonic()
         dt = now - t0
+        now_wall = time.time() if traced_batch else None
         self._host_gap_t = now
         obs.histogram("serve.decode.horizon").observe(horizon)
         ok = self.engine.step_ok
@@ -518,6 +591,12 @@ class Scheduler:
         for slot in list(self._live):
             live = self._live[slot]
             e = int(block_emitted[slot])
+            if live.trace_id is not None and t0_wall is not None and e:
+                # One fragment per traced request per dispatch window:
+                # where a slow request's decode time actually went.
+                obs.emit_span("serve.decode_window", t0_wall, now_wall,
+                              trace_id=live.trace_id,
+                              request_id=live.request_id, tokens=e)
             retired = False
             for i in range(e):
                 tok = int(tokens[slot, i])
@@ -530,6 +609,9 @@ class Scheduler:
                     # would overstate TTFT by (H-1)/H of a block.
                     live.ttft_s = ((t0 - live.submit_t)
                                    + dt * (i + 1) / horizon)
+                    if live.trace_id is not None and t0_wall is not None:
+                        live.first_token_wall = (t0_wall
+                                                 + dt * (i + 1) / horizon)
                     obs.histogram("serve.ttft_s").observe(live.ttft_s)
                 # Per-token decode latency: the block cost split over
                 # the tokens it produced, observed once per token —
@@ -592,6 +674,19 @@ class Scheduler:
                 error: Optional[str] = None) -> None:
         """[holds: _lock] — every caller (admission, decode, drain)
         already holds the lock; ``results`` is read by waiter threads."""
+        if live.trace_id is not None and live.decode_t0_wall is not None:
+            # The retire fragment: one span covering this request's
+            # whole decode residency, carrying the first-token epoch
+            # milestone the stitcher ends the TTFT decomposition at.
+            attrs = {"request_id": live.request_id,
+                     "finish_reason": reason,
+                     "tokens": len(live.tokens)}
+            if live.ttft_s is not None:
+                attrs["ttft_s"] = live.ttft_s
+            if live.first_token_wall is not None:
+                attrs["first_token"] = live.first_token_wall
+            obs.emit_span("serve.decode", live.decode_t0_wall,
+                          time.time(), trace_id=live.trace_id, **attrs)
         result = RequestResult(
             request_id=live.request_id, tokens=live.tokens,
             finish_reason=reason, ttft_s=live.ttft_s,
@@ -599,6 +694,17 @@ class Scheduler:
         self.results[live.request_id] = result
         if self.on_finish is not None:
             self.on_finish(result)
+
+    def _emit_park_span(self, live: _Live, outcome: str) -> None:
+        """[holds: _lock] One ``serve.park`` fragment per traced park,
+        emitted at its release (ACK / resume / TTL / drain) — the
+        stitched timeline's view of how long the source held the blocks
+        and which way the two-phase handoff resolved."""
+        if live.trace_id is None or live.park_wall is None:
+            return
+        obs.emit_span("serve.park", live.park_wall, time.time(),
+                      trace_id=live.trace_id,
+                      request_id=live.request_id, outcome=outcome)
 
     # ------------------------------------------------------- migration
     def export_parked(self, request_id: str) -> dict:
@@ -618,21 +724,31 @@ class Scheduler:
                 raise KeyError(request_id)
             slot, live, _ = self._parked[request_id]
             pool = self.engine.pool
-            if not self.engine.paged:
-                raise migrate.MigrationError(
-                    "kv_layout 'dense' has no blocks to export — "
-                    "migration requires the paged pool")
-            tokens = [int(t) for t in live.req.prompt]
-            nfull = min(len(tokens) // pool.block_size,
-                        int(pool._bound[slot]))
-            if nfull == 0:
-                # Sub-block prompt: nothing reusable to ship — a legal,
-                # empty payload (the decode side just prefills cold).
-                return migrate.encode_wire([], [], pool.block_size)
-            layers, _ = pool.export_block_payload(slot, nfull)
-            return migrate.encode_wire(
-                tokens[:nfull * pool.block_size], layers,
-                pool.block_size)
+            # The export fragment adopts the PARKED request's trace (the
+            # authoritative id — it arrived with the prefill_only
+            # admission); untraced parks record nothing.
+            with obs.trace_context(live.trace_id):
+                with obs.traced_span("serve.kv_export",
+                                     request_id=request_id) as sp:
+                    if not self.engine.paged:
+                        raise migrate.MigrationError(
+                            "kv_layout 'dense' has no blocks to export "
+                            "— migration requires the paged pool")
+                    tokens = [int(t) for t in live.req.prompt]
+                    nfull = min(len(tokens) // pool.block_size,
+                                int(pool._bound[slot]))
+                    if nfull == 0:
+                        # Sub-block prompt: nothing reusable to ship —
+                        # a legal, empty payload (the decode side just
+                        # prefills cold).
+                        return migrate.encode_wire([], [],
+                                                   pool.block_size)
+                    layers, _ = pool.export_block_payload(slot, nfull)
+                    wire = migrate.encode_wire(
+                        tokens[:nfull * pool.block_size], layers,
+                        pool.block_size)
+                    sp.set(blocks=nfull, bytes=wire["nbytes"])
+                    return wire
 
     def ack_parked(self, request_id: str) -> bool:
         """Commit of the two-phase handoff (``/kv_ack``): the decode
@@ -643,8 +759,9 @@ class Scheduler:
             parked = self._parked.pop(request_id, None)
             if parked is None:
                 return False
-            slot, _, _ = parked
+            slot, live, _ = parked
             self.engine.pool.free(slot)
+            self._emit_park_span(live, "acked")
             obs.counter("serve.retired_total").inc()
             return True
 
@@ -660,6 +777,9 @@ class Scheduler:
             if parked is None:
                 return False
             slot, live, _ = parked
+            self._emit_park_span(live, "resumed")
+            if live.trace_id is not None:
+                live.decode_t0_wall = time.time()
             # The "prefilled" result was this request's park receipt,
             # not its answer — drop it so the real retirement's result
             # is the one waiters read.
@@ -731,8 +851,9 @@ class Scheduler:
             # source simply stops being pullable (the router's next
             # /kv_export gets a typed 404 and retries elsewhere).
             for rid in list(self._parked):
-                slot, _, _ = self._parked.pop(rid)
+                slot, parked_live, _ = self._parked.pop(rid)
                 self.engine.pool.free(slot)
+                self._emit_park_span(parked_live, "drained")
                 obs.counter("serve.retired_total").inc()
             obs.gauge("serve.queue_depth").set(0)
             obs.gauge("serve.batch_occupancy").set(

@@ -381,6 +381,16 @@ class _ThreadWorker:
                 self.wfile.write(body)
 
             def do_GET(self):
+                if self.path == "/stats":
+                    # Live registry snapshot (stats schema v1) — the
+                    # per-replica row of the router's fleet view.
+                    # Thread workers share the process registry, so
+                    # every member answers the same numbers (the
+                    # production process backend is per-process).
+                    payload = obs.stats_snapshot()
+                    payload["role"] = getattr(worker.args, "role",
+                                              "both")
+                    return self._send(200, payload)
                 if self.path != "/healthz":
                     return self._send(404, {"error": "unknown path"})
                 if not worker._ready.is_set():
@@ -449,6 +459,7 @@ class _ThreadWorker:
             obj = json.loads(h.rfile.read(n))
         except (ValueError, json.JSONDecodeError) as e:
             return h._send(400, {"error": str(e)})
+        obs.adopt_trace_header(h.headers, obj)
         if isinstance(obj, dict) and obj.get("resume"):
             return self._handle_resume(h, str(obj["resume"]))
         mig_meta = None

@@ -61,6 +61,42 @@ def test_histogram_reservoir_bounds_memory():
         obs.disable()
 
 
+def test_histogram_reservoir_unbiased_over_long_runs():
+    """The regression the reservoir switch fixes: a distribution shift
+    AFTER the reservoir first fills must dominate the percentiles when
+    it dominates the stream — the old stride decimation anchored its
+    kept set to the startup prefix, biasing long-run percentiles toward
+    the first ~cap observations."""
+    obs.enable()
+    try:
+        h = obs_registry.Histogram("shift", cap=256)
+        # Fill the reservoir entirely with the startup regime, then
+        # stream 20x as many observations of the steady-state regime.
+        for _ in range(256):
+            h.observe(1.0)
+        for _ in range(256 * 20):
+            h.observe(100.0)
+        assert h.count == 256 * 21
+        assert len(h._samples) == 256        # still bounded
+        # ~95% of the stream is the late regime: p50 (and even p10)
+        # must sit there. Exact streaming stats are unaffected.
+        assert h.percentile(50) == 100.0
+        s = h.summary()
+        assert s["min"] == 1.0 and s["max"] == 100.0
+        late_frac = sum(1 for v in h._samples if v == 100.0) / 256
+        assert late_frac == pytest.approx(20 / 21, abs=0.08)
+        # Seeded per-name RNG: the same stream reproduces the same
+        # reservoir (captures are deterministic).
+        h2 = obs_registry.Histogram("shift", cap=256)
+        for _ in range(256):
+            h2.observe(1.0)
+        for _ in range(256 * 20):
+            h2.observe(100.0)
+        assert h2._samples == h._samples
+    finally:
+        obs.disable()
+
+
 def test_span_records_duration_and_attrs():
     obs.enable()
     try:
@@ -109,6 +145,97 @@ def test_disabled_mode_is_noop_without_allocation():
     assert obs.REGISTRY.spans == []
     # Instruments exist (get-or-create) but recorded nothing.
     assert all(v == 0 for v in obs.REGISTRY.snapshot()["counters"].values())
+
+
+# ------------------------------------------------------- trace context
+def test_span_adopts_ambient_trace_and_nests():
+    obs.enable()
+    try:
+        with obs.trace_context("t" * 16):
+            with obs.span("outer") as sp:
+                assert sp.trace_id == "t" * 16
+                assert sp.parent_id is None
+                with obs.span("inner") as child:
+                    assert child.trace_id == "t" * 16
+                    assert child.parent_id == sp.span_id
+        outer = [r for r in obs.REGISTRY.spans if r["name"] == "outer"]
+        inner = [r for r in obs.REGISTRY.spans if r["name"] == "inner"]
+        assert outer[0]["trace_id"] == "t" * 16
+        assert inner[0]["parent_id"] == outer[0]["span_id"]
+        # outside any context, spans carry no trace fields at all
+        with obs.span("plain"):
+            pass
+        plain = [r for r in obs.REGISTRY.spans if r["name"] == "plain"]
+        assert "trace_id" not in plain[0]
+    finally:
+        obs.disable()
+
+
+def test_traced_span_gates_on_ambient_trace():
+    obs.enable()
+    try:
+        assert obs.traced_span("x") is obs.NULL_SPAN  # no ambient trace
+        with obs.trace_context("a" * 16):
+            with obs.traced_span("x"):
+                pass
+        assert [r["name"] for r in obs.REGISTRY.spans] == ["x"]
+    finally:
+        obs.disable()
+    assert obs.traced_span("x") is obs.NULL_SPAN      # disabled
+
+
+def test_emit_span_retroactive_record():
+    obs.enable()
+    try:
+        obs.emit_span("later", 10.0, 12.5, trace_id="b" * 16, k=1)
+        rec = obs.REGISTRY.spans[-1]
+        assert rec["t0"] == 10.0 and rec["dur_s"] == 2.5
+        assert rec["trace_id"] == "b" * 16 and rec["span_id"]
+        assert rec["attrs"] == {"k": 1}
+    finally:
+        obs.disable()
+    obs.emit_span("noop", 0.0, 1.0)            # disabled: records nothing
+    assert obs.REGISTRY.spans[-1]["name"] == "later"
+
+
+def test_mint_trace_id_sampling_and_disable():
+    assert obs.mint_trace_id() is None         # disabled -> no tracing
+    obs.enable()
+    try:
+        tid = obs.mint_trace_id()
+        assert isinstance(tid, str) and len(tid) == 16
+        obs.set_trace_sample(0.0)
+        assert obs.mint_trace_id() is None     # sampled out entirely
+        obs.set_trace_sample(1.0)
+        assert obs.mint_trace_id() is not None
+        with pytest.raises(ValueError):
+            obs.set_trace_sample(1.5)
+    finally:
+        obs.set_trace_sample(1.0)
+        obs.disable()
+
+
+def test_stats_snapshot_matches_pinned_schema():
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "tools"))
+    from check_telemetry_schema import check_stats_payload
+    obs.enable()
+    try:
+        obs.counter("train.steps").inc(3)
+        obs.gauge("g").set(2.0)
+        obs.histogram("h").observe(1.0)
+        payload = obs.stats_snapshot()
+    finally:
+        obs.disable()
+    assert check_stats_payload(payload) == []
+    assert payload["kind"] == "replica" and payload["enabled"] is True
+    assert payload["counters"]["train.steps"] == 3
+    assert payload["histograms"]["h"]["count"] == 1
+    # disabled snapshots still validate (enabled: false, curl-able)
+    assert check_stats_payload(obs.stats_snapshot()) == []
+    # malformed payloads are named, not waved through
+    assert check_stats_payload({"kind": "replica"}) != []
+    assert check_stats_payload({"kind": "fleet", "ts": 1.0}) != []
 
 
 # ------------------------------------------------------- run-scoped sink
@@ -246,3 +373,20 @@ def test_record_collective_bandwidth(tmp_path):
     assert row["calls"] == 1 and row["payload_bytes"] == 1 << 20
     assert row["bus_gbps"]["count"] == 1
     assert row["bus_gbps"]["p50"] == pytest.approx((1 << 20) / 0.01 / 1e9)
+
+
+def test_adopt_trace_header_rule():
+    """THE shared header-adoption rule (obs.adopt_trace_header — one
+    definition, used by all three HTTP front ends): the header fills an
+    absent trace_id, never overrides a non-empty payload field, and
+    leaves non-dict payloads for the caller's validation."""
+    p = {"prompt_tokens": [1]}
+    obs.adopt_trace_header({obs.TRACE_HEADER: "abc"}, p)
+    assert p["trace_id"] == "abc"
+    p = {"trace_id": "keep"}
+    obs.adopt_trace_header({obs.TRACE_HEADER: "abc"}, p)
+    assert p["trace_id"] == "keep"
+    p = {}
+    obs.adopt_trace_header({}, p)
+    assert "trace_id" not in p
+    obs.adopt_trace_header({obs.TRACE_HEADER: "abc"}, [1, 2])  # no-op

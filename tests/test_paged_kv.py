@@ -719,6 +719,13 @@ def test_nezha_bench_gates_against_committed_baseline(tmp_path):
     assert nb.main(args + ["--update"]) == 0
     base = json.load(open(sb))
     assert "cpu" in base["by_platform"]
+    # The committed sweep's tokens/sec comes from the capture-free
+    # pass, with the stitched trace block grafted in from the separate
+    # captured pass (ISSUE 12): every horizon slot carries one.
+    sweep = base["by_platform"]["cpu"]["closed_loop_horizon_sweep"]
+    assert "capture-free" in sweep["trace_source"]
+    for h_rec in sweep["by_horizon"].values():
+        assert h_rec["trace"] and h_rec["trace"]["count"] > 0
     # A foreign platform slot must survive updates untouched.
     base["by_platform"]["tpu"] = {"closed_loop_horizon_sweep": {
         "by_horizon": {"1": {"tokens_per_sec": 123456.0}}}}
