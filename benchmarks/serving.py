@@ -117,6 +117,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "blocks); non-shared requests are padded to "
                         "the same total length so hit/miss TTFT "
                         "compares like for like")
+    p.add_argument("--speculative", action="store_true",
+                   help="speculative decoding: a draft model proposes "
+                        "--draft-k tokens per window, one target "
+                        "forward verifies them — the record gains "
+                        "spec{draft_k, accept_rate, tokens_per_verify} "
+                        "and the headline tokens/sec reflects >1 token "
+                        "emitted per verify dispatch")
+    p.add_argument("--draft-k", type=int, default=4,
+                   help="speculative: draft tokens per verify window")
+    p.add_argument("--draft-layers", type=int, default=None,
+                   help="speculative: early-exit self-draft depth "
+                        "(default: full depth — identity draft, accept "
+                        "rate ~1, the machinery-overhead measurement)")
     p.add_argument("--fault-rate", type=float, default=0.0,
                    help="probability per prefill / per decode step of an "
                         "injected fault (prefill errors + NaN logit "
@@ -264,6 +277,10 @@ def run(args) -> dict:
             gap = rec.get("host_gap_s") or {}
             gap_s = (f", host gap p50 {gap['p50'] * 1e3:.2f} ms"
                      if gap else "")
+            sp = rec.get("spec")
+            sp_s = (f", spec k={sp['draft_k']} "
+                    f"{sp['tokens_per_verify']:.2f} tok/verify "
+                    f"({sp['accept_rate']:.0%} accept)" if sp else "")
             print(f"h={rec['decode_horizon']} {rec['mode']} load: "
                   f"{rec['offered']} -> "
                   f"{rec['tokens_per_sec']:.1f} tok/s "
@@ -271,7 +288,7 @@ def run(args) -> dict:
                   f"{rec['dispatches_per_token']:.3f} disp/tok), "
                   f"ttft p50 {rec['ttft_s']['p50'] * 1e3:.1f} ms, "
                   f"tpot p50 {rec['tpot_s']['p50'] * 1e3:.1f} ms, "
-                  f"{rec['dropped_queue_full']} dropped{gap_s}")
+                  f"{rec['dropped_queue_full']} dropped{gap_s}{sp_s}")
     return record
 
 
@@ -285,6 +302,11 @@ def _run_one(args, model, variables, decode_horizon: int,
 
     buckets = tuple(int(b) for b in args.prefill_buckets.split(",")) \
         if args.prefill_buckets else ()
+    spec = None
+    if getattr(args, "speculative", False):
+        from nezha_tpu.serve.engine import SpeculativeConfig
+        spec = SpeculativeConfig(draft_k=args.draft_k,
+                                 draft_layers=args.draft_layers)
     cfg = ServeConfig(
         max_batch_size=args.max_batch_size, max_len=args.max_len,
         max_prefill_len=args.max_prefill_len, prefill_buckets=buckets,
@@ -293,7 +315,7 @@ def _run_one(args, model, variables, decode_horizon: int,
         kv_layout=args.kv_layout, kv_block_size=args.kv_block_size,
         kv_num_blocks=args.kv_num_blocks,
         prefix_cache=args.prefix_cache == "on",
-        kv_dtype=args.kv_dtype)
+        kv_dtype=args.kv_dtype, speculative=spec)
     engine = Engine(model, variables, cfg)
     sched = Scheduler(engine)
     rng = random.Random(args.seed)
@@ -410,6 +432,8 @@ def _run_one(args, model, variables, decode_horizon: int,
                         else args.rate)})
         register_serve_instruments()
     steps_before = engine.step_calls      # exclude warmup dispatches
+    spec_before = ((engine.spec_verifies, engine.spec_draft_tokens,
+                    engine.spec_accepted) if spec else (0, 0, 0))
 
     # (Occupancy percentiles come from the scheduler itself — it folds
     # per-decode occupancy into the metric.batch_occupancy histogram.)
@@ -564,6 +588,24 @@ def _run_one(args, model, variables, decode_horizon: int,
             "errored": len(errored),
         },
     }
+    if spec:
+        # The speculative headline (ISSUE 13 acceptance): tokens
+        # EMITTED per verify dispatch (> 1 means the draft is paying
+        # for itself) and the realized draft accept rate, measured
+        # over the post-warmup load only.
+        verifies = engine.spec_verifies - spec_before[0]
+        drafted = engine.spec_draft_tokens - spec_before[1]
+        accepted = engine.spec_accepted - spec_before[2]
+        record["spec"] = {
+            "draft_k": spec.draft_k,
+            "draft_layers": spec.draft_layers,
+            "verifies": verifies,
+            "draft_tokens": drafted,
+            "accepted_tokens": accepted,
+            "accept_rate": accepted / drafted if drafted else 0.0,
+            "tokens_per_verify": ((accepted + verifies) / verifies
+                                  if verifies else 0.0),
+        }
     if shared_prefix:
         # TTFT by hit/miss over clean finishes: the prefix-reuse win is
         # the GAP between these two (a hit skips the shared span's
@@ -637,6 +679,12 @@ def _run_replicas(args, decode_horizon: int) -> dict:
         wargv += ["--decode-impl", args.decode_impl]
     if args.platform:
         wargv += ["--platform", args.platform]
+    if getattr(args, "speculative", False):
+        # Speculation rides into every replica worker, exactly as the
+        # nezha-serve front end forwards it (the router is draft-blind).
+        wargv += ["--speculative", "--draft-k", str(args.draft_k)]
+        if args.draft_layers is not None:
+            wargv += ["--draft-layers", str(args.draft_layers)]
     wargs = serve_parser().parse_args(wargv)
     roles: tuple = ()
     total = args.replicas

@@ -44,6 +44,12 @@ EXPECTED_POINTS = frozenset({
     # (injected_fault / migration_failed) and is retried, fallen back,
     # or restarted by the router, never silently dropped.
     "router.migrate", "replica.kv_export", "replica.kv_install",
+    # Speculative decoding: armed on the carried logits after every
+    # speculative step dispatch — a nan/inf rule poisons one victim
+    # row (the in-program tripwire retires ONLY that request, zero
+    # slot/block leaks in either pool), an error rule raises typed
+    # InjectedFault into the scheduler's bounded-retry envelope.
+    "serve.spec.verify",
 })
 SOURCE_PREFIX = "nezha_tpu/"
 EXCLUDE_PREFIX = "nezha_tpu/faults/"

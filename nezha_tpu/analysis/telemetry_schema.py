@@ -65,7 +65,13 @@ _SERVE_COUNTERS = {"serve.admitted_total", "serve.rejected_total",
                    # their int8-wire bytes. Topology-invariant: a
                    # homogeneous run reports 0s, never omits them.
                    "serve.kv.migrations_total",
-                   "serve.kv.migration_bytes"}
+                   "serve.kv.migration_bytes",
+                   # Speculative decoding (PR 13): draft tokens
+                   # proposed and accepted across all verify windows.
+                   # Knob-invariant: a non-speculative run reports 0s,
+                   # never omits them.
+                   "serve.spec.draft_tokens_total",
+                   "serve.spec.accepted_total"}
 _SERVE_GAUGES = {"serve.queue_depth", "serve.batch_occupancy",
                  "serve.kv.blocks_used",
                  # KV quantization (PR 9): device bytes the resident KV
@@ -82,7 +88,12 @@ _SERVE_HISTOGRAMS = {"serve.ttft_s", "serve.tpot_s",
                      "serve.host_gap_s", "serve.decode.horizon",
                      # Per-block max-abs dequant error sampled at each
                      # prefill-chunk write (count 0 on bf16 runs).
-                     "serve.kv.quant_error"}
+                     "serve.kv.quant_error",
+                     # Speculative decoding (PR 13): accepted-prefix
+                     # length per verify window, in DRAFT tokens
+                     # (tokens-per-verify = value + 1; count 0 on
+                     # non-speculative runs).
+                     "serve.spec.accepted_len"}
 
 # Router-run schema (nezha-serve --replicas N / benchmarks/serving.py
 # --replicas): the supervisor/router pair pre-registers this full set,

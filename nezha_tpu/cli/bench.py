@@ -20,7 +20,9 @@ entry point
 4. exits nonzero when a gated metric regressed past ``--threshold``.
 
 Gated metrics: serving ``tokens_per_sec`` per decode horizon (higher is
-better) and the decode-attention kernel's median ``kernel_ms`` across
+better), the speculative-decode suite's ``tokens_per_verify`` and
+spec-vs-classic throughput ratio (higher is better), and the
+decode-attention kernel's median ``kernel_ms`` across
 configs (lower is better). Latency-shaped CPU numbers are noisy, so the
 default threshold is deliberately loose (30%) — the gate catches
 step-function regressions (a lost kernel, a recompile-per-token bug),
@@ -244,7 +246,54 @@ def _run_serving(args, platform: str) -> dict:
         ["--disaggregate"] + tiers + dis_load))
     coloc = serving_bench.run(serving_bench.build_parser().parse_args(
         ["--replicas", "2" if args.quick else "3"] + dis_load))
+    # Speculative decode vs classic at EQUAL HARDWARE (ISSUE 13
+    # acceptance): same model, same batch, same closed-loop load, both
+    # runs in this one process so the ratio sees the same machine
+    # state. The load is GREEDY (the bit-identical-parity mode) with
+    # decodes long enough to amortize the draft's prefill tax — the
+    # regime speculation targets (decode-dominated small-batch
+    # traffic); h=1 so every accepted draft token is a dispatch the
+    # classic engine would have paid for. The draft is a 1-layer
+    # early-exit self-draft (no second checkpoint). A draft_k sweep
+    # rides along so the accept-rate-vs-window-size tradeoff is in the
+    # committed record.
+    if args.quick:
+        spec_load = ["--requests", str(requests), "--concurrency", "2",
+                     "--max-batch-size", "2", "--max-len", "48",
+                     "--max-prefill-len", "8", "--prompt-len", "4",
+                     "--max-new-tokens", "8", "--sample-fraction", "0",
+                     "--decode-horizon", "1", "--platform", platform]
+        spec_ks = [3]
+    else:
+        spec_load = ["--requests", str(requests), "--concurrency", "4",
+                     "--max-batch-size", "4", "--max-len", "88",
+                     "--max-prefill-len", "16", "--prompt-len", "8",
+                     "--max-new-tokens", "72", "--sample-fraction", "0",
+                     "--decode-horizon", "1", "--platform", platform]
+        spec_ks = [2, 4, 7]
+    spec_classic = serving_bench.run(
+        serving_bench.build_parser().parse_args(spec_load))
+    spec_sweep = {}
+    for kk in spec_ks:
+        spec_sweep[str(kk)] = serving_bench.run(
+            serving_bench.build_parser().parse_args(
+                spec_load + ["--speculative", "--draft-k", str(kk),
+                             "--draft-layers", "1"]))
+    spec_best = spec_sweep[str(spec_ks[-1])]
     return {"closed_loop_horizon_sweep": sweep,
+            "speculative_decode": {
+                "load": "greedy closed loop, long decode, h=1, "
+                        "1-layer self-draft",
+                "classic": spec_classic,
+                "draft_k_sweep": spec_sweep,
+                "headline_draft_k": spec_ks[-1],
+                "tokens_per_verify":
+                    spec_best["spec"]["tokens_per_verify"],
+                "accept_rate": spec_best["spec"]["accept_rate"],
+                "tokens_per_sec_ratio_spec_vs_classic": (
+                    spec_best["tokens_per_sec"]
+                    / max(spec_classic["tokens_per_sec"], 1e-9)),
+            },
             "disaggregated_prefill_decode": {
                 "load": "long-prompt mix "
                         + dis_load[dis_load.index("--prompt-len-mix") + 1],
@@ -389,6 +438,26 @@ def _gate(results: dict, baselines: dict, platform: str,
             rows[metric] = {
                 "current": cur, "baseline": base, "ratio": ratio,
                 "ok": ratio <= 1.0 + threshold}
+        # Speculative-decode gates (ISSUE 13): tokens emitted per
+        # verify dispatch and the spec-vs-classic throughput ratio,
+        # both higher-is-better against the committed record (absent
+        # for pre-speculation baselines — those gate nothing). A
+        # machinery regression (accept mask broken, draft cache
+        # desyncs -> rejects everything) shows up as tokens_per_verify
+        # collapsing toward 1; a perf regression in the fused program
+        # shows up in the ratio.
+        base_spec = srv_base.get("speculative_decode") or {}
+        cur_spec = (results["serving"].get("speculative_decode")
+                    or {})
+        for metric in ("tokens_per_verify",
+                       "tokens_per_sec_ratio_spec_vs_classic"):
+            base = base_spec.get(metric)
+            cur = cur_spec.get(metric)
+            if base and cur is not None:
+                ratio = cur / base
+                rows[f"spec.{metric}"] = {
+                    "current": cur, "baseline": base, "ratio": ratio,
+                    "ok": ratio >= 1.0 - threshold}
         vs["serving"] = rows
     da_base = _platform_slot(baselines.get("decode_attention") or {},
                              platform)

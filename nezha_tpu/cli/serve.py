@@ -143,6 +143,33 @@ def build_parser() -> argparse.ArgumentParser:
                    help="paged layout: when the free list runs dry, "
                         "evict LRU prefix-cache blocks (lru) or go "
                         "straight to typed backpressure (none)")
+    p.add_argument("--speculative", action="store_true",
+                   help="speculative decoding: a cheap DRAFT model "
+                        "proposes --draft-k tokens per window, one "
+                        "batched target forward verifies them all, and "
+                        "the longest agreeing prefix is emitted — "
+                        ">1 token per verify dispatch at unchanged "
+                        "outputs (greedy bit-identical; sampled via "
+                        "lossless rejection sampling). Draft KV lives "
+                        "in a mirrored paged pool (int8 included); see "
+                        "docs/RUNBOOK.md §8 for when a draft pays off")
+    p.add_argument("--draft-k", type=int, default=4,
+                   help="speculative: draft tokens proposed per verify "
+                        "window (a window emits 1..draft_k+1 tokens)")
+    p.add_argument("--draft-layers", type=int, default=None,
+                   help="speculative: SELF-DRAFT depth — the draft is "
+                        "the target's first N layers sharing its "
+                        "weights (early-exit drafting, no second "
+                        "checkpoint); default: full depth (identity "
+                        "draft, accept-rate ~1). Ignored with "
+                        "--draft-ckpt-dir/--draft-hf-dir")
+    p.add_argument("--draft-ckpt-dir", default=None,
+                   help="speculative: load a SEPARATE draft model from "
+                        "this nezha-train checkpoint dir (same "
+                        "tokenizer/vocab as the target)")
+    p.add_argument("--draft-hf-dir", default=None,
+                   help="speculative: load the draft model from a "
+                        "Hugging Face GPT2LMHeadModel directory")
     p.add_argument("--k-max", type=int, default=64,
                    help="static top-k cap; per-request top_k is clamped "
                         "to it")
@@ -254,6 +281,30 @@ def _build_stack(args):
             raise SystemExit(
                 f"--prefill-buckets must be comma-separated ints, got "
                 f"{args.prefill_buckets!r}")
+    spec = None
+    draft_model = draft_variables = None
+    if not getattr(args, "speculative", False) and (
+            getattr(args, "draft_ckpt_dir", None)
+            or getattr(args, "draft_hf_dir", None)):
+        # A draft checkpoint without the knob would silently serve
+        # classic — the operator believes their draft is in play.
+        raise SystemExit(
+            "--draft-ckpt-dir/--draft-hf-dir require --speculative")
+    if getattr(args, "speculative", False):
+        from nezha_tpu.serve.engine import SpeculativeConfig
+        spec = SpeculativeConfig(draft_k=args.draft_k,
+                                 draft_layers=args.draft_layers)
+        if getattr(args, "draft_ckpt_dir", None) \
+                or getattr(args, "draft_hf_dir", None):
+            # An explicit draft checkpoint rides the SAME cli/common
+            # loader as the target (either nezha-train format or an HF
+            # dir); without one the engine builds an early-exit
+            # self-draft from the target's own weights.
+            dargs = argparse.Namespace(**vars(args))
+            dargs.ckpt_dir = args.draft_ckpt_dir
+            dargs.hf_dir = args.draft_hf_dir
+            dargs.random_init = False
+            draft_model, draft_variables = load_gpt2_for_inference(dargs)
     cfg = ServeConfig(
         max_batch_size=args.max_batch_size, max_len=max_len,
         max_prefill_len=args.max_prefill_len,
@@ -268,8 +319,10 @@ def _build_stack(args):
         kv_num_blocks=args.kv_num_blocks,
         prefix_cache=args.prefix_cache == "on",
         kv_eviction=args.kv_eviction,
-        kv_dtype=args.kv_dtype)
-    engine = Engine(model, variables, cfg)
+        kv_dtype=args.kv_dtype,
+        speculative=spec)
+    engine = Engine(model, variables, cfg, draft_model=draft_model,
+                    draft_variables=draft_variables)
     return Scheduler(engine), tokenizer, eos_id
 
 
@@ -887,6 +940,16 @@ def _worker_argv(args, rid: int, port: int, role: Optional[str] = None
              "--http", str(port)]
     if args.kv_num_blocks is not None:
         argv += ["--kv-num-blocks", str(args.kv_num_blocks)]
+    if getattr(args, "speculative", False):
+        # Speculation rides into every worker: the router is
+        # draft-blind (accept/verify is engine-internal).
+        argv += ["--speculative", "--draft-k", str(args.draft_k)]
+        if args.draft_layers is not None:
+            argv += ["--draft-layers", str(args.draft_layers)]
+        if getattr(args, "draft_ckpt_dir", None):
+            argv += ["--draft-ckpt-dir", args.draft_ckpt_dir]
+        if getattr(args, "draft_hf_dir", None):
+            argv += ["--draft-hf-dir", args.draft_hf_dir]
     if args.tokenizer:
         argv += ["--tokenizer", args.tokenizer]
     if args.prefill_buckets:

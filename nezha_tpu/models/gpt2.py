@@ -308,7 +308,7 @@ class Attention(Module):
             import jax.lax as lax
             per_row = getattr(pos, "ndim", 0) == 1
             zero = jnp.zeros((), jnp.int32)
-            if per_row:
+            if per_row and s == 1:
                 def _row_update(c, new, p):
                     return lax.dynamic_update_slice(c, new, (zero, p, zero))
 
@@ -316,6 +316,28 @@ class Attention(Module):
                     cache["k"], k.astype(cache["k"].dtype), pos)
                 v_all = jax.vmap(_row_update)(
                     cache["v"], v.astype(cache["v"].dtype), pos)
+            elif per_row:
+                # Speculative verify window: s tokens per row at
+                # PER-ROW offsets. A dynamic_update_slice would CLAMP a
+                # near-capacity row's window start backwards and
+                # overwrite valid prefix K/V, so the write is a
+                # per-position scatter with out-of-range (and
+                # non-emitting-row) positions routed to the DROP index
+                # — rejected draft positions within range just hold
+                # garbage until the next window overwrites them (never
+                # attended: each row's mask stops at its own depth).
+                L_d = cache["k"].shape[2]
+                ppos = pos[:, None] + jnp.arange(s)[None, :]   # [B, s]
+                if active is not None:
+                    ppos = jnp.where(active[:, None], ppos, L_d)
+                ppos = jnp.where(ppos < L_d, ppos, L_d)        # OOB: drop
+                bidx = jnp.arange(b)[:, None]
+                k_all = cache["k"].at[bidx, :, ppos, :].set(
+                    k.transpose(0, 2, 1, 3).astype(cache["k"].dtype),
+                    mode="drop")
+                v_all = cache["v"].at[bidx, :, ppos, :].set(
+                    v.transpose(0, 2, 1, 3).astype(cache["v"].dtype),
+                    mode="drop")
             else:
                 k_all = lax.dynamic_update_slice(
                     cache["k"], k.astype(cache["k"].dtype),
@@ -470,7 +492,44 @@ class Attention(Module):
         L = m * bs_kv
         per_row = getattr(pos, "ndim", 0) == 1
         qerr = None
-        if per_row:
+        if per_row and s > 1:
+            # Speculative verify window: s tokens per row at PER-ROW
+            # offsets, scattered through the block table. Positions
+            # past the row's bound frontier gather a scratch (0) table
+            # entry by construction, and positions past capacity — or
+            # any position of a non-emitting row — are routed to
+            # scratch explicitly: the PR 7 pad idiom, so a rejected
+            # draft position can never scribble on a rebound block.
+            ppos = pos[:, None] + jnp.arange(s)[None, :]       # [B, s]
+            route = ppos >= L
+            if active is not None:
+                route = route | ~active[:, None]
+            ppos_c = jnp.minimum(ppos, L - 1)
+            bi = jnp.clip(ppos_c // bs_kv, 0, m - 1)
+            blk = jnp.take_along_axis(tab, bi, axis=1)         # [B, s]
+            blk = jnp.where(route, 0, blk)
+            off = jnp.where(route, 0, ppos_c % bs_kv)
+            if quant:
+                # Sequential per-position block requants (the
+                # _quant_decode_write move, once per window position):
+                # position j+1's gather sees position j's write, so the
+                # window lands exactly as k+1 single-token decodes
+                # would — the bounded requant error is the same one
+                # serve.kv.quant_error samples at prefill.
+                k_pool, v_pool = kp, vp
+                for j in range(s):
+                    k_pool, ks_pool = _quant_decode_write(
+                        k_pool, ks_pool, blk[:, j], off[:, j],
+                        k[:, :, j, :])
+                    v_pool, vs_pool = _quant_decode_write(
+                        v_pool, vs_pool, blk[:, j], off[:, j],
+                        v[:, :, j, :])
+            else:
+                k_pool = kp.at[blk, :, off, :].set(
+                    k.transpose(0, 2, 1, 3).astype(kp.dtype))
+                v_pool = vp.at[blk, :, off, :].set(
+                    v.transpose(0, 2, 1, 3).astype(vp.dtype))
+        elif per_row:
             # Decode: one token per row at its own depth. Clamp matches
             # the dense layout's update-slice clamp (a capacity-filled
             # row is done — its pad write may land on its own last

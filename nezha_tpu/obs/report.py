@@ -161,6 +161,21 @@ def render_serving_section(summary: Optional[dict]) -> List[str]:
         if qe and qe.get("count"):
             parts.append(f"  quant err p99 {qe['p99']:.2e}")
         lines.append("".join(parts))
+    al = hists.get("serve.spec.accepted_len")
+    if al and al.get("count"):
+        # Speculative decoding (absent when the knob is off — the
+        # histogram only fills on speculative runs): accepted-prefix
+        # length percentiles per verify window, the realized accept
+        # rate (accepted / proposed draft tokens), and the headline
+        # tokens-per-verify (accepted-len p50 + 1 for the t0 column).
+        drafted = counters.get("serve.spec.draft_tokens_total", 0)
+        accepted = counters.get("serve.spec.accepted_total", 0)
+        rate = accepted / drafted if drafted else 0.0
+        lines.append(
+            f"  speculation: accept-rate p50 {al['p50']:.0f}"
+            f"/{drafted / al['count']:.0f} drafts  "
+            f"({rate:.0%} of {drafted:.0f} proposed)  "
+            f"tokens/verify {al['mean'] + 1:.2f}")
     ph = hists.get("serve.prefill.bucket_len")
     if ph and ph.get("count"):
         # Bucket occupancy: how wide the static prefill programs

@@ -39,7 +39,15 @@ serve stack replaces the batch lifecycle with a slot lifecycle:
   instead of masking them, and the emit mask zeroes finished rows'
   lengths. All programs route through the runtime ``CompileCache``, so
   the frozen-program steady state is provable from the
-  ``compile_cache.*`` obs counters.
+  ``compile_cache.*`` obs counters. ``ServeConfig.speculative`` grows
+  the step into a fused draft→verify→accept loop: a cheap draft model
+  (an early-exit slice of the target, or a separate checkpoint)
+  proposes ``draft_k`` tokens per window, ONE batched target forward
+  scores them all, and the longest agreeing prefix is emitted — up to
+  ``decode_horizon * (draft_k + 1)`` tokens per dispatch at unchanged
+  outputs (greedy bit-identical; sampled via lossless rejection
+  sampling), the draft's KV mirroring the target pool's slot
+  lifecycle.
 - ``scheduler``: bounded FIFO admission with backpressure, per-request
   deadlines, and the iteration loop (admit -> decode one block for all
   active rows -> retire on EOS / max-new-tokens / deadline, freeing
@@ -74,7 +82,8 @@ into the same run-dir telemetry artifacts training writes
 (``--replicas/--kill-rate`` chaos-loads the router).
 """
 
-from nezha_tpu.serve.engine import Engine, ServeConfig
+from nezha_tpu.serve.engine import (Engine, ServeConfig,
+                                    SpeculativeConfig, self_draft)
 from nezha_tpu.serve.migrate import MigrationError
 from nezha_tpu.serve.router import Router, register_router_instruments
 from nezha_tpu.serve.sampling import sample_tokens
@@ -95,7 +104,8 @@ from nezha_tpu.serve.supervisor import (
 )
 
 __all__ = [
-    "Engine", "ServeConfig", "SlotPool", "PagedSlotPool", "PrefixTrie",
+    "Engine", "ServeConfig", "SpeculativeConfig", "self_draft",
+    "SlotPool", "PagedSlotPool", "PrefixTrie",
     "KVBlocksExhausted", "sample_tokens",
     "Scheduler", "Request", "RequestResult", "QueueFull", "FinishReason",
     "Router", "RouterConfig", "Supervisor", "ProcessBackend",

@@ -93,7 +93,10 @@ from jax import lax
 from nezha_tpu import faults, obs
 from nezha_tpu.models.generate import _caches_from_states
 from nezha_tpu.runtime.executor import Executor
-from nezha_tpu.serve.sampling import finite_rows, split_and_sample
+from nezha_tpu.serve.sampling import (accept_mask, categorical_rows,
+                                      filter_logits, filtered_probs,
+                                      finite_rows, residual_logits,
+                                      sample_tokens, split_and_sample)
 from nezha_tpu.serve.slots import (KVBlocksExhausted, PagedSlotPool,
                                    SlotPool, read_slot, write_slot)
 
@@ -110,6 +113,29 @@ def default_prefill_buckets(max_prefill_len: int) -> Tuple[int, ...]:
         b *= 2
     buckets.append(max_prefill_len)
     return tuple(buckets)
+
+
+@dataclasses.dataclass(frozen=True)
+class SpeculativeConfig:
+    """Speculative-decoding knobs (``ServeConfig.speculative``).
+
+    ``draft_k`` is the number of draft tokens proposed per verify
+    window: one verify forward scores all ``draft_k + 1`` positions, so
+    a window emits between 1 (every proposal rejected) and
+    ``draft_k + 1`` tokens per verify while staying exactly the target
+    model's output (greedy: bit-identical; sampled: the lossless
+    rejection-sampling law). ``draft_layers`` selects SELF-DRAFTING:
+    the draft model is the target's first N layers sharing the
+    target's own weights (early-exit drafting — no second checkpoint),
+    with ``None`` meaning full depth, an identity draft whose accept
+    rate is ~1 (the machinery-overhead measurement point, and the
+    bench's guaranteed->1-token-per-verify configuration). Both are
+    ignored for the draft's ARCHITECTURE when an explicit
+    ``draft_model`` is handed to :class:`Engine` (``draft_k`` still
+    applies)."""
+
+    draft_k: int = 4
+    draft_layers: Optional[int] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -180,6 +206,18 @@ class ServeConfig:
     # identically on the gathered XLA fallback), so int8 blocks never
     # round-trip through a dense bf16 cache.
     kv_dtype: str = "bf16"
+    # Speculative decoding (None = off, bit-identical to the classic
+    # horizon engine): a cheap DRAFT model proposes draft_k tokens per
+    # window, one batched target forward verifies all draft_k + 1
+    # positions, and an in-program accept mask emits the longest
+    # agreeing prefix — so one step dispatch can emit up to
+    # decode_horizon * (draft_k + 1) tokens while every emitted token
+    # remains exactly the target model's (greedy bit-identical;
+    # sampled via standard rejection sampling with a carried residual
+    # distribution). The draft's KV lives in a mirrored pool of the
+    # same paged machinery (int8 welcome); accepted tokens flow into
+    # the existing block-consumption path as ordinary emits.
+    speculative: Optional[SpeculativeConfig] = None
 
     def __post_init__(self):
         if self.max_batch_size < 1:
@@ -210,6 +248,20 @@ class ServeConfig:
         if self.decode_horizon < 1:
             raise ValueError(
                 f"decode_horizon must be >= 1, got {self.decode_horizon}")
+        if self.speculative is not None:
+            spec = self.speculative
+            if isinstance(spec, dict):
+                # Convenience for argv/JSON config paths.
+                spec = SpeculativeConfig(**spec)
+                object.__setattr__(self, "speculative", spec)
+            if spec.draft_k < 1:
+                raise ValueError(
+                    f"speculative.draft_k must be >= 1, got "
+                    f"{spec.draft_k}")
+            if spec.draft_layers is not None and spec.draft_layers < 1:
+                raise ValueError(
+                    f"speculative.draft_layers must be >= 1 or None, "
+                    f"got {spec.draft_layers}")
         if not 1 <= self.max_prefill_len <= self.max_len:
             raise ValueError(
                 f"need 1 <= max_prefill_len <= max_len, got "
@@ -238,6 +290,40 @@ class ServeConfig:
         object.__setattr__(self, "prefill_buckets", buckets)
 
 
+def self_draft(model, variables, num_layers: Optional[int] = None):
+    """Build an early-exit SELF-DRAFT from the target: the same
+    architecture truncated to its first ``num_layers`` transformer
+    blocks (None = full depth), SHARING the target's embedding / trunk
+    / final-norm weights — the no-second-checkpoint draft source
+    ROADMAP item 3 names. -> ``(draft_model, draft_variables)``; the
+    variables dict references the target's own leaves (no copy).
+    Draft quality only moves the ACCEPT RATE — every emitted token is
+    verified against the target, so a bad draft costs speed, never
+    correctness."""
+    cfg = model.cfg
+    layers = cfg.num_layers if num_layers is None else int(num_layers)
+    if not 1 <= layers <= cfg.num_layers:
+        raise ValueError(
+            f"draft_layers must be in [1, {cfg.num_layers}], got "
+            f"{layers}")
+    draft = type(model)(dataclasses.replace(cfg, num_layers=layers),
+                        policy=model.policy)
+    params = variables["params"]
+    if cfg.scan_layers:
+        dparams = {k: v for k, v in params.items() if k != "h_scan"}
+        dparams["h_scan"] = jax.tree_util.tree_map(
+            lambda p: p[:layers], params["h_scan"])
+    else:
+        dparams = {}
+        for key, val in params.items():
+            if key.startswith("h") and key[1:].isdigit():
+                if int(key[1:]) < layers:
+                    dparams[key] = val
+            else:
+                dparams[key] = val
+    return draft, {"params": dparams, "state": variables.get("state", {})}
+
+
 class Engine:
     """Device-side serving state + the frozen program set.
 
@@ -257,7 +343,8 @@ class Engine:
     amortization this engine exists to improve.
     """
 
-    def __init__(self, model, variables, cfg: ServeConfig = ServeConfig()):
+    def __init__(self, model, variables, cfg: ServeConfig = ServeConfig(),
+                 draft_model=None, draft_variables=None):
         if cfg.max_len > model.cfg.max_positions:
             raise ValueError(
                 f"max_len {cfg.max_len} exceeds the model's max_positions "
@@ -338,9 +425,79 @@ class Engine:
                                                paged=self.paged,
                                                quantized=self.kv_quant)
                              for w in cfg.prefill_buckets}
-        self._step_fn = _build_step(self.model, self.k_max, cfg.pad_id,
-                                    cfg.decode_horizon,
-                                    paged=self.paged)
+        # Speculative decoding: a DRAFT engine rides along — its own
+        # model (explicit, or an early-exit self-draft sharing the
+        # target's weights), its own KV pool MIRRORING the target
+        # pool's slot lifecycle (same paged machinery, int8 included),
+        # its own executor for the bucket prefill programs. The draft's
+        # decode never dispatches separately: it lives inside the ONE
+        # fused draft→verify→accept step program, so the frozen
+        # program-count contract is counted per engine — target:
+        # 1 step + len(prefill_buckets); draft: len(prefill_buckets).
+        self.spec = cfg.speculative
+        self.draft_model = None
+        self.draft_variables = None
+        self.draft_pool = None
+        self.draft_executor = None
+        if self.spec is not None:
+            if draft_model is not None:
+                dm, dv = draft_model, draft_variables
+                if dv is None:
+                    raise ValueError(
+                        "draft_model requires draft_variables")
+                if (cfg.decode_impl is not None
+                        and cfg.decode_impl != dm.cfg.decode_impl):
+                    dm = type(dm)(
+                        dataclasses.replace(dm.cfg,
+                                            decode_impl=cfg.decode_impl),
+                        policy=dm.policy)
+            else:
+                dm, dv = self_draft(self.model, self.variables,
+                                    self.spec.draft_layers)
+            if dm.cfg.vocab_size != self.model.cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {dm.cfg.vocab_size} != target vocab "
+                    f"{self.model.cfg.vocab_size} — the accept test "
+                    f"compares distributions over one vocabulary")
+            if cfg.max_len > dm.cfg.max_positions:
+                raise ValueError(
+                    f"max_len {cfg.max_len} exceeds the draft model's "
+                    f"max_positions {dm.cfg.max_positions}")
+            self.draft_model, self.draft_variables = dm, dv
+            if self.paged:
+                # Dense-equivalent block budget + no prefix cache: the
+                # draft pool is bookkeeping-cheap (draft blocks are a
+                # fraction of target bytes) and must NEVER be the
+                # backpressure source — admission budgets are sized
+                # against the target pool alone.
+                self.draft_pool = PagedSlotPool(
+                    dm, cfg.max_batch_size, cfg.max_len,
+                    cfg.cache_dtype, block_size=cfg.kv_block_size,
+                    num_blocks=None, prefix_cache=False,
+                    eviction="none", quantized=self.kv_quant)
+            else:
+                self.draft_pool = SlotPool(dm, cfg.max_batch_size,
+                                           cfg.max_len, cfg.cache_dtype)
+            self.pool.mirror = self.draft_pool
+            self.draft_executor = Executor(donate_argnums=(1,))
+            self._draft_prefill_fns = {
+                w: _build_draft_prefill(dm, w, paged=self.paged)
+                for w in cfg.prefill_buckets}
+            # Carried residual-distribution flag: True where the row's
+            # last_logits hold the rejection residual (already-filtered
+            # log-probs — sampled raw, never re-filtered).
+            self.residual = jnp.zeros((b,), bool)
+            # Host ledgers for the bench record / acceptance gates.
+            self.spec_verifies = 0
+            self.spec_draft_tokens = 0
+            self.spec_accepted = 0
+            self._step_fn = _build_spec_step(
+                self.model, dm, self.k_max, cfg.pad_id,
+                cfg.decode_horizon, self.spec.draft_k, paged=self.paged)
+        else:
+            self._step_fn = _build_step(self.model, self.k_max,
+                                        cfg.pad_id, cfg.decode_horizon,
+                                        paged=self.paged)
 
     # -------------------------------------------------------- host API
     def bucket_for(self, n: int) -> int:
@@ -512,6 +669,38 @@ class Engine:
             hist = obs.histogram("serve.kv.quant_error")
             for err in qerrs:
                 hist.observe(float(err))
+        if self.spec is not None:
+            # Draft-side prefill: the draft cache must hold the SAME
+            # prompt before the first draft chain runs. Always a cold
+            # plan from 0 — the draft pool keeps no prefix cache, and a
+            # target-side prefix hit says nothing about draft KV. An
+            # exception here (genuine or injected) unwinds through the
+            # scheduler's admission handler, which retires only this
+            # request and frees the slot — the mirror releases the
+            # draft pool's partial binds in the same free().
+            dchunks = self._plan_chunks(n, 0)
+            if self.paged:
+                self.draft_pool.prepare_write(
+                    slot, 0,
+                    max(off + width for off, _, width in dchunks))
+            for off, ln, width in dchunks:
+                padded = np.zeros((1, width), np.int32)
+                padded[0, :ln] = tokens[off:off + ln]
+                dscalars = (np.int32(ln), np.int32(slot), np.int32(off))
+                if self.paged:
+                    self.draft_pool.caches = self.draft_executor.run(
+                        self._draft_prefill_fns[width],
+                        self.draft_variables, self.draft_pool.caches,
+                        jnp.asarray(self.draft_pool.tables_host),
+                        jnp.asarray(padded), *dscalars)
+                else:
+                    self.draft_pool.caches = self.draft_executor.run(
+                        self._draft_prefill_fns[width],
+                        self.draft_variables, self.draft_pool.caches,
+                        jnp.asarray(padded), *dscalars)
+            # Fresh request: its carried logits are real target logits,
+            # not a residual distribution.
+            self.residual = self.residual.at[slot].set(False)
         if self.paged:
             # Index this prompt's full blocks for future prefix hits
             # (the trie takes its own references — the cache outlives
@@ -520,6 +709,33 @@ class Engine:
         if faults.enabled():
             self.last_logits = faults.corrupt(
                 "serve.prefill.logits", self.last_logits, rows=(slot,))
+
+    def _bind_decode_windows(self, active: np.ndarray, cap: int,
+                             pools) -> None:
+        """Lazy binding (paged layout): make every active row's write
+        window for this block — ``[pos, pos + min(cap, budget))``,
+        clamped to capacity — exclusively owned in each of ``pools``
+        BEFORE the dispatch. The bound is what the row can actually
+        EMIT: once done (or for a degenerate budget-0 row) its
+        non-emitting writes route to the scratch block, so nothing
+        past the budget needs binding — a row one token from finishing
+        must never be retired for blocks it would never write. A bind
+        that finds no block (genuine exhaustion or an injected
+        serve.kv.bind fault) surfaces as the typed KVBlocksExhausted
+        carrying the victim slot — the scheduler retires that one
+        request and redials; the batch never crashes."""
+        for slot in np.flatnonzero(np.asarray(active, bool)):
+            pos_h = int(self.host_positions[slot])
+            need = min(cap, max(int(self.host_budgets[slot]), 0))
+            if need == 0:
+                continue
+            start = min(pos_h, self.cfg.max_len - 1)
+            end = max(min(pos_h + need, self.cfg.max_len), start + 1)
+            try:
+                for pool in pools:
+                    pool.prepare_write(int(slot), start, end)
+            except faults.InjectedFault as e:
+                raise KVBlocksExhausted(str(e), slot=int(slot)) from e
 
     def step(self, active: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         """Decode one BLOCK of up to ``decode_horizon`` tokens for every
@@ -535,36 +751,11 @@ class Engine:
         pre-burst tokens are still counted in ``emitted``."""
         faults.point("serve.step")
         self.step_calls += 1
+        if self.spec is not None:
+            return self._spec_step(active)
         if self.paged:
-            # Lazy binding: make every active row's write window for
-            # this block ([pos, pos+H), clamped to capacity — done rows'
-            # frozen pad write included) exclusively owned BEFORE the
-            # dispatch. A bind that finds no block (genuine exhaustion
-            # or an injected serve.kv.bind fault) surfaces as the typed
-            # KVBlocksExhausted carrying the victim slot — the
-            # scheduler retires that one request and redials; the batch
-            # never crashes.
-            h = self.cfg.decode_horizon
-            for slot in np.flatnonzero(np.asarray(active, bool)):
-                pos_h = int(self.host_positions[slot])
-                # The row writes real K/V only while it still emits:
-                # min(horizon, remaining budget) positions. Once done
-                # (or for a degenerate budget-0 row) its non-emitting
-                # scan steps route pad writes to the scratch block, so
-                # nothing past the budget needs binding — a row one
-                # token from finishing must never be retired for
-                # blocks it would never write.
-                need = min(h, max(int(self.host_budgets[slot]), 0))
-                if need == 0:
-                    continue
-                start = min(pos_h, self.cfg.max_len - 1)
-                end = max(min(pos_h + need, self.cfg.max_len),
-                          start + 1)
-                try:
-                    self.pool.prepare_write(int(slot), start, end)
-                except faults.InjectedFault as e:
-                    raise KVBlocksExhausted(str(e), slot=int(slot)) \
-                        from e
+            self._bind_decode_windows(active, self.cfg.decode_horizon,
+                                      (self.pool,))
             out = self.executor.run(
                 self._step_fn, self.variables, self.pool.caches,
                 jnp.asarray(self.pool.tables_host),
@@ -607,12 +798,118 @@ class Engine:
             self.host_budgets -= emitted_h.astype(np.int64)
         return tok_h, emitted_h
 
+    def _spec_step(self, active: np.ndarray
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+        """The speculative decode block (``step()`` dispatches here
+        when ``cfg.speculative`` is set): one compiled program runs
+        ``decode_horizon`` draft→verify→accept windows and returns the
+        SAME ``(tokens, emitted)`` contract as the classic step — the
+        emitted tokens are compacted to a left-aligned prefix of the
+        ``[B, H*(k+1)]`` block, so the scheduler's slice-at-emitted
+        consumption path is unchanged."""
+        k = self.spec.draft_k
+        cap = self.cfg.decode_horizon * (k + 1)
+        if self.paged:
+            # Both pools bind the same window: verify/draft writes past
+            # it are garbage by construction and route to the scratch
+            # block through the unbound table tail.
+            self._bind_decode_windows(active, cap,
+                                      (self.pool, self.draft_pool))
+            out = self.executor.run(
+                self._step_fn, self.variables,
+                (self.pool.caches, self.draft_pool.caches),
+                self.draft_variables,
+                jnp.asarray(self.pool.tables_host),
+                jnp.asarray(self.draft_pool.tables_host),
+                self.last_logits, self.positions,
+                jnp.asarray(active, bool), self.keys,
+                self.temps, self.top_ks, self.top_ps,
+                self.eos_ids, self.budgets, self.residual)
+        else:
+            out = self.executor.run(
+                self._step_fn, self.variables,
+                (self.pool.caches, self.draft_pool.caches),
+                self.draft_variables,
+                self.last_logits, self.positions,
+                jnp.asarray(active, bool), self.keys,
+                self.temps, self.top_ks, self.top_ps,
+                self.eos_ids, self.budgets, self.residual)
+        (tok, emitted, ok, win_emitted, caches_all, last, pos, keys,
+         budgets, residual) = out
+        for arr in (tok, emitted, ok, win_emitted):
+            copy_async = getattr(arr, "copy_to_host_async", None)
+            if copy_async is not None:
+                copy_async()
+        self.pool.caches, self.draft_pool.caches = caches_all
+        if faults.enabled():
+            # The pinned verify-step fault point: a nan/inf rule
+            # poisons one active row's carried logits, which the next
+            # dispatch's in-program tripwire converts into a
+            # victim-only retirement (FinishReason.ERROR, zero leaks);
+            # an error rule raises typed InjectedFault into the
+            # scheduler's bounded-retry envelope.
+            last = faults.corrupt(
+                "serve.spec.verify", last,
+                rows=lambda: np.flatnonzero(active))
+        self.last_logits, self.positions, self.keys = last, pos, keys
+        self.budgets, self.residual = budgets, residual
+        self.step_ok = np.asarray(ok)
+        tok_h, emitted_h = np.asarray(tok), np.asarray(emitted)
+        win_h = np.asarray(win_emitted)
+        # Speculation ledger: every window that emitted >= 1 token ran
+        # one verify forward; its accepted-prefix length is (e_w - 1)
+        # draft tokens (the t0 column is the classic carried-logits
+        # sample, always exact). The drafted denominator charges all k
+        # proposals per verify even when EOS/budget truncation made
+        # some positions unacceptable — on short-completion loads the
+        # reported accept_rate therefore UNDERSTATES draft fidelity
+        # (tokens_per_verify, the headline, is unaffected: it counts
+        # what was actually emitted per dispatch paid).
+        ws = win_h[np.asarray(active, bool)]
+        ran = ws[ws > 0]
+        if ran.size:
+            verifies = int(ran.size)
+            accepted = int((ran - 1).sum())
+            self.spec_verifies += verifies
+            self.spec_draft_tokens += verifies * k
+            self.spec_accepted += accepted
+            obs.counter("serve.spec.draft_tokens_total").inc(
+                verifies * k)
+            obs.counter("serve.spec.accepted_total").inc(accepted)
+            hist = obs.histogram("serve.spec.accepted_len")
+            for v in (ran - 1).tolist():
+                hist.observe(v)
+        if self.paged:
+            self.host_positions += emitted_h.astype(np.int64)
+            self.host_budgets -= emitted_h.astype(np.int64)
+        return tok_h, emitted_h
+
+    @property
+    def tokens_per_dispatch(self) -> int:
+        """Ceiling on tokens one step dispatch can emit:
+        ``decode_horizon`` windows of ``1 + draft_k`` tokens each
+        (``decode_horizon`` exactly when speculative is off) — the
+        value the ``serve.decode.horizon`` histogram observes."""
+        h = self.cfg.decode_horizon
+        return h * (1 + self.spec.draft_k) if self.spec else h
+
     def compile_stats(self) -> dict:
         """Executor cache stats — steady state is ``entries ==
         1 + len(prefill_buckets)`` (step + one prefill per bucket),
         misses frozen there after every bucket has been warmed while
-        hits grow."""
+        hits grow. Speculative mode keeps the SAME count: the
+        draft→verify→accept loop is baked into the one step program
+        (the draft engine's own bucket prefills are counted separately
+        — :meth:`draft_compile_stats`)."""
         return self.executor.stats()
+
+    def draft_compile_stats(self) -> Optional[dict]:
+        """Draft-engine executor stats (None when speculative is off):
+        steady state is ``entries == len(prefill_buckets)`` — the
+        draft's bucket prefill programs; its decode never dispatches
+        on its own."""
+        return (self.draft_executor.stats()
+                if self.draft_executor is not None else None)
 
 
 def _build_prefill(model, width: int, paged: bool = False,
@@ -810,3 +1107,279 @@ def _build_step(model, k_max: int, pad_id: int, horizon: int,
             return core(variables, caches, None, *rest)
 
     return step
+
+
+def _build_draft_prefill(model, width: int, paged: bool = False):
+    """The draft engine's bucket prefill: the same chunk-at-traced-
+    offset move as the target's (:func:`_build_prefill`) minus all
+    sampling/completion state — the draft only needs its KV loaded.
+    Logits are discarded; a quantized pool's per-chunk ``qerr`` is
+    dropped with the dict re-filter (draft quant error is not a
+    serving metric — the accept test measures draft fidelity end to
+    end)."""
+    def core(variables, caches, tables, tokens, length, slot, pos):
+        del length
+        if paged:
+            zero = jnp.zeros((), jnp.int32)
+            tab_row = lax.dynamic_slice(
+                tables, (slot, zero), (1, tables.shape[1]))
+            rows = [{**pool, "tables": tab_row} for pool in caches]
+        else:
+            rows = [{"k": read_slot(pool["k"], slot),
+                     "v": read_slot(pool["v"], slot)}
+                    for pool in caches]
+        _, states = model.apply(variables, tokens, training=False,
+                                cache=rows, pos=pos)
+        new_rows = _caches_from_states(model, states, rows)
+        if paged:
+            kept = tuple(caches[0].keys())
+            return [{kk: r[kk] for kk in kept} for r in new_rows]
+        return [{"k": write_slot(pool["k"], rk["k"], slot),
+                 "v": write_slot(pool["v"], rk["v"], slot)}
+                for pool, rk in zip(caches, new_rows)]
+
+    if paged:
+        def prefill(variables, caches, tables, tokens, *rest):
+            return core(variables, caches, tables, tokens, *rest)
+    else:
+        def prefill(variables, caches, tokens, *rest):
+            return core(variables, caches, None, tokens, *rest)
+
+    return prefill
+
+
+def _build_spec_step(model, draft_model, k_max: int, pad_id: int,
+                     horizon: int, draft_k: int, paged: bool = False):
+    """The fused speculative step: ONE compiled program scanning
+    ``horizon`` draft→verify→accept windows, device-resident end to
+    end. Each window:
+
+    1. samples ``t0`` from the carried target logits — exactly the
+       classic step's move (or, after a rejection, a raw categorical
+       from the carried RESIDUAL logits — the deferred rejection
+       resample of lossless speculative sampling);
+    2. runs ``draft_k + 1`` single-token draft forwards (a ``lax.scan``
+       chain feeding sampled proposals), collecting the k proposals and
+       the filtered draft distributions each was drawn from — the last
+       forward only keeps the draft cache complete for the
+       all-accepted case;
+    3. runs ONE ``draft_k + 1``-wide target forward over
+       ``[t0, d_1..d_k]`` at per-row traced positions (the
+       models/gpt2.py verify-window write path: per-position scatter,
+       overshoot and non-emitting rows routed to scratch/drop);
+    4. accepts the longest agreeing prefix in-program
+       (serve/sampling.py accept_mask: greedy exact-match, sampled
+       ``u·q <= p``), cuts it at EOS / budget / a non-finite verify
+       row, emits ``e ∈ [0, k+1]`` tokens, advances positions and the
+       per-row PRNG key by exactly ``e`` split steps (the carried key
+       stream stays a function of (seed, emitted count) — spec outputs
+       are horizon-invariant, and greedy rows are bit-identical to the
+       classic engine), and carries either the next plain target
+       logits (``P[e-1]``) or the rejection residual.
+
+    The carried done/ok masks freeze rows mid-horizon exactly as the
+    classic scan does; rejected/overshoot columns never reach the host
+    — the program compacts each row's emitted tokens to a left-aligned
+    prefix of the ``[B, horizon*(k+1)]`` block and returns per-window
+    emitted counts for the acceptance histogram."""
+    k = draft_k
+    w = k + 1
+
+    def window(active, temps, top_ks, top_ps, eos_ids, budgets,
+               variables, dvariables, tables, dtables, carry):
+        (caches, dcaches, last_logits, positions, keys, done, ok,
+         emitted, residual) = carry
+        b = positions.shape[0]
+        ok = ok & finite_rows(last_logits)
+        emit0 = active & ~done & ok & (emitted < budgets)
+        greedy = temps <= 0.0
+        splits = jax.vmap(lambda kk: jax.random.split(kk, 2))(keys)
+        sub0 = splits[:, 1]
+        # t0: the classic carried-logits sample — the same key the
+        # classic engine would use at this emitted count, so sampled
+        # spec streams stay aligned with the classic stream at every
+        # window boundary. Residual rows draw a RAW categorical: their
+        # carried logits are already-filtered log-probs.
+        t_cls = sample_tokens(last_logits, sub0, temps, top_ks, top_ps,
+                              k_max)
+        t_res = categorical_rows(sub0, last_logits)
+        t0 = jnp.where(residual, t_res, t_cls)
+        t0 = jnp.where(emit0, t0, pad_id).astype(jnp.int32)
+
+        def dstep(c, j):
+            dc, tok_in = c
+            if paged:
+                rows = [{**cc, "tables": dtables} for cc in dc]
+            else:
+                rows = dc
+            dlog, dstates = draft_model.apply(
+                dvariables, tok_in[:, None], training=False,
+                cache=rows, pos=positions + j, active=emit0)
+            new_rows = _caches_from_states(draft_model, dstates, rows)
+            if paged:
+                kept = tuple(dc[0].keys())
+                dc2 = [{kk: r[kk] for kk in kept} for r in new_rows]
+            else:
+                dc2 = new_rows
+            row = dlog[:, -1, :]
+            # The draft proposes from the row's FILTERED distribution
+            # (same temperature/top-k/top-p as the target side): the
+            # rejection law is lossless for any proposal q, but a
+            # proposal outside the target's truncated support has
+            # p = 0 and always rejects — matching the support is what
+            # keeps sampled accept rates near the draft's actual
+            # fidelity.
+            fl = filter_logits(row, temps, top_ks, top_ps, k_max)
+            dkey = jax.vmap(
+                lambda kk: jax.random.fold_in(kk, 1 + j))(keys)
+            d = jnp.where(greedy, jnp.argmax(row, axis=-1),
+                          categorical_rows(dkey, fl)).astype(jnp.int32)
+            d = jnp.where(emit0, d, pad_id)
+            return (dc2, d), (d, jax.nn.softmax(fl, axis=-1))
+
+        (dcaches, _), (d_all, q_all) = lax.scan(
+            dstep, (dcaches, t0), jnp.arange(w))
+        win = jnp.concatenate(
+            [t0[:, None], jnp.transpose(d_all[:k], (1, 0))], axis=1)
+
+        if paged:
+            vrows = [{**cc, "tables": tables} for cc in caches]
+        else:
+            vrows = caches
+        vlog, vstates = model.apply(variables, win, training=False,
+                                    cache=vrows, pos=positions,
+                                    active=emit0)
+        new_rows = _caches_from_states(model, vstates, vrows)
+        if paged:
+            kept = tuple(caches[0].keys())
+            new_caches = [{kk: r[kk] for kk in kept} for r in new_rows]
+        else:
+            new_caches = new_rows
+        # Health: the whole verify window must be finite — a poisoned
+        # window emits NOTHING (the conservative discard of the classic
+        # step at window granularity); pre-window tokens were already
+        # delivered, and the carried ok=False retires the row.
+        okrow = jnp.isfinite(vlog).all(axis=(1, 2))
+        ok = jnp.where(emit0, ok & okrow, ok)
+
+        tmax = jnp.argmax(vlog, axis=-1).astype(jnp.int32)    # [B, w]
+        pf = jax.vmap(
+            lambda l: filtered_probs(l, temps, top_ks, top_ps, k_max),
+            in_axes=1, out_axes=1)(vlog[:, :k, :])            # [B, k, V]
+        qf = jnp.transpose(q_all[:k], (1, 0, 2))              # [B, k, V]
+        u = jax.vmap(lambda kk: jax.random.uniform(
+            jax.random.fold_in(kk, w + 1), (k,)))(keys)       # [B, k]
+        acc = accept_mask(win[:, 1:], pf, qf, u, greedy, tmax[:, :k])
+
+        jidx = jnp.arange(w)
+        acc_full = jnp.concatenate([jnp.ones((b, 1), bool), acc],
+                                   axis=1)                    # [B, w]
+        acc_prefix = jnp.cumprod(acc_full.astype(jnp.int32),
+                                 axis=1).astype(bool)
+        is_eos = (eos_ids >= 0)[:, None] & (win == eos_ids[:, None])
+        no_prior_eos = (jnp.cumsum(is_eos.astype(jnp.int32), axis=1)
+                        - is_eos.astype(jnp.int32)) == 0
+        within_budget = (emitted[:, None] + jidx[None, :]
+                         < budgets[:, None])
+        emit_w = ((emit0 & okrow)[:, None] & acc_prefix
+                  & no_prior_eos & within_budget)             # [B, w]
+        e = emit_w.sum(axis=1).astype(jnp.int32)
+        tok_out = jnp.where(emit_w, win, pad_id)
+        emitted_new = emitted + e
+        done = done | (emit_w & is_eos).any(axis=1) \
+            | (emit0 & okrow & (emitted_new >= budgets))
+
+        # Carried distribution for the next window: the plain target
+        # logits after the last emitted token — or, when the stop was a
+        # REJECTION (sampled rows only), the residual norm(max(p-q, 0))
+        # in log space, flagged so the next t0 samples it raw.
+        e1 = jnp.clip(e, 1, w)
+        sel = jnp.take_along_axis(vlog, (e1 - 1)[:, None, None],
+                                  axis=1)[:, 0, :]
+        stop = jnp.minimum(e, w - 1)
+        gat = lambda m: jnp.take_along_axis(m, stop[:, None],
+                                            axis=1)[:, 0]
+        rej = (emit0 & okrow & (e < w) & ~greedy & gat(no_prior_eos)
+               & gat(within_budget) & ~gat(acc_full))
+        ek = jnp.clip(e, 1, k)
+        pf_e = jnp.take_along_axis(pf, (ek - 1)[:, None, None],
+                                   axis=1)[:, 0, :]
+        qf_e = jnp.take_along_axis(qf, (ek - 1)[:, None, None],
+                                   axis=1)[:, 0, :]
+        rlog = residual_logits(pf_e, qf_e)
+        upd = emit0 & okrow
+        last_new = jnp.where(upd[:, None],
+                             jnp.where(rej[:, None], rlog, sel),
+                             last_logits)
+        residual_new = jnp.where(upd, rej, residual)
+
+        # Keys advance by exactly e split steps — the classic
+        # one-split-per-emit chain, so the carried stream is a function
+        # of (seed, emitted count) alone.
+        def adv(kk, j):
+            nxt = jax.vmap(lambda key: jax.random.split(key, 2)[0])(kk)
+            return jnp.where((j < e)[:, None], nxt, kk), None
+
+        keys_new, _ = lax.scan(adv, keys, jnp.arange(w))
+
+        return ((new_caches, dcaches, last_new, positions + e, keys_new,
+                 done, ok, emitted_new, residual_new),
+                (tok_out, emit_w, e))
+
+    def core(variables, caches_all, dvariables, tables, dtables,
+             last_logits, positions, active, keys, temps, top_ks,
+             top_ps, eos_ids, budgets, residual):
+        caches, dcaches = caches_all
+        b = positions.shape[0]
+        init = (caches, dcaches, last_logits, positions, keys,
+                jnp.zeros((b,), bool),        # done (within this block)
+                jnp.ones((b,), bool),         # ok   (health, carried)
+                jnp.zeros((b,), jnp.int32),   # emitted (within block)
+                residual)
+
+        def scan_body(carry, _):
+            return window(active, temps, top_ks, top_ps, eos_ids,
+                          budgets, variables, dvariables, tables,
+                          dtables, carry)
+
+        if horizon == 1:
+            carry, (tok_w, emit_m, e_w) = scan_body(init, None)
+            toks = tok_w[:, None, :]
+            mask = emit_m[:, None, :]
+            win_emitted = e_w[:, None]
+        else:
+            carry, (tok_s, emit_s, e_s) = lax.scan(scan_body, init,
+                                                   None, length=horizon)
+            toks = jnp.transpose(tok_s, (1, 0, 2))     # [B, H, w]
+            mask = jnp.transpose(emit_s, (1, 0, 2))
+            win_emitted = jnp.transpose(e_s, (1, 0))   # [B, H]
+        (caches, dcaches, last_logits, positions, keys, done, ok,
+         emitted, residual) = carry
+        width = horizon * w
+        tok_flat = toks.reshape(b, width)
+        mask_flat = mask.reshape(b, width)
+        # Compact each row's emitted tokens to a left-aligned prefix
+        # (stable: emission order preserved) so the scheduler's
+        # slice-at-emitted consumption works unchanged; everything past
+        # a row's count is pad (masked to pad_id before the sort, so
+        # the unemitted tail lands as pad already left-aligned).
+        order = jnp.argsort(
+            jnp.logical_not(mask_flat).astype(jnp.int32), axis=1,
+            stable=True)
+        tok_block = jnp.take_along_axis(
+            jnp.where(mask_flat, tok_flat, pad_id), order, axis=1)
+        return (tok_block, emitted, ok, win_emitted,
+                (caches, dcaches), last_logits, positions, keys,
+                jnp.maximum(budgets - emitted, 0), residual)
+
+    if paged:
+        def spec_step(variables, caches_all, dvariables, tables,
+                      dtables, *rest):
+            return core(variables, caches_all, dvariables, tables,
+                        dtables, *rest)
+    else:
+        def spec_step(variables, caches_all, dvariables, *rest):
+            return core(variables, caches_all, dvariables, None, None,
+                        *rest)
+
+    return spec_step

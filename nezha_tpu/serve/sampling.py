@@ -28,6 +28,22 @@ when the token is actually EMITTED, so a request's RNG stream depends
 on its seed and emitted-token count alone, never on the decode horizon
 or its batch neighbors (horizon=1 and horizon=8 sample identical
 sequences).
+
+The speculative-decoding kernels live here too (the engine's
+draft→verify→accept window composes them): :func:`filter_logits` is the
+ONE per-row temperature/top-k/top-p truncation both the classic sampler
+and the speculative accept test apply — the rejection test is lossless
+for any proposal distribution, but a draft proposal outside the
+target's truncated support has p = 0 and always rejects, so the draft
+proposes from the same filtered support to keep accept rates at the
+draft's actual fidelity; :func:`accept_mask` is the per-position accept
+decision (greedy: exact match against the target argmax; sampled: the
+standard ``u·q ≤ p`` rejection test); :func:`residual_logits` is the
+rejection-resample distribution ``norm(max(p − q, 0))`` in log space —
+the engine carries it as the row's next sampling distribution (flagged
+``residual``), so the token emitted after a rejection is drawn from
+exactly the residual the lossless-speculative-sampling theorem
+requires, one window later.
 """
 
 from __future__ import annotations
@@ -48,16 +64,17 @@ def finite_rows(logits) -> jax.Array:
     return jnp.isfinite(logits).all(axis=-1)
 
 
-def sample_tokens(logits, keys, temperature, top_k, top_p,
+def filter_logits(logits, temperature, top_k, top_p,
                   k_max: int) -> jax.Array:
-    """logits ``[B, V]``, keys ``[B, 2]`` (one PRNG key per row),
-    temperature/top_p ``[B]`` float, top_k ``[B]`` int (``<= 0`` = off),
-    ``k_max`` static int (``1 <= k_max <= V``) -> token ids ``[B]``.
-    """
+    """The per-row temperature/top-k/top-p truncation, factored out of
+    :func:`sample_tokens` so the speculative accept test can apply the
+    IDENTICAL filtering to draft and target logits: ``[B, V]`` logits ->
+    ``[B, V]`` scaled logits with truncated entries at ``-inf``.
+    Sampling from the result (``categorical``) is exactly what
+    :func:`sample_tokens` does for non-greedy rows."""
     b, v = logits.shape
     if not 1 <= k_max <= v:
         raise ValueError(f"k_max must be in [1, {v}], got {k_max}")
-    greedy = temperature <= 0.0
     scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
 
     # Per-row top-k under the static cap: the k_max'th-largest values are
@@ -76,8 +93,25 @@ def sample_tokens(logits, keys, temperature, top_k, top_p,
     keep = (exclusive_cum < top_p[:, None]) | (rank == 0)
     threshold = jnp.min(
         jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
-    scaled = jnp.where(scaled < threshold, -jnp.inf, scaled)
+    return jnp.where(scaled < threshold, -jnp.inf, scaled)
 
+
+def filtered_probs(logits, temperature, top_k, top_p,
+                   k_max: int) -> jax.Array:
+    """``softmax(filter_logits(...))`` — the probability vector the
+    speculative rejection test and residual are computed over."""
+    return jax.nn.softmax(filter_logits(logits, temperature, top_k,
+                                        top_p, k_max), axis=-1)
+
+
+def sample_tokens(logits, keys, temperature, top_k, top_p,
+                  k_max: int) -> jax.Array:
+    """logits ``[B, V]``, keys ``[B, 2]`` (one PRNG key per row),
+    temperature/top_p ``[B]`` float, top_k ``[B]`` int (``<= 0`` = off),
+    ``k_max`` static int (``1 <= k_max <= V``) -> token ids ``[B]``.
+    """
+    greedy = temperature <= 0.0
+    scaled = filter_logits(logits, temperature, top_k, top_p, k_max)
     sampled = jax.vmap(jax.random.categorical)(keys, scaled)
     return jnp.where(greedy, jnp.argmax(logits, axis=-1),
                      sampled).astype(jnp.int32)
@@ -96,3 +130,64 @@ def split_and_sample(keys, logits, temperature, top_k, top_p,
     tok = sample_tokens(logits, splits[:, 1], temperature, top_k, top_p,
                         k_max)
     return splits[:, 0], tok
+
+
+# ------------------------------------------------- speculative decoding
+def categorical_rows(keys, logits) -> jax.Array:
+    """Per-row categorical draw: ``keys [B, 2]``, ``logits [B, V]`` ->
+    ``[B]`` int32. Used for the residual-distribution resample, whose
+    logits are ALREADY filtered log-probabilities — re-applying the
+    temperature/top-k/top-p filter there would distort the lossless
+    rejection-sampling law."""
+    return jax.vmap(jax.random.categorical)(keys, logits).astype(
+        jnp.int32)
+
+
+def accept_mask(draft_tokens, p_probs, q_probs, u, greedy,
+                target_argmax) -> jax.Array:
+    """Per-position speculative accept decision.
+
+    ``draft_tokens [B, K]`` (the k proposed tokens), ``p_probs`` /
+    ``q_probs [B, K, V]`` (target / draft distributions at each
+    position, BOTH filtered by :func:`filter_logits` with the row's own
+    sampling params), ``u [B, K]`` uniforms, ``greedy [B]`` bool,
+    ``target_argmax [B, K]`` (per-position argmax of the UNfiltered
+    target logits) -> ``[B, K]`` bool accepts.
+
+    Greedy rows accept exactly the tokens classic greedy would have
+    emitted (``draft == argmax(p)``) — the bit-identity half of the
+    parity gate. Sampled rows run the standard rejection test
+    ``u · q(d) < p(d)`` (accept with probability ``min(1, p/q)``; the
+    STRICT inequality matters — ``jax.random.uniform`` can return
+    exactly 0, and ``0 · q <= 0`` would accept a token the target's
+    truncated distribution assigns ZERO probability, an output classic
+    sampling could never emit). A draft distribution that went
+    non-finite fails the test DETERMINISTICALLY (no ``u`` involved),
+    which keeps the emitted stream unbiased: the position simply falls
+    back to a fresh sample from the plain target distribution next
+    window."""
+    psel = jnp.take_along_axis(p_probs, draft_tokens[..., None],
+                               axis=2)[..., 0]
+    qsel = jnp.take_along_axis(q_probs, draft_tokens[..., None],
+                               axis=2)[..., 0]
+    q_ok = jnp.isfinite(q_probs).all(axis=-1)
+    sampled_acc = q_ok & (u * qsel < psel)
+    greedy_acc = draft_tokens == target_argmax
+    return jnp.where(greedy[:, None], greedy_acc, sampled_acc)
+
+
+def residual_logits(p_probs, q_probs) -> jax.Array:
+    """The rejection-resample distribution in log space:
+    ``log(max(p − q, 0))`` per row (``[B, V]`` each). Sampling
+    ``categorical`` from this is the residual draw of standard
+    speculative sampling — the engine defers it one window by carrying
+    these logits as the row's next sampling distribution. The floor
+    guards zero-mass entries from producing ``-inf``: the engine's
+    NaN/inf health tripwire (:func:`finite_rows`) runs on the CARRIED
+    logits, so a ``-inf`` here would retire the row as poisoned. The
+    floor must be a NORMAL fp32 number — XLA's CPU backend flushes
+    denormals to zero (``1e-38 -> 0 -> log = -inf``, a bug found by
+    driving the real server); ``1e-30`` lands zero-mass entries at
+    ~``-69`` in log space, finite yet still zero probability for
+    categorical purposes next to any real residual mass."""
+    return jnp.log(jnp.maximum(p_probs - q_probs, 0.0) + 1e-30)

@@ -190,6 +190,13 @@ def register_serve_instruments() -> None:
     obs.gauge("serve.kv.bytes_resident")
     obs.gauge("serve.kv.quant_bits")
     obs.histogram("serve.kv.quant_error")
+    # Speculative decoding instruments (schema-pinned, 0/empty when the
+    # knob is off so every serving summary shares one shape): draft
+    # tokens proposed, draft tokens accepted, and the per-verify
+    # accepted-prefix length histogram (tokens-per-verify = p50 + 1).
+    obs.counter("serve.spec.draft_tokens_total")
+    obs.counter("serve.spec.accepted_total")
+    obs.histogram("serve.spec.accepted_len")
     obs.gauge("serve.queue_depth")
     obs.gauge("serve.batch_occupancy")
     obs.histogram("serve.ttft_s")
@@ -585,7 +592,9 @@ class Scheduler:
         dt = now - t0
         now_wall = time.time() if traced_batch else None
         self._host_gap_t = now
-        obs.histogram("serve.decode.horizon").observe(horizon)
+        obs.histogram("serve.decode.horizon").observe(
+            self.engine.tokens_per_dispatch)
+        speculative = self.engine.spec is not None
         ok = self.engine.step_ok
         emitted = 0
         for slot in list(self._live):
@@ -606,12 +615,18 @@ class Scheduler:
                     # The first token landed at its position WITHIN the
                     # block, not at the block end — a fresh row emits
                     # from scan step 0, so crediting the whole block
-                    # would overstate TTFT by (H-1)/H of a block.
+                    # would overstate TTFT by (H-1)/H of a block. In
+                    # speculative mode the block's width varies with
+                    # acceptance, so the first ACCEPTED token is
+                    # credited at its position among the row's e
+                    # actually-emitted tokens (PR 5's move, denominator
+                    # adjusted); classic keeps the exact /horizon form.
+                    denom = e if speculative else horizon
                     live.ttft_s = ((t0 - live.submit_t)
-                                   + dt * (i + 1) / horizon)
+                                   + dt * (i + 1) / denom)
                     if live.trace_id is not None and t0_wall is not None:
                         live.first_token_wall = (t0_wall
-                                                 + dt * (i + 1) / horizon)
+                                                 + dt * (i + 1) / denom)
                     obs.histogram("serve.ttft_s").observe(live.ttft_s)
                 # Per-token decode latency: the block cost split over
                 # the tokens it produced, observed once per token —

@@ -108,11 +108,26 @@ class SlotPool:
         # LIFO free list: the most-recently-freed slot is re-used first,
         # keeping the active rows clustered low (cheap occupancy reads).
         self._free: List[int] = list(range(capacity - 1, -1, -1))
+        # A second pool shadowing this one's slot lifecycle (the
+        # speculative engine's DRAFT KV pool): alloc/free mirror by slot
+        # INDEX, so the draft model's cache rows for request R always
+        # live at the same slot as the target's, and freeing the target
+        # slot can never leak the draft's blocks.
+        self.mirror = None
 
     # ----------------------------------------------------------- alloc
     def alloc(self) -> Optional[int]:
         """-> a free slot index, or None when the pool is fully occupied."""
-        return self._free.pop() if self._free else None
+        slot = self._free.pop() if self._free else None
+        if slot is not None and self.mirror is not None:
+            self.mirror.claim(slot)
+        return slot
+
+    def claim(self, slot: int) -> None:
+        """Take a SPECIFIC free slot (the mirror path: the leader pool
+        chose the index). Raises if the slot is not free — lifecycle
+        drift between the pools must surface, not corrupt."""
+        self._free.remove(slot)
 
     def free(self, slot: int) -> None:
         if not 0 <= slot < self.capacity:
@@ -120,6 +135,8 @@ class SlotPool:
         if slot in self._free:
             raise ValueError(f"slot {slot} is already free (double free)")
         self._free.append(slot)
+        if self.mirror is not None:
+            self.mirror.free(slot)
 
     @property
     def num_free(self) -> int:
@@ -504,26 +521,43 @@ class PagedSlotPool:
         self.trie = PrefixTrie(block_size)
         self.cow_copies = 0
         self.prefix_hits = 0
+        # Mirror pool (speculative draft KV — see SlotPool.mirror):
+        # slot lifecycle is mirrored by INDEX; block bookkeeping stays
+        # per-pool (the draft binds its own blocks lazily, sized by the
+        # draft model's geometry). leak_check recurses into it, so the
+        # chaos oracles cover both pools in one call.
+        self.mirror = None
 
     # ------------------------------------------------------ slot layer
     def alloc(self) -> Optional[int]:
         """-> a free slot index, or None when every slot is occupied.
         Blocks are bound separately (:meth:`bind_for_prompt` /
         :meth:`prepare_write`) — a fresh slot holds none."""
-        return self._free_slots.pop() if self._free_slots else None
+        slot = self._free_slots.pop() if self._free_slots else None
+        if slot is not None and self.mirror is not None:
+            self.mirror.claim(slot)
+        return slot
+
+    def claim(self, slot: int) -> None:
+        """Take a SPECIFIC free slot (the mirror path — see
+        :meth:`SlotPool.claim`). Raises when the slot is not free."""
+        self._free_slots.remove(slot)
 
     def free(self, slot: int) -> None:
         """Release the slot and DROP ITS BLOCK REFERENCES in the same
         call (the same-iteration contract the chaos suites pin): blocks
         nobody else references return to the free list, the table row
         resets to scratch so a stale dispatch mask can never write into
-        a rebound block."""
+        a rebound block. A mirror pool (the draft cache) frees the same
+        slot — and its own blocks — in the same call."""
         if not 0 <= slot < self.capacity:
             raise ValueError(f"slot {slot} out of range [0, {self.capacity})")
         if slot in self._free_slots:
             raise ValueError(f"slot {slot} is already free (double free)")
         self.release_blocks(slot)
         self._free_slots.append(slot)
+        if self.mirror is not None:
+            self.mirror.free(slot)
 
     def release_blocks(self, slot: int) -> None:
         """Drop the slot's block references (without freeing the slot):
@@ -845,3 +879,13 @@ class PagedSlotPool:
             raise AssertionError(
                 f"KV block leak: {n_free} free + {n_held} held != "
                 f"{self.num_blocks - 1} allocatable")
+        if self.mirror is not None:
+            # Draft-pool extension of the oracle: the mirror's slot
+            # free-list must agree with ours slot for slot (lifecycle
+            # lockstep), and its own block books must balance too.
+            if sorted(self.mirror._free_slots) != sorted(self._free_slots):
+                raise AssertionError(
+                    f"draft pool slot drift: mirror free "
+                    f"{sorted(self.mirror._free_slots)} != "
+                    f"{sorted(self._free_slots)}")
+            self.mirror.leak_check()

@@ -702,7 +702,8 @@ def test_fault_point_registry_pinned():
     full set, including the multi-replica points (router.route /
     router.probe / supervisor.spawn / replica.exec), the paged-KV
     bind point (serve.kv.bind), and the migration points
-    (router.migrate / replica.kv_export / replica.kv_install)."""
+    (router.migrate / replica.kv_export / replica.kv_install), and
+    the speculative verify point (serve.spec.verify)."""
     from check_fault_points import EXPECTED_POINTS, check, find_points
 
     assert check(_ROOT) == []
@@ -714,5 +715,6 @@ def test_fault_point_registry_pinned():
         "supervisor.spawn", "replica.exec",
         "serve.kv.bind",
         "router.migrate", "replica.kv_export", "replica.kv_install",
+        "serve.spec.verify",
     }
     assert set(find_points(_ROOT)) == set(EXPECTED_POINTS)
