@@ -169,6 +169,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "the clean-finish percentiles")
     p.add_argument("--model-preset", choices=["tiny", "full"],
                    default="tiny")
+    p.add_argument("--mesh", type=int, default=1,
+                   help="M > 1 runs the engine TENSOR-SHARDED over a "
+                        "1xM device mesh (serve/sharded): params "
+                        "Megatron-sharded, paged K/V head-sharded, "
+                        "frozen program contract per mesh — requires M "
+                        "visible devices and num_heads %% M == 0 "
+                        "(single-replica closed/open loop only)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--run-dir", default=None,
                    help="write telemetry artifacts here")
@@ -213,6 +220,11 @@ def run(args) -> dict:
     from nezha_tpu.cli.common import setup_jax
     setup_jax(args)
 
+    if getattr(args, "mesh", 1) > 1 and (args.replicas > 1
+                                         or args.disaggregate):
+        raise SystemExit("--mesh > 1 applies to the single-replica "
+                         "loops (the router benches compose meshes "
+                         "via nezha-serve --replicas --mesh)")
     if args.replicas > 1 or args.disaggregate:
         if len(horizons) != 1:
             raise SystemExit("--replicas > 1 takes a single "
@@ -316,7 +328,13 @@ def _run_one(args, model, variables, decode_horizon: int,
         kv_num_blocks=args.kv_num_blocks,
         prefix_cache=args.prefix_cache == "on",
         kv_dtype=args.kv_dtype, speculative=spec)
-    engine = Engine(model, variables, cfg)
+    mesh_m = int(getattr(args, "mesh", 1) or 1)
+    if mesh_m > 1:
+        from nezha_tpu.serve.sharded import ShardedEngine
+        engine = ShardedEngine(model, variables, cfg,
+                               mesh_devices=mesh_m)
+    else:
+        engine = Engine(model, variables, cfg)
     sched = Scheduler(engine)
     rng = random.Random(args.seed)
     vocab = engine.vocab
@@ -555,6 +573,7 @@ def _run_one(args, model, variables, decode_horizon: int,
         "latency_s": _percentiles(lats),
         "prefill_buckets": list(engine.cfg.prefill_buckets),
         "decode_impl": args.decode_impl or "auto",
+        "mesh_devices": getattr(engine, "mesh_devices", 1),
         "compile_cache": engine.compile_stats(),
         # Paged-pool occupancy record: resident-request and
         # blocks-resident peaks are THE concurrency-at-equal-memory

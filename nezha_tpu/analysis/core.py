@@ -91,4 +91,4 @@ def load_rules() -> None:
     """Import every built-in rule module (each registers itself)."""
     from nezha_tpu.analysis.rules import (  # noqa: F401
         bench_records, donation, fault_points, host_sync, locks,
-        telemetry, traced_branch)
+        mesh_tables, telemetry, traced_branch)

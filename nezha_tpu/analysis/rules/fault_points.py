@@ -50,6 +50,12 @@ EXPECTED_POINTS = frozenset({
     # slot/block leaks in either pool), an error rule raises typed
     # InjectedFault into the scheduler's bounded-retry envelope.
     "serve.spec.verify",
+    # Train->serve checkpoint resharding (serve/sharded/reshard.py):
+    # armed at the start of every reshard — an injected error surfaces
+    # as the same typed ReshardError a corrupt/missing leaf produces,
+    # and the sharded engine REFUSES TO START rather than serving
+    # garbage weights.
+    "serve.reshard",
 })
 SOURCE_PREFIX = "nezha_tpu/"
 EXCLUDE_PREFIX = "nezha_tpu/faults/"

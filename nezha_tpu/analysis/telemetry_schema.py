@@ -71,7 +71,12 @@ _SERVE_COUNTERS = {"serve.admitted_total", "serve.rejected_total",
                    # Knob-invariant: a non-speculative run reports 0s,
                    # never omits them.
                    "serve.spec.draft_tokens_total",
-                   "serve.spec.accepted_total"}
+                   "serve.spec.accepted_total",
+                   # Tensor-sharded serving (PR 14): trace-shape
+                   # estimate of the cross-shard collective payload the
+                   # mesh moved. Topology-invariant: single-device runs
+                   # report 0, never omit it.
+                   "serve.mesh.collective_bytes"}
 _SERVE_GAUGES = {"serve.queue_depth", "serve.batch_occupancy",
                  "serve.kv.blocks_used",
                  # KV quantization (PR 9): device bytes the resident KV
@@ -79,7 +84,10 @@ _SERVE_GAUGES = {"serve.queue_depth", "serve.batch_occupancy",
                  # + per-block scales, 16/32 = plain bf16/f32 pools).
                  # Layout/dtype-invariant: every serving run reports
                  # them.
-                 "serve.kv.bytes_resident", "serve.kv.quant_bits"}
+                 "serve.kv.bytes_resident", "serve.kv.quant_bits",
+                 # Tensor-sharded serving (PR 14): the mesh size this
+                 # engine spans (1 = classic single-device engine).
+                 "serve.mesh.devices"}
 _SERVE_HISTOGRAMS = {"serve.ttft_s", "serve.tpot_s",
                      "serve.prefill.bucket_len",
                      # Decode-horizon instruments (PR 5): host time
@@ -154,6 +162,10 @@ _PINNED_SPANS = {
     "serve.kv_install",      # decode side: export POST+install+ACK
     "serve.decode_window",   # one per decode dispatch the request rode
     "serve.decode",          # decode residency + first-token milestone
+    # Tensor-sharded serving (PR 14): the train->serve checkpoint
+    # resharding window (nezha-reshard / nezha-serve --mesh startup) —
+    # attrs carry source format, step, and mesh size.
+    "serve.reshard_s",
 }
 
 # Namespaces whose METRIC names (counter/gauge/histogram) the source

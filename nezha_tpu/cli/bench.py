@@ -48,7 +48,12 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--suites", default="serving,decode_attention",
                    help="comma-separated subset of "
-                        "{serving, decode_attention}")
+                        "{serving, decode_attention, sharded_serve}. "
+                        "sharded_serve (mesh 1 vs 2 vs 4 at equal "
+                        "total memory + the bit-identical greedy-"
+                        "parity gate) is opt-in: it needs forced host "
+                        "devices off-TPU and its runtime is a "
+                        "multiple of the serving sweep's")
     p.add_argument("--serving-baseline", default="BENCH_serving.json",
                    help="committed serving record to gate against")
     p.add_argument("--decode-baseline",
@@ -339,6 +344,101 @@ def _run_serving(args, platform: str) -> dict:
             }}
 
 
+def _run_sharded_serve(args, platform: str) -> dict:
+    """The tensor-sharded serving suite (ISSUE 14): the SAME closed
+    loop at mesh 1 vs 2 vs 4 under EQUAL TOTAL MEMORY (one fixed
+    kv_num_blocks budget — a mesh-M run holds the same logical blocks,
+    each device 1/M of the bytes), plus the hard correctness gate:
+    greedy outputs across mesh sizes must be BIT-IDENTICAL to the
+    single-device engine. Meshes the visible device count cannot host
+    are recorded as dropped, never silently skipped (the tier-1 rig
+    forces 8 host devices; a bare laptop records mesh 1 only)."""
+    import jax
+
+    sys.path.insert(0, _bench_dir())
+    import serving as serving_bench
+
+    ndev = len(jax.devices())
+    want = [1, 2, 4]
+    meshes = [m for m in want if m <= ndev]
+    dropped = [m for m in want if m > ndev]
+    if dropped:
+        print(f"nezha-bench: sharded_serve dropping meshes {dropped} "
+              f"({ndev} device(s) visible)", file=sys.stderr)
+    requests = args.requests or (8 if args.quick else 24)
+    # Equal total memory: ONE block budget across every mesh size.
+    load = ["--requests", str(requests), "--concurrency", "4",
+            "--max-batch-size", "4",
+            "--max-len", "32", "--max-prefill-len", "8",
+            "--prompt-len", "4",
+            "--max-new-tokens", "4" if args.quick else "8",
+            "--kv-block-size", "4", "--kv-num-blocks", "33",
+            "--sample-fraction", "0", "--platform", platform]
+    by_mesh = {}
+    for m in meshes:
+        by_mesh[str(m)] = serving_bench.run(
+            serving_bench.build_parser().parse_args(
+                load + ["--mesh", str(m)]))
+    single = by_mesh.get("1") or {}
+    ratios_ttft, ratios_tpot = {}, {}
+    for m, rec in by_mesh.items():
+        if m == "1" or not single:
+            continue
+        ratios_ttft[m] = (rec["ttft_s"]["p50"]
+                          / max(single["ttft_s"]["p50"], 1e-9))
+        ratios_tpot[m] = (rec["tpot_s"]["p50"]
+                          / max(single["tpot_s"]["p50"], 1e-9))
+    return {
+        "kv_budget": "33 blocks x 4 tokens shared across meshes "
+                     "(equal TOTAL memory; each mesh-M device holds "
+                     "1/M of the bytes)",
+        "devices_visible": ndev,
+        "meshes": meshes, "dropped_meshes": dropped,
+        "by_mesh": by_mesh,
+        "greedy_parity": _sharded_greedy_parity(meshes),
+        "ttft_p50_ratio_vs_single": ratios_ttft,
+        "tpot_p50_ratio_vs_single": ratios_tpot,
+    }
+
+
+def _sharded_greedy_parity(meshes) -> bool:
+    """Bit-identical greedy parity across mesh sizes: one tiny model,
+    one prompt set, engines at every runnable mesh — token streams
+    must match the single-device engine exactly. The hard gate of the
+    sharded_serve suite (a False here fails the bench regardless of
+    baselines)."""
+    import jax
+    import jax.numpy as jnp
+
+    from nezha_tpu.cli.train import TINY_GPT2_KW
+    from nezha_tpu.models.gpt2 import GPT2, GPT2Config
+    from nezha_tpu.serve import Engine, Request, Scheduler, ServeConfig
+    from nezha_tpu.serve.sharded import ShardedEngine
+
+    model = GPT2(GPT2Config(**TINY_GPT2_KW))
+    variables = model.init(jax.random.PRNGKey(0))
+    cfg = ServeConfig(max_batch_size=2, max_len=32, max_prefill_len=8,
+                      cache_dtype=jnp.float32)
+    prompts = [[5, 17, 3], [9, 8, 7, 6, 5], [1, 2]]
+
+    def decode(engine):
+        sched = Scheduler(engine)
+        for i, p in enumerate(prompts):
+            sched.submit(Request(prompt=p, max_new_tokens=6,
+                                 request_id=f"p{i}"))
+        sched.run_until_idle(max_iters=300)
+        return {k: v.tokens for k, v in sched.results.items()}
+
+    ref = decode(Engine(model, variables, cfg))
+    for m in meshes:
+        if m == 1:
+            continue
+        if decode(ShardedEngine(model, variables, cfg,
+                                mesh_devices=m)) != ref:
+            return False
+    return True
+
+
 def _run_decode_attention(args, platform: str) -> dict:
     sys.path.insert(0, _bench_dir())
     import decode_attention as da_bench
@@ -446,6 +546,8 @@ def _gate(results: dict, baselines: dict, platform: str,
         # desyncs -> rejects everything) shows up as tokens_per_verify
         # collapsing toward 1; a perf regression in the fused program
         # shows up in the ratio.
+        # Sharded-serving gates (ISSUE 14) live in the serving rows —
+        # see below after the spec gates.
         base_spec = srv_base.get("speculative_decode") or {}
         cur_spec = (results["serving"].get("speculative_decode")
                     or {})
@@ -459,6 +561,30 @@ def _gate(results: dict, baselines: dict, platform: str,
                     "current": cur, "baseline": base, "ratio": ratio,
                     "ok": ratio >= 1.0 - threshold}
         vs["serving"] = rows
+    # Sharded-serving gates (ISSUE 14): greedy parity is a HARD
+    # correctness gate (no baseline needed — bit-identical or the run
+    # fails), and the sharded-vs-single TTFT/TPOT p50 ratios are held
+    # to the committed record within --threshold (lower is better; a
+    # regression means the mesh's collective overhead grew).
+    cur_sh = results.get("sharded_serve")
+    if cur_sh:
+        rows = vs.setdefault("serving", {})
+        par = cur_sh.get("greedy_parity")
+        if par is not None:
+            rows["sharded.greedy_parity"] = {
+                "current": 1.0 if par else 0.0, "baseline": 1.0,
+                "ratio": 1.0 if par else 0.0, "ok": bool(par)}
+        base_sh = (srv_base or {}).get("sharded_serve") or {}
+        for metric in ("ttft_p50_ratio_vs_single",
+                       "tpot_p50_ratio_vs_single"):
+            for m, cur in (cur_sh.get(metric) or {}).items():
+                base = (base_sh.get(metric) or {}).get(m)
+                if base and cur is not None:
+                    ratio = cur / base
+                    rows[f"sharded.{metric}@mesh{m}"] = {
+                        "current": cur, "baseline": base,
+                        "ratio": ratio,
+                        "ok": ratio <= 1.0 + threshold}
     da_base = _platform_slot(baselines.get("decode_attention") or {},
                              platform)
     if "decode_attention" in results and da_base:
@@ -519,7 +645,8 @@ def _update_baseline(path: str, baseline: Optional[dict],
 
 def run(args) -> dict:
     suites = [s.strip() for s in str(args.suites).split(",") if s.strip()]
-    bad_suites = set(suites) - {"serving", "decode_attention"}
+    bad_suites = set(suites) - {"serving", "decode_attention",
+                                "sharded_serve"}
     if bad_suites:
         raise SystemExit(f"unknown suite(s) {sorted(bad_suites)}")
     if args.threshold <= 0:
@@ -529,6 +656,8 @@ def run(args) -> dict:
     results = {}
     if "serving" in suites:
         results["serving"] = _run_serving(args, platform)
+    if "sharded_serve" in suites:
+        results["sharded_serve"] = _run_sharded_serve(args, platform)
     if "decode_attention" in suites:
         results["decode_attention"] = _run_decode_attention(args,
                                                             platform)
@@ -547,10 +676,23 @@ def run(args) -> dict:
         "ok": not regressions,
     }
     if args.update:
-        if "serving" in results:
+        if "serving" in results or "sharded_serve" in results:
+            # The sharded_serve record rides INSIDE the serving slot
+            # (one committed BENCH_serving.json). A partial-suite
+            # --update preserves whatever the other suite committed
+            # last — a serving-only rerun can never drop the sharded
+            # record, and vice versa.
+            prev = _platform_slot(baselines.get("serving") or {},
+                                  platform) or {}
+            slot = (dict(results["serving"]) if "serving" in results
+                    else dict(prev))
+            if "sharded_serve" in results:
+                slot["sharded_serve"] = results["sharded_serve"]
+            elif "sharded_serve" in prev:
+                slot.setdefault("sharded_serve",
+                                prev["sharded_serve"])
             _update_baseline(args.serving_baseline,
-                             baselines["serving"], platform,
-                             results["serving"],
+                             baselines["serving"], platform, slot,
                              "nezha-bench serving sweep")
         if "decode_attention" in results:
             _update_baseline(args.decode_baseline,

@@ -112,6 +112,22 @@ def ckpt_has_scan_trunk(ckpt_dir: str) -> bool:
     return False
 
 
+def gpt2_for_preset(preset: str, *, scan_layers: bool = False):
+    """THE preset -> GPT2 model mapping for every inference CLI
+    (`nezha-generate`, `nezha-serve`, `nezha-reshard` — one site, so
+    the serve/reshard/load paths can never build models with drifting
+    configs or numerics): full decodes bf16 (the checkpoint's training
+    policy), tiny fp32."""
+    from nezha_tpu.models.gpt2 import GPT2, GPT2Config
+    from nezha_tpu.tensor import bf16_policy
+
+    if preset == "full":
+        return GPT2(GPT2Config(scan_layers=scan_layers),
+                    policy=bf16_policy())
+    from nezha_tpu.cli.train import TINY_GPT2_KW
+    return GPT2(GPT2Config(**TINY_GPT2_KW, scan_layers=scan_layers))
+
+
 def load_gpt2_for_inference(args):
     """(model, variables) for the inference CLIs (`nezha-generate`,
     `nezha-serve`) from any of their three weight sources: --hf-dir
@@ -122,8 +138,7 @@ def load_gpt2_for_inference(args):
     compute numerics as the checkpoint's training run."""
     import jax
 
-    from nezha_tpu.models.gpt2 import GPT2, GPT2Config
-    from nezha_tpu.tensor import bf16_policy
+    from nezha_tpu.models.gpt2 import GPT2
 
     if getattr(args, "hf_dir", None):
         import transformers
@@ -140,11 +155,7 @@ def load_gpt2_for_inference(args):
     scan = False
     if getattr(args, "ckpt_dir", None):
         scan = ckpt_has_scan_trunk(args.ckpt_dir)
-    if args.model_preset == "full":
-        model = GPT2(GPT2Config(scan_layers=scan), policy=bf16_policy())
-    else:
-        from nezha_tpu.cli.train import TINY_GPT2_KW
-        model = GPT2(GPT2Config(**TINY_GPT2_KW, scan_layers=scan))
+    model = gpt2_for_preset(args.model_preset, scan_layers=scan)
     if getattr(args, "ckpt_dir", None):
         # Either checkpoint format: dense npz OR the per-shard layout
         # that zero1/gspmd/pp training writes. Generation needs the

@@ -1,0 +1,136 @@
+"""`nezha-reshard` — re-lay a training checkpoint for the serve mesh.
+
+Training topologies (zero1/dp replicas, gspmd meshes, plain npz) lay
+parameters out for throughput; the sharded serve engine
+(``nezha-serve --mesh M``) needs them Megatron head/feature-sharded
+over a 1xM ``tp`` mesh. This entry runs that redistribution standalone
+(``nezha-serve --mesh M --ckpt-dir ...`` invokes the same path
+implicitly at startup):
+
+- loads the newest (or ``--step``) training checkpoint — dense npz
+  (CRC32-verified per leaf against the PR 4 embedded manifest, streamed
+  one leaf at a time so host memory stays bounded by the largest leaf)
+  or the per-shard zero1/gspmd format (each serve-device slice
+  assembled from exactly the stored shards overlapping it);
+- commits every leaf to its serve-mesh ``NamedSharding``;
+- with ``--out DIR``, writes the re-laid state as a serve-topology
+  sharded checkpoint (readable by this tool or ``nezha-serve`` on any
+  later mesh size), and with ``--verify`` reads it back and proves the
+  round trip bitwise.
+
+Corruption is a TYPED refusal (``ReshardError``, exit 1) — a CRC
+mismatch or missing leaf must never become served garbage. RUNBOOK §9
+documents the `serve.reshard` chaos drill.
+
+    nezha-reshard --ckpt-dir runs/gpt2 --mesh 4 --model-preset tiny \
+        --out /ckpts/gpt2.serve4 --verify
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="nezha-reshard", description=__doc__)
+    p.add_argument("--ckpt-dir", required=True,
+                   help="training checkpoint dir (nezha-train npz or "
+                        "sharded format)")
+    p.add_argument("--mesh", type=int, required=True,
+                   help="serve mesh size M (1xM tensor-parallel; "
+                        "num_heads must divide by it)")
+    p.add_argument("--model-preset", choices=["full", "tiny"],
+                   default="full")
+    p.add_argument("--step", type=int, default=None,
+                   help="checkpoint step (default: newest)")
+    p.add_argument("--out", default=None,
+                   help="write the re-laid state as a serve-topology "
+                        "sharded checkpoint here")
+    p.add_argument("--verify", action="store_true",
+                   help="with --out: read the written checkpoint back "
+                        "and prove the round trip bitwise")
+    p.add_argument("--json", action="store_true",
+                   help="print the reshard report as JSON")
+    p.add_argument("--platform", default=None,
+                   help="force a jax platform (e.g. cpu)")
+    return p
+
+
+def run(args) -> int:
+    from nezha_tpu.cli.common import setup_jax
+    setup_jax(args)
+    import jax
+
+    from nezha_tpu.cli.common import gpt2_for_preset
+    from nezha_tpu.parallel.mesh import make_mesh
+    from nezha_tpu.serve.sharded import (ReshardError, reshard_checkpoint,
+                                         save_serve_checkpoint,
+                                         verify_roundtrip)
+
+    if args.mesh < 1:
+        raise SystemExit(f"--mesh must be >= 1, got {args.mesh}")
+    ndev = len(jax.devices())
+    if args.mesh > ndev:
+        raise SystemExit(
+            f"--mesh {args.mesh} but only {ndev} device(s) visible")
+    # The serve model is always the unrolled decode layout;
+    # reshard_checkpoint detects a scan-trunk checkpoint from its
+    # leaves and unstacks it.
+    model = gpt2_for_preset(args.model_preset)
+    if model.cfg.num_heads % args.mesh:
+        # Param placement alone would succeed (feature axes divide),
+        # but no engine can serve the result — producing the artifact
+        # would be a trap, so refuse up front as the help text says.
+        raise SystemExit(
+            f"--mesh {args.mesh}: num_heads={model.cfg.num_heads} not "
+            f"divisible by the mesh — no engine can serve this "
+            f"topology (K/V pools shard on the head axis)")
+    mesh = make_mesh({"tp": args.mesh}, devices=jax.devices()[:args.mesh])
+    try:
+        variables, step = reshard_checkpoint(args.ckpt_dir, model, mesh,
+                                             step=args.step)
+    except ReshardError as e:
+        print(f"nezha-reshard: REFUSED: {e}", file=sys.stderr)
+        return 1
+    report = {"ckpt_dir": args.ckpt_dir, "step": step,
+              "mesh_devices": args.mesh}
+    total = shard = 0
+    dev0 = mesh.devices.flat[0]
+    for leaf in jax.tree_util.tree_leaves(variables):
+        if isinstance(leaf, jax.Array):
+            total += leaf.nbytes
+            shard += sum(s.data.nbytes for s in leaf.addressable_shards
+                         if s.device == dev0)
+    report["params_bytes"] = total
+    report["params_bytes_per_device"] = shard
+    if args.out:
+        path = save_serve_checkpoint(args.out, variables, step)
+        report["out"] = path
+        if args.verify:
+            bad = verify_roundtrip(args.out, variables, step)
+            report["roundtrip_ok"] = not bad
+            if bad:
+                print(f"nezha-reshard: round-trip mismatch on "
+                      f"{len(bad)} leaf/leaves: {bad[:5]}",
+                      file=sys.stderr)
+                return 1
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        rt = (" round-trip OK" if report.get("roundtrip_ok")
+              else "")
+        print(f"resharded step {step} onto a 1x{args.mesh} mesh: "
+              f"{total / 2**20:.2f} MiB total, "
+              f"{shard / 2**20:.2f} MiB/device"
+              + (f" -> {report['out']}" if args.out else "") + rt)
+    return 0
+
+
+def main(argv=None) -> int:
+    return run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -219,6 +219,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "supervisor front end over N engine worker "
                         "processes (requires --http; each worker is "
                         "the single-replica stack on its own port)")
+    p.add_argument("--mesh", type=int, default=1,
+                   help="M > 1 makes each replica an M-device TENSOR-"
+                        "PARALLEL engine (serve/sharded): parameters "
+                        "Megatron-sharded and the paged K/V pools "
+                        "head-sharded across a 1xM mesh, block tables "
+                        "host-side, the frozen program contract "
+                        "preserved per mesh. With --ckpt-dir the "
+                        "train->serve resharding (nezha-reshard) runs "
+                        "implicitly at startup, CRC-verified — a "
+                        "corrupt checkpoint refuses to start. Composes "
+                        "with --replicas: N routed replicas x M-device "
+                        "meshes (docs/RUNBOOK.md §10). Requires "
+                        "kv-layout=paged and num_heads %% M == 0")
     p.add_argument("--replica-backend", choices=["process", "thread"],
                    default="process",
                    help="how workers are hosted: 'process' spawns real "
@@ -267,7 +280,44 @@ def _build_stack(args):
     from nezha_tpu.cli.generate import _load_tokenizer
     from nezha_tpu.serve import Engine, ServeConfig, Scheduler
 
-    model, variables = load_gpt2_for_inference(args)
+    mesh_m = int(getattr(args, "mesh", 1) or 1)
+    if mesh_m > 1 and getattr(args, "ckpt_dir", None):
+        # The implicit nezha-reshard: build the serve mesh first, then
+        # stream the training checkpoint straight into the head-sharded
+        # layout (CRC-verified, one leaf of host memory at a time) —
+        # the full-gather-then-scatter a naive load would do is exactly
+        # what arXiv:2112.01075 exists to avoid. A corrupt or missing
+        # checkpoint is a typed REFUSAL to start, never garbage served.
+        import jax as _jax
+
+        from nezha_tpu.cli.common import gpt2_for_preset
+        from nezha_tpu.parallel.mesh import make_mesh
+        from nezha_tpu.serve.sharded import (ReshardError,
+                                             reshard_checkpoint)
+        model = gpt2_for_preset(args.model_preset)
+        # Engine-topology constraints checked BEFORE the (potentially
+        # minutes-long) checkpoint load — a doomed mesh must refuse in
+        # milliseconds, typed, not traceback after the reshard.
+        if model.cfg.num_heads % mesh_m:
+            raise SystemExit(
+                f"--mesh {mesh_m}: num_heads="
+                f"{model.cfg.num_heads} not divisible by the mesh — "
+                f"K/V pools shard on the head axis")
+        if args.kv_layout != "paged":
+            raise SystemExit(
+                f"--mesh {mesh_m} requires --kv-layout paged (the "
+                f"dense layout has no head-sharded pool)")
+        mesh = make_mesh({"tp": mesh_m},
+                         devices=_jax.devices()[:mesh_m])
+        try:
+            variables, step = reshard_checkpoint(args.ckpt_dir, model,
+                                                 mesh)
+        except ReshardError as e:
+            raise SystemExit(f"--mesh {mesh_m}: reshard refused: {e}")
+        print(f"resharded step {step} from {args.ckpt_dir} onto a "
+              f"1x{mesh_m} serve mesh", file=sys.stderr)
+    else:
+        model, variables = load_gpt2_for_inference(args)
     tokenizer = _load_tokenizer(args)
     from nezha_tpu.cli.common import resolve_eos_id
     eos_id = resolve_eos_id(args.eos_id, tokenizer, model.cfg.vocab_size)
@@ -321,8 +371,21 @@ def _build_stack(args):
         kv_eviction=args.kv_eviction,
         kv_dtype=args.kv_dtype,
         speculative=spec)
-    engine = Engine(model, variables, cfg, draft_model=draft_model,
-                    draft_variables=draft_variables)
+    if mesh_m > 1:
+        from nezha_tpu.serve.sharded import ShardedEngine
+        try:
+            engine = ShardedEngine(model, variables, cfg,
+                                   mesh_devices=mesh_m,
+                                   draft_model=draft_model,
+                                   draft_variables=draft_variables)
+        except ValueError as e:
+            # Topology constraints (heads %% mesh, paged-only, device
+            # count) as the CLI's typed refusal — the non-ckpt paths
+            # reach here without the pre-reshard check above.
+            raise SystemExit(f"--mesh {mesh_m}: {e}")
+    else:
+        engine = Engine(model, variables, cfg, draft_model=draft_model,
+                        draft_variables=draft_variables)
     return Scheduler(engine), tokenizer, eos_id
 
 
@@ -937,6 +1000,7 @@ def _worker_argv(args, rid: int, port: int, role: Optional[str] = None
              "--drain-timeout", str(args.drain_timeout),
              "--trace-sample", str(getattr(args, "trace_sample", 1.0)),
              "--seed", str(args.seed),
+             "--mesh", str(getattr(args, "mesh", 1) or 1),
              "--http", str(port)]
     if args.kv_num_blocks is not None:
         argv += ["--kv-num-blocks", str(args.kv_num_blocks)]

@@ -180,6 +180,40 @@ def _decode_flash_ok(cfg) -> bool:
     return impl == "flash"
 
 
+def _decode_flash_shmap_mesh(cfg):
+    """The enclosing auto-partitioner mesh when the flash-DECODE kernel
+    can run per-shard under a nested ``shard_map`` (the sharded serve
+    engine's path, ops/pallas/decode_attention.py
+    ``flash_decode_attention_sharded``); None otherwise. Same gates as
+    the prefill ``flash_shmap`` idiom — TPU backend, a ``tp`` axis
+    dividing the heads, ``NEZHA_NO_NESTED_KERNELS`` honored — plus the
+    decode kernel's own switches (``decode_impl``, the shared
+    ``attn_impl`` resolution, ``NEZHA_NO_DECODE_KERNEL``).
+    ``decode_impl="kernel"`` honors the force on ANY backend (interpret
+    mode off-TPU, the parity-test path — under the partitioner the raw
+    Mosaic call is never an option, so the nested variant IS the forced
+    kernel). Otherwise, off-TPU the composed masked path simply
+    auto-partitions under the mesh."""
+    import os
+
+    if os.environ.get("NEZHA_NO_DECODE_KERNEL") \
+            or os.environ.get("NEZHA_NO_NESTED_KERNELS"):
+        return None
+    if cfg.decode_impl == "xla":
+        return None
+    if cfg.decode_impl == "auto" and cfg.attn_impl not in ("auto",
+                                                           "flash"):
+        return None
+    if cfg.decode_impl == "kernel":
+        from nezha_tpu.parallel.gspmd import auto_partitioner_mesh
+        mesh = auto_partitioner_mesh()
+        if (mesh is not None and "tp" in mesh.axis_names
+                and cfg.num_heads % mesh.shape["tp"] == 0):
+            return mesh
+        return None
+    return _tp_flash_mesh(cfg.num_heads)
+
+
 def _flash_auto_ok() -> bool:
     """ONE backend policy for every attn_impl='auto' site (train, prefill,
     BERT): compiled flash on TPU, and never under the GSPMD
@@ -576,20 +610,44 @@ class Attention(Module):
                     v.transpose(0, 2, 1, 3).astype(vp.dtype))
         use_decode_kernel = (not prefill and s == 1 and per_row
                              and _decode_flash_ok(cfg))
-        if use_decode_kernel:
+        shmap_mesh = None
+        if not prefill and s == 1 and per_row:
+            from nezha_tpu.parallel.gspmd import under_auto_partitioner
+            if under_auto_partitioner():
+                # Under the sharded serve engine's auto-partitioner
+                # trace the RAW kernel is never an option — a Mosaic
+                # custom call cannot be handed to the partitioner,
+                # forced decode_impl="kernel" included. The nested-
+                # shard_map variant runs it PER SHARD on each device's
+                # head slice (block tables replicated, the training-
+                # side flash_shmap idiom on the decode path); when the
+                # mesh can't host it, the composed path partitions.
+                use_decode_kernel = False
+                shmap_mesh = _decode_flash_shmap_mesh(cfg)
+        if use_decode_kernel or shmap_mesh is not None:
             # The kernel takes the POOLS + table directly (block-table
             # gather operand): rows only DMA table entries below their
             # own length, inactive rows skip every block. Int8 pools
             # add the [N, H] scale operands and the kernel dequantizes
             # inside its block loop — the int8 cache never round-trips
             # through a dense bf16 view.
-            from nezha_tpu.ops.pallas import flash_decode_attention
             lengths = pos + 1
             if active is not None:
                 lengths = jnp.where(active, lengths, 0)
-            out = flash_decode_attention(
-                q, k_pool, v_pool, lengths, block_tables=tab,
-                block_scales=((ks_pool, vs_pool) if quant else None))
+            if shmap_mesh is not None:
+                from nezha_tpu.ops.pallas import (
+                    flash_decode_attention_sharded)
+                out = flash_decode_attention_sharded(
+                    q, k_pool, v_pool, lengths, shmap_mesh,
+                    block_tables=tab,
+                    block_scales=((ks_pool, vs_pool) if quant
+                                  else None))
+            else:
+                from nezha_tpu.ops.pallas import flash_decode_attention
+                out = flash_decode_attention(
+                    q, k_pool, v_pool, lengths, block_tables=tab,
+                    block_scales=((ks_pool, vs_pool) if quant
+                                  else None))
         else:
             # Composed path: gather the rows' blocks into the dense
             # [b, H, L, D] view and run the same masked attention the

@@ -161,6 +161,20 @@ def render_serving_section(summary: Optional[dict]) -> List[str]:
         if qe and qe.get("count"):
             parts.append(f"  quant err p99 {qe['p99']:.2e}")
         lines.append("".join(parts))
+    mesh = gauges.get("serve.mesh.devices", 0)
+    if mesh and mesh >= 2:
+        # Tensor-sharded serving (absent on single-device runs): mesh
+        # size, the per-shard share of resident KV, and the trace-shape
+        # collective-payload estimate the mesh moved.
+        parts = [f"  mesh: {mesh:.0f} devices (head-sharded KV)"]
+        if "serve.kv.bytes_resident" in gauges:
+            per_shard = gauges["serve.kv.bytes_resident"] / mesh / 1024
+            parts.append(f"  {per_shard:.1f} KiB/shard resident")
+        cb = counters.get("serve.mesh.collective_bytes", 0)
+        if cb:
+            parts.append(f"  collectives ~{cb / 2**20:.2f} MiB "
+                         f"(trace-shape est.)")
+        lines.append("".join(parts))
     al = hists.get("serve.spec.accepted_len")
     if al and al.get("count"):
         # Speculative decoding (absent when the knob is off — the

@@ -13,8 +13,12 @@ are Mosaic/Pallas kernels tiled for MXU/VPU and VMEM:
 All kernels run in interpret mode on CPU (tests) and compile on TPU.
 """
 
-from nezha_tpu.ops.pallas.decode_attention import flash_decode_attention
+from nezha_tpu.ops.pallas.decode_attention import (
+    flash_decode_attention,
+    flash_decode_attention_sharded,
+)
 from nezha_tpu.ops.pallas.flash_attention import flash_attention
 from nezha_tpu.ops.pallas.layer_norm import fused_layer_norm
 
-__all__ = ["flash_attention", "flash_decode_attention", "fused_layer_norm"]
+__all__ = ["flash_attention", "flash_decode_attention",
+           "flash_decode_attention_sharded", "fused_layer_norm"]

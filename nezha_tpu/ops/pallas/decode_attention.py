@@ -324,3 +324,67 @@ def flash_decode_attention(q, k, v, lengths,
         interpret=interpret,
         **kwargs,
     )(q, k, v, len2d)
+
+
+def flash_decode_attention_sharded(q, k, v, lengths, mesh, *,
+                                   scale: Optional[float] = None,
+                                   block_tables=None, block_scales=None,
+                                   interpret: Optional[bool] = None):
+    """:func:`flash_decode_attention` PER SHARD under a nested
+    ``shard_map`` over the mesh's ``tp`` (head) axis — the sharded
+    serve engine's decode-attention path.
+
+    Heads are embarrassingly parallel in decode attention (each head's
+    online softmax reads only its own K/V slice), so sharding
+    ``q [B, H, 1, D]``, the K/V block pools ``[N, H, bs, D]``, and the
+    per-(block, head) scale rows ``[N, H]`` on the H axis runs the
+    Mosaic kernel device-locally on an ``H / tp`` slice — the GSPMD
+    auto-partitioner (which cannot partition a Pallas custom call)
+    never sees it, exactly the ``_tp_sharded_flash`` idiom the
+    training path proved. The per-row ``lengths`` and the
+    scalar-prefetched ``block_tables`` REPLICATE: block identities are
+    mesh-invariant host bookkeeping (see serve/sharded/pool.py — and
+    the ``mesh-host-side-tables`` lint rule that keeps it so).
+
+    ``scale`` defaults per shard to ``1/sqrt(D)`` — D is untouched by
+    head sharding, so per-shard defaulting equals the unsharded
+    kernel's. Output is ``[B, H, 1, D]`` sharded on H, matching the
+    enclosing program's head-sharded activations."""
+    from jax.sharding import PartitionSpec as P
+
+    from nezha_tpu.parallel._compat import shard_map
+
+    hspec = P(None, "tp")
+    rep = P()
+
+    if block_scales is not None:
+        ks, vs = block_scales
+
+        def body_q(q_, k_, v_, l_, t_, ks_, vs_):
+            return flash_decode_attention(
+                q_, k_, v_, l_, scale=scale, interpret=interpret,
+                block_tables=t_, block_scales=(ks_, vs_))
+
+        f = shard_map(body_q, mesh=mesh,
+                      in_specs=(hspec, hspec, hspec, rep, rep, hspec,
+                                hspec),
+                      out_specs=hspec)
+        return f(q, k, v, lengths, block_tables, ks, vs)
+    if block_tables is not None:
+        def body_t(q_, k_, v_, l_, t_):
+            return flash_decode_attention(
+                q_, k_, v_, l_, scale=scale, interpret=interpret,
+                block_tables=t_)
+
+        f = shard_map(body_t, mesh=mesh,
+                      in_specs=(hspec, hspec, hspec, rep, rep),
+                      out_specs=hspec)
+        return f(q, k, v, lengths, block_tables)
+
+    def body(q_, k_, v_, l_):
+        return flash_decode_attention(q_, k_, v_, l_, scale=scale,
+                                      interpret=interpret)
+
+    f = shard_map(body, mesh=mesh,
+                  in_specs=(hspec, hspec, hspec, rep), out_specs=hspec)
+    return f(q, k, v, lengths)

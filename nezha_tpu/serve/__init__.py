@@ -75,11 +75,24 @@ it:
   seeded-backoff failover for replicas that die before their response
   begins (a committed stream is never retried — typed error instead).
 
+- ``sharded`` (imported on demand, not at package import): the SECOND
+  scale axis — ``ShardedEngine`` spreads one replica over an M-device
+  1xM ``tp`` mesh (params Megatron-sharded, paged K/V head-sharded,
+  pool bookkeeping host-side and unchanged, the frozen program set
+  traced under the GSPMD auto-partitioner with pinned output
+  shardings), and ``reshard`` streams a training-topology checkpoint
+  into the serve layout with per-leaf CRC verification
+  (``nezha-reshard``; typed ``ReshardError`` = refuse to start).
+  ``--replicas N --mesh M`` composes: N routed replicas x M-device
+  meshes.
+
 ``nezha-serve`` (cli/serve.py) fronts the scheduler with stdio-JSONL and
 stdlib-http modes (``--replicas N`` puts the router/supervisor pair in
-front of N worker processes); ``benchmarks/serving.py`` load-tests it
+front of N worker processes, ``--mesh M`` makes each worker an M-device
+tensor-parallel engine); ``benchmarks/serving.py`` load-tests it
 into the same run-dir telemetry artifacts training writes
-(``--replicas/--kill-rate`` chaos-loads the router).
+(``--replicas/--kill-rate`` chaos-loads the router, ``--mesh`` runs the
+single-replica loops sharded).
 """
 
 from nezha_tpu.serve.engine import (Engine, ServeConfig,

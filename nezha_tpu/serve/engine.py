@@ -367,10 +367,8 @@ class Engine:
         self.paged = cfg.kv_layout == "paged"
         self.kv_quant = cfg.kv_dtype == "int8"
         if self.paged:
-            self.pool = PagedSlotPool(
-                model, cfg.max_batch_size, cfg.max_len, cfg.cache_dtype,
-                block_size=cfg.kv_block_size,
-                num_blocks=cfg.kv_num_blocks,
+            self.pool = self._make_paged_pool(
+                model, num_blocks=cfg.kv_num_blocks,
                 prefix_cache=cfg.prefix_cache, eviction=cfg.kv_eviction,
                 quantized=self.kv_quant)
             # Host mirrors of each row's next write position and
@@ -384,8 +382,7 @@ class Engine:
             self.host_budgets = np.zeros((cfg.max_batch_size,),
                                          np.int64)
         else:
-            self.pool = SlotPool(model, cfg.max_batch_size, cfg.max_len,
-                                 cfg.cache_dtype)
+            self.pool = self._make_dense_pool(model)
         b = cfg.max_batch_size
         self.last_logits = jnp.zeros((b, self.vocab), jnp.float32)
         # [B] bool from the latest step: False where that row's logits
@@ -408,6 +405,9 @@ class Engine:
         # decode_horizon tokens for every row) — tests assert the
         # dispatch-per-token amortization against this.
         self.step_calls = 0
+        # Tokens the most recent prefill's compiled chunks pushed
+        # through the target model (set per prefill call).
+        self.last_prefill_tokens = 0
         # Donate the pooled caches (positional arg 1 in EVERY program):
         # without donation every decoded token would copy the whole
         # [B_max, H, L_max, D] K/V pool per layer just to write one row —
@@ -421,9 +421,10 @@ class Engine:
         # The paged variants take the block tables as one extra operand
         # — shapes are static, so the "1 step + len(prefill_buckets)
         # programs" contract is layout-invariant.
-        self._prefill_fns = {w: _build_prefill(self.model, w,
-                                               paged=self.paged,
-                                               quantized=self.kv_quant)
+        self._prefill_fns = {w: self._wrap_program(
+                                    _build_prefill(self.model, w,
+                                                   paged=self.paged,
+                                                   quantized=self.kv_quant))
                              for w in cfg.prefill_buckets}
         # Speculative decoding: a DRAFT engine rides along — its own
         # model (explicit, or an early-exit self-draft sharing the
@@ -470,18 +471,16 @@ class Engine:
                 # fraction of target bytes) and must NEVER be the
                 # backpressure source — admission budgets are sized
                 # against the target pool alone.
-                self.draft_pool = PagedSlotPool(
-                    dm, cfg.max_batch_size, cfg.max_len,
-                    cfg.cache_dtype, block_size=cfg.kv_block_size,
-                    num_blocks=None, prefix_cache=False,
+                self.draft_pool = self._make_paged_pool(
+                    dm, num_blocks=None, prefix_cache=False,
                     eviction="none", quantized=self.kv_quant)
             else:
-                self.draft_pool = SlotPool(dm, cfg.max_batch_size,
-                                           cfg.max_len, cfg.cache_dtype)
+                self.draft_pool = self._make_dense_pool(dm)
             self.pool.mirror = self.draft_pool
             self.draft_executor = Executor(donate_argnums=(1,))
             self._draft_prefill_fns = {
-                w: _build_draft_prefill(dm, w, paged=self.paged)
+                w: self._wrap_program(
+                    _build_draft_prefill(dm, w, paged=self.paged))
                 for w in cfg.prefill_buckets}
             # Carried residual-distribution flag: True where the row's
             # last_logits hold the rejection residual (already-filtered
@@ -491,13 +490,46 @@ class Engine:
             self.spec_verifies = 0
             self.spec_draft_tokens = 0
             self.spec_accepted = 0
-            self._step_fn = _build_spec_step(
+            self._step_fn = self._wrap_program(_build_spec_step(
                 self.model, dm, self.k_max, cfg.pad_id,
-                cfg.decode_horizon, self.spec.draft_k, paged=self.paged)
+                cfg.decode_horizon, self.spec.draft_k, paged=self.paged))
         else:
-            self._step_fn = _build_step(self.model, self.k_max,
-                                        cfg.pad_id, cfg.decode_horizon,
-                                        paged=self.paged)
+            self._step_fn = self._wrap_program(
+                _build_step(self.model, self.k_max, cfg.pad_id,
+                            cfg.decode_horizon, paged=self.paged))
+
+    # ----------------------------------------------- subsystem hooks
+    # The tensor-sharded engine (serve/sharded/engine.py) specializes
+    # the engine at exactly two seams — where pools are built and where
+    # built programs are handed to the executor — so every other line
+    # of the admission/decode machinery stays layout-blind. Single-
+    # device serving goes through the identity versions below.
+    def _make_paged_pool(self, model, *, num_blocks, prefix_cache,
+                         eviction, quantized):
+        """Paged-pool constructor hook (target AND draft pools route
+        through here). Overridden by the sharded engine to lay the
+        block pools out head-sharded across its mesh."""
+        cfg = self.cfg
+        return PagedSlotPool(
+            model, cfg.max_batch_size, cfg.max_len, cfg.cache_dtype,
+            block_size=cfg.kv_block_size, num_blocks=num_blocks,
+            prefix_cache=prefix_cache, eviction=eviction,
+            quantized=quantized)
+
+    def _make_dense_pool(self, model):
+        """Dense-pool constructor hook (see :meth:`_make_paged_pool`)."""
+        cfg = self.cfg
+        return SlotPool(model, cfg.max_batch_size, cfg.max_len,
+                        cfg.cache_dtype)
+
+    def _wrap_program(self, fn):
+        """Program hook: every built prefill/step program passes through
+        here before it reaches the executor. The sharded engine wraps
+        the trace in ``auto_partitioner_scope(mesh)`` so model code
+        sees the mesh (nested shard_map kernels, no Mosaic under the
+        auto-partitioner); the identity keeps single-device dispatch
+        byte-for-byte what it was."""
+        return fn
 
     # -------------------------------------------------------- host API
     def bucket_for(self, n: int) -> int:
@@ -621,6 +653,12 @@ class Engine:
             self.host_positions[slot] = n
             self.host_budgets[slot] = budget
         obs.counter("serve.prefill.chunks_total").inc(len(chunks))
+        # Tokens the compiled chunks will actually push through the
+        # target model: bucket pads included, a prefix hit's cached
+        # span excluded (and a cold fallback's full re-plan included).
+        # The sharded engine's collective-payload estimate reads this
+        # after the call — prefill_span() would overcount hits.
+        self.last_prefill_tokens = sum(w for _, _, w in chunks)
         qerrs: List[Any] = []
         for off, ln, width in chunks:
             obs.histogram("serve.prefill.bucket_len").observe(width)

@@ -42,6 +42,15 @@ through the pinned fault points ``replica.kv_export`` and
 ``replica.kv_install`` (scheduler-side) and ``router.migrate``
 (router-side); ``serve.kv.migrations_total`` / ``serve.kv.
 migration_bytes`` count committed installs (schema-pinned).
+
+The wire is MESH-BLIND (tensor-sharded serving, serve/sharded): a
+source running a head-sharded pool exports via GATHER-ON-EXPORT — the
+pool's block gather converts to host arrays, which assembles the
+full-head payload from the M shards — and an install scatters into
+whatever mesh the destination runs, so parked prompts migrate between
+replicas of ANY mesh sizes without a protocol change. A per-shard pull
+(M parallel transfers, no gather) is the noted follow-up when transfer
+bandwidth, not protocol simplicity, becomes the bottleneck.
 """
 
 from __future__ import annotations

@@ -197,6 +197,13 @@ def register_serve_instruments() -> None:
     obs.counter("serve.spec.draft_tokens_total")
     obs.counter("serve.spec.accepted_total")
     obs.histogram("serve.spec.accepted_len")
+    # Tensor-sharded serving (serve/sharded, PR 14): the mesh size this
+    # engine spans (1 = classic single-device) and the trace-shape
+    # estimate of cross-shard collective payload (0 off-mesh) — every
+    # serving summary carries both, so dashboards can split fleets by
+    # topology without schema forks.
+    obs.gauge("serve.mesh.devices")
+    obs.counter("serve.mesh.collective_bytes")
     obs.gauge("serve.queue_depth")
     obs.gauge("serve.batch_occupancy")
     obs.histogram("serve.ttft_s")
@@ -269,6 +276,11 @@ class Scheduler:
         obs.gauge("serve.kv.quant_bits").set(
             8 if pool.quantized
             else 8 * int(np.dtype(pool.dtype).itemsize))
+        # 1 for the classic engine; the sharded engine set M already at
+        # its own construction — re-set here so the gauge is correct
+        # whichever was built first.
+        obs.gauge("serve.mesh.devices").set(
+            getattr(engine, "mesh_devices", 1))
 
     # ------------------------------------------------------- admission
     def submit(self, req: Request) -> str:

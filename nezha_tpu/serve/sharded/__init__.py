@@ -1,0 +1,38 @@
+"""Tensor-sharded serving: one replica spread over an M-device mesh.
+
+The subsystem behind ``nezha-serve --mesh M`` and ``nezha-reshard``:
+
+- :class:`~nezha_tpu.serve.sharded.engine.ShardedEngine` — the
+  frozen-program engine with parameters Megatron-sharded and the paged
+  K/V pools head-sharded across a 1xM ``tp`` mesh; block tables and
+  every other piece of pool bookkeeping stay host-side and
+  layout-identical to the single-device engine.
+- :class:`~nezha_tpu.serve.sharded.pool.ShardedPagedSlotPool` — one
+  logical block pool, M physical shards; ``bytes_resident_per_shard``
+  is the per-device budget instrument.
+- :mod:`~nezha_tpu.serve.sharded.reshard` — train-topology checkpoint
+  -> serve-mesh parameters, streamed one leaf at a time with CRC
+  verification; typed :class:`ReshardError` means the engine refuses
+  to start rather than serving garbage.
+
+Composes with the other scale axis: ``--replicas N --mesh M`` = N
+routed replicas x M-device meshes (the router never sees the mesh).
+"""
+
+from nezha_tpu.serve.sharded.engine import ShardedEngine
+from nezha_tpu.serve.sharded.pool import ShardedPagedSlotPool
+from nezha_tpu.serve.sharded.reshard import (
+    ReshardError,
+    place_variables,
+    reshard_checkpoint,
+    save_serve_checkpoint,
+    serve_shardings,
+    serve_tp_rules,
+    verify_roundtrip,
+)
+
+__all__ = [
+    "ShardedEngine", "ShardedPagedSlotPool", "ReshardError",
+    "place_variables", "reshard_checkpoint", "save_serve_checkpoint",
+    "serve_shardings", "serve_tp_rules", "verify_roundtrip",
+]

@@ -1,0 +1,383 @@
+"""Train-topology checkpoint -> serve-mesh parameters, one leaf at a time.
+
+A training run lays parameters out for THROUGHPUT (zero1/dp replicas,
+gspmd meshes, or a plain single-host npz); the sharded serve engine
+needs them laid out for LATENCY — Megatron head/feature-sharded over a
+1xM ``tp`` mesh. This module is the bridge (the portable-redistribution
+problem of arXiv:2112.01075, serving edition):
+
+- :func:`serve_tp_rules` / :func:`serve_shardings` — the serve-side
+  partition specs, derived from the SAME ``GPT2_TP_RULES`` table
+  training uses (no second table to drift), with one serving-specific
+  relaxation: the vocab-sharded embedding falls back to replication
+  when ``vocab_size % M != 0`` (GPT-2's 50257 divides none of 2/4/8 —
+  jit sharding requires exact divisibility, and the decode path is not
+  embedding-bound).
+- :func:`place_variables` — commit an in-memory variables tree onto the
+  mesh per those specs (the ``--random-init`` / already-loaded path).
+- :func:`reshard_checkpoint` — the STREAMING path behind
+  ``nezha-reshard`` and ``nezha-serve --mesh M --ckpt-dir ...``: walk
+  the serve template one leaf at a time, read that leaf from the
+  training checkpoint (dense npz: lazy per-entry decompress, CRC32-
+  verified against the PR 4 embedded manifest; sharded dirs: assembled
+  per-device-slice from the overlapping stored shards via
+  ``make_array_from_callback``, so no host ever materializes more than
+  the slices it feeds), and ``device_put`` it straight into its
+  head-sharded ``NamedSharding``. Host memory stays bounded by the
+  largest single leaf, never the model.
+- :func:`save_serve_checkpoint` / :func:`verify_roundtrip` — write the
+  re-laid parameters as a serve-topology sharded checkpoint (per-shard
+  npz, COMPLETE-marker committed) and prove the round trip bitwise.
+
+Failure is typed end to end: a missing leaf, a CRC32 mismatch, a torn
+npz, or an injected ``serve.reshard`` fault all surface as
+:class:`ReshardError` — the engine REFUSES TO START rather than serving
+garbage weights (the drill RUNBOOK §9 documents). The whole load runs
+under the schema-pinned ``serve.reshard_s`` span.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from pathlib import Path
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nezha_tpu import faults, obs
+from nezha_tpu.parallel.gspmd import GPT2_TP_RULES, param_specs_from_rules
+
+
+class ReshardError(RuntimeError):
+    """Typed reshard failure: checkpoint missing/corrupt (torn npz, CRC
+    mismatch, absent leaf), geometry mismatch, or an injected
+    ``serve.reshard`` fault. The sharded engine refuses to start on it
+    — a half-loaded model must never reach the decode loop."""
+
+
+def serve_tp_rules(model_cfg, mesh_devices: int):
+    """The serving partition-rule table: ``GPT2_TP_RULES`` verbatim,
+    except the vocab-sharded embedding replicates when the vocab does
+    not divide the mesh (jit shardings require exact divisibility;
+    attention/MLP weights — the bulk of the bytes — still shard)."""
+    rules = []
+    for pat, spec in GPT2_TP_RULES:
+        if (pat == r"^wte/embedding$"
+                and model_cfg.vocab_size % max(int(mesh_devices), 1)):
+            rules.append((pat, P()))
+        else:
+            rules.append((pat, spec))
+    return rules
+
+
+def serve_shardings(params: Any, mesh: Mesh, rules) -> Any:
+    """Pytree of ``NamedSharding``s matching ``params`` (array leaves
+    or ShapeDtypeStructs) under the serve rules."""
+    specs = param_specs_from_rules(params, rules)
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def place_variables(variables: Any, mesh: Mesh, rules) -> Any:
+    """Commit a variables tree onto the serve mesh: params per the rule
+    table, model state replicated. Idempotent — re-placing an
+    already-committed tree is a no-op device_put."""
+    shardings = serve_shardings(variables["params"], mesh, rules)
+    params = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, s), variables["params"], shardings)
+    state = jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, P())),
+        variables.get("state", {}))
+    return {"params": params, "state": state}
+
+
+# ------------------------------------------------------- leaf plumbing
+def _leaf_key(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _nest(flat: dict) -> dict:
+    """``{"a/b/c": leaf}`` -> nested dicts (host-side; the scan-trunk
+    fallback's unstack input)."""
+    out: dict = {}
+    for key, val in flat.items():
+        node = out
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = val
+    return out
+
+
+def _template_variables(model):
+    """Shape/dtype-only serve template (no weights materialized):
+    ``jax.eval_shape`` over the model's own init."""
+    return jax.eval_shape(model.init, jax.random.PRNGKey(0))
+
+
+# --------------------------------------------------- the streaming load
+def reshard_checkpoint(ckpt_dir: str, model, mesh: Mesh, *,
+                       step: Optional[int] = None,
+                       rules=None) -> Tuple[Any, int]:
+    """Load a training-topology checkpoint and re-lay it onto the serve
+    mesh, one leaf at a time -> ``(variables, step)`` with every param
+    committed to its head-sharded ``NamedSharding``.
+
+    Sources, in order: the dense npz format (``train/checkpoint.py`` —
+    CRC32-verified per leaf against the embedded PR 4 manifest as it
+    streams) and the per-shard format (``train/sharded_checkpoint.py``
+    — zero1/dp/gspmd saves, each serve-side device slice assembled from
+    exactly the stored shards that overlap it). Scan-layers trunks
+    (``h_scan``) take a verified non-streaming fallback: restore, then
+    unstack to the unrolled decode layout the serve engine runs.
+
+    Raises :class:`ReshardError` on ANY integrity or geometry problem —
+    the engine must refuse to start, not serve garbage."""
+    from nezha_tpu.train import checkpoint as ckpt
+    from nezha_tpu.train import sharded_checkpoint as sckpt
+
+    try:
+        faults.point("serve.reshard")
+    except faults.InjectedFault as e:
+        raise ReshardError(f"injected reshard fault: {e}") from e
+    if rules is None:
+        rules = serve_tp_rules(model.cfg, int(mesh.shape.get("tp", 1)))
+    template = _template_variables(model)
+    shardings = serve_shardings(template["params"], mesh, rules)
+    with obs.span("serve.reshard_s", ckpt_dir=str(ckpt_dir),
+                  mesh=int(mesh.shape.get("tp", 1))) as sp:
+        dense_step = (step if step is not None
+                      else ckpt.latest_step(ckpt_dir))
+        npz = (os.path.join(ckpt_dir, f"step_{dense_step:08d}.npz")
+               if dense_step is not None else None)
+        if npz is not None and os.path.exists(npz):
+            out = _reshard_npz(npz, template, shardings, mesh, rules,
+                               model)
+            sp.set(source="npz", step=int(dense_step))
+            return out, int(dense_step)
+        sstep = step if step is not None else sckpt.latest_step(ckpt_dir)
+        sdir = (os.path.join(ckpt_dir, f"step_{sstep:08d}.sharded")
+                if sstep is not None else None)
+        if sdir is not None and os.path.isdir(sdir):
+            out = _reshard_sharded_dir(sdir, template, shardings, mesh)
+            sp.set(source="sharded", step=int(sstep))
+            return out, int(sstep)
+        raise ReshardError(
+            f"no training checkpoint (npz or sharded) in {ckpt_dir!r}")
+
+
+def _reshard_npz(path: str, template, shardings, mesh, rules, model):
+    """Stream one dense-npz checkpoint onto the mesh. ``np.load`` is a
+    lazy zip reader — each leaf decompresses on access, so host memory
+    is bounded by the largest leaf. Every leaf's bytes are CRC32-
+    checked against the embedded manifest BEFORE they are committed to
+    a device (manifest-less pre-PR-4 saves load with a stderr-free
+    pass — nothing to verify against)."""
+    from nezha_tpu.train.checkpoint import MANIFEST_KEY
+
+    try:
+        z = np.load(path)
+    except Exception as e:
+        raise ReshardError(
+            f"{os.path.basename(path)}: unreadable "
+            f"({type(e).__name__}: {e})") from e
+    try:
+        files = set(z.files)
+        manifest = None
+        if MANIFEST_KEY in files:
+            try:
+                manifest = json.loads(str(z[MANIFEST_KEY]))["leaves"]
+            except Exception as e:
+                raise ReshardError(
+                    f"{os.path.basename(path)}: unreadable embedded "
+                    f"manifest ({type(e).__name__}: {e})") from e
+        if any("h_scan" in k for k in files):
+            return _reshard_scan_npz(z, manifest, files, mesh, rules,
+                                     model)
+
+        def read_leaf(key: str) -> np.ndarray:
+            # TrainState layout ("variables/params/...") or the
+            # graph-engine layout ("params/...").
+            for cand in (f"variables/{key}", key):
+                if cand in files:
+                    arr = z[cand]
+                    if manifest is not None:
+                        meta = manifest.get(cand)
+                        if meta is None:
+                            raise ReshardError(
+                                f"leaf {cand!r} missing from the "
+                                f"checkpoint manifest")
+                        crc = zlib.crc32(np.ascontiguousarray(
+                            arr).tobytes()) & 0xFFFFFFFF
+                        if crc != meta["crc32"]:
+                            raise ReshardError(
+                                f"CRC32 mismatch for leaf {cand!r} — "
+                                f"checkpoint corrupt, refusing to "
+                                f"serve it")
+                    return arr
+            raise ReshardError(f"checkpoint missing leaf {key!r}")
+
+        return _stream_leaves(template, shardings, mesh, read_leaf)
+    finally:
+        z.close()
+
+
+def _reshard_scan_npz(z, manifest, files, mesh, rules, model):
+    """Scan-layers fallback: the checkpoint's trunk is stacked under
+    ``h_scan`` while the serve template is unrolled (``h0..hN``), so
+    leaf-by-leaf streaming cannot key-match. Verify + load the params
+    (CRC per leaf), unstack ONCE on host, then place — host memory
+    briefly holds the trunk, the documented cost of this layout."""
+    from nezha_tpu.models.gpt2 import unstack_layer_params
+
+    flat = {}
+    for key in files:
+        if not (key.startswith("variables/params/")
+                or key.startswith("params/")):
+            continue
+        arr = z[key]
+        if manifest is not None:
+            meta = manifest.get(key)
+            crc = zlib.crc32(
+                np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+            if meta is None or crc != meta["crc32"]:
+                raise ReshardError(
+                    f"CRC32 mismatch for leaf {key!r} — checkpoint "
+                    f"corrupt, refusing to serve it")
+        flat[key.split("params/", 1)[1]] = arr
+    params = _nest(flat)
+    params = unstack_layer_params(params, model.cfg.num_layers)
+    return place_variables({"params": params, "state": {}}, mesh, rules)
+
+
+def _reshard_sharded_dir(sdir: str, template, shardings, mesh):
+    """Per-shard training save -> serve mesh: each serve device's slice
+    is assembled from exactly the stored shards overlapping it
+    (``_ShardStore.read``), then committed via
+    ``make_array_from_callback`` — the memory-bounded redistribution
+    move of arXiv:2112.01075. The format carries COMPLETE markers, not
+    CRCs; a missing/incomplete process file surfaces typed."""
+    from nezha_tpu.train.sharded_checkpoint import _ShardStore
+
+    try:
+        store = _ShardStore(Path(sdir))
+    except Exception as e:
+        raise ReshardError(
+            f"{os.path.basename(sdir)}: unreadable shard store "
+            f"({type(e).__name__}: {e})") from e
+    try:
+        def read_leaf(key: str):
+            for cand in (f"variables/{key}", key):
+                if cand in store.leaves:
+                    return cand
+            raise ReshardError(f"checkpoint missing leaf {key!r}")
+
+        leaves_t, treedef = jax.tree_util.tree_flatten_with_path(
+            template)
+        shard_leaves = jax.tree_util.tree_leaves(
+            shardings, is_leaf=lambda x: isinstance(x, NamedSharding))
+        # Template order: params leaves first, then state — shardings
+        # only covers params; state leaves replicate.
+        placed = []
+        state_sh = NamedSharding(mesh, P())
+        n_params = len(shard_leaves)
+        for i, (path, leaf) in enumerate(leaves_t):
+            key = _leaf_key(path)
+            cand = read_leaf(key)
+            entry = store.leaves[cand]
+            if tuple(entry["shape"]) != tuple(leaf.shape):
+                raise ReshardError(
+                    f"shape mismatch for {key!r}: serve template "
+                    f"{tuple(leaf.shape)} vs saved "
+                    f"{tuple(entry['shape'])}")
+            sh = shard_leaves[i] if i < n_params else state_sh
+            try:
+                arr = jax.make_array_from_callback(
+                    tuple(leaf.shape), sh,
+                    lambda idx, k=cand, dt=leaf.dtype:
+                        store.read(k, idx).astype(dt))
+            except ValueError as e:
+                raise ReshardError(
+                    f"stored shards do not cover {key!r}: {e}") from e
+            # Own the bytes (see restore_sharded): a zero-copy alias of
+            # the callback's host buffer must never meet a donating
+            # program.
+            placed.append(arr.copy())
+        return jax.tree_util.tree_unflatten(treedef, placed)
+    finally:
+        store.close()
+
+
+def _stream_leaves(template, shardings, mesh, read_leaf):
+    """Walk the serve template leaf-by-leaf: read (verified) host
+    bytes, cast to the template dtype, commit to the leaf's serve
+    sharding, drop the host copy — bounded by one leaf."""
+    leaves_t, treedef = jax.tree_util.tree_flatten_with_path(template)
+    shard_leaves = jax.tree_util.tree_leaves(
+        shardings, is_leaf=lambda x: isinstance(x, NamedSharding))
+    state_sh = NamedSharding(mesh, P())
+    n_params = len(shard_leaves)
+    placed = []
+    for i, (path, leaf) in enumerate(leaves_t):
+        key = _leaf_key(path)
+        arr = read_leaf(key)
+        if tuple(arr.shape) != tuple(leaf.shape):
+            raise ReshardError(
+                f"shape mismatch for {key!r}: serve template "
+                f"{tuple(leaf.shape)} vs saved {tuple(arr.shape)}")
+        sh = shard_leaves[i] if i < n_params else state_sh
+        placed.append(jax.device_put(
+            np.asarray(arr).astype(leaf.dtype), sh))
+    return jax.tree_util.tree_unflatten(treedef, placed)
+
+
+# ----------------------------------------------------- serve-side save
+def save_serve_checkpoint(out_dir: str, variables: Any,
+                          step: int) -> str:
+    """Write the re-laid parameters as a serve-topology sharded
+    checkpoint (per-shard npz + COMPLETE markers — the
+    ``train/sharded_checkpoint.py`` format, readable by
+    :func:`reshard_checkpoint` on ANY later mesh size, including 1)."""
+    from nezha_tpu.train import sharded_checkpoint as sckpt
+    return sckpt.save_sharded(out_dir, {"variables": variables}, step)
+
+
+def verify_roundtrip(out_dir: str, variables: Any,
+                     step: int) -> List[str]:
+    """Bitwise round-trip proof for ``nezha-reshard --verify``: read the
+    serve-topology save back and compare every leaf against the live
+    re-laid parameters. -> list of mismatched leaf keys (empty =
+    round trip exact)."""
+    from nezha_tpu.train.sharded_checkpoint import _ShardStore
+
+    store = _ShardStore(Path(out_dir) / f"step_{step:08d}.sharded")
+    bad: List[str] = []
+    try:
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                variables)[0]:
+            key = f"variables/{_leaf_key(path)}"
+            if key not in store.leaves:
+                bad.append(key)
+                continue
+            full = tuple(slice(0, n) for n in leaf.shape)
+            stored = store.read(key, full)
+            live = np.asarray(jax.device_get(leaf))
+            if stored.tobytes() != live.astype(stored.dtype).tobytes():
+                bad.append(key)
+    finally:
+        store.close()
+    return bad

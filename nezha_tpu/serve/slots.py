@@ -748,7 +748,10 @@ class PagedSlotPool:
         per-block amax/254 bound — the same bound
         ``serve.kv.quant_error`` samples). Export is read-only: the
         source's refs are untouched — release is the ACK's job
-        (two-phase handoff, serve/migrate.py)."""
+        (two-phase handoff, serve/migrate.py). On a head-sharded pool
+        (serve/sharded) the host conversion IS the gather: the wire
+        payload always carries full heads, whatever mesh the source
+        ran (gather-on-export)."""
         if not 1 <= nblocks <= int(self._bound[slot]):
             raise ValueError(
                 f"cannot export {nblocks} block(s) from slot {slot}: "

@@ -138,6 +138,65 @@ def test_host_sync_pallas_partial_binding(tmp_path):
     assert [f.symbol for f in found] == ["_kernel"]
 
 
+# -------------------------------------------- mesh-host-side-tables rule
+def test_mesh_host_side_tables_rule_fixture(tmp_path):
+    """The sharded-serving split: host-side pool bookkeeping
+    (block tables / free list / trie) must never mutate inside a
+    shard_map-lowered body — including transitively-called helpers —
+    while reads of an uploaded copy, host-side mutation, and mutation
+    inside a PLAIN jit body stay legal."""
+    index = _tree(tmp_path, {"sharded.py": """
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+
+        class Pool:
+            def bind(self, slot):                # host-side: legal
+                self.tables_host[slot, 0] = 1
+                self._free_blocks.pop()
+
+        def _helper(pool, b):
+            pool._refs[b] += 1                   # finding (transitive)
+
+        def run(pool, mesh, caches, tables):
+            def body(c, t):
+                pool.tables_host[0, 0] = 9       # finding: table write
+                pool._free_blocks.append(3)      # finding: mutator call
+                pool.trie.insert([1], [2], None) # finding: trie mutate
+                _helper(pool, 0)
+                row = t[0]                       # READ of upload: legal
+                return c + row
+            f = shard_map(body, mesh=mesh, in_specs=None,
+                          out_specs=None)
+            return f(caches, tables)
+    """})
+    found = _rule_findings(index, "mesh-host-side-tables")
+    assert {f.detail for f in found} == {"tables_host", "_free_blocks",
+                                         "trie", "_refs"}
+    assert {f.symbol for f in found} == {"run.body", "_helper"}
+    # Negative twin: the same mutations outside any shard_map body.
+    clean = _tree(tmp_path / "neg", {"host.py": """
+        import jax
+
+        class Pool:
+            def free(self, slot):
+                self.tables_host[slot, :] = 0
+                self._free_blocks.append(slot)
+
+        @jax.jit
+        def step(caches, tables):
+            return caches                        # jit body, no mutation
+    """})
+    assert _rule_findings(clean, "mesh-host-side-tables") == []
+
+
+def test_mesh_host_side_tables_real_tree_clean():
+    """The real serving tree honors the split: the engine's shard_map
+    surfaces (nested flash kernels, the sharded engine's programs)
+    never touch the host bookkeeping."""
+    index = SourceIndex(_ROOT, roots=("nezha_tpu",), extra_files=())
+    assert _rule_findings(index, "mesh-host-side-tables") == []
+
+
 # -------------------------------------------------- traced-branch rule
 def test_traced_branch_rule_fixture(tmp_path):
     index = _tree(tmp_path, {"branchy.py": """

@@ -702,8 +702,9 @@ def test_fault_point_registry_pinned():
     full set, including the multi-replica points (router.route /
     router.probe / supervisor.spawn / replica.exec), the paged-KV
     bind point (serve.kv.bind), and the migration points
-    (router.migrate / replica.kv_export / replica.kv_install), and
-    the speculative verify point (serve.spec.verify)."""
+    (router.migrate / replica.kv_export / replica.kv_install), the
+    speculative verify point (serve.spec.verify), and the train->serve
+    resharding point (serve.reshard)."""
     from check_fault_points import EXPECTED_POINTS, check, find_points
 
     assert check(_ROOT) == []
@@ -716,5 +717,6 @@ def test_fault_point_registry_pinned():
         "serve.kv.bind",
         "router.migrate", "replica.kv_export", "replica.kv_install",
         "serve.spec.verify",
+        "serve.reshard",
     }
     assert set(find_points(_ROOT)) == set(EXPECTED_POINTS)
