@@ -103,6 +103,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "capacity-at-equal-memory knob; the record "
                         "reports peak resident bytes so equal-byte "
                         "budgets compare directly")
+    p.add_argument("--kv-host-blocks", type=int, default=0,
+                   help="paged int8: host KV spill tier budget in "
+                        "blocks — evicted prefix-cache blocks demote "
+                        "to host RAM and promote back on a returning "
+                        "prefix hit (0 = off); the record reports "
+                        "demotions/promotions and host-tier peaks")
+    p.add_argument("--churn-users", type=int, default=0,
+                   help="multi-tenant churn scenario (the kv_churn "
+                        "suite's traffic shape): N > 0 cycles requests "
+                        "over N 'users', each with a fixed block-"
+                        "aligned prompt prefix + a fresh per-visit "
+                        "tail — sized so device blocks CYCLE between "
+                        "a user's visits, a revisit is served from "
+                        "the host tier (promote) when --kv-host-blocks "
+                        "is set and from a cold re-prefill when not; "
+                        "the record splits TTFT by first visit vs "
+                        "revisit. Run with --concurrency 1: the "
+                        "scenario's eviction cadence assumes visits "
+                        "issue sequentially (nothing enforces it)")
+    p.add_argument("--churn-prefix-len", type=int, default=None,
+                   help="churn: per-user prefix length in tokens "
+                        "(default 4 KV blocks); must be block-aligned "
+                        "for the full prefix to be cacheable")
     p.add_argument("--prefix-cache", choices=["on", "off"], default="on",
                    help="paged: shared-prefix prefill reuse on/off")
     p.add_argument("--shared-prefix-frac", type=float, default=0.0,
@@ -327,7 +350,9 @@ def _run_one(args, model, variables, decode_horizon: int,
         kv_layout=args.kv_layout, kv_block_size=args.kv_block_size,
         kv_num_blocks=args.kv_num_blocks,
         prefix_cache=args.prefix_cache == "on",
-        kv_dtype=args.kv_dtype, speculative=spec)
+        kv_dtype=args.kv_dtype,
+        kv_host_blocks=getattr(args, "kv_host_blocks", 0),
+        speculative=spec)
     mesh_m = int(getattr(args, "mesh", 1) or 1)
     if mesh_m > 1:
         from nezha_tpu.serve.sharded import ShardedEngine
@@ -350,6 +375,37 @@ def _run_one(args, model, variables, decode_horizon: int,
     # classified with the misses: classification reads the live trie,
     # so a would-be seeder that never ran (queue-full drop, injected
     # prefill error before registration) doesn't misfile its successor.
+    # Multi-tenant churn (the kv_churn scenario): U users, each with a
+    # fixed block-aligned prefix, revisited round-robin — request i is
+    # user i % U on visit i // U. The pool is expected to be sized so
+    # device blocks cycle between a user's visits (the bench harness
+    # picks kv_num_blocks ~ 2 users' prefixes): with a host tier the
+    # revisit PROMOTES its demoted blocks and prefills one tail chunk;
+    # without one it re-prefills cold. TTFT splits by first visit vs
+    # revisit are the record.
+    churn_users = int(getattr(args, "churn_users", 0) or 0)
+    churn_round = {}                   # request_id -> visit index
+    churn_plen = 0
+    churn_prefixes = []
+    if churn_users:
+        if args.shared_prefix_frac > 0:
+            raise SystemExit("--churn-users and --shared-prefix-frac "
+                             "are separate scenarios — pick one")
+        churn_plen = args.churn_prefix_len or 4 * args.kv_block_size
+        if churn_plen % args.kv_block_size:
+            raise SystemExit(
+                f"--churn-prefix-len {churn_plen} must be a multiple "
+                f"of --kv-block-size {args.kv_block_size} (only full "
+                f"blocks are cacheable/demotable)")
+        if churn_plen + 2 + args.max_new_tokens > args.max_len:
+            raise SystemExit(
+                f"--churn-prefix-len {churn_plen} + tail 2 + "
+                f"max_new_tokens {args.max_new_tokens} exceeds "
+                f"--max-len {args.max_len}")
+        churn_prefixes = [[rng.randrange(vocab)
+                           for _ in range(churn_plen)]
+                          for _ in range(churn_users)]
+
     shared_prefix = []
     expected_hit = {}                  # request_id -> bool
     if args.shared_prefix_frac > 0:
@@ -378,6 +434,17 @@ def _run_one(args, model, variables, decode_horizon: int,
     def make_request(i: int) -> Request:
         sampled = rng.random() < args.sample_fraction
         rid = f"bench-{i}"
+        if churn_users:
+            u = i % churn_users
+            prompt = churn_prefixes[u] + [rng.randrange(vocab),
+                                          rng.randrange(vocab)]
+            churn_round[rid] = i // churn_users
+            prompt_len_of[rid] = len(prompt)
+            return Request(prompt=prompt,
+                           max_new_tokens=args.max_new_tokens,
+                           temperature=0.8 if sampled else 0.0,
+                           top_k=40 if sampled else None,
+                           seed=i, request_id=rid)
         if shared_prefix and rng.random() < args.shared_prefix_frac:
             prompt = shared_prefix + [rng.randrange(vocab),
                                       rng.randrange(vocab)]
@@ -418,11 +485,21 @@ def _run_one(args, model, variables, decode_horizon: int,
     sched.run_until_idle()
     if engine.paged:
         # Warmup must not leak into the measured record: drop its
-        # cached blocks and zero the reuse counters so prefix_hit_rate
-        # and blocks-resident peaks describe the measured load only.
+        # cached blocks (and any host-demoted ones) and zero the reuse
+        # counters so prefix_hit_rate, blocks-resident peaks, and the
+        # demote/promote ledgers describe the measured load only.
         engine.pool.clear_prefix_cache()
         engine.pool.prefix_hits = 0
         engine.pool.cow_copies = 0
+        if engine.pool.host_blocks:
+            # Warm the demote/promote maintenance programs too — the
+            # first eviction-demotion or promote-hit of the measured
+            # load must not pay their compiles inside a TTFT window.
+            engine.pool.warm_host_tier_programs()
+            engine.pool.clear_host_tier()
+            engine.pool.demotions = 0
+            engine.pool.promotions = 0
+            engine.pool.promote_failures = 0
 
     # Chaos mode: a seeded probabilistic plan armed AFTER warmup (a
     # faulted warmup would skip compiling a bucket program) injecting
@@ -457,17 +534,20 @@ def _run_one(args, model, variables, decode_horizon: int,
     # per-decode occupancy into the metric.batch_occupancy histogram.)
     t0 = time.monotonic()
     issued = finished = dropped = 0
-    peak_resident = peak_blocks = 0
+    peak_resident = peak_blocks = peak_host_blocks = 0
 
     def _track_peaks():
         # The paged-pool occupancy claim: how many requests were
         # RESIDENT (decoding concurrently) and how many KV blocks that
         # took — dense reserves worst-case rows, paged only what's
         # written, so at equal device memory paged peaks strictly
-        # higher on under-max_len traffic.
-        nonlocal peak_resident, peak_blocks
+        # higher on under-max_len traffic. The host-tier peak rides
+        # along (0 without a tier).
+        nonlocal peak_resident, peak_blocks, peak_host_blocks
         peak_resident = max(peak_resident, len(sched._live))
         peak_blocks = max(peak_blocks, engine.pool.blocks_used)
+        peak_host_blocks = max(peak_host_blocks,
+                               engine.pool.host_blocks_used)
 
     try:
         if args.mode == "closed":
@@ -590,6 +670,15 @@ def _run_one(args, model, variables, decode_horizon: int,
             "prefix_cache": args.prefix_cache == "on",
             "prefix_hits": getattr(engine.pool, "prefix_hits", 0),
             "cow_copies": getattr(engine.pool, "cow_copies", 0),
+            # Host spill tier (all 0 when --kv-host-blocks is off):
+            # the demote/promote ledgers plus the tier's peak
+            # occupancy — "promotions tracking demotions" is the
+            # churn scenario's health signature.
+            "host_blocks": engine.pool.host_blocks,
+            "demotions": engine.pool.demotions,
+            "promotions": engine.pool.promotions,
+            "promote_failures": engine.pool.promote_failures,
+            "peak_host_blocks_used": peak_host_blocks,
             "peak_resident_requests": peak_resident,
             "peak_blocks_used": peak_blocks,
             # Peak device bytes the resident KV held — the number the
@@ -624,6 +713,37 @@ def _run_one(args, model, variables, decode_horizon: int,
             "accept_rate": accepted / drafted if drafted else 0.0,
             "tokens_per_verify": ((accepted + verifies) / verifies
                                   if verifies else 0.0),
+        }
+    if churn_users:
+        # TTFT by first visit vs revisit over clean finishes: a first
+        # visit is a cold prefill by construction; a revisit is served
+        # from whatever tier still holds the user's prefix — device
+        # trie (fast), host tier via promote (the tentpole's win), or
+        # nothing (cold again — the no-host-tier control). The
+        # revisit/first p50 ratio is the kv_churn suite's gated
+        # number, and promotions > 0 is what proves the host tier (not
+        # lucky device residency) served the revisits.
+        first = [r.ttft_s for r in clean
+                 if churn_round.get(r.request_id) == 0
+                 and r.ttft_s is not None]
+        revisit = [r.ttft_s for r in clean
+                   if churn_round.get(r.request_id, 0) > 0
+                   and r.ttft_s is not None]
+        p_first = _percentiles(first or [0.0])
+        p_revisit = _percentiles(revisit or [0.0])
+        record["kv_churn"] = {
+            "users": churn_users,
+            "visits_per_user": -(-args.requests // churn_users),
+            "prefix_len": churn_plen,
+            "host_blocks": engine.pool.host_blocks,
+            "demotions": engine.pool.demotions,
+            "promotions": engine.pool.promotions,
+            "promote_failures": engine.pool.promote_failures,
+            "prefix_hits": getattr(engine.pool, "prefix_hits", 0),
+            "ttft_first_visit_s": p_first,
+            "ttft_revisit_s": p_revisit,
+            "revisit_vs_first_ttft_p50": (
+                p_revisit["p50"] / max(p_first["p50"], 1e-9)),
         }
     if shared_prefix:
         # TTFT by hit/miss over clean finishes: the prefix-reuse win is

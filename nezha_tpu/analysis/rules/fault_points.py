@@ -50,6 +50,12 @@ EXPECTED_POINTS = frozenset({
     # slot/block leaks in either pool), an error rule raises typed
     # InjectedFault into the scheduler's bounded-retry envelope.
     "serve.spec.verify",
+    # Tiered KV host spill (serve/slots.py): armed at the start of
+    # every host->device block promotion — an injected error degrades
+    # the request to a cold prefill (typed, counted in the pool's
+    # promote_failures ledger), never an error surfaced to the client
+    # and never a leaked block on either tier.
+    "serve.kv.promote",
     # Train->serve checkpoint resharding (serve/sharded/reshard.py):
     # armed at the start of every reshard — an injected error surfaces
     # as the same typed ReshardError a corrupt/missing leaf produces,

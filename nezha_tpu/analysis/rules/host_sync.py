@@ -15,7 +15,13 @@ for a chaos test to flake three PRs later.
 Scope: every function :mod:`nezha_tpu.analysis.traced` identifies as
 traced — jit-decorated, handed to scan/while_loop/pallas_call, the
 serve engine's ``_build_*`` program closures, and their in-module
-helpers."""
+helpers.
+
+The rule also pins the tiered-KV contract: the paged pool's HOST-TIER
+buffers (``_host_tier`` and friends — plain numpy, host RAM) are pool
+maintenance and must never be touched inside a traced body; promotion
+is an async copy dispatched BEFORE the prefill programs, not state the
+programs read."""
 
 from __future__ import annotations
 
@@ -59,11 +65,23 @@ _HOST_DOTTED = {
 # device-tainted value (float(0.5) literals and closure scalars stay
 # legal inside traced code).
 _CONCRETIZERS = {"float", "int", "bool", "complex"}
+# Host-tier KV buffers (the paged pool's demoted-block store,
+# serve/slots.py): plain numpy in an OrderedDict, readable only from
+# host code. ANY touch inside a traced body — read or write — is
+# wrong twice over: it executes at trace time only (a silent no-op in
+# steady state, exactly like print), and promotion/demotion are
+# host-side pool maintenance by contract (the frozen-program set must
+# never grow a host dependency). Attribute ACCESS is flagged, not just
+# calls — `caches[...] = pool._host_tier[key]` has no call to catch.
+_HOST_TIER_ATTRS = {"_host_tier", "host_blocks_used",
+                    "host_bytes_resident", "clear_host_tier",
+                    "_demote", "_promote"}
 
 
 @rule("host-sync-in-hot-path",
       "no host sync/IO (block_until_ready, .item(), float()/np.asarray "
-      "on device values, print/open/time) inside traced program bodies")
+      "on device values, print/open/time) and no host-tier KV buffer "
+      "access inside traced program bodies")
 def check(index: SourceIndex) -> List[Finding]:
     findings: List[Finding] = []
     for mod in index:
@@ -76,6 +94,19 @@ def check(index: SourceIndex) -> List[Finding]:
             tainted = device_tainted(fn, include_params=False)
             qual = index.qualname(mod, fn)
             for node in walk_own(fn, set(traced)):
+                if (isinstance(node, ast.Attribute)
+                        and node.attr in _HOST_TIER_ATTRS):
+                    findings.append(Finding(
+                        file=mod.rel, line=node.lineno,
+                        rule="host-sync-in-hot-path",
+                        symbol=qual, detail=f".{node.attr}",
+                        message=(f"host-tier KV buffer `.{node.attr}` "
+                                 f"touched inside traced function "
+                                 f"{qual or '<module>'} ({reason}) — "
+                                 f"the host spill tier is host-side "
+                                 f"pool maintenance, never compiled "
+                                 f"program state")))
+                    continue
                 if not isinstance(node, ast.Call):
                     continue
                 name = dotted_name(node.func)

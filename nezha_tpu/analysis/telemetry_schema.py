@@ -66,6 +66,12 @@ _SERVE_COUNTERS = {"serve.admitted_total", "serve.rejected_total",
                    # homogeneous run reports 0s, never omits them.
                    "serve.kv.migrations_total",
                    "serve.kv.migration_bytes",
+                   # Tiered KV host spill (PR 15): trie blocks demoted
+                   # to host RAM on eviction / promoted back on a
+                   # returning prefix hit. Knob-invariant: runs with
+                   # no host tier report 0s, never omit them.
+                   "serve.kv.demotions_total",
+                   "serve.kv.promotions_total",
                    # Speculative decoding (PR 13): draft tokens
                    # proposed and accepted across all verify windows.
                    # Knob-invariant: a non-speculative run reports 0s,
@@ -85,6 +91,10 @@ _SERVE_GAUGES = {"serve.queue_depth", "serve.batch_occupancy",
                  # Layout/dtype-invariant: every serving run reports
                  # them.
                  "serve.kv.bytes_resident", "serve.kv.quant_bits",
+                 # Tiered KV host spill (PR 15): occupancy of the
+                 # host-side LRU of demoted blocks (0 without a tier).
+                 "serve.kv.host_blocks_used",
+                 "serve.kv.host_bytes_resident",
                  # Tensor-sharded serving (PR 14): the mesh size this
                  # engine spans (1 = classic single-device engine).
                  "serve.mesh.devices"}
@@ -166,6 +176,10 @@ _PINNED_SPANS = {
     # resharding window (nezha-reshard / nezha-serve --mesh startup) —
     # attrs carry source format, step, and mesh size.
     "serve.reshard_s",
+    # Tiered KV host spill (PR 15): one span per host->device
+    # promotion — the async-copy window dispatched ahead of the
+    # bucketed prefill (attrs carry the block count).
+    "serve.kv.promote_s",
 }
 
 # Namespaces whose METRIC names (counter/gauge/histogram) the source

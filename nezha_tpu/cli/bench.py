@@ -48,12 +48,17 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--suites", default="serving,decode_attention",
                    help="comma-separated subset of "
-                        "{serving, decode_attention, sharded_serve}. "
-                        "sharded_serve (mesh 1 vs 2 vs 4 at equal "
-                        "total memory + the bit-identical greedy-"
+                        "{serving, decode_attention, sharded_serve, "
+                        "kv_churn}. sharded_serve (mesh 1 vs 2 vs 4 at "
+                        "equal total memory + the bit-identical greedy-"
                         "parity gate) is opt-in: it needs forced host "
                         "devices off-TPU and its runtime is a "
-                        "multiple of the serving sweep's")
+                        "multiple of the serving sweep's. kv_churn "
+                        "(many users revisiting after their KV blocks "
+                        "cycled — the tiered-KV host-spill record) is "
+                        "opt-in: its hard gate pins promote-hit TTFT "
+                        "at <= 0.5x the cold prefill, a latency ratio "
+                        "that wants a quiet machine")
     p.add_argument("--serving-baseline", default="BENCH_serving.json",
                    help="committed serving record to gate against")
     p.add_argument("--decode-baseline",
@@ -439,6 +444,67 @@ def _sharded_greedy_parity(meshes) -> bool:
     return True
 
 
+def _run_kv_churn(args, platform: str) -> dict:
+    """The tiered-KV churn suite (ISSUE 15): U users with distinct
+    block-aligned prompt prefixes revisit round-robin, against a
+    device pool deliberately sized to hold only ~2 users' cached
+    prefixes — between a user's visits their trie blocks are LRU-
+    evicted, so a revisit is a cold re-prefill UNLESS the host tier
+    caught the demotion and promotes it back. Two runs at identical
+    shapes: host tier ON (the promote path) and OFF (the cold-
+    re-prefill control). The acceptance gate is within the HOST run:
+    revisit (promote-hit) TTFT p50 <= 0.5x first-visit (cold) TTFT
+    p50, with promotions > 0 proving the tier — not lucky device
+    residency — served the revisits."""
+    sys.path.insert(0, _bench_dir())
+    import serving as serving_bench
+
+    # One proven shape for quick and full (full just churns longer):
+    # 64-token prefixes over 16-token int8 blocks against a 13-usable-
+    # block device pool — ~2 users' cached prefixes fit, so a user's
+    # blocks are always evicted (demoted) before their next visit. The
+    # cold prefill is 9 chunks of 8; the promote hit is a 4-block
+    # host->device copy + ONE tail chunk.
+    users, rounds = (4, 3) if args.quick else (6, 4)
+    common = ["--requests", str(users * rounds), "--concurrency", "1",
+              "--churn-users", str(users),
+              "--churn-prefix-len", "64",
+              "--kv-block-size", "16", "--kv-dtype", "int8",
+              "--kv-num-blocks", "14",
+              "--max-batch-size", "2", "--max-prefill-len", "8",
+              "--max-len", "80", "--max-new-tokens", "4",
+              "--sample-fraction", "0",
+              "--platform", platform]
+    host_budget = 32
+    host = serving_bench.run(serving_bench.build_parser().parse_args(
+        common + ["--kv-host-blocks", str(host_budget)]))
+    ctrl = serving_bench.run(serving_bench.build_parser().parse_args(
+        common + ["--kv-host-blocks", "0"]))
+    hc, cc = host["kv_churn"], ctrl["kv_churn"]
+    return {
+        "load": f"{users} users x {rounds} visits, 64-token prefixes "
+                f"over 16-token int8 blocks, 13-usable-block device "
+                f"pool, host budget {host_budget}",
+        "host_tier": host,
+        "control_no_host_tier": ctrl,
+        "demotions": hc["demotions"],
+        "promotions": hc["promotions"],
+        "promote_failures": hc["promote_failures"],
+        # The gated headline: promote-hit TTFT vs the SAME run's cold
+        # first visits (identical prompt shapes, same machine state).
+        "promote_vs_cold_ttft_p50": hc["revisit_vs_first_ttft_p50"],
+        # The control's revisits re-prefill cold (any device-trie
+        # survivors only flatter it), so this ratio shows what the
+        # tier is worth end to end. Recorded, not gated — two separate
+        # runs' latencies divide noisily on CPU.
+        "control_revisit_vs_first_ttft_p50":
+            cc["revisit_vs_first_ttft_p50"],
+        "revisit_ttft_p50_host_vs_control": (
+            hc["ttft_revisit_s"]["p50"]
+            / max(cc["ttft_revisit_s"]["p50"], 1e-9)),
+    }
+
+
 def _run_decode_attention(args, platform: str) -> dict:
     sys.path.insert(0, _bench_dir())
     import decode_attention as da_bench
@@ -566,6 +632,31 @@ def _gate(results: dict, baselines: dict, platform: str,
     # fails), and the sharded-vs-single TTFT/TPOT p50 ratios are held
     # to the committed record within --threshold (lower is better; a
     # regression means the mesh's collective overhead grew).
+    # Tiered-KV churn gates (ISSUE 15): promote-hit TTFT must be at
+    # most half the cold-prefill TTFT (the acceptance pin — a hard
+    # gate, no baseline needed), and promotions must be nonzero (a
+    # ratio earned by device-trie luck instead of the host tier would
+    # otherwise pass vacuously). Baseline drift of the ratio is
+    # additionally held to --threshold when a committed record exists.
+    cur_ch = results.get("kv_churn")
+    if cur_ch:
+        rows = vs.setdefault("serving", {})
+        ratio = cur_ch.get("promote_vs_cold_ttft_p50")
+        if ratio is not None:
+            rows["kv_churn.promote_vs_cold_ttft_p50"] = {
+                "current": ratio, "baseline": 0.5,
+                "ratio": ratio / 0.5, "ok": ratio <= 0.5}
+        promos = cur_ch.get("promotions", 0)
+        rows["kv_churn.promotions"] = {
+            "current": float(promos), "baseline": 1.0,
+            "ratio": float(promos), "ok": promos > 0}
+        base_ch = (srv_base or {}).get("kv_churn") or {}
+        base_ratio = base_ch.get("promote_vs_cold_ttft_p50")
+        if base_ratio and ratio is not None:
+            rows["kv_churn.promote_vs_cold_ttft_p50_vs_baseline"] = {
+                "current": ratio, "baseline": base_ratio,
+                "ratio": ratio / base_ratio,
+                "ok": ratio / base_ratio <= 1.0 + threshold}
     cur_sh = results.get("sharded_serve")
     if cur_sh:
         rows = vs.setdefault("serving", {})
@@ -646,7 +737,7 @@ def _update_baseline(path: str, baseline: Optional[dict],
 def run(args) -> dict:
     suites = [s.strip() for s in str(args.suites).split(",") if s.strip()]
     bad_suites = set(suites) - {"serving", "decode_attention",
-                                "sharded_serve"}
+                                "sharded_serve", "kv_churn"}
     if bad_suites:
         raise SystemExit(f"unknown suite(s) {sorted(bad_suites)}")
     if args.threshold <= 0:
@@ -658,6 +749,8 @@ def run(args) -> dict:
         results["serving"] = _run_serving(args, platform)
     if "sharded_serve" in suites:
         results["sharded_serve"] = _run_sharded_serve(args, platform)
+    if "kv_churn" in suites:
+        results["kv_churn"] = _run_kv_churn(args, platform)
     if "decode_attention" in suites:
         results["decode_attention"] = _run_decode_attention(args,
                                                             platform)
@@ -676,21 +769,22 @@ def run(args) -> dict:
         "ok": not regressions,
     }
     if args.update:
-        if "serving" in results or "sharded_serve" in results:
-            # The sharded_serve record rides INSIDE the serving slot
-            # (one committed BENCH_serving.json). A partial-suite
-            # --update preserves whatever the other suite committed
-            # last — a serving-only rerun can never drop the sharded
-            # record, and vice versa.
+        if ("serving" in results or "sharded_serve" in results
+                or "kv_churn" in results):
+            # The sharded_serve and kv_churn records ride INSIDE the
+            # serving slot (one committed BENCH_serving.json). A
+            # partial-suite --update preserves whatever the other
+            # suites committed last — a serving-only rerun can never
+            # drop the sharded or churn record, and vice versa.
             prev = _platform_slot(baselines.get("serving") or {},
                                   platform) or {}
             slot = (dict(results["serving"]) if "serving" in results
                     else dict(prev))
-            if "sharded_serve" in results:
-                slot["sharded_serve"] = results["sharded_serve"]
-            elif "sharded_serve" in prev:
-                slot.setdefault("sharded_serve",
-                                prev["sharded_serve"])
+            for rider in ("sharded_serve", "kv_churn"):
+                if rider in results:
+                    slot[rider] = results[rider]
+                elif rider in prev:
+                    slot.setdefault(rider, prev[rider])
             _update_baseline(args.serving_baseline,
                              baselines["serving"], platform, slot,
                              "nezha-bench serving sweep")

@@ -143,6 +143,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="paged layout: when the free list runs dry, "
                         "evict LRU prefix-cache blocks (lru) or go "
                         "straight to typed backpressure (none)")
+    p.add_argument("--kv-host-blocks", type=int, default=0,
+                   help="host KV spill tier (requires --kv-dtype int8 "
+                        "+ --kv-eviction lru): evicted prefix-cache "
+                        "blocks demote their int8+scales payload into "
+                        "a host-RAM LRU of up to N blocks instead of "
+                        "being discarded, and a returning prefix hit "
+                        "promotes them back with an async host-to-"
+                        "device copy ahead of the prefill — turn-N+1 "
+                        "chat traffic pays one tail chunk, not a cold "
+                        "prefill; /healthz reports the tier's "
+                        "occupancy (docs/RUNBOOK.md §8). 0 = off")
     p.add_argument("--speculative", action="store_true",
                    help="speculative decoding: a cheap DRAFT model "
                         "proposes --draft-k tokens per window, one "
@@ -370,6 +381,7 @@ def _build_stack(args):
         prefix_cache=args.prefix_cache == "on",
         kv_eviction=args.kv_eviction,
         kv_dtype=args.kv_dtype,
+        kv_host_blocks=args.kv_host_blocks,
         speculative=spec)
     if mesh_m > 1:
         from nezha_tpu.serve.sharded import ShardedEngine
@@ -705,7 +717,12 @@ def run_http(scheduler, args, tokenizer, eos_id, port: int,
                 "queued": scheduler.queue_depth,
                 "occupancy": pool.occupancy,
                 "role": getattr(args, "role", "both"),
-                "parked": scheduler.parked_count})
+                "parked": scheduler.parked_count,
+                # Host spill tier occupancy (0/0 when --kv-host-blocks
+                # is off or the layout is dense): what the router's
+                # replica table and operators size the tier against.
+                "host_blocks": pool.host_blocks,
+                "host_blocks_used": pool.host_blocks_used})
 
         def do_POST(self):
             from nezha_tpu.serve import migrate
@@ -997,6 +1014,7 @@ def _worker_argv(args, rid: int, port: int, role: Optional[str] = None
              "--kv-dtype", args.kv_dtype,
              "--prefix-cache", args.prefix_cache,
              "--kv-eviction", args.kv_eviction,
+             "--kv-host-blocks", str(args.kv_host_blocks),
              "--drain-timeout", str(args.drain_timeout),
              "--trace-sample", str(getattr(args, "trace_sample", 1.0)),
              "--seed", str(args.seed),

@@ -161,6 +161,20 @@ def render_serving_section(summary: Optional[dict]) -> List[str]:
         if qe and qe.get("count"):
             parts.append(f"  quant err p99 {qe['p99']:.2e}")
         lines.append("".join(parts))
+        demoted = counters.get("serve.kv.demotions_total", 0)
+        promoted = counters.get("serve.kv.promotions_total", 0)
+        host_used = gauges.get("serve.kv.host_blocks_used", 0)
+        if demoted or promoted or host_used:
+            # Host spill tier (absent when kv_host_blocks is 0 or the
+            # run never churned): blocks currently parked in host RAM,
+            # and the demote/promote traffic — a healthy churn load
+            # shows promotions tracking demotions (returning users hit
+            # the tier) rather than demotions alone (a write-only
+            # spill buys nothing).
+            lines.append(
+                f"  kv host tier: {host_used:.0f} blocks resident "
+                f"({gauges.get('serve.kv.host_bytes_resident', 0) / 1024:.1f} "
+                f"KiB)  {demoted:.0f} demoted  {promoted:.0f} promoted")
     mesh = gauges.get("serve.mesh.devices", 0)
     if mesh and mesh >= 2:
         # Tensor-sharded serving (absent on single-device runs): mesh

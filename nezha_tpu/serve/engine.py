@@ -194,6 +194,17 @@ class ServeConfig:
     kv_num_blocks: Optional[int] = None
     prefix_cache: bool = True
     kv_eviction: str = "lru"
+    # Host tier (0 = off): when kv_eviction="lru" reclaims a trie-only
+    # block, demote its int8 payload + per-block scales into a
+    # host-RAM LRU of up to this many blocks instead of discarding it;
+    # a later trie hit whose blocks were demoted promotes them back
+    # with an async host->device copy dispatched ahead of the bucketed
+    # prefill, so a returning chat user pays one tail chunk instead of
+    # a full cold prefill. Requires the paged layout, kv_dtype="int8"
+    # (demotion moves the lossless wire-format bytes verbatim), and
+    # prefix_cache — host RAM typically holds ~100x the device's
+    # resident conversations at int8 (docs/RUNBOOK.md §8).
+    kv_host_blocks: int = 0
     # KV storage dtype. "bf16" (default) stores blocks in cache_dtype —
     # bit-identical to the pre-quantization engine. "int8" (paged
     # layout only) stores K/V blocks as int8 with one fp32 absmax
@@ -245,6 +256,27 @@ class ServeConfig:
             raise ValueError(
                 "kv_dtype='int8' requires kv_layout='paged' (scales "
                 "are per-block state; the dense pool has no blocks)")
+        if self.kv_host_blocks < 0:
+            raise ValueError(
+                f"kv_host_blocks must be >= 0, got "
+                f"{self.kv_host_blocks}")
+        if self.kv_host_blocks:
+            if self.kv_layout != "paged" or self.kv_dtype != "int8":
+                raise ValueError(
+                    "kv_host_blocks requires kv_layout='paged' and "
+                    "kv_dtype='int8' — the host tier demotes the "
+                    "int8+scales block payload verbatim (lossless); "
+                    "a bf16 tier would serve quantize-dequant blocks "
+                    "that differ from a fresh prefill")
+            if not self.prefix_cache:
+                raise ValueError(
+                    "kv_host_blocks requires prefix_cache (demotion "
+                    "feeds off trie eviction)")
+            if self.kv_eviction != "lru":
+                raise ValueError(
+                    "kv_host_blocks requires kv_eviction='lru' "
+                    "(demotion IS the eviction path; 'none' never "
+                    "evicts, so the tier would be inert)")
         if self.decode_horizon < 1:
             raise ValueError(
                 f"decode_horizon must be >= 1, got {self.decode_horizon}")
@@ -370,7 +402,8 @@ class Engine:
             self.pool = self._make_paged_pool(
                 model, num_blocks=cfg.kv_num_blocks,
                 prefix_cache=cfg.prefix_cache, eviction=cfg.kv_eviction,
-                quantized=self.kv_quant)
+                quantized=self.kv_quant,
+                host_blocks=cfg.kv_host_blocks)
             # Host mirrors of each row's next write position and
             # remaining token budget (set at prefill, advanced/decayed
             # by the block's emitted count): the lazy block binder must
@@ -505,16 +538,18 @@ class Engine:
     # of the admission/decode machinery stays layout-blind. Single-
     # device serving goes through the identity versions below.
     def _make_paged_pool(self, model, *, num_blocks, prefix_cache,
-                         eviction, quantized):
+                         eviction, quantized, host_blocks=0):
         """Paged-pool constructor hook (target AND draft pools route
-        through here). Overridden by the sharded engine to lay the
-        block pools out head-sharded across its mesh."""
+        through here — the draft always passes ``host_blocks=0``: its
+        pool keeps no prefix cache, so there is nothing to demote).
+        Overridden by the sharded engine to lay the block pools out
+        head-sharded across its mesh."""
         cfg = self.cfg
         return PagedSlotPool(
             model, cfg.max_batch_size, cfg.max_len, cfg.cache_dtype,
             block_size=cfg.kv_block_size, num_blocks=num_blocks,
             prefix_cache=prefix_cache, eviction=eviction,
-            quantized=quantized)
+            quantized=quantized, host_blocks=host_blocks)
 
     def _make_dense_pool(self, model):
         """Dense-pool constructor hook (see :meth:`_make_paged_pool`)."""
@@ -624,7 +659,13 @@ class Engine:
             # Prefix reuse: take references on cached blocks covering
             # the prompt's full-block prefix (capped at n-1 — the last
             # token always re-runs so its logits seed decoding), then
-            # bind/COW everything the planned chunks will write.
+            # bind/COW everything the planned chunks will write. With a
+            # host tier the bind also PROMOTES host-demoted blocks: the
+            # async host->device scatter is dispatched inside this call
+            # — ahead of every chunk dispatch below — so the partial-
+            # prefix chunk programs start from the promoted span and
+            # queue behind the copy on the device stream (dataflow
+            # through pool.caches orders them; no host sync anywhere).
             start = self.pool.bind_for_prompt(slot, tokens.tolist())
         chunks = self._plan_chunks(n, start)
         if self.paged:

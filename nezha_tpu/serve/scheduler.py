@@ -181,6 +181,14 @@ def register_serve_instruments() -> None:
     obs.counter("serve.kv.migrations_total")
     obs.counter("serve.kv.migration_bytes")
     obs.gauge("serve.kv.blocks_used")
+    # Tiered KV host spill (PR 15): trie blocks demoted to host RAM on
+    # eviction instead of discarded, and blocks promoted back on a
+    # returning prefix hit; occupancy gauges for the host-side LRU.
+    # Layout/knob-invariant 0s on runs without a host tier.
+    obs.counter("serve.kv.demotions_total")
+    obs.counter("serve.kv.promotions_total")
+    obs.gauge("serve.kv.host_blocks_used")
+    obs.gauge("serve.kv.host_bytes_resident")
     # KV quantization instruments (schema-pinned, layout/dtype
     # invariant): device bytes the resident KV actually holds (the
     # capacity lever int8 moves), the storage width in bits (8 = int8,
@@ -377,6 +385,10 @@ class Scheduler:
                 self.engine.pool.blocks_used)
             obs.gauge("serve.kv.bytes_resident").set(
                 self.engine.pool.bytes_resident)
+            obs.gauge("serve.kv.host_blocks_used").set(
+                self.engine.pool.host_blocks_used)
+            obs.gauge("serve.kv.host_bytes_resident").set(
+                self.engine.pool.host_bytes_resident)
             return emitted
 
     def run_until_idle(self, max_iters: Optional[int] = None) -> int:
@@ -447,9 +459,15 @@ class Scheduler:
                 # Admission budget is FREE BLOCKS, not free slots: only
                 # admit the queue head if its worst-case (no prefix
                 # hit) prefill binding fits the free list plus what
-                # cache eviction could reclaim. Otherwise wait — live
-                # rows retire and release blocks, and FIFO order holds
-                # (skipping ahead would starve long prompts).
+                # cache eviction could reclaim. The worst case also
+                # COVERS a host-tier promotion: a promoted span
+                # allocates exactly the device blocks a cold prefill
+                # of that span would have bound (promotion substitutes
+                # a host->device copy for recompute, never extra
+                # footprint), so promotable requests need no separate
+                # budget line. Otherwise wait — live rows retire and
+                # release blocks, and FIFO order holds (skipping ahead
+                # would starve long prompts).
                 need = self.engine.prefill_blocks_needed(
                     len(self._queue[0].req.prompt))
                 if pool.available_blocks() < need:

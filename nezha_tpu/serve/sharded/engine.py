@@ -139,13 +139,14 @@ class ShardedEngine(Engine):
 
     # ----------------------------------------------- subsystem hooks
     def _make_paged_pool(self, model, *, num_blocks, prefix_cache,
-                         eviction, quantized):
+                         eviction, quantized, host_blocks=0):
         cfg = self.cfg
         return ShardedPagedSlotPool(
             model, cfg.max_batch_size, cfg.max_len, cfg.cache_dtype,
             mesh=self.mesh, block_size=cfg.kv_block_size,
             num_blocks=num_blocks, prefix_cache=prefix_cache,
-            eviction=eviction, quantized=quantized)
+            eviction=eviction, quantized=quantized,
+            host_blocks=host_blocks)
 
     def _make_dense_pool(self, model):
         raise ValueError("the sharded engine has no dense pool")

@@ -59,7 +59,7 @@ class ShardedPagedSlotPool(PagedSlotPool):
                  dtype=jnp.bfloat16, *, mesh: Mesh,
                  block_size: int = 16, num_blocks: Optional[int] = None,
                  prefix_cache: bool = True, eviction: str = "lru",
-                 quantized: bool = False):
+                 quantized: bool = False, host_blocks: int = 0):
         if "tp" not in mesh.axis_names:
             raise ValueError(
                 f"serve mesh must carry a 'tp' axis, got "
@@ -69,10 +69,15 @@ class ShardedPagedSlotPool(PagedSlotPool):
             raise ValueError(
                 f"num_heads={model.cfg.num_heads} not divisible by the "
                 f"mesh's tp={tp} — the KV pools shard on the head axis")
+        # The host tier composes unchanged: demotion is the migration
+        # export gather (gather-on-export assembles full heads from
+        # the shards) and promotion the migration install scatter
+        # (XLA partitions the leading-axis write along the untouched
+        # head axis), so one host payload format serves every mesh.
         super().__init__(model, capacity, max_len, dtype,
                          block_size=block_size, num_blocks=num_blocks,
                          prefix_cache=prefix_cache, eviction=eviction,
-                         quantized=quantized)
+                         quantized=quantized, host_blocks=host_blocks)
         self.mesh = mesh
         self._kv_sharding = NamedSharding(mesh, P(None, "tp"))
         self.caches = self._place(self.caches)

@@ -38,6 +38,33 @@ cache alone) are evicted LRU-first; past that, binding raises the typed
 a crash. The ``serve.kv.bind`` fault point arms the same path for
 chaos plans.
 
+**Host tier** (``ServeConfig.kv_host_blocks``, int8 pools only): LRU
+eviction normally *discards* a cached block, so a chat user returning
+for turn N+1 after device blocks cycle pays a full cold prefill. With
+a host budget configured, an evicted trie block is DEMOTED instead —
+its int8 payload + per-(block, head) scales (already the migration
+wire format, so the copy is lossless and bit-identical) land in a
+host-side LRU keyed by the block's full prompt-prefix token path.
+``bind_for_prompt`` then extends a device trie match through the host
+tier: consecutively host-cached blocks past the device-matched prefix
+are PROMOTED back — fresh ref == 1 allocations (the write invariant
+holds by construction), the wire payload scattered in by the same
+device op migration installs with, the blocks re-indexed in the trie,
+and the requesting slot referencing them like any other prefix hit.
+The scatter is DISPATCHED before any host bookkeeping (the engine's
+``copy_to_host_async``-then-bookkeep idiom, reversed), so the bucketed
+prefill chunks that follow queue behind the host→device copy instead
+of the host ever blocking on it — promotion is pure data movement and
+adds NO compiled programs. Promotion is exclusive (the host entry
+moves, it is not copied), a failed promote (pool exhausted mid-alloc,
+or the ``serve.kv.promote`` fault point) degrades to a cold prefill —
+typed, counted, never an error surfaced to the request — and
+``leak_check`` audits the host tier's books (entry count vs budget,
+byte accounting, per-entry geometry) next to the device ref counts.
+At int8, host RAM holds ~100x the device's resident conversations —
+this is what makes shared-prefix reuse survive real multi-tenant
+churn instead of only back-to-back templated bursts.
+
 Stale-KV reuse invariant (regression-tested for both layouts): freeing
 a slot/block is bookkeeping only — stale K/V stays in the buffers, and
 that is safe by construction because a new occupant's prefill
@@ -54,9 +81,10 @@ attended, because the row's own ``lengths`` stop at its content.
 
 from __future__ import annotations
 
+import collections
 import itertools
 import math
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -89,6 +117,14 @@ class SlotPool:
 
     paged = False
     quantized = False
+    # Host-tier accounting, layout-invariant (the serve.kv.host_*
+    # gauges report 0 for dense pools, never go missing).
+    host_blocks = 0
+    host_blocks_used = 0
+    host_bytes_resident = 0
+    demotions = 0
+    promotions = 0
+    promote_failures = 0
 
     def __init__(self, model, capacity: int, max_len: int,
                  dtype=jnp.bfloat16):
@@ -279,7 +315,8 @@ class PrefixTrie:
             children = node.children
         return inserted
 
-    def evict(self, want: int, release, only=None) -> int:
+    def evict(self, want: int, release, only=None,
+              on_evict: Optional[Callable] = None) -> int:
         """Drop up to ``want`` cached blocks, leaf-first and LRU-first
         within the leaves (a parent only becomes evictable once its
         children are gone — evicting an interior node would orphan the
@@ -287,8 +324,13 @@ class PrefixTrie:
         candidates — the pool passes "ref count is exactly 1" so
         eviction only ever destroys entries whose release actually
         FREES a block (a leaf still bound by a live prefix-hit request
-        would free nothing). ``release(block)`` drops the trie's
-        reference. -> nodes actually evicted."""
+        would free nothing). ``on_evict(path_tokens, block)``, when
+        given, runs for each victim BEFORE its release, with the full
+        root-to-node token path — the pool's host-tier demotion hook
+        (the block still holds the node's content here: full blocks
+        are immutable and ref == 1 means nobody else can write it).
+        ``release(block)`` drops the trie's reference. -> nodes
+        actually evicted."""
         evicted = 0
         while evicted < want:
             leaves = [n for n in self._leaves
@@ -297,9 +339,23 @@ class PrefixTrie:
                 break
             victim = min(leaves, key=lambda n: n.tick)
             self._remove(victim)
+            if on_evict is not None:
+                on_evict(self._path_tokens(victim), victim.block)
             release(victim.block)
             evicted += 1
         return evicted
+
+    @staticmethod
+    def _path_tokens(node: _TrieNode) -> Tuple[int, ...]:
+        """The full root-to-``node`` token path — the prompt prefix
+        whose K/V the node's block (with its ancestors') holds. The
+        host tier keys on this, never on the node's own block tokens
+        alone: a block's content depends on every preceding token."""
+        parts: List[Tuple[int, ...]] = []
+        while node is not None:
+            parts.append(node.tokens)
+            node = node.parent
+        return tuple(t for tok in reversed(parts) for t in tok)
 
     def clear(self, release) -> int:
         """Drop every cached block (the ``prefix_cache`` off-switch /
@@ -452,7 +508,7 @@ class PagedSlotPool:
                  dtype=jnp.bfloat16, *, block_size: int = 16,
                  num_blocks: Optional[int] = None,
                  prefix_cache: bool = True, eviction: str = "lru",
-                 quantized: bool = False):
+                 quantized: bool = False, host_blocks: int = 0):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         if max_len < 1:
@@ -462,6 +518,22 @@ class PagedSlotPool:
         if eviction not in ("lru", "none"):
             raise ValueError(
                 f"eviction must be 'lru' or 'none', got {eviction!r}")
+        if host_blocks < 0:
+            raise ValueError(
+                f"host_blocks must be >= 0, got {host_blocks}")
+        if host_blocks and not quantized:
+            # The host tier stores the pool's native block bytes, and
+            # only int8 blocks ARE the wire format (lossless round
+            # trip). A bf16 tier would silently serve quantize-dequant
+            # blocks that differ from a fresh prefill — refuse rather
+            # than make hit-vs-miss results diverge.
+            raise ValueError(
+                "host_blocks requires a quantized (int8) pool — the "
+                "demoted payload is the int8+scales block verbatim")
+        if host_blocks and not prefix_cache:
+            raise ValueError(
+                "host_blocks requires prefix_cache (demotion feeds off "
+                "trie eviction; without the trie the tier is inert)")
         self.capacity = capacity
         self.max_len = max_len
         self.dtype = dtype
@@ -521,6 +593,18 @@ class PagedSlotPool:
         self.trie = PrefixTrie(block_size)
         self.cow_copies = 0
         self.prefix_hits = 0
+        # Host tier (0 = disabled): demoted blocks' int8+scales wire
+        # payloads, LRU-ordered (oldest first), keyed by the FULL
+        # prompt-prefix token path that block's K/V encodes. One entry
+        # is one block: per-layer {"k","v","k_scale","v_scale"} host
+        # arrays shaped [1, H, bs, D] / [1, H].
+        self.host_blocks = host_blocks
+        self._host_tier: "collections.OrderedDict[Tuple[int, ...], list]" \
+            = collections.OrderedDict()
+        self._host_bytes = 0
+        self.demotions = 0
+        self.promotions = 0
+        self.promote_failures = 0
         # Mirror pool (speculative draft KV — see SlotPool.mirror):
         # slot lifecycle is mirrored by INDEX; block bookkeeping stays
         # per-pool (the draft binds its own blocks lazily, sized by the
@@ -633,9 +717,13 @@ class PagedSlotPool:
             # still binds would destroy cache value AND free nothing —
             # exhaustion must only be raised once every reclaimable
             # block has genuinely been reclaimed (the capacity
-            # available_blocks() promised admission).
+            # available_blocks() promised admission). With a host tier
+            # configured the victim's payload is demoted to host RAM
+            # first instead of being discarded.
             self.trie.evict(1, self._release,
-                            only=lambda b: self._refs[b] == 1)
+                            only=lambda b: self._refs[b] == 1,
+                            on_evict=(self._demote if self.host_blocks
+                                      else None))
         if not self._free_blocks:
             raise KVBlocksExhausted(
                 f"no free KV blocks ({self.blocks_used}/"
@@ -655,28 +743,248 @@ class PagedSlotPool:
             raise AssertionError(
                 f"block {block} ref count went negative (double release)")
 
+    # ------------------------------------------------------- host tier
+    @property
+    def host_blocks_used(self) -> int:
+        """Demoted blocks resident in the host tier — the
+        ``serve.kv.host_blocks_used`` gauge value."""
+        return len(self._host_tier)
+
+    @property
+    def host_bytes_resident(self) -> int:
+        """Host RAM the demoted payloads hold (int8 data + fp32 scale
+        rows, all layers) — the ``serve.kv.host_bytes_resident``
+        gauge."""
+        return self._host_bytes
+
+    @staticmethod
+    def _entry_bytes(entry: List[Dict[str, np.ndarray]]) -> int:
+        return sum(a.nbytes for layer in entry for a in layer.values())
+
+    def _host_put(self, key: Tuple[int, ...], entry: list) -> None:
+        """Insert one payload at the tier's MRU end with the byte books
+        adjusted and the LRU budget cap re-applied — the ONE place the
+        host-tier accounting invariant (that :meth:`leak_check`'s host
+        column audits) is maintained; both demotion and the failed-
+        promote restore route through here."""
+        old = self._host_tier.pop(key, None)
+        if old is not None:
+            self._host_bytes -= self._entry_bytes(old)
+        self._host_tier[key] = entry
+        self._host_bytes += self._entry_bytes(entry)
+        # Host LRU: the budget is a hard cap — oldest entries drop
+        # (for good; there is no colder tier below host RAM).
+        while len(self._host_tier) > self.host_blocks:
+            _, dropped = self._host_tier.popitem(last=False)
+            self._host_bytes -= self._entry_bytes(dropped)
+
+    def _demote(self, path_tokens: Tuple[int, ...], block: int) -> None:
+        """Trie-eviction hook: capture ``block``'s int8 payload +
+        scales into the host tier before the block returns to the free
+        list. The gather is the migration export op on one index; the
+        device→host copies are started async and collected immediately
+        (the eviction path is about to rebind this block, so the bytes
+        must land before the pool's next write — the copy overlaps the
+        per-leaf ``np.asarray`` walk, not the decode hot path)."""
+        idx = jnp.asarray(np.asarray([block], np.int32))
+        layers = _gather_blocks_quantized_jit(self.caches, idx)
+        for layer in layers:
+            for arr in layer.values():
+                copy_async = getattr(arr, "copy_to_host_async", None)
+                if copy_async is not None:
+                    copy_async()
+        entry = [{k: np.asarray(v) for k, v in layer.items()}
+                 for layer in layers]
+        self._host_put(path_tokens, entry)
+        self.demotions += 1
+        obs.counter("serve.kv.demotions_total").inc()
+
+    def _promote(self, slot: int, tokens: List[int],
+                 start_blocks: int) -> int:
+        """Extend a device trie match through the host tier: promote
+        the longest run of consecutively host-cached blocks past the
+        ``start_blocks`` device-matched ones back onto the device —
+        fresh ref == 1 allocations, the wire payload scattered in by
+        the migration install op, the blocks re-indexed in the trie
+        and referenced by ``slot``. The scatter is DISPATCHED before
+        any bookkeeping (async host→device; the prefill chunks that
+        follow queue behind it on the device stream — the engine's
+        copy_to_host_async-then-bookkeep idiom, reversed). Degrades to
+        a cold prefill — typed, counted, nothing leaked — when the
+        pool cannot hold the span or the ``serve.kv.promote`` fault
+        point fires. -> blocks promoted."""
+        bs = self.block_size
+        # Never promote the block holding position n-1: the final
+        # prompt token always re-runs (its logits seed decoding), so
+        # that block would COPY-ON-WRITE immediately — one allocation
+        # MORE than the cold footprint the scheduler's admission
+        # budget promised (on a pool at the admission edge the COW
+        # would then exhaust, throwing the whole promote away via the
+        # engine's cold fallback). Capped at (n-1)//bs, a promote
+        # allocates exactly the blocks a cold prefill of the same span
+        # would have bound. Device-trie hits keep matching the final
+        # block — they take references (0 allocations), so their COW
+        # stays within budget.
+        limit = min((len(tokens) - 1) // bs, self.blocks_per_slot)
+        keys: List[Tuple[int, ...]] = []
+        entries: List[list] = []
+        bi = start_blocks
+        while bi < limit:
+            key = tuple(tokens[:(bi + 1) * bs])
+            entry = self._host_tier.get(key)
+            if entry is None:
+                break
+            keys.append(key)
+            entries.append(entry)
+            bi += 1
+        if not entries:
+            return 0
+        with obs.span("serve.kv.promote_s", blocks=len(entries)):
+            try:
+                faults.point("serve.kv.promote")
+            except faults.InjectedFault:
+                # The pinned degrade drill: the request simply
+                # prefills cold; the host entries stay resident for
+                # the next hit.
+                self.promote_failures += 1
+                return 0
+            # Exclusive move: pop the entries FIRST, so a demotion our
+            # own allocations trigger (eviction under pressure) can
+            # never race the host-LRU into dropping what we're reading.
+            for key, entry in zip(keys, entries):
+                self._host_tier.pop(key, None)
+                self._host_bytes -= self._entry_bytes(entry)
+            blocks: List[int] = []
+            try:
+                for _ in entries:
+                    blocks.append(self._alloc_block(slot))
+            except (KVBlocksExhausted, faults.InjectedFault):
+                # Typed degrade: release what we allocated, put the
+                # entries back (MRU — they were just wanted), prefill
+                # cold. Admission budgeted for exactly this no-hit
+                # footprint, so nothing downstream is surprised. The
+                # allocs that DID succeed may each have demoted a
+                # third-party block into the tier, so the restore must
+                # re-apply the LRU budget cap — _host_put does.
+                for b in blocks:
+                    self._release(b)
+                for key, entry in zip(keys, entries):
+                    self._host_put(key, entry)
+                self.promote_failures += 1
+                return 0
+            # Async host->device: dispatch the uploads + scatters NOW;
+            # every line after this is host bookkeeping the copies
+            # overlap. Later device work (COW, prefill chunks) takes
+            # self.caches as input, so XLA's dataflow ordering — not a
+            # host sync — guarantees the promoted bytes land first.
+            # The install jit keys on the index SHAPE, so the span is
+            # scattered in POWER-OF-TWO runs (the prefill-bucket idiom
+            # one level down): an m-block promote costs popcount(m)
+            # dispatches against at most log2(blocks_per_slot) compiled
+            # maintenance programs, all warmable off the clock
+            # (:meth:`warm_host_tier_programs`) — never one program per
+            # distinct m compiling inside a measured TTFT window.
+            off = 0
+            while off < len(blocks):
+                run = 1
+                while run * 2 <= len(blocks) - off:
+                    run *= 2
+                idx = jnp.asarray(
+                    np.asarray(blocks[off:off + run], np.int32))
+                chunk = entries[off:off + run]
+                payload = [
+                    {k: jnp.asarray(np.concatenate(
+                        [e[li][k] for e in chunk], axis=0))
+                     for k in chunk[0][li]}
+                    for li in range(len(chunk[0]))]
+                self.caches = _scatter_blocks_quantized_jit(
+                    self.caches, idx, payload)
+                off += run
+
+            def take_ref(block: int) -> None:
+                self._refs[block] += 1
+
+            # Re-index under the trie (existing device-prefix nodes are
+            # kept — insert only takes refs on the NEW nodes), then
+            # bind the promoted span to the slot, then drop our
+            # allocation refs: each promoted block ends at ref 2 (trie
+            # + slot), exactly like a device prefix hit.
+            path = ([int(b) for b in self.tables_host[slot, :start_blocks]]
+                    + blocks)
+            self.trie.insert(tokens[:bi * bs], path, take_ref)
+            for i, b in enumerate(blocks):
+                self._refs[b] += 1
+                self.tables_host[slot, start_blocks + i] = b
+            self._bound[slot] = start_blocks + len(blocks)
+            for b in blocks:
+                self._release(b)
+            self.promotions += len(blocks)
+            obs.counter("serve.kv.promotions_total").inc(len(blocks))
+        return len(blocks)
+
+    def clear_host_tier(self) -> int:
+        """Drop every demoted payload (knob flips / tests / operator
+        relief valve). -> entries dropped."""
+        n = len(self._host_tier)
+        self._host_tier.clear()
+        self._host_bytes = 0
+        return n
+
+    def warm_host_tier_programs(self) -> None:
+        """Compile the demote/promote maintenance programs — the
+        one-block gather plus every power-of-two scatter width up to
+        ``blocks_per_slot`` (promotion batches in power-of-two runs) —
+        off the measured clock, via identity rewrites of the scratch
+        block (never ref-counted, content is pad garbage by contract —
+        writing it with its own bytes, even ``run`` times over, changes
+        nothing). Benchmarks call this during warmup so the first real
+        demotion/promotion never pays a compile inside a measured TTFT
+        window; skipping it costs exactly those spikes, nothing else."""
+        if not (self.host_blocks and self.quantized):
+            return
+        one = jnp.asarray(np.zeros((1,), np.int32))
+        layers = _gather_blocks_quantized_jit(self.caches, one)
+        entry = [{k: np.asarray(v) for k, v in layer.items()}
+                 for layer in layers]
+        run = 1
+        while run <= self.blocks_per_slot:
+            idx = jnp.asarray(np.zeros((run,), np.int32))
+            payload = [
+                {k: jnp.asarray(np.repeat(v, run, axis=0))
+                 for k, v in layer.items()}
+                for layer in entry]
+            self.caches = _scatter_blocks_quantized_jit(
+                self.caches, idx, payload)
+            run *= 2
+
     # -------------------------------------------------- prompt binding
     def bind_for_prompt(self, slot: int, tokens: Sequence[int]) -> int:
         """Admission-time binding: match the prompt's full-block prefix
         against the trie and take REFERENCES on the cached blocks
-        instead of re-prefilling them. -> ``shared_len``, the number of
-        leading positions whose K/V the slot now holds (block-aligned,
-        capped at ``len(tokens) - 1`` so the final prompt token is
-        always re-run — its logits seed decoding). The cap can land the
-        first write inside the last shared block; :meth:`prepare_write`
-        COWs it then."""
+        instead of re-prefilling them; with a host tier configured,
+        extend the match through host-demoted blocks (promoted back as
+        fresh allocations — see :meth:`_promote`). -> ``shared_len``,
+        the number of leading positions whose K/V the slot now holds
+        (block-aligned, capped at ``len(tokens) - 1`` so the final
+        prompt token is always re-run — its logits seed decoding). The
+        cap can land the first write inside the last shared block;
+        :meth:`prepare_write` COWs it then."""
         if self._bound[slot]:
             raise ValueError(f"slot {slot} already holds blocks")
         n = len(tokens)
+        toks = [int(t) for t in tokens]
         shared_blocks: List[int] = []
         if self.prefix_cache_enabled:
-            shared_blocks = self.trie.match(tokens)
+            shared_blocks = self.trie.match(toks)
+        nshared = len(shared_blocks)
         if shared_blocks:
             for i, b in enumerate(shared_blocks):
                 self._refs[b] += 1
                 self.tables_host[slot, i] = b
-            self._bound[slot] = len(shared_blocks)
-        return min(len(shared_blocks) * self.block_size, n - 1)
+            self._bound[slot] = nshared
+        if self.host_blocks and self.prefix_cache_enabled:
+            nshared += self._promote(slot, toks, nshared)
+        return min(nshared * self.block_size, n - 1)
 
     def count_prefix_hit(self) -> None:
         """Account one MATERIALIZED prefix hit. Called by the engine
@@ -861,6 +1169,37 @@ class PagedSlotPool:
                             f"{None if sc is None else sc.shape} "
                             f"(expected [{self.num_blocks}, "
                             f"{layer[kv].shape[1]}])")
+        # Host-tier column of the oracle: entry count within budget,
+        # byte books balanced, every entry shaped like this pool's
+        # blocks and keyed by a whole number of full blocks. A drift
+        # here means a demote/promote path moved payloads without
+        # moving the accounting — the host-side twin of a ref leak.
+        if self.host_blocks or self._host_tier:
+            if len(self._host_tier) > self.host_blocks:
+                raise AssertionError(
+                    f"host tier holds {len(self._host_tier)} entries, "
+                    f"budget {self.host_blocks} — the LRU cap leaked")
+            nbytes = sum(self._entry_bytes(e)
+                         for e in self._host_tier.values())
+            if nbytes != self._host_bytes:
+                raise AssertionError(
+                    f"host tier byte books off: {self._host_bytes} "
+                    f"recorded, {nbytes} resident")
+            shape = tuple(self.caches[0]["k"].shape[1:])
+            for key, entry in self._host_tier.items():
+                if len(key) % self.block_size or \
+                        len(key) // self.block_size == 0:
+                    raise AssertionError(
+                        f"host tier key length {len(key)} is not a "
+                        f"whole number of blocks (bs {self.block_size})")
+                if (len(entry) != len(self.caches)
+                        or tuple(entry[0]["k"].shape) != (1,) + shape):
+                    raise AssertionError(
+                        f"host tier entry geometry drifted: "
+                        f"{len(entry)} layer(s) shaped "
+                        f"{tuple(entry[0]['k'].shape)}, pool has "
+                        f"{len(self.caches)} layer(s) of [1, "
+                        f"{', '.join(str(s) for s in shape)}] blocks")
         expect = np.zeros((self.num_blocks,), np.int64)
         for slot in range(self.capacity):
             if slot in self._free_slots:

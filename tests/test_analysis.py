@@ -110,6 +110,38 @@ def test_host_sync_builder_convention_and_scan(tmp_path):
                                          "_build_prefill.prefill"}
 
 
+def test_host_sync_host_tier_buffer_fixture(tmp_path):
+    """The tiered-KV extension: any touch of the pool's host-tier
+    buffers (`_host_tier` and friends) inside a traced body is a
+    finding — promotion/demotion are host-side pool maintenance by
+    contract — while host-side code uses them freely."""
+    index = _tree(tmp_path, {"tier.py": """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def bad_read(pool, caches, key):
+            entry = pool._host_tier[key]          # finding
+            used = pool.host_blocks_used          # finding
+            return caches, entry, used
+
+        def _build_step(model):
+            def step(pool, caches):
+                pool._promote(0, [], 0)           # finding
+                return caches
+            return step
+
+        def host_side(pool):
+            pool._host_tier.clear()               # NOT traced: fine
+            return pool.host_bytes_resident
+    """})
+    found = _rule_findings(index, "host-sync-in-hot-path")
+    assert {f.detail for f in found} == {"._host_tier",
+                                         ".host_blocks_used",
+                                         "._promote"}
+    assert {f.symbol for f in found} == {"bad_read", "_build_step.step"}
+
+
 def test_host_sync_pallas_partial_binding(tmp_path):
     """Kernels bound through `kernel = functools.partial(...)` then
     `pallas_call(kernel, ...)` are in scope; a def whose RESULT is

@@ -703,7 +703,8 @@ def test_fault_point_registry_pinned():
     router.probe / supervisor.spawn / replica.exec), the paged-KV
     bind point (serve.kv.bind), and the migration points
     (router.migrate / replica.kv_export / replica.kv_install), the
-    speculative verify point (serve.spec.verify), and the train->serve
+    speculative verify point (serve.spec.verify), the host-tier
+    promotion point (serve.kv.promote), and the train->serve
     resharding point (serve.reshard)."""
     from check_fault_points import EXPECTED_POINTS, check, find_points
 
@@ -714,7 +715,7 @@ def test_fault_point_registry_pinned():
         "checkpoint.save", "dist.join",
         "router.route", "router.probe",
         "supervisor.spawn", "replica.exec",
-        "serve.kv.bind",
+        "serve.kv.bind", "serve.kv.promote",
         "router.migrate", "replica.kv_export", "replica.kv_install",
         "serve.spec.verify",
         "serve.reshard",
