@@ -202,6 +202,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--run-dir", default=None,
                    help="write telemetry artifacts here")
+    p.add_argument("--obs-windows", choices=["on", "off"], default="on",
+                   help="install the rolling-window tap during a "
+                        "--run-dir capture (off = the capture-only "
+                        "baseline of nezha-bench's scrape_overhead "
+                        "suite)")
+    p.add_argument("--scrape-interval", type=float, default=0.0,
+                   help="when > 0, a background thread renders the "
+                        "Prometheus /metrics exposition from the live "
+                        "registry every N seconds during the measured "
+                        "load — what a 1s scraper costs the serving "
+                        "path (needs --run-dir)")
     p.add_argument("--json", action="store_true",
                    help="print the result record as JSON")
     p.add_argument("--platform", default=None)
@@ -524,8 +535,32 @@ def _run_one(args, model, variables, decode_horizon: int,
             "requests": args.requests,
             "decode_horizon": decode_horizon,
             "offered": (args.concurrency if args.mode == "closed"
-                        else args.rate)})
+                        else args.rate)},
+            windows=getattr(args, "obs_windows", "on") == "on")
         register_serve_instruments()
+    # The scrape-overhead measurement (nezha-bench scrape_overhead
+    # suite): a background thread rendering the full windowed /metrics
+    # exposition from the live registry at --scrape-interval, exactly
+    # what an external Prometheus scraper costs the serving path.
+    scrape_interval = float(getattr(args, "scrape_interval", 0.0) or 0.0)
+    scrape_stop = scrape_thread = None
+    scrape_count = [0]
+    if scrape_interval > 0 and sink is not None:
+        import threading
+
+        from nezha_tpu.obs import timeseries as _ts
+        scrape_stop = threading.Event()
+
+        def _scraper():
+            while not scrape_stop.wait(scrape_interval):
+                windows = (_ts.windows_payload()
+                           if _ts.current_windows() is not None else None)
+                _ts.render_prometheus(obs.stats_snapshot(), windows)
+                scrape_count[0] += 1
+
+        scrape_thread = threading.Thread(target=_scraper, daemon=True,
+                                         name="bench-scraper")
+        scrape_thread.start()
     steps_before = engine.step_calls      # exclude warmup dispatches
     spec_before = ((engine.spec_verifies, engine.spec_draft_tokens,
                     engine.spec_accepted) if spec else (0, 0, 0))
@@ -592,6 +627,9 @@ def _run_one(args, model, variables, decode_horizon: int,
                 finished = issued - sched.queue_depth - len(sched._live)
     finally:
         faults.install(prev_plan)
+        if scrape_stop is not None:
+            scrape_stop.set()
+            scrape_thread.join(timeout=2.0)
     wall = time.monotonic() - t0
     decode_steps = engine.step_calls - steps_before
 
@@ -694,6 +732,15 @@ def _run_one(args, model, variables, decode_horizon: int,
             "injected": plan.num_injected if plan else 0,
             "by_point": plan.injected_counts if plan else {},
             "errored": len(errored),
+        },
+        # What the telemetry plane itself cost this record: whether the
+        # rolling-window tap was installed, and how many /metrics
+        # expositions the in-process scraper rendered during the load.
+        "telemetry": {
+            "windows": (run_dir is not None
+                        and getattr(args, "obs_windows", "on") == "on"),
+            "scrape_interval_s": scrape_interval,
+            "scrapes": scrape_count[0],
         },
     }
     if spec:

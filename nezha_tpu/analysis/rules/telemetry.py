@@ -11,6 +11,12 @@ name exists as a histogram). A typo'd instrument therefore fails the
 lint when the call site lands, instead of surfacing as a blank
 dashboard panel after the capture ships.
 
+Typed events (PR 16) face the same contract: a literal
+``obs.record_event("...")`` kind under the ``watchdog.`` / ``slo.``
+namespaces must be a member of the pinned ``EVENT_KINDS`` registry —
+alert routing and ``nezha-telemetry --slo`` key on event kinds exactly
+as dashboards key on instrument names.
+
 Dynamic names (f-strings, variables) are skipped, never guessed — the
 run-dir validator still catches those at capture time."""
 
@@ -31,9 +37,10 @@ _KIND_SETS = {
 
 
 @rule("telemetry-schema",
-      "literal obs.counter/gauge/histogram/span names under the serve./"
-      "router./dist./checkpoint. namespaces are members of the pinned "
-      "schema sets (right name AND right instrument kind)")
+      "literal obs.counter/gauge/histogram/span names under the pinned "
+      "namespaces are members of the pinned schema sets (right name AND "
+      "right instrument kind); literal obs.record_event kinds under "
+      "watchdog./slo. are members of the pinned event registry")
 def check(index: SourceIndex) -> List[Finding]:
     findings: List[Finding] = []
     for mod in index:
@@ -54,6 +61,18 @@ def check(index: SourceIndex) -> List[Finding]:
                 continue
             # faults.injected_total rides in the serve set but is not
             # namespace-prefixed; only pinned namespaces are enforced.
+            if kind == "record_event":
+                if not name.startswith(ts.EVENT_KIND_PREFIXES):
+                    continue
+                if name not in ts.EVENT_KINDS:
+                    findings.append(_finding(
+                        index, mod, node, name,
+                        f"event kind {name!r} is not in the pinned "
+                        f"event registry (EVENT_KINDS) for its "
+                        f"namespace — add it to "
+                        f"analysis/telemetry_schema.py (and the "
+                        f"RUNBOOK event taxonomy) deliberately"))
+                continue
             if kind == "span":
                 if not name.startswith(ts.PINNED_SPAN_PREFIXES):
                     continue

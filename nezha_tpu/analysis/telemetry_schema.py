@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 from typing import List
 
 SCHEMA_VERSION = 1
@@ -144,6 +145,14 @@ _DIST_COUNTERS = {"dist.join_retries_total", "dist.heartbeat_lost_total"}
 # validate in a capture).
 _CHECKPOINT_COUNTERS = {"checkpoint.corrupt_total"}
 
+# Watchdog/SLO self-instrumentation (PR 16): pinned for the SOURCE rule
+# only — they appear only in runs that started a watchdog thread, so
+# there is no marker-counter contract in captures.
+_OBS_COUNTERS = {"watchdog.checks_total", "watchdog.events_total",
+                 "watchdog.check_errors_total",
+                 "slo.evaluations_total", "slo.violations_total"}
+_OBS_GAUGES = {"slo.burn_rate_max"}
+
 # Span-name registry for the namespaces this module owns: spans under
 # serve./checkpoint./dist./router. are an interface (reports and
 # dashboards key on them), so an unknown name in those namespaces is
@@ -184,13 +193,120 @@ _PINNED_SPANS = {
 
 # Namespaces whose METRIC names (counter/gauge/histogram) the source
 # rule pins, with the full membership per instrument kind.
-PINNED_METRIC_PREFIXES = ("serve.", "router.", "dist.", "checkpoint.")
+PINNED_METRIC_PREFIXES = ("serve.", "router.", "dist.", "checkpoint.",
+                          "watchdog.", "slo.")
 PINNED_COUNTERS = (_SERVE_COUNTERS | _ROUTER_COUNTERS | _DIST_COUNTERS
-                   | _CHECKPOINT_COUNTERS)
-PINNED_GAUGES = _SERVE_GAUGES | _ROUTER_GAUGES
+                   | _CHECKPOINT_COUNTERS | _OBS_COUNTERS)
+PINNED_GAUGES = _SERVE_GAUGES | _ROUTER_GAUGES | _OBS_GAUGES
 PINNED_HISTOGRAMS = _SERVE_HISTOGRAMS | _ROUTER_HISTOGRAMS
 PINNED_SPANS = _PINNED_SPANS
 PINNED_SPAN_PREFIXES = _PINNED_SPAN_PREFIXES
+
+# ------------------------------------------------- events.jsonl schema
+# The typed watchdog/SLO event stream (PR 16; obs/registry.record_event
+# -> obs/sink.write_event). Kinds under the watchdog./slo. namespaces
+# are an interface — alert routing and nezha-telemetry --slo key on
+# them — so the registry below is the ONLY place new kinds are minted
+# (the source lint rule checks literal record_event kinds against it).
+EVENT_SCHEMA_VERSION = 1
+EVENT_KIND_PREFIXES = ("watchdog.", "slo.")
+EVENT_KINDS = {
+    "watchdog.queue_depth_sustained",   # queue never drained a window
+    "watchdog.ttft_regression",         # p99 vs trailing baseline
+    "watchdog.replica_flap",            # restarts-per-window threshold
+    "watchdog.slo_burn",                # error-budget burn-rate alert
+    "slo.eval",                         # one record per SLO evaluation
+}
+EVENT_SEVERITIES = ("info", "warning", "critical")
+
+
+def check_events_jsonl(path: str, errors: List[str]) -> None:
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                errors.append(f"events.jsonl:{i}: not valid JSON")
+                continue
+            if not isinstance(rec, dict):
+                errors.append(f"events.jsonl:{i}: not an object")
+                continue
+            if rec.get("event_schema_version") != EVENT_SCHEMA_VERSION:
+                errors.append(
+                    f"events.jsonl:{i}: event_schema_version must be "
+                    f"{EVENT_SCHEMA_VERSION}, got "
+                    f"{rec.get('event_schema_version')!r}")
+            if not _is_num(rec.get("ts")):
+                errors.append(f"events.jsonl:{i}: 'ts' must be a number")
+            kind = rec.get("kind")
+            if not isinstance(kind, str) or not kind:
+                errors.append(f"events.jsonl:{i}: 'kind' must be a "
+                              f"non-empty string")
+            elif (kind.startswith(EVENT_KIND_PREFIXES)
+                    and kind not in EVENT_KINDS):
+                errors.append(f"events.jsonl:{i}: kind {kind!r} is not "
+                              f"in the pinned event registry "
+                              f"(EVENT_KINDS) for its namespace")
+            if rec.get("severity") not in EVENT_SEVERITIES:
+                errors.append(f"events.jsonl:{i}: 'severity' must be one "
+                              f"of {list(EVENT_SEVERITIES)}, got "
+                              f"{rec.get('severity')!r}")
+            if not isinstance(rec.get("source"), str):
+                errors.append(f"events.jsonl:{i}: 'source' must be a "
+                              f"string")
+            if not isinstance(rec.get("detail"), dict):
+                errors.append(f"events.jsonl:{i}: 'detail' must be an "
+                              f"object")
+
+
+# --------------------------------------------- /metrics exposition pins
+# The Prometheus-text exposition contract (obs/timeseries.py renders
+# it; a unit test pins both sides to these values). Every sample name
+# carries the prefix; windowed samples are labeled with one of the
+# window labels; histogram quantile samples with one of the quantile
+# labels. Scrapers (nezha-top, external Prometheus) key on this shape.
+EXPOSITION_PREFIX = "nezha_"
+EXPOSITION_WINDOW_LABELS = ("10s", "60s", "300s")
+EXPOSITION_QUANTILE_LABELS = ("p50", "p90", "p99")
+
+_EXPO_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{([^}]*)\})?\s+(-?[0-9.eE+]+"
+    r"|[+-]?Inf|NaN)$")
+_EXPO_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+
+
+def check_metrics_exposition(text: str) -> List[str]:
+    """-> schema violations of one ``GET /metrics`` body (empty =
+    valid): every non-comment line a well-formed sample, every name
+    under the pinned prefix, window/quantile label values drawn from
+    the pinned vocabularies."""
+    errors: List[str] = []
+    for i, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _EXPO_SAMPLE_RE.match(line)
+        if not m:
+            errors.append(f"metrics:{i}: not a valid exposition sample")
+            continue
+        name, raw_labels = m.group(1), m.group(2)
+        if not name.startswith(EXPOSITION_PREFIX):
+            errors.append(f"metrics:{i}: sample name {name!r} lacks the "
+                          f"pinned {EXPOSITION_PREFIX!r} prefix")
+        labels = dict(_EXPO_LABEL_RE.findall(raw_labels)) \
+            if raw_labels else {}
+        w = labels.get("window")
+        if w is not None and w not in EXPOSITION_WINDOW_LABELS:
+            errors.append(f"metrics:{i}: window label {w!r} not in "
+                          f"{list(EXPOSITION_WINDOW_LABELS)}")
+        q = labels.get("quantile")
+        if q is not None and q not in EXPOSITION_QUANTILE_LABELS:
+            errors.append(f"metrics:{i}: quantile label {q!r} not in "
+                          f"{list(EXPOSITION_QUANTILE_LABELS)}")
+    return errors
 
 
 def _is_num(v) -> bool:
@@ -495,8 +611,10 @@ def check_stats_payload(obj) -> List[str]:
 
 
 def check_run_dir(run_dir: str) -> List[str]:
-    """-> list of schema violations (empty = valid). All three artifacts
-    are required — a run dir missing one is itself a violation."""
+    """-> list of schema violations (empty = valid). All three original
+    artifacts are required — a run dir missing one is itself a
+    violation. ``events.jsonl`` (PR 16) is validated when present but
+    never required, so pre-PR16 captures stay valid."""
     errors: List[str] = []
     for name, checker in (("metrics.jsonl", check_metrics_jsonl),
                           ("spans.jsonl", check_spans_jsonl),
@@ -506,4 +624,7 @@ def check_run_dir(run_dir: str) -> List[str]:
             errors.append(f"{name}: missing from {run_dir}")
             continue
         checker(path, errors)
+    events = os.path.join(run_dir, "events.jsonl")
+    if os.path.isfile(events):
+        check_events_jsonl(events, errors)
     return errors

@@ -21,8 +21,10 @@ entry point
 
 Gated metrics: serving ``tokens_per_sec`` per decode horizon (higher is
 better), the speculative-decode suite's ``tokens_per_verify`` and
-spec-vs-classic throughput ratio (higher is better), and the
-decode-attention kernel's median ``kernel_ms`` across
+spec-vs-classic throughput ratio (higher is better), the opt-in
+scrape_overhead suite's scraped-vs-capture-only throughput ratio (hard
+0.95 floor — windows + a 1s /metrics scraper must cost under 5%), and
+the decode-attention kernel's median ``kernel_ms`` across
 configs (lower is better). Latency-shaped CPU numbers are noisy, so the
 default threshold is deliberately loose (30%) — the gate catches
 step-function regressions (a lost kernel, a recompile-per-token bug),
@@ -49,7 +51,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--suites", default="serving,decode_attention",
                    help="comma-separated subset of "
                         "{serving, decode_attention, sharded_serve, "
-                        "kv_churn}. sharded_serve (mesh 1 vs 2 vs 4 at "
+                        "kv_churn, scrape_overhead}. scrape_overhead "
+                        "(the telemetry-plane tax: the same closed "
+                        "loop capture-only vs capture + rolling "
+                        "windows + a 1s /metrics scraper; hard gate "
+                        "scraped >= 0.95x baseline tokens/sec) is "
+                        "opt-in: a latency ratio of two full serving "
+                        "runs wants a quiet machine. "
+                        "sharded_serve (mesh 1 vs 2 vs 4 at "
                         "equal total memory + the bit-identical greedy-"
                         "parity gate) is opt-in: it needs forced host "
                         "devices off-TPU and its runtime is a "
@@ -505,6 +514,61 @@ def _run_kv_churn(args, platform: str) -> dict:
     }
 
 
+def _run_scrape_overhead(args, platform: str) -> dict:
+    """The telemetry-plane overhead record (ISSUE 16 acceptance): the
+    SAME closed-loop load twice in one process — a capture-only run
+    (run-dir sink, rolling windows OFF, no scraper) vs capture +
+    rolling windows + an in-process thread rendering the full windowed
+    ``/metrics`` exposition every second. The hard gate pins the
+    scraped pass's tokens/sec at >= 0.95x the baseline's: the window
+    tap is O(1) bucket math per instrument write and a scrape renders
+    from window deltas without touching the serving loop's locks, so
+    always-on telemetry must cost under 5%."""
+    import tempfile
+
+    sys.path.insert(0, _bench_dir())
+    import serving as serving_bench
+
+    # The horizon-sweep shape at h=4 (the dispatch-amortized serving
+    # regime): a telemetry tax that hides at h=1's dispatch overhead
+    # would still show here. The load runs ~2s on the CPU tiny model —
+    # long enough that the 1s scraper fires at least twice inside the
+    # measured window AND that run-to-run noise (±3% on short loads)
+    # stays under the 5% bound being gated. Quick mode shrinks the
+    # load and tightens the interval so the scraper still fires during
+    # tier-1 smoke runs.
+    requests = args.requests or (8 if args.quick else 256)
+    load = ["--requests", str(requests),
+            "--concurrency", "2" if args.quick else "6",
+            "--max-batch-size", "2" if args.quick else "6",
+            "--max-len", "48" if args.quick else "64",
+            "--max-prefill-len", "8" if args.quick else "16",
+            "--max-new-tokens", "4" if args.quick else "32",
+            "--decode-horizon", "4", "--platform", platform]
+    interval = 0.02 if args.quick else 1.0
+    with tempfile.TemporaryDirectory(prefix="nezha-bench-scrape-") as td:
+        base = serving_bench.run(
+            serving_bench.build_parser().parse_args(
+                load + ["--run-dir", os.path.join(td, "base"),
+                        "--obs-windows", "off"]))
+        scraped = serving_bench.run(
+            serving_bench.build_parser().parse_args(
+                load + ["--run-dir", os.path.join(td, "scraped"),
+                        "--obs-windows", "on",
+                        "--scrape-interval", str(interval)]))
+    return {
+        "load": f"closed loop h=4, {requests} requests, scrape every "
+                f"{interval}s",
+        "scrape_interval_s": interval,
+        "baseline_capture_only": base,
+        "windows_scraped": scraped,
+        "scrapes": (scraped.get("telemetry") or {}).get("scrapes", 0),
+        "tokens_per_sec_ratio_scraped_vs_baseline": (
+            scraped["tokens_per_sec"]
+            / max(base["tokens_per_sec"], 1e-9)),
+    }
+
+
 def _run_decode_attention(args, platform: str) -> dict:
     sys.path.insert(0, _bench_dir())
     import decode_attention as da_bench
@@ -657,6 +721,20 @@ def _gate(results: dict, baselines: dict, platform: str,
                 "current": ratio, "baseline": base_ratio,
                 "ratio": ratio / base_ratio,
                 "ok": ratio / base_ratio <= 1.0 + threshold}
+    # Scrape-overhead gate (ISSUE 16): rolling windows + a 1s /metrics
+    # scraper must keep closed-loop tokens/sec within 5% of the
+    # capture-only baseline measured in the SAME process — a hard
+    # gate with a fixed 0.95 floor, no committed baseline needed (the
+    # two passes ARE each other's baseline). --threshold deliberately
+    # does not loosen it: the 5% bound is the acceptance pin itself.
+    cur_sc = results.get("scrape_overhead")
+    if cur_sc:
+        rows = vs.setdefault("serving", {})
+        ratio = cur_sc.get("tokens_per_sec_ratio_scraped_vs_baseline")
+        if ratio is not None:
+            rows["scrape_overhead.tokens_per_sec_ratio"] = {
+                "current": ratio, "baseline": 0.95,
+                "ratio": ratio / 0.95, "ok": ratio >= 0.95}
     cur_sh = results.get("sharded_serve")
     if cur_sh:
         rows = vs.setdefault("serving", {})
@@ -737,7 +815,8 @@ def _update_baseline(path: str, baseline: Optional[dict],
 def run(args) -> dict:
     suites = [s.strip() for s in str(args.suites).split(",") if s.strip()]
     bad_suites = set(suites) - {"serving", "decode_attention",
-                                "sharded_serve", "kv_churn"}
+                                "sharded_serve", "kv_churn",
+                                "scrape_overhead"}
     if bad_suites:
         raise SystemExit(f"unknown suite(s) {sorted(bad_suites)}")
     if args.threshold <= 0:
@@ -751,6 +830,8 @@ def run(args) -> dict:
         results["sharded_serve"] = _run_sharded_serve(args, platform)
     if "kv_churn" in suites:
         results["kv_churn"] = _run_kv_churn(args, platform)
+    if "scrape_overhead" in suites:
+        results["scrape_overhead"] = _run_scrape_overhead(args, platform)
     if "decode_attention" in suites:
         results["decode_attention"] = _run_decode_attention(args,
                                                             platform)
@@ -770,7 +851,8 @@ def run(args) -> dict:
     }
     if args.update:
         if ("serving" in results or "sharded_serve" in results
-                or "kv_churn" in results):
+                or "kv_churn" in results
+                or "scrape_overhead" in results):
             # The sharded_serve and kv_churn records ride INSIDE the
             # serving slot (one committed BENCH_serving.json). A
             # partial-suite --update preserves whatever the other
@@ -780,7 +862,8 @@ def run(args) -> dict:
                                   platform) or {}
             slot = (dict(results["serving"]) if "serving" in results
                     else dict(prev))
-            for rider in ("sharded_serve", "kv_churn"):
+            for rider in ("sharded_serve", "kv_churn",
+                          "scrape_overhead"):
                 if rider in results:
                     slot[rider] = results[rider]
                 elif rider in prev:

@@ -276,7 +276,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "meaningful with --run-dir (no run = no spans)")
     p.add_argument("--run-dir", default=None,
                    help="write telemetry artifacts (metrics.jsonl / "
-                        "spans.jsonl / summary.json) here")
+                        "spans.jsonl / events.jsonl / summary.json) "
+                        "here")
+    p.add_argument("--slo", action="append", default=None,
+                   metavar="SPEC",
+                   help="declarative SLO evaluated per window, e.g. "
+                        "'serve.ttft_s p99 < 0.5 over 60s "
+                        "[objective 0.99]' (repeatable, or "
+                        "';'-separated). Evaluations and burn-rate "
+                        "alerts stream to events.jsonl as typed "
+                        "records; 'nezha-telemetry RUN_DIR --slo' "
+                        "renders compliance/burn. Implies the "
+                        "watchdog thread")
+    p.add_argument("--watchdog-interval", type=float, default=0.0,
+                   metavar="SECONDS",
+                   help="run the anomaly watchdog (sustained queue "
+                        "depth, TTFT regression vs trailing baseline, "
+                        "replica flap, SLO burn) every SECONDS, "
+                        "emitting typed events to events.jsonl; 0 "
+                        "disables (default; --slo implies 10s)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. cpu)")
@@ -699,6 +717,24 @@ def run_http(scheduler, args, tokenizer, eos_id, port: int,
                 payload = obs.stats_snapshot()
                 payload["role"] = getattr(args, "role", "both")
                 return self._send(200, payload)
+            if self.path == "/windows":
+                # Mergeable rolled-up window views (the router's fleet
+                # /metrics scrapes these and merges the sketches).
+                from nezha_tpu import obs
+                return self._send(200, obs.windows_payload())
+            if self.path == "/metrics":
+                # Prometheus text exposition: cumulative totals plus
+                # window-labeled rates/quantiles.
+                from nezha_tpu import obs
+                body = obs.render_prometheus(
+                    obs.stats_snapshot(), obs.windows_payload()).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             if self.path != "/healthz":
                 return self._send(404, {"error": "unknown path"})
             pool = scheduler.engine.pool
@@ -917,6 +953,26 @@ def run_http(scheduler, args, tokenizer, eos_id, port: int,
     return 0
 
 
+def _start_watchdog(args):
+    """Start the anomaly watchdog thread when ``--watchdog-interval``
+    or ``--slo`` asks for one (an SLO implies the watchdog — something
+    must evaluate it). Returns the started WatchdogThread or None.
+    Spec errors exit with the offending ``--slo`` string."""
+    from nezha_tpu import obs
+    try:
+        slos = obs.parse_slo_args(getattr(args, "slo", None))
+    except ValueError as e:
+        raise SystemExit(f"--slo: {e}")
+    interval = float(getattr(args, "watchdog_interval", 0.0) or 0.0)
+    if interval <= 0 and not slos:
+        return None
+    if interval <= 0:
+        interval = 10.0
+    wd = obs.Watchdog(slos=slos,
+                      config=obs.WatchdogConfig(interval_s=interval))
+    return obs.WatchdogThread(wd).start()
+
+
 def run_worker(args, stdin=None, stdout=None, ready_cb=None,
                drain_event=None) -> int:
     """The single-replica stack — the classic ``--replicas 1`` entry
@@ -951,6 +1007,9 @@ def run_worker(args, stdin=None, stdout=None, ready_cb=None,
         obs.set_trace_sample(getattr(args, "trace_sample", 1.0))
     except ValueError as e:
         raise SystemExit(f"--trace-sample: {e}")
+    # Watchdog first: a bad --slo spec must exit before a sink opens.
+    # Its checks are harmless pre-run (telemetry still disabled).
+    watchdog = _start_watchdog(args)
     sink = None
     if args.run_dir:
         sink = obs.start_run(args.run_dir, meta={
@@ -977,6 +1036,8 @@ def run_worker(args, stdin=None, stdout=None, ready_cb=None,
         return run_stdio(scheduler, args, tokenizer, eos_id,
                          stdin=stdin, stdout=stdout, drain=drain)
     finally:
+        if watchdog is not None:
+            watchdog.stop()
         if sink is not None:
             from nezha_tpu import obs
             obs.end_run()
@@ -1017,9 +1078,16 @@ def _worker_argv(args, rid: int, port: int, role: Optional[str] = None
              "--kv-host-blocks", str(args.kv_host_blocks),
              "--drain-timeout", str(args.drain_timeout),
              "--trace-sample", str(getattr(args, "trace_sample", 1.0)),
+             "--watchdog-interval",
+             str(getattr(args, "watchdog_interval", 0.0) or 0.0),
              "--seed", str(args.seed),
              "--mesh", str(getattr(args, "mesh", 1) or 1),
              "--http", str(port)]
+    # SLOs ride into every worker: each process-backend replica
+    # evaluates them against its own registry and streams typed events
+    # to its replica run-dir (the router evaluates the fleet's).
+    for spec in getattr(args, "slo", None) or []:
+        argv += ["--slo", str(spec)]
     if args.kv_num_blocks is not None:
         argv += ["--kv-num-blocks", str(args.kv_num_blocks)]
     if getattr(args, "speculative", False):
@@ -1106,6 +1174,11 @@ def run_multi(args, ready_cb=None, drain_event=None) -> int:
         obs.set_trace_sample(getattr(args, "trace_sample", 1.0))
     except ValueError as e:
         raise SystemExit(f"--trace-sample: {e}")
+    # The fleet-level watchdog: sees the router registry (replica-flap
+    # rule) — and, in thread mode, the shared registry every member
+    # writes, so the per-replica rules cover the whole fleet too.
+    # Started first so a bad --slo spec exits before a sink opens.
+    watchdog = _start_watchdog(args)
     sink = None
     if args.run_dir:
         from nezha_tpu.serve.router import register_router_instruments
@@ -1150,6 +1223,8 @@ def run_multi(args, ready_cb=None, drain_event=None) -> int:
     finally:
         router.stop()
         sup.shutdown()
+        if watchdog is not None:
+            watchdog.stop()
         if sink is not None:
             from nezha_tpu import obs
             obs.end_run()

@@ -17,6 +17,12 @@ per-request timelines — the TTFT decomposition (router queue ->
 prefill wait -> prefill compute -> migration transfer -> decode wait ->
 first token) and the slowest-requests table with critical-path
 attribution (docs/RUNBOOK.md "Tracing a slow request").
+
+``--slo`` renders the SLO/watchdog view instead: per-SLO compliance
+and error-budget burn recomputed from the typed ``events.jsonl``
+records (``slo.eval``), plus the watchdog event log — the offline twin
+of the live ``/metrics`` + events stream (docs/RUNBOOK.md
+"Monitoring & SLOs").
 """
 
 from __future__ import annotations
@@ -45,6 +51,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "per-request timelines and render the TTFT "
                         "decomposition + slowest-requests table instead "
                         "of the metrics report")
+    p.add_argument("--slo", action="store_true",
+                   help="render the SLO/watchdog view from the run's "
+                        "events.jsonl (this dir + per-replica subdirs): "
+                        "per-SLO compliance and error-budget burn rate, "
+                        "plus the watchdog event log; with --json, the "
+                        "raw rows")
     p.add_argument("--check", action="store_true",
                    help="also validate the artifacts against the frozen "
                         "telemetry schema (exit 1 on drift)")
@@ -57,11 +69,20 @@ def main(argv=None) -> int:
         print(f"no such run directory: {args.run_dir}", file=sys.stderr)
         return 2
     # Deferred so `--help` stays instant (repo convention for CLI entries).
-    from nezha_tpu.obs.report import (load_run, render_report,
-                                      render_trace_report,
+    from nezha_tpu.obs.report import (load_fleet_events, load_run,
+                                      render_report, render_slo_report,
+                                      render_trace_report, slo_rows,
                                       stitch_run_dir, summarize_streams)
 
-    if args.trace:
+    if args.slo:
+        if args.json:
+            events = load_fleet_events(args.run_dir)
+            print(json.dumps({"slos": slo_rows(events),
+                              "events": events},
+                             indent=2, sort_keys=True))
+        else:
+            print(render_slo_report(args.run_dir))
+    elif args.trace:
         # The fleet view: walk this dir plus the per-replica subdirs a
         # --replicas run writes, stitch fragments by trace id, render
         # per-request timelines (docs/RUNBOOK.md "Tracing a slow

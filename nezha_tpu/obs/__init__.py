@@ -15,6 +15,12 @@ computation in bench.py. Three pieces:
 - ``metrics`` / ``trace``: the JSONL logger, async-dispatch-aware
   StepTimer, and jax.profiler wrappers absorbed from ``utils/metrics.py``
   and ``utils/profiling.py`` (those modules remain as thin re-exports).
+- ``timeseries`` / ``slo`` / ``watchdog``: the rolling-window layer —
+  fixed-interval bucket rings with mergeable log-bucket sketches
+  (``Registry.windows(duration)``, the Prometheus-style ``/metrics``
+  exposition and its fleet merge), declarative SLOs with error-budget
+  burn rate, and the anomaly watchdog streaming typed events to
+  ``events.jsonl``.
 """
 
 from nezha_tpu.obs.metrics import MetricsLogger, StepTimer, read_metrics
@@ -39,6 +45,7 @@ from nezha_tpu.obs.registry import (
     mint_trace_id,
     new_span_id,
     record_collective,
+    record_event,
     record_metrics,
     set_trace_sample,
     span,
@@ -46,8 +53,10 @@ from nezha_tpu.obs.registry import (
     trace_context,
     trace_sample,
     traced_span,
+    windows,
 )
 from nezha_tpu.obs.sink import (
+    EVENTS_FILE,
     METRICS_FILE,
     SPANS_FILE,
     SUMMARY_FILE,
@@ -56,7 +65,28 @@ from nezha_tpu.obs.sink import (
     end_run,
     start_run,
 )
+from nezha_tpu.obs.slo import (
+    SLOConfig,
+    SLOTracker,
+    evaluate_slo,
+    parse_slo,
+    parse_slo_args,
+    summarize_slo_events,
+)
+from nezha_tpu.obs.timeseries import (
+    LogSketch,
+    WINDOW_DURATIONS,
+    WindowStore,
+    current_windows,
+    install_windows,
+    merge_window_payloads,
+    parse_prometheus,
+    render_prometheus,
+    uninstall_windows,
+    windows_payload,
+)
 from nezha_tpu.obs.trace import Tracer, annotate, profile_trace
+from nezha_tpu.obs.watchdog import Watchdog, WatchdogConfig, WatchdogThread
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "Span", "REGISTRY",
@@ -66,7 +96,15 @@ __all__ = [
     "set_trace_sample", "trace_sample", "traced_span", "emit_span",
     "stats_snapshot", "TRACE_HEADER", "adopt_trace_header",
     "RunSink", "start_run", "end_run", "current_sink",
-    "METRICS_FILE", "SPANS_FILE", "SUMMARY_FILE",
+    "METRICS_FILE", "SPANS_FILE", "EVENTS_FILE", "SUMMARY_FILE",
     "MetricsLogger", "StepTimer", "read_metrics",
     "Tracer", "annotate", "profile_trace",
+    "record_event", "windows",
+    "LogSketch", "WindowStore", "WINDOW_DURATIONS",
+    "install_windows", "uninstall_windows", "current_windows",
+    "windows_payload", "merge_window_payloads",
+    "render_prometheus", "parse_prometheus",
+    "SLOConfig", "SLOTracker", "parse_slo", "parse_slo_args",
+    "evaluate_slo", "summarize_slo_events",
+    "Watchdog", "WatchdogConfig", "WatchdogThread",
 ]

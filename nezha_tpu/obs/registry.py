@@ -30,14 +30,18 @@ from typing import Any, Dict, List, Optional, Tuple
 
 # One mutable cell shared by every instrument: ``enabled`` is THE fast-path
 # check. Instruments cache a reference to this object, so toggling it flips
-# every existing counter/gauge/span site at once.
+# every existing counter/gauge/span site at once. ``windows`` is the
+# optional rolling-window tap (obs/timeseries.WindowStore): it lives
+# INSIDE the enabled branch, so the disabled fast path stays a single
+# attribute check whether or not windows were ever installed.
 
 
 class _State:
-    __slots__ = ("enabled",)
+    __slots__ = ("enabled", "windows")
 
     def __init__(self):
         self.enabled = False
+        self.windows = None
 
 
 _state = _State()
@@ -183,6 +187,9 @@ class Counter:
     def inc(self, n: int = 1) -> None:
         if _state.enabled:
             self.value += n
+            w = _state.windows
+            if w is not None:
+                w.record_counter(self.name, n)
 
 
 class Gauge:
@@ -197,6 +204,9 @@ class Gauge:
     def set(self, v) -> None:
         if _state.enabled:
             self.value = float(v)
+            w = _state.windows
+            if w is not None:
+                w.record_gauge(self.name, self.value)
 
 
 class Histogram:
@@ -254,6 +264,12 @@ class Histogram:
                 j = self._rng.randrange(self.count)
                 if j < self._cap:
                     self._samples[j] = v
+        # Window tap outside the reservoir lock: the store has its own
+        # lock, and nesting them would couple every histogram's hot path
+        # to the rotation critical section.
+        w = _state.windows
+        if w is not None:
+            w.record_histogram(self.name, v)
 
     def percentile(self, q: float) -> Optional[float]:
         with self._lock:
@@ -367,19 +383,28 @@ class Registry:
     counters/gauges; histograms carry their own lock, spans take the
     registry's)."""
 
-    # Get-or-create maps and the span log, shared by every recording
-    # thread — declared for nezha-lint's lock-discipline rule.
+    # Get-or-create maps and the span/event logs, shared by every
+    # recording thread — declared for nezha-lint's lock-discipline rule.
     _LOCK_GUARDED = {"_counters": "_lock", "_gauges": "_lock",
-                     "_histograms": "_lock", "spans": "_lock"}
+                     "_histograms": "_lock", "spans": "_lock",
+                     "events": "_lock"}
 
-    def __init__(self, max_spans: int = 10000):
+    def __init__(self, max_spans: int = 10000, max_events: int = 1000):
         self._lock = threading.Lock()
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
         self.spans: List[dict] = []
+        self.events: List[dict] = []
         self._max_spans = max_spans
+        self._max_events = max_events
         self._sink = None  # RunSink streaming spans/metrics, when attached
+        # Stable identity for fleet roll-up dedupe: thread-backend
+        # replicas all answer /stats from THIS one process-wide
+        # registry, and the router must sum each distinct registry once
+        # — not once per member — for thread and process backends to
+        # report the same fleet totals.
+        self.registry_id = uuid.uuid4().hex[:16]
 
     # -------------------------------------------------- instrument access
     def counter(self, name: str) -> Counter:
@@ -449,6 +474,39 @@ class Registry:
             sink = self._sink
         if sink is not None:
             sink.write_span(rec)
+
+    def record_event(self, kind: str, severity: str = "info",
+                     source: str = "watchdog", **detail) -> Optional[dict]:
+        """Record a typed telemetry event (the watchdog/SLO stream):
+        kept in a bounded in-process log and streamed to the run dir's
+        ``events.jsonl`` when a sink is attached. Event kinds under the
+        ``watchdog.``/``slo.`` namespaces are pinned by
+        analysis/telemetry_schema.py (EVENT_KINDS). No-op while
+        telemetry is disabled."""
+        if not _state.enabled:
+            return None
+        rec = {"event_schema_version": 1, "ts": time.time(),
+               "kind": kind, "severity": severity, "source": source,
+               "detail": detail}
+        with self._lock:
+            if len(self.events) < self._max_events:
+                self.events.append(rec)
+            sink = self._sink
+        if sink is not None:
+            sink.write_event(rec)
+        return rec
+
+    def windows(self, duration_s: float, skip: int = 0) -> dict:
+        """The rolled-up window view over the trailing ``duration_s``
+        seconds (obs/timeseries.WindowStore.view shape). With no window
+        store installed the empty view renders — zero buckets, no
+        instruments — so exposition callers never branch on None.
+        ``skip`` drops that many newest buckets (trailing baselines)."""
+        w = _state.windows
+        if w is None:
+            from nezha_tpu.obs.timeseries import empty_view
+            return empty_view(duration_s)
+        return w.view(duration_s, skip=skip)
 
     # --------------------------------------------------------- aggregates
     def record_metrics(self, step: int, metrics: Dict[str, Any]) -> None:
@@ -535,6 +593,7 @@ class Registry:
                 "kind": "replica",
                 "ts": time.time(),
                 "enabled": _state.enabled,
+                "registry_id": self.registry_id,
                 "counters": counters,
                 "gauges": gauges,
                 "histograms": {h.name: h.summary() for h in hists}}
@@ -545,6 +604,7 @@ class Registry:
             self._gauges.clear()
             self._histograms.clear()
             self.spans.clear()
+            self.events.clear()
 
 
 # The process-wide default registry and its module-level shorthands: the
@@ -591,6 +651,16 @@ def record_collective(op: str, payload_bytes: int,
                       seconds: Optional[float] = None,
                       bus_bytes: Optional[float] = None) -> None:
     REGISTRY.record_collective(op, payload_bytes, seconds, bus_bytes)
+
+
+def record_event(kind: str, severity: str = "info",
+                 source: str = "watchdog", **detail) -> Optional[dict]:
+    return REGISTRY.record_event(kind, severity=severity, source=source,
+                                 **detail)
+
+
+def windows(duration_s: float, skip: int = 0) -> dict:
+    return REGISTRY.windows(duration_s, skip=skip)
 
 
 def enable() -> None:

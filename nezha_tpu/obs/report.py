@@ -25,7 +25,8 @@ from typing import Any, Dict, List, Optional
 from nezha_tpu.obs.metrics import read_metrics
 from nezha_tpu.obs.registry import (UNFOLDED_METRIC_KEYS, percentile_of,
                                     values_summary)
-from nezha_tpu.obs.sink import METRICS_FILE, SPANS_FILE, SUMMARY_FILE
+from nezha_tpu.obs.sink import (EVENTS_FILE, METRICS_FILE, SPANS_FILE,
+                                SUMMARY_FILE)
 
 
 def load_run(run_dir: str) -> dict:
@@ -630,4 +631,91 @@ def render_report(run_dir: str) -> str:
                          f"{s.get('name', '?')}{a}")
     else:
         lines.append("spans: none recorded")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------------ SLO view
+
+
+def load_fleet_events(run_dir: str) -> List[dict]:
+    """Every typed event record reachable from ``run_dir`` — its own
+    events.jsonl plus any immediate subdirectory's (the per-replica
+    ``replica<N>/`` layout), each tagged with its source directory under
+    ``_src``, sorted by timestamp so the fleet event log interleaves
+    correctly across replicas."""
+    sources = [(".", run_dir)]
+    try:
+        names = sorted(os.listdir(run_dir))
+    except OSError:
+        names = []
+    for name in names:
+        sub = os.path.join(run_dir, name)
+        if os.path.isdir(sub):
+            sources.append((name, sub))
+    out: List[dict] = []
+    for src, d in sources:
+        path = os.path.join(d, EVENTS_FILE)
+        if not os.path.isfile(path):
+            continue
+        for rec in read_metrics(path):  # same JSONL shape
+            if isinstance(rec, dict):
+                rec = dict(rec)
+                rec["_src"] = src
+                out.append(rec)
+    out.sort(key=lambda r: r.get("ts", 0.0))
+    return out
+
+
+def slo_rows(events: List[dict]) -> List[dict]:
+    """Per-SLO compliance/burn rows recomputed from ``slo.eval`` event
+    records (the offline twin of the live tracker — see
+    :func:`nezha_tpu.obs.slo.summarize_slo_events`)."""
+    from nezha_tpu.obs.slo import summarize_slo_events
+    rows = summarize_slo_events(events)
+    return [rows[name] for name in sorted(rows)]
+
+
+def render_slo_report(run_dir: str) -> str:
+    """Plain-text SLO/watchdog view for a run directory: the per-SLO
+    compliance + error-budget burn table recomputed from the run's
+    ``slo.eval`` events, then the watchdog alert log."""
+    events = load_fleet_events(run_dir)
+    lines: List[str] = [f"SLO report: {os.path.abspath(run_dir)}"]
+    if not events:
+        lines.append("(no events.jsonl captured — was the run started with "
+                     "--run-dir and --slo/--watchdog-interval?)")
+        return "\n".join(lines)
+
+    rows = slo_rows(events)
+    lines.append("")
+    if rows:
+        lines.append("SLOs:")
+        lines.append(f"  {'slo':<40}{'evals':>7}{'good':>7}{'bad':>6}"
+                     f"{'compliance':>12}{'burn':>8}")
+        for row in rows:
+            comp = row.get("compliance")
+            burn = row.get("burn_rate")
+            comp_s = f"{comp:.1%}" if isinstance(comp, float) else "-"
+            burn_s = f"{burn:.2f}" if isinstance(burn, float) else "-"
+            lines.append(f"  {row['slo']:<40}"
+                         f"{row.get('evaluations', 0):>7}"
+                         f"{row.get('good', 0):>7}{row.get('bad', 0):>6}"
+                         f"{comp_s:>12}{burn_s:>8}")
+    else:
+        lines.append("SLOs: no slo.eval records (run without --slo?)")
+
+    alerts = [e for e in events
+              if isinstance(e.get("kind"), str)
+              and e["kind"].startswith("watchdog.")]
+    lines.append("")
+    if alerts:
+        lines.append(f"watchdog events ({len(alerts)}):")
+        for e in alerts[-20:]:
+            detail = e.get("detail") or {}
+            d = (" " + " ".join(f"{k}={v}" for k, v in sorted(
+                detail.items()))) if detail else ""
+            lines.append(f"  [{e.get('severity', '?'):<8}] "
+                         f"{e.get('_src', '.')}: {e.get('kind', '?')}{d}")
+    else:
+        lines.append("watchdog events: none")
     return "\n".join(lines)

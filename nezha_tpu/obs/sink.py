@@ -13,6 +13,11 @@ File contract (frozen; tools/check_telemetry_schema.py validates it):
     metrics.jsonl   one object per line: {"step": int, "ts": float, ...}
     spans.jsonl     one object per line: {"name", "t0", "t1", "dur_s",
                     "attrs"}
+    events.jsonl    one object per line: {"event_schema_version", "ts",
+                    "kind", "severity", "source", "detail"} — the typed
+                    watchdog/SLO event stream (PR 16; validated by the
+                    schema checker when present, so pre-PR16 captures
+                    stay valid)
     summary.json    the Registry.snapshot() shape (schema_version 1) plus
                     a "run" block of caller-provided metadata
 """
@@ -29,6 +34,7 @@ from nezha_tpu.obs.metrics import MetricsLogger
 
 METRICS_FILE = "metrics.jsonl"
 SPANS_FILE = "spans.jsonl"
+EVENTS_FILE = "events.jsonl"
 SUMMARY_FILE = "summary.json"
 
 
@@ -53,6 +59,7 @@ class RunSink:
         self._metrics = MetricsLogger(os.path.join(run_dir, METRICS_FILE),
                                       mode="w")
         self._spans = open(os.path.join(run_dir, SPANS_FILE), "w")
+        self._events = open(os.path.join(run_dir, EVENTS_FILE), "w")
         self._t_start = time.time()
         self._closed = False
 
@@ -64,6 +71,11 @@ class RunSink:
         if not self._closed:
             self._spans.write(json.dumps(rec) + "\n")
             self._spans.flush()
+
+    def write_event(self, rec: dict) -> None:
+        if not self._closed:
+            self._events.write(json.dumps(rec) + "\n")
+            self._events.flush()
 
     def summary(self) -> dict:
         out = self.registry.snapshot()
@@ -81,6 +93,7 @@ class RunSink:
         self._closed = True
         self._metrics.close()
         self._spans.close()
+        self._events.close()
         path = os.path.join(self.run_dir, SUMMARY_FILE)
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
@@ -102,11 +115,17 @@ def current_sink() -> Optional[RunSink]:
 
 
 def start_run(run_dir: str, meta: Optional[Dict[str, Any]] = None,
-              reset: bool = True) -> RunSink:
+              reset: bool = True, windows: bool = True,
+              window_interval_s: float = 10.0,
+              window_retention_s: float = 300.0) -> RunSink:
     """Open a telemetry run: enable the registry, attach the sink.
 
     ``reset`` clears instruments accumulated before the run started so the
     summary is genuinely run-scoped (pass False to keep process history).
+    ``windows`` installs the rolling-window tap (obs/timeseries) so
+    ``Registry.windows(duration)`` and the ``/metrics`` exposition carry
+    live 10s/60s/300s views; pass False for a capture-only run (the
+    bench scrape-overhead baseline measures exactly this delta).
     Starting a new run closes any previous one first.
     """
     global _current
@@ -120,6 +139,10 @@ def start_run(run_dir: str, meta: Optional[Dict[str, Any]] = None,
     for op in ("all_reduce", "reduce_scatter", "all_gather"):
         _registry.REGISTRY.counter(f"collective.{op}.calls")
         _registry.REGISTRY.counter(f"collective.{op}.payload_bytes")
+    if windows:
+        from nezha_tpu.obs.timeseries import install_windows
+        install_windows(interval_s=window_interval_s,
+                        retention_s=window_retention_s)
     sink = RunSink(run_dir, meta=meta)
     _current = sink
     _registry.REGISTRY._sink = sink
@@ -128,11 +151,13 @@ def start_run(run_dir: str, meta: Optional[Dict[str, Any]] = None,
 
 
 def end_run() -> None:
-    """Write summary.json, detach the sink, disable telemetry."""
+    """Write summary.json, detach the sink and the window store,
+    disable telemetry."""
     global _current
     sink = _current
     _current = None
     _registry.REGISTRY._sink = None
     if sink is not None:
         sink.close()
+    _registry._state.windows = None
     _registry.disable()

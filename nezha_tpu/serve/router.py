@@ -71,7 +71,11 @@ spans become fragments of one per-request timeline
 (``nezha-telemetry RUN_DIR --trace`` stitches them; the
 ``router.request`` span is the root fragment). ``GET /stats`` answers
 the LIVE fleet snapshot: the router's registry, every replica's
-``/stats`` payload, and a summed roll-up — no run-dir flush needed.
+``/stats`` payload, and a roll-up that sums each distinct registry
+once (``registry_id`` dedupe — thread and process backends report the
+same fleet totals). PR 16 adds the windowed pair: ``GET /windows``
+(member window views merged sketch-wise) and ``GET /metrics``
+(Prometheus text of the fleet roll-up — ``nezha-top``'s poll target).
 """
 
 from __future__ import annotations
@@ -220,59 +224,97 @@ class Router:
         payload = self._get_json(r, "/healthz")
         return payload is not None, payload
 
-    # ------------------------------------------------------- live stats
-    def fleet_stats(self) -> dict:
-        """The live fleet snapshot ``GET /stats`` answers (stats schema
-        v1, pinned by analysis/telemetry_schema.check_stats_payload):
-        the router's own registry snapshot, every routable replica's
-        ``/stats`` payload fetched live (None for a member that did not
-        answer), and a ``fleet`` roll-up summing the replicas' counters
-        and gauges — one curl shows live occupancy, migration rate, and
-        the queue split without touching a run dir. With the thread
-        replica backend all replicas share this process's registry, so
-        their payloads are identical and the roll-up over-counts by the
-        member count; per-replica rows (and the production process
-        backend) are exact."""
-        reps = self.sup.replicas()
-        # Fetch every member CONCURRENTLY under one shared deadline: a
-        # wedged replica (exactly what an operator curls /stats to
-        # diagnose) costs the view one probe window, not one window
-        # PER wedged member; a fetch that misses the deadline reports
-        # that member's stats as null.
+    def _fetch_all(self, path: str) -> Dict[int, Optional[dict]]:
+        """Fetch one endpoint from every routable member CONCURRENTLY
+        under one shared deadline: a wedged replica (exactly what an
+        operator curls the fleet views to diagnose) costs the view one
+        probe window, not one window PER wedged member; a fetch that
+        misses the deadline reports that member as null."""
         fetched: Dict[int, Optional[dict]] = {}
         threads = []
-        for r in reps:
+        for r in self.sup.replicas():
             if r.state in (STARTING, LIVE) and r.port:
                 def fetch(rep=r):
-                    fetched[rep.rid] = self._fetch_stats(rep)
+                    fetched[rep.rid] = self._get_json(rep, path)
                 t = threading.Thread(target=fetch, daemon=True)
                 threads.append(t)
                 t.start()
         deadline = time.monotonic() + self.cfg.probe_timeout_s
         for t in threads:
             t.join(max(deadline - time.monotonic(), 0.0))
+        return fetched
+
+    # ------------------------------------------------------- live stats
+    def fleet_stats(self) -> dict:
+        """The live fleet snapshot ``GET /stats`` answers (stats schema
+        v1, pinned by analysis/telemetry_schema.check_stats_payload):
+        the router's own registry snapshot, every routable replica's
+        ``/stats`` payload fetched live (None for a member that did not
+        answer), and a ``fleet`` roll-up summing counters and gauges —
+        one curl shows live occupancy, migration rate, and the queue
+        split without touching a run dir. The roll-up sums each
+        DISTINCT registry once, keyed by the ``registry_id`` every
+        payload carries: with the thread replica backend all members
+        (and the router itself) share this process's registry, so
+        summing per member would over-count by the member count — the
+        dedupe makes thread and process backends report the same fleet
+        totals. Per-replica rows always show every member's payload."""
+        reps = self.sup.replicas()
+        fetched = self._fetch_all("/stats")
+        out = obs.stats_snapshot()
         replicas = []
         fleet_counters: Dict[str, float] = {}
         fleet_gauges: Dict[str, float] = {}
+        seen_regs = set()
+
+        def roll_up(stats: dict) -> None:
+            reg = stats.get("registry_id")
+            if isinstance(reg, str) and reg:
+                if reg in seen_regs:
+                    return
+                seen_regs.add(reg)
+            for k, v in (stats.get("counters") or {}).items():
+                fleet_counters[k] = fleet_counters.get(k, 0) + v
+            for k, v in (stats.get("gauges") or {}).items():
+                fleet_gauges[k] = fleet_gauges.get(k, 0) + v
+
+        # The router's own registry joins the roll-up first: in thread
+        # mode it IS every member's registry (one contribution total);
+        # in process mode it contributes the router.* instruments.
+        roll_up(out)
         for r in reps:
             stats = fetched.get(r.rid)
             if isinstance(stats, dict):
-                for k, v in (stats.get("counters") or {}).items():
-                    fleet_counters[k] = fleet_counters.get(k, 0) + v
-                for k, v in (stats.get("gauges") or {}).items():
-                    fleet_gauges[k] = fleet_gauges.get(k, 0) + v
+                roll_up(stats)
             replicas.append({"rid": r.rid, "role": r.role,
                              "port": r.port, "state": r.state,
                              "healthy": r.healthy, "stats": stats})
-        out = obs.stats_snapshot()
         return {"stats_schema_version": 1, "kind": "fleet",
                 "ts": out["ts"], "enabled": out["enabled"],
                 "router": out, "replicas": replicas,
                 "fleet": {"counters": fleet_counters,
                           "gauges": fleet_gauges}}
 
-    def _fetch_stats(self, r) -> Optional[dict]:
-        return self._get_json(r, "/stats")
+    def fleet_windows(self) -> dict:
+        """The fleet's rolled-up window views (``GET /windows``): every
+        member's ``windows_payload()`` fetched live plus the router's
+        own, merged by obs.merge_window_payloads — sketches merge
+        bucket-wise (exact quantiles, never summed snapshot
+        percentiles), and members sharing a registry (thread backend)
+        contribute once."""
+        fetched = self._fetch_all("/windows")
+        payloads = [obs.windows_payload()]
+        payloads.extend(p for p in fetched.values()
+                        if isinstance(p, dict))
+        return obs.merge_window_payloads(payloads)
+
+    def fleet_metrics_text(self) -> str:
+        """The fleet ``GET /metrics`` body: the deduped cumulative
+        roll-up plus the merged window views, in Prometheus text
+        format."""
+        stats = self.fleet_stats()
+        return obs.render_prometheus(stats.get("fleet"),
+                                     self.fleet_windows())
 
     def wait_live(self, n: int, timeout_s: float = 300.0) -> bool:
         """Probe until ``n`` replicas are live (startup convenience for
@@ -773,6 +815,21 @@ def run_front_end(router: Router, supervisor, port: int, *,
                 # Live fleet view: answered even while draining — the
                 # operator watching a drain is exactly who curls this.
                 return self._send(200, router.fleet_stats())
+            if self.path == "/windows":
+                # The mergeable JSON form of the fleet roll-up (what a
+                # higher-tier aggregator would scrape).
+                return self._send(200, router.fleet_windows())
+            if self.path == "/metrics":
+                # Prometheus text: fleet-merged sketches + deduped
+                # cumulative totals (nezha-top's poll target).
+                body = router.fleet_metrics_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             if self.path != "/healthz":
                 return self._send(404, {"error": "unknown path"})
             live = supervisor.live_count()

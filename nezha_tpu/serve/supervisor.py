@@ -385,12 +385,30 @@ class _ThreadWorker:
                     # Live registry snapshot (stats schema v1) — the
                     # per-replica row of the router's fleet view.
                     # Thread workers share the process registry, so
-                    # every member answers the same numbers (the
-                    # production process backend is per-process).
+                    # every member answers the same numbers (carrying
+                    # the same registry_id, which the router's fleet
+                    # roll-up dedupes on; the production process
+                    # backend is per-process).
                     payload = obs.stats_snapshot()
                     payload["role"] = getattr(worker.args, "role",
                                               "both")
                     return self._send(200, payload)
+                if self.path == "/windows":
+                    # Mergeable window views (sketch bucket counts
+                    # ride along) — what the router scrapes for the
+                    # fleet /metrics roll-up.
+                    return self._send(200, obs.windows_payload())
+                if self.path == "/metrics":
+                    body = obs.render_prometheus(
+                        obs.stats_snapshot(),
+                        obs.windows_payload()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if self.path != "/healthz":
                     return self._send(404, {"error": "unknown path"})
                 if not worker._ready.is_set():

@@ -1,0 +1,865 @@
+"""Windowed fleet telemetry (ISSUE 16): rolling time-series windows,
+the SLO/error-budget engine, the Prometheus /metrics exposition, and
+the anomaly watchdog.
+
+Layers under test, bottom up: the mergeable log-bucket sketch (merged
+replica sketches report the SAME quantile bounds as one union-stream
+sketch — the exactness pin fleet roll-ups rely on), the bucket ring
+(rotation under concurrent writers loses nothing), the registry window
+tap (disabled mode records nothing), the fleet merge (registry-identity
+dedupe — thread and process backends must report identical fleet
+totals, the PR 12 /stats over-count fix), the /metrics exposition
+(render -> parse roundtrip, pinned against the stdlib-only schema
+module's constants), the SLO grammar + hand-computed burn-rate trace,
+the watchdog rules (rising-edge typed events into events.jsonl,
+schema-valid), the CLI surfaces (nezha-serve --slo, nezha-telemetry
+--slo, nezha-top), and the end-to-end acceptance: a multi-replica
+fleet under load serves a fleet-rolled /metrics whose windowed TTFT
+matches the run-dir artifacts, and a fault-injected latency regression
+trips the watchdog.
+"""
+
+import json
+import math
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import jax
+
+from nezha_tpu import faults, obs
+from nezha_tpu.obs import timeseries as ts
+from nezha_tpu.obs.slo import (SLOTracker, evaluate_slo, parse_slo,
+                               parse_slo_args)
+from nezha_tpu.obs.watchdog import Watchdog, WatchdogConfig, WatchdogThread
+from nezha_tpu.serve.router import Router, register_router_instruments
+from nezha_tpu.serve.scheduler import register_serve_instruments
+from nezha_tpu.serve.supervisor import (ProcessBackend, RouterConfig,
+                                        Supervisor, ThreadBackend)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_ROOT, "tools"))
+from check_telemetry_schema import (EVENT_KINDS, check_events_jsonl,  # noqa: E402
+                                    check_metrics_exposition,
+                                    check_run_dir)
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    faults.clear()
+    obs.end_run()
+    obs.REGISTRY.reset()
+    yield
+    faults.clear()
+    obs.end_run()
+    obs.REGISTRY.reset()
+
+
+# ----------------------------------------------------------- LogSketch
+def test_sketch_quantile_bounds():
+    """Every reported quantile is within a gamma factor of the true
+    value (the DDSketch relative-error guarantee), clamped into the
+    exact observed [min, max]."""
+    sk = ts.LogSketch()
+    values = [0.001 * (i + 1) for i in range(1000)]
+    for v in values:
+        sk.observe(v)
+    s = sk.summary()
+    assert s["count"] == 1000
+    assert s["min"] == pytest.approx(0.001)
+    assert s["max"] == pytest.approx(1.0)
+    assert s["sum"] == pytest.approx(sum(values))
+    for q, true in ((50, 0.5), (90, 0.9), (99, 0.99)):
+        got = sk.quantile(q)
+        assert true / ts.DEFAULT_GAMMA <= got <= true * ts.DEFAULT_GAMMA, (
+            q, got, true)
+
+
+def test_sketch_zero_and_negative_bucket():
+    sk = ts.LogSketch()
+    for v in (0.0, -1.5, 0.25):
+        sk.observe(v)
+    s = sk.summary()
+    assert s["count"] == 3
+    assert s["min"] == -1.5 and s["max"] == 0.25
+    # p50 falls in the zero/negative mass -> reported as the floor 0.0
+    # clamped to min
+    assert sk.quantile(50) <= 0.25
+
+
+def test_sketch_merge_exactness():
+    """THE fleet roll-up pin: merging per-replica sketches yields
+    byte-identical buckets — and therefore IDENTICAL quantile bounds —
+    to one sketch fed the union stream. (``sum``/``mean`` may differ by
+    float addition order; count/min/max/quantiles must be exact.)"""
+    import random
+    rng = random.Random(7)
+    streams = [[rng.lognormvariate(-3.0, 1.0) for _ in range(400)]
+               for _ in range(3)]
+    parts = []
+    union = ts.LogSketch()
+    for stream in streams:
+        p = ts.LogSketch()
+        for v in stream:
+            p.observe(v)
+            union.observe(v)
+        parts.append(p)
+    merged = ts.LogSketch()
+    for p in parts:
+        merged.merge(p)
+    assert merged.buckets == union.buckets
+    assert merged.zero == union.zero
+    ms, us = merged.summary(), union.summary()
+    for key in ("count", "min", "max", "p50", "p90", "p99"):
+        assert ms[key] == us[key], key
+    assert math.isclose(ms["sum"], us["sum"], rel_tol=1e-9)
+
+
+def test_sketch_serialization_roundtrip():
+    sk = ts.LogSketch()
+    for v in (0.01, 0.5, 0.5, 3.0, 0.0):
+        sk.observe(v)
+    d = json.loads(json.dumps(sk.to_dict()))   # survives JSON transport
+    back = ts.LogSketch.from_dict(d)
+    assert back.buckets == sk.buckets
+    assert back.summary() == sk.summary()
+
+
+# --------------------------------------------------------- WindowStore
+def _fake_clock(start=1000.0):
+    state = {"t": start}
+
+    def clock():
+        return state["t"]
+
+    return state, clock
+
+
+def test_window_rotation_and_rates():
+    state, clock = _fake_clock()
+    store = ts.WindowStore(interval_s=10.0, retention_s=300.0,
+                           clock=clock)
+    # 3 buckets: 5 incs in the first, 3 in the second, 2 in the third.
+    for n, _ in ((5, 0), (3, 1), (2, 2)):
+        for _ in range(n):
+            store.record_counter("serve.admitted_total", 1)
+        state["t"] += 10.0
+    state["t"] -= 10.0        # stay inside the third bucket
+    v10 = store.view(10.0)
+    assert v10["counters"]["serve.admitted_total"]["delta"] == 2
+    assert v10["counters"]["serve.admitted_total"]["rate"] == \
+        pytest.approx(0.2)
+    v30 = store.view(30.0)
+    assert v30["buckets"] == 3
+    assert v30["counters"]["serve.admitted_total"]["delta"] == 10
+    assert v30["counters"]["serve.admitted_total"]["rate"] == \
+        pytest.approx(10 / 30)
+    # skip drops the NEWEST buckets (the watchdog's trailing baseline).
+    v_base = store.view(30.0, skip=1)
+    assert v_base["counters"]["serve.admitted_total"]["delta"] == 8
+
+
+def test_window_gauge_and_histogram_rollup():
+    state, clock = _fake_clock()
+    store = ts.WindowStore(interval_s=10.0, retention_s=60.0,
+                           clock=clock)
+    store.record_gauge("serve.queue_depth", 4)
+    store.record_histogram("serve.ttft_s", 0.02)
+    state["t"] += 10.0
+    store.record_gauge("serve.queue_depth", 9)
+    store.record_gauge("serve.queue_depth", 1)
+    store.record_histogram("serve.ttft_s", 0.08)
+    view = store.view(60.0)
+    g = view["gauges"]["serve.queue_depth"]
+    assert g == {"last": 1, "min": 1, "max": 9}
+    h = view["histograms"]["serve.ttft_s"]
+    assert h["count"] == 2
+    assert h["min"] == pytest.approx(0.02)
+    assert h["max"] == pytest.approx(0.08)
+    assert "sketch" in h    # mergeable transport form rides in the view
+
+
+def test_window_retention_bounds_memory():
+    state, clock = _fake_clock()
+    store = ts.WindowStore(interval_s=1.0, retention_s=5.0, clock=clock)
+    for i in range(50):
+        store.record_counter("c", 1)
+        state["t"] += 1.0
+    assert len(store._buckets) == 5           # ring stayed bounded
+    assert store.view(300.0)["counters"]["c"]["delta"] == 5
+
+
+def test_window_concurrent_writers_lose_nothing():
+    """Satellite 3: writer threads hammer the store while the clock
+    advances under them (bucket rotation mid-write). Every increment
+    must land in SOME retained bucket — the one lock serializes
+    recording and rotation."""
+    state, clock = _fake_clock()
+    # Retention far exceeds the simulated time span: nothing ages out,
+    # so conservation is exact.
+    store = ts.WindowStore(interval_s=1.0, retention_s=10_000.0,
+                           clock=clock)
+    N, W = 2000, 4
+    stop = threading.Event()
+
+    def rotator():
+        while not stop.is_set():
+            state["t"] += 0.25            # rotates every few writes
+            time.sleep(0.0002)
+
+    def writer(k):
+        for _ in range(N):
+            store.record_counter("hits", 1)
+            store.record_histogram("lat", 0.01)
+
+    rot = threading.Thread(target=rotator, daemon=True)
+    rot.start()
+    threads = [threading.Thread(target=writer, args=(k,))
+               for k in range(W)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    stop.set()
+    rot.join(timeout=10)
+    assert not any(t.is_alive() for t in threads)
+    view = store.view(10_000.0)
+    assert view["counters"]["hits"]["delta"] == N * W
+    assert view["histograms"]["lat"]["count"] == N * W
+
+
+# ------------------------------------------------- registry window tap
+def test_registry_tap_and_disabled_noop():
+    obs.enable()
+    try:
+        store = ts.install_windows(interval_s=10.0)
+        obs.counter("serve.admitted_total").inc(3)
+        obs.gauge("serve.queue_depth").set(2)
+        obs.histogram("serve.ttft_s").observe(0.05)
+        view = obs.windows(60.0)
+        assert view["counters"]["serve.admitted_total"]["delta"] == 3
+        assert view["gauges"]["serve.queue_depth"]["last"] == 2
+        assert view["histograms"]["serve.ttft_s"]["count"] == 1
+        # Disabled: instrument writes don't reach the store either.
+        obs.disable()
+        obs.counter("serve.admitted_total").inc(100)
+        obs.histogram("serve.ttft_s").observe(9.0)
+        obs.enable()
+        view = obs.windows(60.0)
+        assert view["counters"]["serve.admitted_total"]["delta"] == 3
+        assert view["histograms"]["serve.ttft_s"]["count"] == 1
+        assert store is ts.current_windows()
+    finally:
+        ts.uninstall_windows()
+        obs.disable()
+
+
+def test_windows_view_without_store_is_empty_shape():
+    view = obs.windows(60.0)
+    assert view["buckets"] == 0
+    assert view["counters"] == {} and view["histograms"] == {}
+
+
+# ---------------------------------------------------------- fleet merge
+def _payload_with(registry_id, counters=(), gauges=(), hist=()):
+    state, clock = _fake_clock()
+    store = ts.WindowStore(interval_s=10.0, clock=clock)
+    for name, n in counters:
+        store.record_counter(name, n)
+    for name, v in gauges:
+        store.record_gauge(name, v)
+    for name, vals in hist:
+        for v in vals:
+            store.record_histogram(name, v)
+    return {"window_schema_version": 1, "ts": clock(),
+            "registry_id": registry_id,
+            "windows": {"60s": store.view(60.0)}}
+
+
+def test_merge_dedupes_by_registry_identity():
+    """The satellite-1 pin at the merge layer: two members backed by
+    the SAME registry (thread backend) contribute once; distinct
+    registries (process backend) sum."""
+    shared = _payload_with("reg-a",
+                           counters=[("serve.admitted_total", 5)],
+                           gauges=[("serve.queue_depth", 3)])
+    merged = ts.merge_window_payloads([shared, shared])
+    assert merged["members"] == 2 and merged["deduped"] == 1
+    view = merged["windows"]["60s"]
+    assert view["counters"]["serve.admitted_total"]["delta"] == 5
+
+    other = _payload_with("reg-b",
+                          counters=[("serve.admitted_total", 7)],
+                          gauges=[("serve.queue_depth", 2)])
+    merged = ts.merge_window_payloads([shared, other, shared])
+    assert merged["members"] == 3 and merged["deduped"] == 1
+    view = merged["windows"]["60s"]
+    assert view["counters"]["serve.admitted_total"]["delta"] == 12
+    # Fleet gauge: "last" sums (total queued across the fleet),
+    # min/max envelope.
+    assert view["gauges"]["serve.queue_depth"]["last"] == 5
+    assert view["gauges"]["serve.queue_depth"]["max"] == 3
+
+
+def test_merge_sketches_fleet_exact():
+    """Fleet histogram quantiles come from MERGED sketches, not from
+    averaging member summaries — identical to a union-stream sketch."""
+    a_vals = [0.01 * (i + 1) for i in range(100)]
+    b_vals = [0.5 + 0.01 * i for i in range(100)]
+    a = _payload_with("a", hist=[("serve.ttft_s", a_vals)])
+    b = _payload_with("b", hist=[("serve.ttft_s", b_vals)])
+    merged = ts.merge_window_payloads([a, b])
+    union = ts.LogSketch()
+    for v in a_vals + b_vals:
+        union.observe(v)
+    got = merged["windows"]["60s"]["histograms"]["serve.ttft_s"]
+    want = union.summary()
+    for key in ("count", "min", "max", "p50", "p90", "p99"):
+        assert got[key] == want[key], key
+
+
+# ------------------------------------------------- /metrics exposition
+def test_prometheus_render_parse_roundtrip():
+    obs.enable()
+    try:
+        ts.install_windows(interval_s=10.0)
+        obs.counter("serve.admitted_total").inc(5)
+        obs.gauge("serve.queue_depth").set(4)
+        for i in range(50):
+            obs.histogram("serve.ttft_s").observe(0.01 + 0.001 * i)
+        text = ts.render_prometheus(obs.stats_snapshot(),
+                                    ts.windows_payload())
+    finally:
+        ts.uninstall_windows()
+        obs.disable()
+    assert check_metrics_exposition(text) == []
+    samples = ts.parse_prometheus(text)
+    # Cumulative samples: unlabeled.
+    assert ts.metric_value(samples, "nezha_serve_admitted_total") == 5
+    assert ts.metric_value(samples, "nezha_serve_queue_depth") == 4
+    # Windowed samples: every pinned window label renders.
+    for w in ts.WINDOW_LABELS:
+        assert ts.metric_value(samples, "nezha_serve_admitted_total_rate",
+                               window=w) is not None, w
+    assert ts.metric_value(samples, "nezha_serve_queue_depth_last",
+                           window="60s") == 4
+    p99 = ts.metric_value(samples, "nezha_serve_ttft_s",
+                          window="60s", quantile="p99")
+    assert p99 == pytest.approx(0.059, rel=ts.DEFAULT_GAMMA - 1 + 0.01)
+    assert ts.metric_value(samples, "nezha_serve_ttft_s_count",
+                           window="60s") == 50
+
+
+def test_exposition_constants_pinned_against_schema_module():
+    """The stdlib-only schema module duplicates the exposition
+    constants (the tools shim can't import timeseries without jax);
+    this is the unit pin that they never drift apart."""
+    from nezha_tpu.analysis import telemetry_schema as sch
+    assert sch.EXPOSITION_PREFIX == ts.EXPOSITION_PREFIX
+    assert tuple(sch.EXPOSITION_WINDOW_LABELS) == tuple(ts.WINDOW_LABELS)
+    assert tuple(sch.EXPOSITION_QUANTILE_LABELS) == \
+        tuple(ts.QUANTILE_LABELS)
+    assert set(sch.EVENT_KINDS) == set(EVENT_KINDS)
+
+
+# ----------------------------------------------------------------- SLO
+def test_slo_parse_roundtrip_and_errors():
+    cfg = parse_slo("serve.ttft_s p99 < 0.5 over 60s objective 0.99")
+    assert cfg.metric == "serve.ttft_s" and cfg.stat == "p99"
+    assert cfg.op == "<" and cfg.threshold == 0.5
+    assert cfg.window_s == 60.0 and cfg.objective == 0.99
+    assert parse_slo(cfg.spec()) == cfg     # spec() round-trips
+    cfgs = parse_slo_args(["serve.ttft_s p99 < 0.5 over 60s; "
+                           "serve.queue_depth max < 16 over 10s",
+                           "serve.errors_total rate < 1 over 300s"])
+    assert len(cfgs) == 3
+    for bad in ("nonsense", "serve.ttft_s p42 < 0.5 over 60s",
+                "serve.ttft_s p99 ~ 0.5 over 60s",
+                "serve.ttft_s p99 < x over 60s"):
+        with pytest.raises(ValueError):
+            parse_slo(bad)
+
+
+def test_slo_evaluate_against_view():
+    state, clock = _fake_clock()
+    store = ts.WindowStore(interval_s=10.0, clock=clock)
+    for v in (0.01, 0.02, 0.9):
+        store.record_histogram("serve.ttft_s", v)
+    view = store.view(60.0)
+    ok_cfg = parse_slo("serve.ttft_s p50 < 0.5 over 60s")
+    bad_cfg = parse_slo("serve.ttft_s p99 < 0.5 over 60s")
+    v_ok = evaluate_slo(ok_cfg, view)
+    v_bad = evaluate_slo(bad_cfg, view)
+    assert v_ok["ok"] is True and v_ok["no_data"] is False
+    assert v_bad["ok"] is False and v_bad["value"] >= 0.5
+    # A window that never saw the metric: vacuous ok + no_data.
+    v_nd = evaluate_slo(ok_cfg, store.view(60.0, skip=10))
+    assert v_nd["ok"] is True and v_nd["no_data"] is True
+
+
+def test_slo_burn_rate_hand_computed_trace():
+    """THE burn-rate pin (ISSUE 16 acceptance): objective 0.9, 8 good
+    + 2 bad evaluations -> compliance 0.8, bad fraction 0.2, budget
+    0.1, burn rate exactly 2.0."""
+    tracker = SLOTracker(parse_slo(
+        "serve.ttft_s p99 < 0.5 over 60s objective 0.9"))
+    for ok in [True] * 8 + [False] * 2:
+        tracker.observe(ok)
+    assert tracker.total == 10
+    assert tracker.compliance == pytest.approx(0.8)
+    assert tracker.bad_fraction() == pytest.approx(0.2)
+    assert tracker.burn_rate() == pytest.approx(2.0)
+    # Horizon is trailing: 100 more good evaluations dilute the burn.
+    for _ in range(100):
+        tracker.observe(True)
+    assert tracker.burn_rate() == pytest.approx(0.0)
+    assert tracker.compliance == pytest.approx(108 / 110)
+
+
+# ------------------------------------------------------------ watchdog
+def _watchdog_rig(interval_s=10.0):
+    """An enabled registry with an installed fake-clock window store
+    and a watchdog wired to it."""
+    obs.enable()
+    state, clock = _fake_clock()
+    ts.install_windows(interval_s=interval_s, clock=clock)
+    return state
+
+
+def test_watchdog_queue_depth_rising_edge():
+    state = _watchdog_rig()
+    try:
+        wd = Watchdog(config=WatchdogConfig(queue_depth_limit=4.0))
+        obs.gauge("serve.queue_depth").set(9)    # min 9 >= 4: sustained
+        events = wd.check()
+        kinds = [e["kind"] for e in events]
+        assert kinds == ["watchdog.queue_depth_sustained"]
+        assert events[0]["severity"] == "warning"
+        # Still firing: NO repeat event (edge-triggered).
+        assert wd.check() == []
+        # Clears (queue drained in a fresh window), then re-fires.
+        state["t"] += 120.0
+        obs.gauge("serve.queue_depth").set(0)
+        assert wd.check() == []
+        state["t"] += 120.0
+        obs.gauge("serve.queue_depth").set(9)
+        assert [e["kind"] for e in wd.check()] == \
+            ["watchdog.queue_depth_sustained"]
+    finally:
+        ts.uninstall_windows()
+        obs.disable()
+
+
+def test_watchdog_ttft_regression_vs_trailing_baseline():
+    state = _watchdog_rig()
+    try:
+        wd = Watchdog(config=WatchdogConfig(
+            window_s=60.0, baseline_window_s=300.0,
+            ttft_regression_factor=2.0, min_samples=8))
+        # Healthy history: ~10ms TTFTs across old buckets.
+        for _ in range(3):
+            for _ in range(10):
+                obs.histogram("serve.ttft_s").observe(0.01)
+            state["t"] += 60.0
+        assert wd.check() == []            # current ~= baseline
+        # Regression: the CURRENT window's p99 is 10x the baseline's.
+        state["t"] += 60.0
+        for _ in range(10):
+            obs.histogram("serve.ttft_s").observe(0.1)
+        events = wd.check()
+        assert [e["kind"] for e in events] == ["watchdog.ttft_regression"]
+        d = events[0]["detail"]
+        assert d["current_p99"] >= 2.0 * d["baseline_p99"]
+        assert events[0]["severity"] == "critical"
+    finally:
+        ts.uninstall_windows()
+        obs.disable()
+
+
+def test_watchdog_replica_flap_and_slo_burn(tmp_path):
+    """Flap + burn rules end to end INTO the run-dir event stream:
+    typed records land in events.jsonl and pass the frozen schema."""
+    run_dir = str(tmp_path / "wd")
+    obs.start_run(run_dir, meta={"kind": "wd_test"},
+                  window_interval_s=10.0)
+    try:
+        slo = parse_slo("serve.ttft_s p99 < 0.05 over 60s objective 0.5")
+        wd = Watchdog(slos=[slo],
+                      config=WatchdogConfig(flap_limit=3.0,
+                                            burn_alert=2.0))
+        obs.counter("router.replica_restarts_total").inc(3)
+        obs.histogram("serve.ttft_s").observe(0.2)   # violates the SLO
+        events = wd.check()
+        kinds = [e["kind"] for e in events]
+        assert "watchdog.replica_flap" in kinds
+        assert "slo.eval" in kinds
+        # burn: 1 bad / 1 total over budget 0.5 -> 2.0 >= alert
+        assert "watchdog.slo_burn" in kinds
+        # Self-instrumentation is pinned schema too.
+        assert obs.counter("slo.violations_total").value == 1
+        assert obs.gauge("slo.burn_rate_max").value == pytest.approx(2.0)
+    finally:
+        obs.end_run()
+    errors = []
+    check_events_jsonl(os.path.join(run_dir, "events.jsonl"), errors)
+    assert errors == []
+    assert check_run_dir(run_dir) == []
+    with open(os.path.join(run_dir, "events.jsonl")) as f:
+        streamed = [json.loads(ln) for ln in f if ln.strip()]
+    assert [r["kind"] for r in streamed] == kinds
+
+
+def test_watchdog_thread_runs_and_survives_errors():
+    obs.enable()
+    try:
+        ts.install_windows(interval_s=10.0)
+        wd = Watchdog(config=WatchdogConfig())
+        t = WatchdogThread(wd, interval_s=0.01).start()
+        deadline = time.monotonic() + 30
+        while (time.monotonic() < deadline
+               and obs.counter("watchdog.checks_total").value < 3):
+            time.sleep(0.005)
+        t.stop()
+        assert obs.counter("watchdog.checks_total").value >= 3
+        # A check that raises must not kill the loop.
+        bad = Watchdog(config=WatchdogConfig())
+        bad.check = lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+        t2 = WatchdogThread(bad, interval_s=0.01).start()
+        deadline = time.monotonic() + 30
+        while (time.monotonic() < deadline
+               and obs.counter("watchdog.check_errors_total").value < 2):
+            time.sleep(0.005)
+        t2.stop()
+        assert obs.counter("watchdog.check_errors_total").value >= 2
+    finally:
+        ts.uninstall_windows()
+        obs.disable()
+
+
+def test_record_event_disabled_is_noop(tmp_path):
+    assert obs.record_event("watchdog.replica_flap") is None
+    assert obs.REGISTRY.events == []
+
+
+# ------------------------------------------------------------ CLI: slo
+def test_telemetry_cli_slo_report(tmp_path, capsys):
+    """nezha-telemetry RUN_DIR --slo: compliance/burn recomputed from
+    the captured slo.eval events, watchdog alerts rendered."""
+    from nezha_tpu.cli.telemetry import main as telemetry_main
+
+    run_dir = str(tmp_path / "run")
+    obs.start_run(run_dir, meta={"kind": "serve"})
+    slo = parse_slo("serve.ttft_s p99 < 0.05 over 60s objective 0.9")
+    wd = Watchdog(slos=[slo], config=WatchdogConfig())
+    for v in (0.01, 0.01, 0.2):
+        ts.current_windows()  # windows installed by start_run
+        obs.histogram("serve.ttft_s").observe(v)
+        wd.check()
+    obs.end_run()
+
+    assert telemetry_main([run_dir, "--slo"]) == 0
+    out = capsys.readouterr().out
+    assert "SLO report" in out
+    assert slo.name in out
+    assert telemetry_main([run_dir, "--slo", "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    row = payload["slos"][0]
+    assert row["slo"] == slo.name
+    assert row["evaluations"] == 3
+    kinds = [e["kind"] for e in payload["events"]]
+    assert kinds.count("slo.eval") == 3
+    # The third eval is bad: 1/3 bad over a 0.1 budget -> burn 3.3
+    # trips the default burn_alert=2.0 rule too.
+    assert "watchdog.slo_burn" in kinds
+
+
+def test_serve_cli_slo_flag_validation():
+    from nezha_tpu.cli.serve import _start_watchdog, build_parser
+    args = build_parser().parse_args(
+        ["--random-init", "--model-preset", "tiny",
+         "--slo", "totally bogus"])
+    with pytest.raises(SystemExit, match="--slo"):
+        _start_watchdog(args)
+    # No SLOs, no interval: watchdog stays off.
+    args = build_parser().parse_args(
+        ["--random-init", "--model-preset", "tiny"])
+    assert _start_watchdog(args) is None
+
+
+# ------------------------------------------------------------ nezha-top
+def test_nezha_top_renders_fleet_frame():
+    from nezha_tpu.cli.top import render_top
+    obs.enable()
+    try:
+        ts.install_windows(interval_s=10.0)
+        obs.counter("serve.admitted_total").inc(50)
+        obs.gauge("serve.queue_depth").set(3)
+        obs.gauge("router.replicas_live").set(2)
+        for i in range(50):
+            obs.histogram("serve.ttft_s").observe(0.01 + 0.001 * i)
+        text = ts.render_prometheus(obs.stats_snapshot(),
+                                    ts.windows_payload())
+    finally:
+        ts.uninstall_windows()
+        obs.disable()
+    frame = render_top(ts.parse_prometheus(text), "60s", url="http://x")
+    assert "queue depth" in frame and "ttft (s)" in frame
+    assert "replicas live" in frame
+    # Degrades readably on an empty scrape.
+    assert "no recognized samples" in render_top([], "60s")
+
+
+def test_nezha_top_polls_http_endpoint(tmp_path):
+    """nezha-top main() against a real /metrics HTTP server, bounded
+    by --iterations."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from nezha_tpu.cli.top import main as top_main
+
+    obs.enable()
+    try:
+        ts.install_windows(interval_s=10.0)
+        obs.counter("serve.admitted_total").inc(5)
+        body = ts.render_prometheus(obs.stats_snapshot(),
+                                    ts.windows_payload()).encode()
+    finally:
+        ts.uninstall_windows()
+        obs.disable()
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        rc = top_main([f"http://127.0.0.1:{srv.server_address[1]}",
+                       "--iterations", "2", "--interval", "0.01",
+                       "--no-clear"])
+        assert rc == 0
+        # Unreachable endpoint: 5 consecutive failures -> exit 1.
+        rc = top_main(["http://127.0.0.1:1", "--iterations", "6",
+                       "--interval", "0.01", "--no-clear"])
+        assert rc == 1
+    finally:
+        srv.shutdown()
+        t.join(timeout=10)
+
+
+# ------------------------------------------- fleet acceptance (thread)
+def _worker_args(extra=()):
+    from nezha_tpu.cli.serve import build_parser
+    return build_parser().parse_args(
+        ["--random-init", "--model-preset", "tiny", "--max-batch-size",
+         "2", "--max-len", "48", "--max-prefill-len", "8",
+         "--queue-capacity", "8", "--platform", "cpu", *extra])
+
+
+def _cfg(**kw):
+    base = dict(replicas=2, probe_interval_s=0.1, probe_misses=3,
+                route_retries=2, retry_backoff_base_s=0.01,
+                retry_backoff_max_s=0.05, restart_backoff_base_s=0.05,
+                restart_backoff_max_s=0.5, drain_timeout_s=20.0, seed=0)
+    base.update(kw)
+    return RouterConfig(**base)
+
+
+def _drive(router, n, salt=0):
+    for i in range(n):
+        code, obj = router.route(
+            {"id": f"m-{salt}-{i}",
+             "prompt_tokens": [(7 * j + 3 + i) % 128 for j in range(9)],
+             "max_new_tokens": 3, "seed": i})
+        assert code == 200, obj
+
+
+def test_fleet_metrics_acceptance_thread_backend(tmp_path):
+    """THE e2e drive: a 2-replica thread fleet under load serves a
+    fleet-rolled /metrics (router AND replica endpoints) whose windowed
+    TTFT quantiles and queue depth agree with the run-dir artifacts,
+    with fleet totals deduped (satellite 1: N thread members sharing
+    one process registry count ONCE)."""
+    run_dir = str(tmp_path / "fleet")
+    cfg = _cfg()
+    sup = Supervisor(ThreadBackend(_worker_args(), drain_timeout_s=20.0),
+                     cfg)
+    router = Router(sup, cfg)
+    obs.start_run(run_dir, meta={"kind": "serve_fleet"},
+                  window_interval_s=10.0)
+    register_router_instruments()
+    register_serve_instruments()
+    N = 6
+    try:
+        sup.start()
+        assert router.wait_live(2, timeout_s=600), sup.describe()
+        _drive(router, N)
+
+        # ---- fleet /stats: deduped totals (the PR 12 over-count fix)
+        fleet = router.fleet_stats()
+        assert fleet["fleet"]["counters"]["serve.admitted_total"] == N
+        # ---- fleet windows: merged payload, deduped member sketches
+        fw = router.fleet_windows()
+        assert fw["members"] >= 2 and fw["deduped"] >= 1
+        view = fw["windows"]["300s"]
+        assert view["counters"]["serve.admitted_total"]["delta"] == N
+        fleet_h = view["histograms"]["serve.ttft_s"]
+        assert fleet_h["count"] == N
+
+        # ---- the fleet /metrics text agrees with the merged windows
+        text = router.fleet_metrics_text()
+        assert check_metrics_exposition(text) == []
+        samples = ts.parse_prometheus(text)
+        assert ts.metric_value(samples, "nezha_serve_admitted_total") == N
+        got_p99 = ts.metric_value(samples, "nezha_serve_ttft_s",
+                                  window="300s", quantile="p99")
+        assert got_p99 == pytest.approx(fleet_h["p99"])
+        assert ts.metric_value(samples, "nezha_serve_queue_depth_last",
+                               window="300s") is not None
+
+        # ---- the replica's own /metrics over real HTTP
+        port = sup.replicas()[0].port
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            replica_text = r.read().decode()
+        assert check_metrics_exposition(replica_text) == []
+        rs = ts.parse_prometheus(replica_text)
+        assert ts.metric_value(rs, "nezha_serve_admitted_total") == N
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/windows", timeout=30) as r:
+            wp = json.loads(r.read())
+        assert wp["registry_id"] == obs.REGISTRY.registry_id
+    finally:
+        obs.end_run()
+        router.stop()
+        sup.shutdown()
+
+    # ---- the windowed quantiles match the run-dir artifacts: the
+    # sketch p99 is within the gamma bound of the summary.json exact
+    # reservoir p99 (same N observations, two estimators).
+    assert check_run_dir(run_dir) == []
+    with open(os.path.join(run_dir, "summary.json")) as f:
+        summary = json.load(f)
+    exact = summary["histograms"]["serve.ttft_s"]
+    assert exact["count"] == N
+    assert got_p99 == pytest.approx(
+        exact["p99"], rel=2 * (ts.DEFAULT_GAMMA - 1))
+
+
+def test_fleet_watchdog_trips_on_injected_regression(tmp_path):
+    """Acceptance: a fault-injected latency regression mid-run trips
+    watchdog.ttft_regression, and the typed event lands schema-valid
+    in the run dir's events.jsonl."""
+    run_dir = str(tmp_path / "reg")
+    cfg = _cfg()
+    sup = Supervisor(ThreadBackend(_worker_args(), drain_timeout_s=20.0),
+                     cfg)
+    router = Router(sup, cfg)
+    wd = Watchdog(config=WatchdogConfig(
+        window_s=2.0, baseline_window_s=30.0,
+        ttft_regression_factor=2.0, min_samples=4))
+    try:
+        sup.start()
+        assert router.wait_live(2, timeout_s=600), sup.describe()
+        # Warm up BEFORE starting the instrumented run: the first
+        # request pays JIT compile (seconds of TTFT) and with few
+        # baseline samples the baseline p99 IS that outlier, masking
+        # any later regression.
+        _drive(router, 2, salt=9)
+        # Short window interval so "healthy history" and "regressed
+        # now" land in different buckets within test time.
+        obs.start_run(run_dir, meta={"kind": "serve_fleet"},
+                      window_interval_s=0.5)
+        register_router_instruments()
+        register_serve_instruments()
+        _drive(router, 6, salt=0)          # healthy baseline traffic
+        # Age the healthy traffic past window_s so the check-time
+        # CURRENT window holds only fault-phase requests and the
+        # trailing baseline (skip excludes the newest 2s) holds the
+        # healthy ones.
+        time.sleep(2.6)
+        assert wd.check() == []            # healthy: no alert
+        # Inject a deterministic prefill delay: every request's TTFT
+        # regresses by ~100ms against a ~ms baseline.
+        faults.install(faults.FaultPlan.parse("serve.prefill:delay=0.1x*"))
+        _drive(router, 6, salt=1)
+        events = wd.check()
+        kinds = [e["kind"] for e in events]
+        assert "watchdog.ttft_regression" in kinds, (
+            kinds, obs.windows(2.0), obs.windows(30.0, skip=4))
+    finally:
+        faults.clear()
+        obs.end_run()
+        router.stop()
+        sup.shutdown()
+    errors = []
+    check_events_jsonl(os.path.join(run_dir, "events.jsonl"), errors)
+    assert errors == []
+    with open(os.path.join(run_dir, "events.jsonl")) as f:
+        streamed = [json.loads(ln) for ln in f if ln.strip()]
+    assert any(r["kind"] == "watchdog.ttft_regression"
+               for r in streamed)
+    assert check_run_dir(run_dir) == []
+
+
+# ------------------------------------------ thread vs process parity
+@pytest.mark.slow
+def test_fleet_totals_thread_vs_process_agree(tmp_path):
+    """Satellite 1, the cross-backend pin: the SAME load through a
+    thread-backed fleet (N members, one shared registry — dedupe) and
+    a process-backed fleet (N members, N registries — sum) reports the
+    SAME fleet totals. Marked slow: real worker subprocesses."""
+    from conftest import worker_env
+
+    from nezha_tpu.cli.serve import _worker_argv
+
+    N = 4
+    totals = {}
+    for backend_kind in ("thread", "process"):
+        cfg = _cfg(replicas=2, probe_timeout_s=10.0)
+        if backend_kind == "thread":
+            args = _worker_args(["--drain-timeout", "20"])
+            backend = ThreadBackend(args, drain_timeout_s=20.0)
+        else:
+            # Process workers only instrument when telemetry is on:
+            # --run-dir gives each replica its own run subdirectory
+            # (and its own registry — the fleet roll-up must SUM them,
+            # where the thread fleet's shared registry must dedupe).
+            args = _worker_args(
+                ["--drain-timeout", "20",
+                 "--run-dir", str(tmp_path / "proc_run")])
+            backend = ProcessBackend(
+                lambda rid, port: _worker_argv(args, rid, port),
+                env=worker_env(),
+                log_dir=str(tmp_path / "logs"))
+        sup = Supervisor(backend, cfg)
+        router = Router(sup, cfg)
+        if backend_kind == "thread":
+            obs.enable()
+            register_router_instruments()
+            register_serve_instruments()
+        try:
+            sup.start()
+            assert router.wait_live(2, timeout_s=600), sup.describe()
+            _drive(router, N)
+            fleet = router.fleet_stats()
+            totals[backend_kind] = \
+                fleet["fleet"]["counters"]["serve.admitted_total"]
+        finally:
+            router.stop()
+            sup.shutdown()
+            obs.disable()
+            obs.REGISTRY.reset()
+    assert totals["thread"] == totals["process"] == N, totals

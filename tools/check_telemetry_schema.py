@@ -35,11 +35,14 @@ except Exception:
     sys.modules["nezha_tpu"] = _pkg
 
 from nezha_tpu.analysis.telemetry_schema import (  # noqa: E402,F401
-    SCHEMA_VERSION, STATS_SCHEMA_VERSION, _DIST_COUNTERS,
-    _PINNED_SPANS, _PINNED_SPAN_PREFIXES, _ROUTER_COUNTERS,
-    _ROUTER_GAUGES, _ROUTER_HISTOGRAMS, _SERVE_COUNTERS, _SERVE_GAUGES,
-    _SERVE_HISTOGRAMS, check_metrics_jsonl, check_run_dir,
-    check_spans_jsonl, check_stats_payload, check_summary_json)
+    EVENT_KINDS, EVENT_SCHEMA_VERSION, EXPOSITION_PREFIX,
+    EXPOSITION_WINDOW_LABELS, SCHEMA_VERSION, STATS_SCHEMA_VERSION,
+    _DIST_COUNTERS, _PINNED_SPANS, _PINNED_SPAN_PREFIXES,
+    _ROUTER_COUNTERS, _ROUTER_GAUGES, _ROUTER_HISTOGRAMS,
+    _SERVE_COUNTERS, _SERVE_GAUGES, _SERVE_HISTOGRAMS,
+    check_events_jsonl, check_metrics_exposition, check_metrics_jsonl,
+    check_run_dir, check_spans_jsonl, check_stats_payload,
+    check_summary_json)
 
 
 def main(argv=None) -> int:
