@@ -167,6 +167,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "(supervisor + N in-process replicas, each its "
                         "own engine, reached over real HTTP) instead "
                         "of one scheduler — closed loop only")
+    p.add_argument("--affinity-routing", choices=["on", "off"],
+                   default="on",
+                   help="--replicas > 1: prefix-affinity routing — the "
+                        "router scores live replicas by how much of "
+                        "the prompt their advertised trie digest "
+                        "covers (discounted by load) instead of pure "
+                        "least-loaded, and hands near-miss picks a "
+                        "peer pull_from pointer; off = the "
+                        "least-loaded control")
+    p.add_argument("--digest-interval", type=float, default=2.0,
+                   help="--replicas > 1: seconds between replica trie-"
+                        "digest rebuilds (advertised over /healthz)")
+    p.add_argument("--digest-max-entries", type=int, default=256,
+                   help="--replicas > 1: bound on advertised digest "
+                        "entries per replica (recency-first)")
     p.add_argument("--disaggregate", action="store_true",
                    help="drive the DISAGGREGATED router: "
                         "--prefill-replicas role=prefill workers take "
@@ -267,9 +282,25 @@ def run(args) -> dict:
             raise SystemExit("--replicas > 1 supports closed-loop "
                              "load only (open-loop arrivals belong to "
                              "the single-replica latency study)")
-        record = _run_replicas(args, horizons[0])
+        if getattr(args, "churn_users", 0) and not args.disaggregate:
+            record = _run_fleet(args, horizons[0])
+        else:
+            record = _run_replicas(args, horizons[0])
         if args.json:
             print(json.dumps(record, indent=2, sort_keys=True))
+        elif "fleet" in record:
+            fl = record["fleet"]
+            peer = fl.get("peer_pull") or {}
+            print(f"fleet replicas={record['replicas']} "
+                  f"affinity={fl['affinity_routing']}: "
+                  f"{fl['users']} users x {fl['visits']} visits, "
+                  f"revisit/first ttft p50 "
+                  f"{fl['revisit_vs_first_ttft_p50']:.2f}, "
+                  f"{fl['affinity_wins']} affinity wins, "
+                  f"{fl['kv_pulls']} pulls "
+                  f"({fl['kv_pull_bytes'] / 1024:.1f} KiB), hits "
+                  f"{fl['fleet_hits']}, peer installed "
+                  f"{peer.get('installed', 0)}")
         else:
             lat = record["latency_s"]
             mig = record.get("migration") or {}
@@ -858,7 +889,21 @@ def _run_replicas(args, decode_horizon: int) -> dict:
              "--queue-capacity", str(args.queue_capacity),
              "--decode-horizon", str(decode_horizon),
              "--max-new-tokens", str(args.max_new_tokens),
+             # KV-pool shape rides into every worker (the fleet KV
+             # scenarios need paged pools with pinned block geometry;
+             # plain replica runs get the same defaults they always
+             # did), and the digest knobs ride along so /healthz
+             # advertises what the affinity scorer consumes.
+             "--kv-layout", args.kv_layout,
+             "--kv-block-size", str(args.kv_block_size),
+             "--kv-dtype", args.kv_dtype,
+             "--kv-host-blocks", str(getattr(args, "kv_host_blocks", 0)),
+             "--prefix-cache", args.prefix_cache,
+             "--digest-interval", str(args.digest_interval),
+             "--digest-max-entries", str(args.digest_max_entries),
              "--seed", str(args.seed)]
+    if args.kv_num_blocks:
+        wargv += ["--kv-num-blocks", str(args.kv_num_blocks)]
     if args.prefill_buckets:
         wargv += ["--prefill-buckets", str(args.prefill_buckets)]
     if args.decode_impl:
@@ -882,7 +927,10 @@ def _run_replicas(args, decode_horizon: int) -> dict:
         replicas=total, roles=roles,
         probe_interval_s=0.1, probe_misses=3,
         restart_backoff_base_s=0.05, restart_backoff_max_s=0.5,
-        drain_timeout_s=5.0, seed=args.seed)
+        drain_timeout_s=5.0, seed=args.seed,
+        affinity_routing=args.affinity_routing == "on",
+        digest_interval_s=args.digest_interval,
+        digest_max_entries=args.digest_max_entries)
     sup = Supervisor(ThreadBackend(wargs, drain_timeout_s=5.0,
                                    roles=roles), cfg)
     router = Router(sup, cfg)
@@ -1123,6 +1171,305 @@ def _run_replicas(args, decode_horizon: int) -> dict:
                    "injected": plan.num_injected if plan else 0,
                    "errored": sum(1 for _, _, o, _ in ok
                                   if o.get("finish_reason") == "error")},
+    }
+
+
+def _run_fleet(args, decode_horizon: int) -> dict:
+    """The fleet-wide KV reuse scenario (``--replicas N
+    --churn-users U``): U users with distinct block-aligned prompt
+    prefixes revisit a ROUTED fleet sequentially, against per-replica
+    pools each deliberately too small to hold every user's prefix.
+
+    With ``--affinity-routing on``, visit 0 lands by consistent-hash
+    cold placement (users SPREAD across the fleet, so the aggregate
+    device cache holds every prefix), trie digests propagate over the
+    /healthz probes, and each revisit routes back to its owner's warm
+    trie — the fleet serves from cache what no single pool could hold.
+    The ``off`` control routes least-loaded: sequential traffic piles
+    every user onto one replica, whose pool cycles, so revisits
+    re-prefill cold. A peer-pull phase (affinity runs only) then
+    saturates one owner's admission queue and routes a revisit — the
+    router must place it on a sibling with a ``pull_from`` pointer to
+    the full owner, and the blocks arrive over the ``/kv_export``
+    int8 wire instead of being re-prefilled.
+
+    The record splits TTFT by first visit / revisit / peer-pull hit
+    and carries the affinity-win and pull ledgers; ``nezha-bench``'s
+    fleet_kv suite gates it."""
+    import http.client
+    import threading
+
+    from nezha_tpu import obs
+    from nezha_tpu.cli.serve import build_parser as serve_parser
+    from nezha_tpu.serve import fleetcache
+    from nezha_tpu.serve.router import Router, register_router_instruments
+    from nezha_tpu.serve.scheduler import register_serve_instruments
+    from nezha_tpu.serve.supervisor import (RouterConfig, Supervisor,
+                                            ThreadBackend)
+
+    users = int(args.churn_users)
+    churn_plen = args.churn_prefix_len or 4 * args.kv_block_size
+    if churn_plen % args.kv_block_size:
+        raise SystemExit(
+            f"--churn-prefix-len {churn_plen} must be a multiple of "
+            f"--kv-block-size {args.kv_block_size} (only full blocks "
+            f"are cacheable/advertisable)")
+    if churn_plen + 2 + args.max_new_tokens > args.max_len:
+        raise SystemExit(
+            f"--churn-prefix-len {churn_plen} + tail 2 + "
+            f"max_new_tokens {args.max_new_tokens} exceeds "
+            f"--max-len {args.max_len}")
+    if args.kv_layout != "paged" or args.prefix_cache != "on":
+        raise SystemExit("the fleet scenario needs --kv-layout paged "
+                         "with --prefix-cache on (digests summarize "
+                         "the prefix trie)")
+    visits = max(2, -(-args.requests // users))
+    affinity = args.affinity_routing == "on"
+    blocks_per_user = churn_plen // args.kv_block_size
+
+    wargv = ["--random-init", "--model-preset", args.model_preset,
+             "--max-batch-size", str(args.max_batch_size),
+             "--max-len", str(args.max_len),
+             "--max-prefill-len", str(args.max_prefill_len),
+             "--queue-capacity", str(args.queue_capacity),
+             "--decode-horizon", str(decode_horizon),
+             "--max-new-tokens", str(args.max_new_tokens),
+             "--kv-layout", args.kv_layout,
+             "--kv-block-size", str(args.kv_block_size),
+             "--kv-dtype", args.kv_dtype,
+             "--kv-host-blocks", str(getattr(args, "kv_host_blocks", 0)),
+             "--prefix-cache", args.prefix_cache,
+             "--digest-interval", str(args.digest_interval),
+             "--digest-max-entries", str(args.digest_max_entries),
+             "--seed", str(args.seed)]
+    if args.kv_num_blocks:
+        wargv += ["--kv-num-blocks", str(args.kv_num_blocks)]
+    if args.platform:
+        wargv += ["--platform", args.platform]
+    wargs = serve_parser().parse_args(wargv)
+    cfg = RouterConfig(
+        replicas=args.replicas,
+        probe_interval_s=0.1, probe_misses=3,
+        restart_backoff_base_s=0.05, restart_backoff_max_s=0.5,
+        drain_timeout_s=5.0, seed=args.seed,
+        affinity_routing=affinity,
+        digest_interval_s=args.digest_interval,
+        digest_max_entries=args.digest_max_entries)
+    sup = Supervisor(ThreadBackend(wargs, drain_timeout_s=5.0), cfg)
+    router = Router(sup, cfg)
+
+    rng = random.Random(args.seed)
+    vocab = 512 if args.model_preset == "tiny" else 50257
+    prefixes = [[rng.randrange(vocab) for _ in range(churn_plen)]
+                for _ in range(users)]
+    hashes = [fleetcache.prefix_hashes(p, args.kv_block_size)
+              for p in prefixes]
+
+    def payload(u: int, v, seed: int) -> dict:
+        # Fixed per-user prefix + a fresh 2-token tail per visit: the
+        # prefix is the reusable span, the tail forces a real (if
+        # tiny) prefill on every visit so TTFT is never zero-work.
+        return {"id": f"fleet-u{u}-v{v}",
+                "prompt_tokens": prefixes[u] + [rng.randrange(vocab),
+                                                rng.randrange(vocab)],
+                "max_new_tokens": args.max_new_tokens, "seed": seed}
+
+    def _post(port, obj, timeout=600):
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=timeout)
+        try:
+            conn.request("POST", "/generate",
+                         body=json.dumps(obj).encode())
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read() or b"{}")
+        finally:
+            conn.close()
+
+    def _owner_of(hs):
+        for r in sup.replicas():
+            parsed = fleetcache.digest_entries_of(r.last_health)
+            if parsed and fleetcache.coverage(parsed[1], hs)[0] \
+                    >= blocks_per_user:
+                return r
+        return None
+
+    sink = None
+    ttft_first, ttft_revisit = [], []
+    peer = None
+    try:
+        sup.start()
+        router.start()
+        if not router.wait_live(args.replicas, timeout_s=600):
+            raise SystemExit(f"replicas never became live: "
+                             f"{sup.describe()}")
+        # Warm every replica's programs off the clock: the full churn
+        # prompt covers every chunk program a cold prefill runs; a
+        # 2-token prompt covers the tail-only program a digest-hit
+        # revisit (or a pulled prefill) runs.
+        warm = [threading.Thread(target=_post, args=(
+                    r.port,
+                    {"id": f"warmup-{r.rid}-{j}",
+                     "prompt_tokens": [(131 * j + 7 * i + 1) % vocab
+                                       for i in range(n)],
+                     "max_new_tokens": 1}))
+                for r in sup.live_replicas()
+                for j, n in enumerate(sorted({churn_plen + 2, 2}))]
+        for t in warm:
+            t.start()
+        for t in warm:
+            t.join()
+        # Warmup must not leak into the measured record: every pool
+        # drops its cached blocks and zeroes the reuse ledgers.
+        for r in sup.replicas():
+            sched = r.handle.worker._sched
+            with sched._lock:
+                pool = sched.engine.pool
+                pool.clear_prefix_cache()
+                pool.prefix_hits = 0
+                pool.cow_copies = 0
+                pool.fleet_hits = {"device": 0, "host": 0, "peer": 0}
+                if pool.host_blocks:
+                    pool.warm_host_tier_programs()
+                    pool.clear_host_tier()
+                    pool.demotions = 0
+                    pool.promotions = 0
+                    pool.promote_failures = 0
+        if args.run_dir:
+            sink = obs.start_run(args.run_dir, meta={
+                "kind": "serve_fleet_bench", "mode": "closed",
+                "replicas": args.replicas,
+                "requests": users * visits, "offered": 1,
+                "decode_horizon": decode_horizon,
+                "affinity": args.affinity_routing})
+            register_router_instruments()
+            register_serve_instruments()
+        wins0 = router.affinity_wins
+        pulls0, pbytes0 = router.kv_pulls, router.kv_pull_bytes
+
+        # Phase 1 — first visits, sequential: cold placement spreads
+        # users across the fleet (affinity) or piles them onto the
+        # least-loaded member (control).
+        for u in range(users):
+            code, obj = router.route(payload(u, 0, u))
+            if code == 200 and obj.get("ttft_s") is not None:
+                ttft_first.append(obj["ttft_s"])
+
+        # Phase 2 — let the digests propagate. The affinity run waits
+        # until the ROUTER's own probe snapshots advertise every
+        # user's full prefix (that snapshot is exactly what revisits
+        # route on); the control — whose single serving pool cycles,
+        # so full fleet coverage never materializes — waits a fixed
+        # digest+probe interval instead, equalizing cache age across
+        # the two runs.
+        if affinity:
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if all(_owner_of(hs) is not None for hs in hashes):
+                    break
+                time.sleep(0.05)
+        else:
+            time.sleep(2 * args.digest_interval + 0.5)
+
+        # Phase 3 — revisits, sequential rounds.
+        for v in range(1, visits):
+            for u in range(users):
+                code, obj = router.route(payload(u, v, v * users + u))
+                if code == 200 and obj.get("ttft_s") is not None:
+                    ttft_revisit.append(obj["ttft_s"])
+
+        # Phase 4 — the peer-pull drill (affinity runs only): clamp
+        # user 0's owner to a zero-capacity admission queue (the
+        # deterministic stand-in for a saturated replica — the
+        # ThreadBackend's workers are in-process, so the clamp is one
+        # attribute write) and route a revisit.  The router forwards
+        # to the owner first (best score), eats its queue-full 503,
+        # re-picks the sibling, and — the whole point — hands it a
+        # ``pull_from`` pointer at the still-exporting owner, so the
+        # prefix arrives over the int8 wire instead of a cold
+        # prefill.  ``/kv_export`` needs no admission, which is why a
+        # full owner's cache keeps paying off.
+        if affinity:
+            owner = _owner_of(hashes[0])
+            peer = {"owner_rid": owner.rid if owner else None,
+                    "saturated": False, "attempts": 0,
+                    "ttft_s": None, "pull_s": None, "installed": 0,
+                    "bytes": 0, "degraded": None}
+            if owner is not None:
+                owner_sched = owner.handle.worker._sched
+                cap = owner_sched.queue_capacity
+                try:
+                    owner_sched.queue_capacity = 0
+                    code, _ = _post(owner.port,
+                                    {"id": "probe-full",
+                                     "prompt_tokens": [1, 2, 3],
+                                     "max_new_tokens": 1})
+                    peer["saturated"] = code == 503
+                    attempts = 0
+                    while peer["saturated"] and attempts < 5:
+                        attempts += 1
+                        code, obj = router.route(
+                            payload(0, f"pull{attempts}",
+                                    9000 + attempts))
+                        fp = (obj.get("fleet_pull")
+                              if code == 200 and isinstance(obj, dict)
+                              else None)
+                        if isinstance(fp, dict):
+                            peer["degraded"] = fp.get("degraded")
+                            if fp.get("installed"):
+                                peer["ttft_s"] = obj.get("ttft_s")
+                                peer["pull_s"] = fp.get("seconds")
+                                peer["installed"] = fp.get(
+                                    "installed", 0)
+                                peer["bytes"] = fp.get("bytes", 0)
+                                break
+                    peer["attempts"] = attempts
+                finally:
+                    owner_sched.queue_capacity = cap
+
+        wins = router.affinity_wins - wins0
+        pulls = router.kv_pulls - pulls0
+        pull_bytes = router.kv_pull_bytes - pbytes0
+        fleet_hits = {"device": 0, "host": 0, "peer": 0}
+        prefix_hits = 0
+        for r in sup.replicas():
+            w = getattr(r.handle, "worker", None)
+            if w is None or w.dead.is_set():
+                continue
+            pool = w._sched.engine.pool
+            for k in fleet_hits:
+                fleet_hits[k] += pool.fleet_hits.get(k, 0)
+            prefix_hits += getattr(pool, "prefix_hits", 0)
+    finally:
+        if sink is not None:
+            obs.end_run()
+        router.stop()
+        sup.shutdown()
+
+    p_first = _percentiles(ttft_first or [0.0])
+    p_revisit = _percentiles(ttft_revisit or [0.0])
+    return {
+        "mode": "closed",
+        "replicas": args.replicas,
+        "decode_horizon": decode_horizon,
+        "offered": 1,
+        "requests": users * visits,
+        "fleet": {
+            "users": users, "visits": visits,
+            "prefix_len": churn_plen,
+            "affinity_routing": args.affinity_routing,
+            "digest_interval_s": args.digest_interval,
+            "digest_max_entries": args.digest_max_entries,
+            "affinity_wins": wins,
+            "kv_pulls": pulls,
+            "kv_pull_bytes": pull_bytes,
+            "fleet_hits": fleet_hits,
+            "prefix_hits": prefix_hits,
+            "ttft_first_visit_s": p_first,
+            "ttft_revisit_s": p_revisit,
+            "revisit_vs_first_ttft_p50": (
+                p_revisit["p50"] / max(p_first["p50"], 1e-9)),
+            "peer_pull": peer,
+        },
     }
 
 

@@ -56,6 +56,15 @@ EXPECTED_POINTS = frozenset({
     # promote_failures ledger), never an error surfaced to the client
     # and never a leaked block on either tier.
     "serve.kv.promote",
+    # Fleet-wide KV reuse (PR 17, serve/fleetcache): the affinity
+    # scorer inside Router._pick — an injected error degrades THAT
+    # request's pick to plain least-loaded, never a client-visible
+    # error — and the peer-pull client (migrate.pull_prefix_into) —
+    # an injected error (or delay, the mid-pull SIGKILL drill's
+    # window-stretcher) surfaces as MigrationError kind
+    # "kv_pull_failed" and the replica front end degrades the request
+    # to a cold prefill, zero blocks leaked on either side.
+    "router.affinity", "replica.kv_pull",
     # Train->serve checkpoint resharding (serve/sharded/reshard.py):
     # armed at the start of every reshard — an injected error surfaces
     # as the same typed ReshardError a corrupt/missing leaf produces,

@@ -73,6 +73,18 @@ _SERVE_COUNTERS = {"serve.admitted_total", "serve.rejected_total",
                    # no host tier report 0s, never omit them.
                    "serve.kv.demotions_total",
                    "serve.kv.promotions_total",
+                   # Fleet-wide KV reuse (PR 17, serve/fleetcache):
+                   # requests that reused cached prefix blocks, split
+                   # by tier of origin (own device trie / own host
+                   # tier / a sibling's peer pull), plus the wire
+                   # bytes peer pulls installed. Knob-invariant:
+                   # single-replica and affinity-off runs report 0s,
+                   # never omit them.
+                   "serve.kv.fleet_hits_total",
+                   "serve.kv.fleet_hits_device_total",
+                   "serve.kv.fleet_hits_host_total",
+                   "serve.kv.fleet_hits_peer_total",
+                   "serve.kv.pull_bytes",
                    # Speculative decoding (PR 13): draft tokens
                    # proposed and accepted across all verify windows.
                    # Knob-invariant: a non-speculative run reports 0s,
@@ -124,7 +136,12 @@ _ROUTER_COUNTERS = {"router.retries_total", "router.failovers_total",
                     # Disaggregated topologies: local-decode (and
                     # no-prefill-tier) degradations — typed fallbacks,
                     # 0 on homogeneous runs.
-                    "router.migrate_fallbacks_total"}
+                    "router.migrate_fallbacks_total",
+                    # Fleet-wide KV reuse (PR 17): admissions where
+                    # the affinity scorer overrode the least-loaded
+                    # pick (coverage win or cold consistent-hash
+                    # placement). 0 with affinity routing off.
+                    "router.affinity_wins_total"}
 _ROUTER_GAUGES = {"router.replicas_live"}
 _ROUTER_HISTOGRAMS = {"router.route_s",
                       # The queueing-delay split of the disaggregated
@@ -189,6 +206,11 @@ _PINNED_SPANS = {
     # promotion — the async-copy window dispatched ahead of the
     # bucketed prefill (attrs carry the block count).
     "serve.kv.promote_s",
+    # Fleet-wide KV reuse (PR 17): one span per near-miss peer pull
+    # the router orchestrated — brackets the whole forward-with-
+    # pull_from hop (attrs carry src/dst rids, blocks, wire bytes,
+    # and whether the replica degraded to a cold prefill).
+    "router.kv_pull_s",
 }
 
 # Namespaces whose METRIC names (counter/gauge/histogram) the source

@@ -267,6 +267,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="consecutive startup failures after which a "
                         "replica's circuit breaker opens (the "
                         "supervisor stops restarting it)")
+    p.add_argument("--affinity-routing", choices=["on", "off"],
+                   default=None,
+                   help="route multi-replica token-id requests by "
+                        "prefix AFFINITY (serve/fleetcache): each "
+                        "replica piggybacks a bounded trie digest on "
+                        "/healthz, the router scores candidates by "
+                        "expected-prefix-hit-length x load and hands "
+                        "near-miss picks a peer pull_from hint over "
+                        "the /kv_export wire. Default: on when "
+                        "--replicas > 1, off otherwise")
+    p.add_argument("--digest-interval", type=float, default=2.0,
+                   help="seconds between fleet-digest rebuilds on each "
+                        "replica (the /healthz digest payload's "
+                        "staleness cadence)")
+    p.add_argument("--digest-max-entries", type=int, default=256,
+                   help="bound on prefix-hash entries one replica "
+                        "advertises per digest (recency-first "
+                        "truncation)")
     p.add_argument("--trace-sample", type=float, default=1.0,
                    help="fraction of requests that carry a distributed "
                         "trace id (per-request lifecycle spans stitched "
@@ -746,7 +764,7 @@ def run_http(scheduler, args, tokenizer, eos_id, port: int,
                 status = "draining"
             else:
                 status = "ok"
-            self._send(200 if status == "ok" else 503, {
+            payload = {
                 "status": status,
                 "active": pool.num_active,
                 "capacity": pool.capacity,
@@ -758,7 +776,14 @@ def run_http(scheduler, args, tokenizer, eos_id, port: int,
                 # is off or the layout is dense): what the router's
                 # replica table and operators size the tier against.
                 "host_blocks": pool.host_blocks,
-                "host_blocks_used": pool.host_blocks_used})
+                "host_blocks_used": pool.host_blocks_used}
+            if status == "ok":
+                # Fleet digest piggyback (PR 17): the router's prober
+                # is the digest transport — no extra endpoint.
+                payload.update(scheduler.fleet_digest(
+                    getattr(args, "digest_interval", 2.0),
+                    getattr(args, "digest_max_entries", 256)))
+            self._send(200 if status == "ok" else 503, payload)
 
         def do_POST(self):
             from nezha_tpu.serve import migrate
@@ -784,14 +809,30 @@ def run_http(scheduler, args, tokenizer, eos_id, port: int,
             if isinstance(obj, dict) and obj.get("resume"):
                 return self._handle_resume(str(obj["resume"]))
             mig_meta = None
-            if isinstance(obj, dict) and obj.get("pull_from") is not None:
+            fleet_meta = None
+            pull = obj.get("pull_from") if isinstance(obj, dict) else None
+            if isinstance(pull, dict) and "tokens" in pull \
+                    and "request_id" not in pull:
+                # Fleet peer pull (PR 17): fetch covering prefix
+                # blocks from the sibling the router named, then fall
+                # through to ordinary admission so the submit below
+                # prefix-hits them. Failure DEGRADES to a cold prefill
+                # — never an HTTP error; the pull is an optimization,
+                # not a dependency.
+                try:
+                    fleet_meta = migrate.pull_prefix_into(scheduler,
+                                                          pull)
+                except migrate.MigrationError as e:
+                    fleet_meta = {"bytes": 0, "blocks": 0,
+                                  "installed": 0, "degraded": str(e),
+                                  "error_type": e.kind}
+            elif pull is not None:
                 # Decode side of a migration: pull + install + ACK
                 # BEFORE admission so the submit below prefix-hits the
                 # installed blocks; failure is the typed 424 the router
                 # retries on.
                 try:
-                    mig_meta = migrate.pull_into(scheduler,
-                                                 obj["pull_from"])
+                    mig_meta = migrate.pull_into(scheduler, pull)
                 except migrate.MigrationError as e:
                     return self._send(424, {
                         "error": str(e), "error_type": e.kind})
@@ -848,6 +889,8 @@ def run_http(scheduler, args, tokenizer, eos_id, port: int,
             out.pop("event")
             if mig_meta is not None:
                 out["migration"] = mig_meta
+            if fleet_meta is not None:
+                out["fleet_pull"] = fleet_meta
             self._send(200, out)
 
         def _handle_resume(self, rid: str):
@@ -1076,6 +1119,12 @@ def _worker_argv(args, rid: int, port: int, role: Optional[str] = None
              "--prefix-cache", args.prefix_cache,
              "--kv-eviction", args.kv_eviction,
              "--kv-host-blocks", str(args.kv_host_blocks),
+             # Digest knobs ride into every worker: the /healthz
+             # digest payload is built replica-side (PR 17).
+             "--digest-interval",
+             str(getattr(args, "digest_interval", 2.0)),
+             "--digest-max-entries",
+             str(getattr(args, "digest_max_entries", 256)),
              "--drain-timeout", str(args.drain_timeout),
              "--trace-sample", str(getattr(args, "trace_sample", 1.0)),
              "--watchdog-interval",
@@ -1157,6 +1206,11 @@ def run_multi(args, ready_cb=None, drain_event=None) -> int:
     def role_of(rid: int) -> str:
         return roles[rid] if roles else args.role
 
+    # Affinity routing defaults ON for a genuine multi-replica fleet
+    # (that is where cross-replica reuse exists to win) and OFF for a
+    # single replica, unless the flag pins it either way.
+    affinity = getattr(args, "affinity_routing", None) \
+        or ("on" if total > 1 else "off")
     cfg = RouterConfig(
         replicas=total, roles=roles,
         probe_interval_s=args.probe_interval,
@@ -1165,7 +1219,10 @@ def run_multi(args, ready_cb=None, drain_event=None) -> int:
         restart_backoff_base_s=args.restart_backoff,
         max_restart_failures=args.max_restart_failures,
         drain_timeout_s=args.drain_timeout,
-        seed=args.seed)
+        seed=args.seed,
+        affinity_routing=(affinity == "on"),
+        digest_interval_s=getattr(args, "digest_interval", 2.0),
+        digest_max_entries=getattr(args, "digest_max_entries", 256))
     from nezha_tpu import obs
     try:
         # The router is the trace-minting edge: the sample knob lives

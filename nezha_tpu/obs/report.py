@@ -176,6 +176,22 @@ def render_serving_section(summary: Optional[dict]) -> List[str]:
                 f"  kv host tier: {host_used:.0f} blocks resident "
                 f"({gauges.get('serve.kv.host_bytes_resident', 0) / 1024:.1f} "
                 f"KiB)  {demoted:.0f} demoted  {promoted:.0f} promoted")
+        fleet = counters.get("serve.kv.fleet_hits_total", 0)
+        pulled = counters.get("serve.kv.pull_bytes", 0)
+        if fleet or pulled:
+            # Fleet-wide KV reuse (PR 17; absent on single-replica /
+            # affinity-off runs which report 0s): the three-tier hit
+            # split — a healthy affinity fleet shows device hits
+            # dominating (the scorer landed revisits on their owner)
+            # with peer hits covering owner churn/saturation.
+            lines.append(
+                f"  fleet kv: {fleet:.0f} hits (device "
+                f"{counters.get('serve.kv.fleet_hits_device_total', 0):.0f}"
+                f" / host "
+                f"{counters.get('serve.kv.fleet_hits_host_total', 0):.0f}"
+                f" / peer "
+                f"{counters.get('serve.kv.fleet_hits_peer_total', 0):.0f})"
+                f"  {pulled / 1024:.1f} KiB pulled")
     mesh = gauges.get("serve.mesh.devices", 0)
     if mesh and mesh >= 2:
         # Tensor-sharded serving (absent on single-device runs): mesh
@@ -259,6 +275,11 @@ def render_replicas_section(summary: Optional[dict]) -> List[str]:
             f"  route: p50 {h['p50'] * 1e3:.1f} ms  "
             f"p90 {h['p90'] * 1e3:.1f} ms  "
             f"p99 {h['p99'] * 1e3:.1f} ms  (n={h['count']})")
+    # Fleet-wide KV reuse (PR 17): affinity overrides of the least-
+    # loaded pick (present only when the scorer actually won any).
+    aff = counters.get("router.affinity_wins_total", 0)
+    if aff:
+        lines.append(f"  affinity: {aff:.0f} wins over least-loaded")
     # Disaggregated tiers: migration volume and the per-tier queueing
     # split (present only when the run actually migrated / split).
     mig = counters.get("serve.kv.migrations_total", 0)

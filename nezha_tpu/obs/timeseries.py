@@ -460,6 +460,18 @@ def render_prometheus(stats: Optional[dict],
 
     lines: List[str] = []
     if stats:
+        ctr = stats.get("counters") or {}
+        # Fleet KV reuse at-a-glance (PR 17): a comment line — both
+        # parse_prometheus and the schema validator skip '#' lines, so
+        # this is scrape-invisible but human-greppable on /metrics.
+        if "serve.kv.fleet_hits_total" in ctr:
+            lines.append(
+                "# fleet kv: "
+                f"{_fmt(ctr['serve.kv.fleet_hits_total'])} hits "
+                f"(device {_fmt(ctr.get('serve.kv.fleet_hits_device_total', 0))}"
+                f" / host {_fmt(ctr.get('serve.kv.fleet_hits_host_total', 0))}"
+                f" / peer {_fmt(ctr.get('serve.kv.fleet_hits_peer_total', 0))}), "
+                f"{_fmt(ctr.get('serve.kv.pull_bytes', 0))} bytes pulled")
         for k in sorted(stats.get("counters") or {}):
             n = prom_name(k)
             lines.append(f"# TYPE {n} counter")

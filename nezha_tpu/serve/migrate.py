@@ -43,6 +43,16 @@ through the pinned fault points ``replica.kv_export`` and
 (router-side); ``serve.kv.migrations_total`` / ``serve.kv.
 migration_bytes`` count committed installs (schema-pinned).
 
+The same wire carries the fleet PEER PULL (PR 17, serve/fleetcache):
+``/kv_export`` in **tokens mode** exports the longest cached
+full-block prefix of an arbitrary prompt straight out of the source's
+prefix trie + host tier — no park, no ACK, read-only on the source —
+and :func:`pull_prefix_into` installs it on the destination tagged
+``origin="peer"``. Peer-pull failure is ``kind="kv_pull_failed"`` and
+the front ends degrade to a cold prefill instead of answering 424:
+unlike a migration (which owns the request), a peer pull is a cache
+optimization the request never depends on.
+
 The wire is MESH-BLIND (tensor-sharded serving, serve/sharded): a
 source running a head-sharded pool exports via GATHER-ON-EXPORT — the
 pool's block gather converts to host arrays, which assembles the
@@ -153,10 +163,36 @@ def decode_wire(obj: dict) -> Tuple[List[int],
 
 
 # -------------------------------------------------------- handler bodies
+def _handle_prefix_export(scheduler, obj) -> Tuple[int, dict]:
+    """``/kv_export`` TOKENS mode (fleet peer pull, PR 17): export the
+    longest cached full-block prefix of the given tokens — a read-only
+    cache probe with no park, no request and no ACK. Zero coverage is
+    a 200 with an empty wire (digests are advisory; a stale entry
+    costs the puller one wasted probe, never an error)."""
+    tokens = obj.get("tokens")
+    if not isinstance(tokens, list) or \
+            not all(isinstance(t, int) for t in tokens):
+        return 400, {"error": "tokens (list of ints) required",
+                     "error_type": "bad_request"}
+    try:
+        wire = scheduler.export_prefix(tokens)
+    except faults.InjectedFault as e:
+        return 500, {"error": str(e), "error_type": "injected_fault"}
+    except MigrationError as e:
+        return 409, {"error": str(e), "error_type": e.kind}
+    return 200, wire
+
+
 def handle_kv_export(scheduler, obj) -> Tuple[int, dict]:
-    """POST ``/kv_export`` body: the source side of the pull. Returns
-    the parked request's wire payload; every failure is typed. The
-    parked slot's refs are NOT released — that is ``/kv_ack``."""
+    """POST ``/kv_export`` body: the source side of the pull. Two
+    modes share the endpoint (and therefore the wire format):
+    ``request_id`` pulls a PARKED request's prefix (the PR 11
+    two-phase migration — refs released only by ``/kv_ack``), while
+    ``tokens`` probes the prefix CACHE (the PR 17 fleet peer pull —
+    read-only, nothing to ACK). Every failure is typed."""
+    if isinstance(obj, dict) and "request_id" not in obj \
+            and "tokens" in obj:
+        return _handle_prefix_export(scheduler, obj)
     rid = obj.get("request_id") if isinstance(obj, dict) else None
     if not isinstance(rid, str) or not rid:
         return 400, {"error": "request_id (string) required",
@@ -293,3 +329,75 @@ def pull_into(scheduler, pull: dict, timeout_s: float = 120.0) -> dict:
             return {"bytes": nbytes, "blocks": nblocks,
                     "installed": installed,
                     "seconds": time.monotonic() - t0, "acked": acked}
+
+
+def pull_prefix_into(scheduler, pull: dict,
+                     timeout_s: float = 30.0) -> dict:
+    """The destination side of a fleet PEER pull (PR 17): fetch the
+    covering prefix blocks named by ``pull`` (``{"host", "port",
+    "tokens"}`` — the router's near-miss hint) from the sibling
+    replica's cache over ``/kv_export`` tokens mode, and install them
+    into this pool's prefix trie tagged ``origin="peer"``. One-phase
+    and read-only on the source: there is no park and no ACK — the
+    source keeps its copy, the destination gains one. -> meta
+    ``{"bytes", "blocks", "installed", "seconds"}`` for the response's
+    ``fleet_pull`` block. Raises :class:`MigrationError` with
+    ``kind="kv_pull_failed"`` on ANY failure (injected fault, source
+    dead mid-transfer, malformed payload, pool exhausted) — the
+    caller's contract is to degrade to a cold prefill, never to error
+    the request: a peer pull is an optimization, not a dependency.
+    ``replica.kv_pull`` is the pinned chaos knob, armed at entry so an
+    injected delay stretches the transfer window the mid-pull SIGKILL
+    drill kills the source inside."""
+    if not isinstance(pull, dict):
+        raise MigrationError("pull_from must be an object",
+                             kind="kv_pull_failed")
+    try:
+        port = int(pull["port"])
+        tokens = [int(t) for t in pull["tokens"]]
+    except (KeyError, TypeError, ValueError):
+        raise MigrationError(
+            "peer pull_from requires integer 'port' and a token list",
+            kind="kv_pull_failed")
+    host = str(pull.get("host", "127.0.0.1"))
+    tid = pull.get("trace_id")
+    body = {"tokens": tokens}
+    if tid:
+        body["trace_id"] = tid
+    t0 = time.monotonic()
+    try:
+        faults.point("replica.kv_pull")
+    except faults.InjectedFault as e:
+        raise MigrationError(f"kv_pull injected fault: {e}",
+                             kind="kv_pull_failed")
+    try:
+        status, wire = _post_json(host, port, "/kv_export", body,
+                                  timeout_s)
+    except Exception as e:
+        raise MigrationError(
+            f"peer kv_export from {host}:{port} failed: "
+            f"{type(e).__name__}: {e}", kind="kv_pull_failed")
+    if status != 200:
+        raise MigrationError(
+            f"peer kv_export from {host}:{port} answered {status}: "
+            f"{wire.get('error') if isinstance(wire, dict) else wire}",
+            kind="kv_pull_failed")
+    try:
+        tokens_out, layers, nbytes = decode_wire(wire)
+        installed = scheduler.install_pulled(tokens_out, layers, nbytes)
+    except MigrationError as e:
+        raise MigrationError(str(e), kind="kv_pull_failed")
+    except faults.InjectedFault as e:
+        raise MigrationError(f"kv_pull install injected fault: {e}",
+                             kind="kv_pull_failed")
+    except KVBlocksExhausted as e:
+        raise MigrationError(
+            f"kv_pull install found no free blocks: {e}",
+            kind="kv_pull_failed")
+    except ValueError as e:
+        raise MigrationError(
+            f"kv_pull install rejected the payload: {e}",
+            kind="kv_pull_failed")
+    nblocks = int(layers[0]["k"].shape[0]) if layers else 0
+    return {"bytes": nbytes, "blocks": nblocks, "installed": installed,
+            "seconds": time.monotonic() - t0}

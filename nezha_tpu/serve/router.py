@@ -15,7 +15,14 @@ the service:
   router-tracked in-flight forwards (ties broken by its last-probed
   queue depth). A replica answering 503 (queue full / draining) is
   skipped for that request; the client sees 503 only when EVERY live
-  replica refused.
+  replica refused. With ``affinity_routing`` on (PR 17,
+  serve/fleetcache) token-id requests are instead scored by
+  prefix-AFFINITY — expected cached-prefix hit length from each
+  replica's /healthz trie digest, discounted by load — and a
+  near-miss hands the chosen replica a peer ``pull_from`` hint so it
+  fetches the covering blocks from the sibling that has them (the
+  ``router.kv_pull_s`` span brackets the hop; failure degrades to a
+  cold prefill, never an error).
 - **failover** — a replica that dies BEFORE its response begins
   provably delivered nothing, so the request is re-dispatched to a
   different replica: bounded retries (``route_retries``) with the PR-4
@@ -103,6 +110,11 @@ def register_router_instruments() -> None:
     for c in ("retries", "failovers", "replica_restarts",
               "migrate_fallbacks"):
         obs.counter(f"router.{c}_total")
+    # Fleet-wide KV reuse (PR 17): admissions where the affinity
+    # scorer overrode the least-loaded pick (coverage win or cold
+    # consistent-hash placement). Knob-invariant 0 when affinity
+    # routing is off.
+    obs.counter("router.affinity_wins_total")
     obs.gauge("router.replicas_live")
     obs.histogram("router.route_s")
     # Disaggregated-tier queueing split: time to the PARKED prefill
@@ -142,6 +154,9 @@ class Router:
                      "migration_bytes": "_ledger_lock",
                      "migration_seconds": "_ledger_lock",
                      "migrate_fallbacks": "_ledger_lock",
+                     "affinity_wins": "_ledger_lock",
+                     "kv_pulls": "_ledger_lock",
+                     "kv_pull_bytes": "_ledger_lock",
                      "_rng": "_rng_lock"}
 
     def __init__(self, supervisor, cfg: Optional[RouterConfig] = None):
@@ -164,6 +179,11 @@ class Router:
         self.migration_bytes = 0
         self.migration_seconds = 0.0
         self.migrate_fallbacks = 0
+        # Fleet-cache ledgers (PR 17): affinity picks that overrode
+        # least-loaded, committed peer pulls, and their wire bytes.
+        self.affinity_wins = 0
+        self.kv_pulls = 0
+        self.kv_pull_bytes = 0
         self._ledger_lock = threading.Lock()
         register_router_instruments()
 
@@ -371,7 +391,8 @@ class Router:
                         status, obj = self._route_disagg(payload)
                     else:
                         status, obj = self._route_inner(
-                            json.dumps(payload).encode(), trace_id=tid)
+                            json.dumps(payload).encode(), trace_id=tid,
+                            payload=payload)
                     sp.set(status=status)
                     return status, obj
         except InjectedFault as e:
@@ -381,7 +402,18 @@ class Router:
                 time.monotonic() - t0)
 
     def _route_inner(self, body: bytes,
-                     trace_id: Optional[str] = None) -> Tuple[int, dict]:
+                     trace_id: Optional[str] = None,
+                     payload: Optional[dict] = None) -> Tuple[int, dict]:
+        # Affinity routing (PR 17) needs the prompt's TOKEN ids to hash
+        # against the fleet digests — text prompts (no ids until the
+        # replica tokenizes) and disaggregated dispatches (payload
+        # None) route least-loaded, exactly as before.
+        tokens = None
+        if self.cfg.affinity_routing and isinstance(payload, dict):
+            pt = payload.get("prompt_tokens")
+            if isinstance(pt, list) and pt \
+                    and all(isinstance(t, int) for t in pt):
+                tokens = pt
         excluded: set = set()
         retries = 0
         failed_over = False
@@ -395,8 +427,9 @@ class Router:
                                   f"{retries} dispatch(es) failed")
                 return _typed(503, "no_live_replicas",
                               "no live replicas")
-            outcome, detail, r = self._dispatch_tier(usable, body,
-                                                     trace_id=trace_id)
+            outcome, detail, r = self._dispatch_tier(
+                usable, body, trace_id=trace_id, payload=payload,
+                tokens=tokens)
             if outcome == "all_full":
                 return _typed(503, "queue_full",
                               f"all {detail} live replica(s) at "
@@ -443,24 +476,174 @@ class Router:
         obs.counter("router.failovers_total").inc()
 
     def _dispatch_tier(self, cand, body: bytes,
-                       trace_id: Optional[str] = None):
-        """Least-loaded sweep over one tier: forward to the best
-        member, skipping 503-full members for this request. ->
-        ``(outcome, detail, replica)`` with :meth:`_forward`'s outcomes
-        plus ``("all_full", tier size, None)`` when every member
-        refused."""
+                       trace_id: Optional[str] = None,
+                       payload: Optional[dict] = None,
+                       tokens: Optional[list] = None):
+        """Least-loaded (or, with tokens + affinity routing, digest-
+        affinity) sweep over one tier: forward to the best member,
+        skipping 503-full members for this request. -> ``(outcome,
+        detail, replica)`` with :meth:`_forward`'s outcomes plus
+        ``("all_full", tier size, None)`` when every member refused.
+
+        On a near-miss (a NON-chosen member's digest covers more of
+        the prompt than the chosen one's) the forward carries a
+        ``pull_from`` hint naming that sibling — queue-full members
+        still serve as pull SOURCES (``/kv_export`` is read-only, no
+        admission involved), which is exactly how a saturated owner's
+        cache keeps paying off through its siblings."""
         full: set = set()
         while True:
             usable = [r for r in cand if r.rid not in full]
             if not usable:
                 return "all_full", len(cand), None
-            r = min(usable, key=lambda x: (
-                x.in_flight, x.last_health.get("queued", 0), x.rid))
-            outcome, detail = self._forward(r, body, trace_id=trace_id)
+            r, pull = self._pick(usable, cand, tokens)
+            if pull is not None and isinstance(payload, dict):
+                outcome, detail = self._forward_pull(
+                    r, payload, pull, trace_id=trace_id)
+            else:
+                outcome, detail = self._forward(r, body,
+                                                trace_id=trace_id)
             if outcome == "full":
                 full.add(r.rid)
                 continue
             return outcome, detail, r
+
+    def _pick(self, usable, cand, tokens):
+        """Choose the dispatch target among ``usable`` (and, on a
+        near-miss, a pull source from the full tier ``cand``). ->
+        ``(replica, pull_hint_or_None)``.
+
+        Baseline is the least-loaded pick (fewest in-flight forwards,
+        ties by probed queue depth, then rid). With affinity routing
+        on and integer prompt tokens at hand, every usable member is
+        scored ``coverage_tokens / (1 + load)`` from its freshest
+        digest; the best scorer wins only when it strictly beats the
+        baseline's own score — affinity never routes to a busier
+        replica than the hit is worth. When NOBODY covers anything,
+        the tie among minimally loaded members is broken by a
+        consistent hash of the prompt's first block instead of by rid,
+        so repeat users grow an owner replica. The ``router.affinity``
+        fault point degrades the whole scorer to the baseline pick —
+        typed, request-scoped, never an error the client sees."""
+        base = min(usable, key=lambda x: (
+            x.in_flight, x.last_health.get("queued", 0), x.rid))
+        if not tokens or not self.cfg.affinity_routing \
+                or len(self.sup.replicas()) < 2:
+            return base, None
+        try:
+            faults.point("router.affinity")
+        except InjectedFault:
+            return base, None
+        from nezha_tpu.serve import fleetcache
+        now = time.monotonic()
+        hashes_by_bs: Dict[int, list] = {}
+        cover: Dict[int, tuple] = {}    # rid -> (blocks, block_size)
+
+        def load_of(x) -> int:
+            try:
+                return x.in_flight + int(
+                    x.last_health.get("queued", 0) or 0)
+            except (TypeError, ValueError):
+                return x.in_flight
+
+        for x in cand:
+            if x.probed_t <= 0:
+                continue    # never probed: no digest to trust
+            parsed = fleetcache.digest_entries_of(x.last_health)
+            if parsed is None:
+                continue
+            bs, entries = parsed
+            try:
+                age = float(x.last_health.get("digest_age_s", 0.0))
+            except (TypeError, ValueError):
+                age = 0.0
+            if age + (now - x.probed_t) > self.cfg.digest_stale_s:
+                continue    # advisory data gone stale — ignore
+            hashes = hashes_by_bs.get(bs)
+            if hashes is None:
+                hashes = fleetcache.prefix_hashes(tokens, bs)
+                hashes_by_bs[bs] = hashes
+            blocks, _tier = fleetcache.coverage(entries, hashes)
+            if blocks:
+                cover[x.rid] = (blocks, bs)
+
+        def score_of(x) -> float:
+            c = cover.get(x.rid)
+            if c is None:
+                return 0.0
+            return fleetcache.score(c[0], c[1], x.in_flight,
+                                    load_of(x) - x.in_flight)
+
+        pick = base
+        if cover:
+            best = max(usable, key=lambda x: (score_of(x), -load_of(x),
+                                              -x.rid))
+            if score_of(best) > score_of(base):
+                pick = best
+        else:
+            # Cold placement: nobody covers anything, so spread the
+            # prefix deterministically across the members tied at the
+            # baseline's load — least-loaded is preserved, only its
+            # rid tie-break changes.
+            bs = next(iter(hashes_by_bs), 16)
+            key = (base.in_flight, base.last_health.get("queued", 0))
+            tied = [x.rid for x in usable
+                    if (x.in_flight,
+                        x.last_health.get("queued", 0)) == key]
+            rid = fleetcache.place_cold(tokens, bs, tied)
+            if rid is not None and rid != base.rid:
+                pick = next(x for x in usable if x.rid == rid)
+        if pick.rid != base.rid:
+            with self._ledger_lock:
+                self.affinity_wins += 1
+            obs.counter("router.affinity_wins_total").inc()
+        # Near-miss peer pull: a sibling (any tier member, even one
+        # whose queue is full — export needs no admission) covering
+        # MORE than the pick gets handed to the pick as pull_from.
+        pick_cov = cover.get(pick.rid, (0, 0))[0]
+        src_rid, src_cov, src_bs = None, pick_cov, 0
+        for x in cand:
+            if x.rid == pick.rid:
+                continue
+            c = cover.get(x.rid)
+            if c is not None and c[0] > src_cov:
+                src_rid, src_cov, src_bs = x.rid, c[0], c[1]
+        if src_rid is None:
+            return pick, None
+        src = next(x for x in cand if x.rid == src_rid)
+        pull = {"host": "127.0.0.1", "port": src.port,
+                "tokens": [int(t) for t in tokens[:src_cov * src_bs]],
+                "blocks": src_cov, "src_rid": src.rid}
+        return pick, pull
+
+    def _forward_pull(self, r, payload: dict, pull: dict,
+                      trace_id: Optional[str] = None):
+        """Forward with a peer-pull hint attached, the hop bracketed
+        by the pinned ``router.kv_pull_s`` span; a committed pull (the
+        replica reports installed blocks) lands in the
+        :attr:`kv_pulls` / :attr:`kv_pull_bytes` ledgers and the
+        schema-pinned counters the replica side already bumped."""
+        hint = dict(pull)
+        src_rid = hint.pop("src_rid", None)
+        if trace_id:
+            hint["trace_id"] = trace_id
+        body = json.dumps({**payload, "pull_from": hint}).encode()
+        with obs.span("router.kv_pull_s", src=src_rid, dst=r.rid,
+                      blocks=pull.get("blocks", 0)) as sp:
+            outcome, detail = self._forward(r, body, trace_id=trace_id)
+            meta = (detail.get("fleet_pull")
+                    if outcome == "ok" and isinstance(detail, dict)
+                    else None)
+            if isinstance(meta, dict):
+                sp.set(bytes=int(meta.get("bytes", 0) or 0),
+                       installed=int(meta.get("installed", 0) or 0),
+                       degraded=bool(meta.get("degraded")))
+                if meta.get("installed"):
+                    with self._ledger_lock:
+                        self.kv_pulls += 1
+                        self.kv_pull_bytes += int(meta.get("bytes", 0)
+                                                  or 0)
+        return outcome, detail
 
     def _src_live(self, src) -> bool:
         return any(r.rid == src.rid for r in self.sup.live_replicas())

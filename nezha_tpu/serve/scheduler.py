@@ -189,6 +189,17 @@ def register_serve_instruments() -> None:
     obs.counter("serve.kv.promotions_total")
     obs.gauge("serve.kv.host_blocks_used")
     obs.gauge("serve.kv.host_bytes_resident")
+    # Fleet-wide KV reuse (PR 17, serve/fleetcache): requests that
+    # reused cached prefix blocks, split by the tier the blocks came
+    # from — own device trie, own host tier, or a sibling replica's
+    # peer pull — plus the wire bytes peer pulls installed. Knob-
+    # invariant 0s on single-replica / affinity-off runs, so every
+    # serving summary renders the same "fleet kv:" line.
+    obs.counter("serve.kv.fleet_hits_total")
+    obs.counter("serve.kv.fleet_hits_device_total")
+    obs.counter("serve.kv.fleet_hits_host_total")
+    obs.counter("serve.kv.fleet_hits_peer_total")
+    obs.counter("serve.kv.pull_bytes")
     # KV quantization instruments (schema-pinned, layout/dtype
     # invariant): device bytes the resident KV actually holds (the
     # capacity lever int8 moves), the storage width in bits (8 = int8,
@@ -256,7 +267,7 @@ class Scheduler:
     # threads too.
     _LOCK_GUARDED = {"_queue": "_lock", "_live": "_lock",
                      "results": "_lock", "_host_gap_t": "_lock",
-                     "_parked": "_lock"}
+                     "_parked": "_lock", "_digest_cache": "_lock"}
 
     def __init__(self, engine: Engine,
                  on_token: Optional[Callable[[str, int], None]] = None,
@@ -272,6 +283,10 @@ class Scheduler:
         # expires_t). Slots here hold their prompt blocks but never
         # decode; step() reclaims entries past their TTL.
         self._parked: Dict[str, tuple] = {}
+        # Lazily built fleet digest (PR 17) — created on the first
+        # /healthz hit that asks for one, recreated when the knobs
+        # change (the CLI passes them per call).
+        self._digest_cache = None
         self._lock = threading.RLock()
         self._ids = itertools.count()
         self.results: Dict[str, RequestResult] = {}
@@ -858,6 +873,69 @@ class Scheduler:
                 # successful PULL separately.
                 obs.counter("serve.kv.migrations_total").inc()
                 obs.counter("serve.kv.migration_bytes").inc(nbytes)
+            return installed
+
+    # ----------------------------------------------------- fleet cache
+    def fleet_digest(self, interval_s: float = 2.0,
+                     max_entries: int = 256) -> dict:
+        """The ``/healthz`` digest payload (PR 17): a bounded
+        prefix-hash summary of what this replica's pool holds, rebuilt
+        at most once per ``interval_s``. Dense pools (nothing
+        block-indexed to advertise) report ``digest_size = 0`` and no
+        ``fleet_digest`` key — the Router simply never scores this
+        replica above zero coverage."""
+        from nezha_tpu.serve import fleetcache
+        with self._lock:
+            if not self.engine.paged:
+                return {"digest_size": 0, "digest_age_s": 0.0}
+            dc = self._digest_cache
+            if (dc is None or dc.interval_s != float(interval_s)
+                    or dc.max_entries != int(max_entries)):
+                dc = fleetcache.DigestCache(interval_s, max_entries)
+                self._digest_cache = dc
+            return dc.payload(self.engine.pool)
+
+    def export_prefix(self, tokens: Sequence[int]) -> dict:
+        """The source half of a PEER pull (``/kv_export`` tokens
+        mode, PR 17): the longest cached full-block prefix of
+        ``tokens`` this pool holds, as the int8+scales wire object.
+        Unlike :meth:`export_parked` there is no park, no request and
+        no ACK — the export is a read-only cache probe; the source
+        gives up nothing and zero coverage is a legal empty wire.
+        Runs under the scheduler lock (the gather must not race a
+        cache-donating decode dispatch). The peer path's chaos knob is
+        ``replica.kv_pull`` on the DESTINATION client (one registered
+        site per point) — source-side failure is exercised by killing
+        the owner outright."""
+        from nezha_tpu.serve import migrate
+        with self._lock:
+            pool = self.engine.pool
+            if not self.engine.paged:
+                raise migrate.MigrationError(
+                    "kv_layout 'dense' has no blocks to export — "
+                    "peer pull requires the paged pool",
+                    kind="kv_pull_failed")
+            covered, layers, _ = pool.export_prefix_payload(tokens)
+            return migrate.encode_wire(covered, layers, pool.block_size)
+
+    def install_pulled(self, tokens: Sequence[int], layers: list,
+                       nbytes: int) -> int:
+        """The destination half of a peer pull: install the wire
+        payload into this pool's prefix cache with the blocks tagged
+        ``origin="peer"`` so their first reuse counts as a fleet peer
+        hit, and account the wire bytes into the schema-pinned
+        ``serve.kv.pull_bytes`` (NOT the migration ledgers — a peer
+        pull is a cache transfer, not a request handoff)."""
+        from nezha_tpu.serve import migrate
+        with self._lock:
+            if not self.engine.paged:
+                raise migrate.MigrationError(
+                    "kv_layout 'dense' cannot install pulled blocks",
+                    kind="kv_pull_failed")
+            installed = self.engine.pool.install_block_payload(
+                tokens, layers, origin="peer")
+            if installed > 0:
+                obs.counter("serve.kv.pull_bytes").inc(nbytes)
             return installed
 
     # ----------------------------------------------------------- drain

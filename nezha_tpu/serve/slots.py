@@ -605,6 +605,15 @@ class PagedSlotPool:
         self.demotions = 0
         self.promotions = 0
         self.promote_failures = 0
+        # Fleet tier tags (PR 17): blocks whose content arrived over a
+        # PEER pull (vs local prefill / migration) — the first trie hit
+        # on such a block is a fleet "peer" hit, after which the block
+        # is indistinguishable from local device cache and is counted
+        # as such. The per-tier hit ledger feeds the
+        # serve.kv.fleet_hits_* counters; one request counts at most
+        # once per tier it touched.
+        self._peer_blocks: set = set()
+        self.fleet_hits = {"device": 0, "host": 0, "peer": 0}
         # Mirror pool (speculative draft KV — see SlotPool.mirror):
         # slot lifecycle is mirrored by INDEX; block bookkeeping stays
         # per-pool (the draft binds its own blocks lazily, sized by the
@@ -739,6 +748,9 @@ class PagedSlotPool:
         self._refs[block] -= 1
         if self._refs[block] == 0:
             self._free_blocks.append(block)
+            # A freed block's peer tag dies with it: the index will be
+            # rebound to unrelated content, which must count as local.
+            self._peer_blocks.discard(block)
         elif self._refs[block] < 0:
             raise AssertionError(
                 f"block {block} ref count went negative (double release)")
@@ -982,8 +994,30 @@ class PagedSlotPool:
                 self._refs[b] += 1
                 self.tables_host[slot, i] = b
             self._bound[slot] = nshared
+        promoted = 0
         if self.host_blocks and self.prefix_cache_enabled:
-            nshared += self._promote(slot, toks, nshared)
+            promoted = self._promote(slot, toks, nshared)
+            nshared += promoted
+        # Fleet three-tier hit accounting (PR 17): classify where this
+        # request's reused blocks came from. Peer-pulled blocks count
+        # as "peer" on their FIRST reuse (then revert to plain device
+        # cache); host promotions count as "host"; everything else the
+        # trie matched is "device". One bump per tier per request, one
+        # bump of the roll-up total per request-with-any-hit.
+        if nshared:
+            tiers = []
+            pulled = self._peer_blocks.intersection(shared_blocks)
+            if pulled:
+                self._peer_blocks.difference_update(pulled)
+                tiers.append("peer")
+            if len(pulled) < len(shared_blocks):
+                tiers.append("device")
+            if promoted:
+                tiers.append("host")
+            for t in tiers:
+                self.fleet_hits[t] += 1
+                obs.counter(f"serve.kv.fleet_hits_{t}_total").inc()
+            obs.counter("serve.kv.fleet_hits_total").inc()
         return min(nshared * self.block_size, n - 1)
 
     def count_prefix_hit(self) -> None:
@@ -1074,8 +1108,75 @@ class PagedSlotPool:
         nbytes = sum(a.nbytes for layer in host for a in layer.values())
         return host, nbytes
 
+    # ------------------------------------------------------ fleet cache
+    def digest_entries(self):
+        """Yield ``(path_tokens, tier)`` for every cached prefix this
+        pool could serve — device trie paths first (hottest first, by
+        LRU tick), then host-tier keys (MRU first) — the recency order
+        :func:`fleetcache.build_digest` truncates against. A bounded
+        host walk (no device ops); callers hold the scheduler lock."""
+        if self.prefix_cache_enabled:
+            for node in sorted(self.trie._nodes,
+                               key=lambda n: n.tick, reverse=True):
+                yield self.trie._path_tokens(node), "device"
+        for key in reversed(self._host_tier):
+            yield key, "host"
+
+    def export_prefix_payload(self, tokens: Sequence[int]
+                              ) -> Tuple[List[int],
+                                         List[Dict[str, np.ndarray]], int]:
+        """Peer-pull export (PR 17): the longest cached full-block
+        prefix of ``tokens`` this pool holds — device trie match,
+        extended through consecutively host-cached blocks — gathered
+        into the int8+scales wire layout WITHOUT touching any slot.
+        -> ``(covered_tokens, per-layer wire arrays, payload bytes)``;
+        zero coverage returns ``([], [], 0)`` (a legal empty wire —
+        digests are advisory, a stale one costs one wasted probe).
+        Read-only like :meth:`export_block_payload`: refs, trie and
+        host tier are untouched; the source gives up nothing."""
+        toks = [int(t) for t in tokens]
+        bs = self.block_size
+        blocks: List[int] = []
+        if self.prefix_cache_enabled:
+            blocks = self.trie.match(toks)
+        host_entries: List[list] = []
+        bi = len(blocks)
+        while (bi + 1) * bs <= len(toks):
+            entry = self._host_tier.get(tuple(toks[:(bi + 1) * bs]))
+            if entry is None:
+                break
+            host_entries.append(entry)
+            bi += 1
+        nblocks = len(blocks) + len(host_entries)
+        if nblocks == 0:
+            return [], [], 0
+        host: List[Dict[str, np.ndarray]] = []
+        if blocks:
+            idx = jnp.asarray(np.asarray(blocks, np.int32))
+            if self.quantized:
+                layers = _gather_blocks_quantized_jit(self.caches, idx)
+            else:
+                layers = _gather_quantize_blocks_jit(self.caches, idx)
+            host = [{k: np.asarray(v) for k, v in layer.items()}
+                    for layer in layers]
+        if host_entries:
+            if host:
+                host = [{k: np.concatenate(
+                            [layer[k]] + [e[li][k] for e in host_entries],
+                            axis=0)
+                         for k in layer}
+                        for li, layer in enumerate(host)]
+            else:
+                host = [{k: np.concatenate(
+                            [e[li][k] for e in host_entries], axis=0)
+                         for k in host_entries[0][li]}
+                        for li in range(len(host_entries[0]))]
+        nbytes = sum(a.nbytes for layer in host for a in layer.values())
+        return toks[:nblocks * bs], host, nbytes
+
     def install_block_payload(self, tokens: Sequence[int],
-                              layers: List[Dict[str, np.ndarray]]) -> int:
+                              layers: List[Dict[str, np.ndarray]],
+                              origin: str = "migrate") -> int:
         """Install a migrated block payload into the PREFIX CACHE:
         allocate fresh blocks (ref == 1 — the write invariant holds by
         construction, these indices are owned by nobody), scatter the
@@ -1090,7 +1191,12 @@ class PagedSlotPool:
         request simply prefills cold). Raises
         :class:`KVBlocksExhausted` (typed, retryable — nothing is
         leaked) when the pool cannot hold the span, and ``ValueError``
-        on a payload whose geometry does not match this pool."""
+        on a payload whose geometry does not match this pool.
+
+        ``origin="peer"`` (PR 17 fleet pull) tags the newly indexed
+        blocks so their first reuse is counted as a fleet "peer" hit;
+        ``"migrate"`` (the PR 11 two-phase handoff) leaves the tier
+        accounting untouched."""
         nblocks = int(layers[0]["k"].shape[0]) if layers else 0
         if nblocks == 0 or not self.prefix_cache_enabled:
             return 0
@@ -1125,11 +1231,16 @@ class PagedSlotPool:
             self.caches = _scatter_blocks_dequant_jit(
                 self.caches, idx, payload)
 
+        new_blocks: List[int] = []
+
         def take_ref(block: int) -> None:
             self._refs[block] += 1
+            new_blocks.append(block)
 
         inserted = self.trie.insert(
             list(int(t) for t in tokens)[:nblocks * bs], blocks, take_ref)
+        if origin == "peer":
+            self._peer_blocks.update(new_blocks)
         # Drop our allocation refs: blocks the trie took stay cached at
         # ref 1 (the trie's); blocks it already had under the same
         # token path return to the free list (first writer won).
@@ -1215,6 +1326,14 @@ class PagedSlotPool:
                 f"KV block ref-count leak at blocks {bad.tolist()}: "
                 f"expected {expect[bad].tolist()}, "
                 f"recorded {self._refs[bad].tolist()}")
+        # Fleet peer tags (PR 17) may only name blocks somebody still
+        # holds: a tag on a freed block would mis-count an unrelated
+        # future binding as a peer hit.
+        untagged = [b for b in self._peer_blocks if self._refs[b] <= 0]
+        if untagged:
+            raise AssertionError(
+                f"peer tier tags leaked past release: blocks "
+                f"{sorted(untagged)} are tagged but free")
         n_free = len(self._free_blocks)
         n_held = int(np.count_nonzero(self._refs))
         if n_free + n_held != self.num_blocks - 1:

@@ -110,6 +110,14 @@ class RouterConfig:
     spawn_timeout_s: float = 300.0
     drain_timeout_s: float = 30.0
     seed: int = 0
+    # Fleet-wide KV reuse (PR 17, serve/fleetcache): route by prefix
+    # affinity instead of pure least-loaded, using the trie digests
+    # replicas piggyback on /healthz. digest_interval_s is the
+    # replica-side rebuild cadence (the CLI forwards it to workers);
+    # digest_max_entries bounds each digest's size on the wire.
+    affinity_routing: bool = False
+    digest_interval_s: float = 2.0
+    digest_max_entries: int = 256
 
     def __post_init__(self):
         if self.replicas < 1:
@@ -120,6 +128,10 @@ class RouterConfig:
             raise ValueError("route_retries must be >= 0")
         if self.max_restart_failures < 1:
             raise ValueError("max_restart_failures must be >= 1")
+        if self.digest_interval_s <= 0:
+            raise ValueError("digest_interval_s must be > 0")
+        if self.digest_max_entries < 1:
+            raise ValueError("digest_max_entries must be >= 1")
         roles = tuple(self.roles)
         if roles:
             if len(roles) != self.replicas:
@@ -146,6 +158,15 @@ class RouterConfig:
         router then admits onto it and migrates KV to the decode
         tier."""
         return "prefill" in self.roles
+
+    @property
+    def digest_stale_s(self) -> float:
+        """How old a replica's digest may be (replica-reported age +
+        time since its probe landed) before the affinity scorer
+        ignores it — a few rebuild intervals, floored at a few probe
+        rounds so slow probing doesn't blind the scorer entirely."""
+        return max(3.0 * self.digest_interval_s,
+                   4.0 * self.probe_interval_s)
 
 
 def replica_exec_point() -> None:
@@ -186,6 +207,9 @@ class Replica:
     spawned_t: float = 0.0
     in_flight: int = 0
     last_health: Dict[str, object] = dataclasses.field(default_factory=dict)
+    # Monotonic timestamp of the last SUCCESSFUL probe (0.0 = never) —
+    # the router's affinity scorer judges digest staleness against it.
+    probed_t: float = 0.0
     error: Optional[str] = None
 
 
@@ -417,13 +441,19 @@ class _ThreadWorker:
                     return self._send(503, {"status": "draining"})
                 sched = worker._sched
                 pool = sched.engine.pool
-                self._send(200, {
+                payload = {
                     "status": "ok", "active": pool.num_active,
                     "capacity": pool.capacity,
                     "queued": sched.queue_depth,
                     "occupancy": pool.occupancy,
                     "role": getattr(worker.args, "role", "both"),
-                    "parked": sched.parked_count})
+                    "parked": sched.parked_count}
+                # Fleet digest piggyback (PR 17): the prober is the
+                # transport — no extra endpoint, no extra round trip.
+                payload.update(sched.fleet_digest(
+                    getattr(worker.args, "digest_interval", 2.0),
+                    getattr(worker.args, "digest_max_entries", 256)))
+                self._send(200, payload)
 
             def do_POST(self):
                 worker._handle_post(self)
@@ -481,12 +511,26 @@ class _ThreadWorker:
         if isinstance(obj, dict) and obj.get("resume"):
             return self._handle_resume(h, str(obj["resume"]))
         mig_meta = None
-        if isinstance(obj, dict) and obj.get("pull_from") is not None:
+        fleet_meta = None
+        pull = obj.get("pull_from") if isinstance(obj, dict) else None
+        if isinstance(pull, dict) and "tokens" in pull \
+                and "request_id" not in pull:
+            # Fleet peer pull (PR 17): fetch covering prefix blocks
+            # from the sibling the router named, then fall through to
+            # ordinary admission so submit prefix-hits them. Failure
+            # DEGRADES to a cold prefill — never an HTTP error; the
+            # pull is an optimization, not a dependency.
+            try:
+                fleet_meta = migrate.pull_prefix_into(sched, pull)
+            except migrate.MigrationError as e:
+                fleet_meta = {"bytes": 0, "blocks": 0, "installed": 0,
+                              "degraded": str(e), "error_type": e.kind}
+        elif pull is not None:
             # Decode side of a migration: pull + install + ACK before
             # admission, so the submit below prefix-hits the installed
             # blocks. Failure is HTTP 424 — the router's retry signal.
             try:
-                mig_meta = migrate.pull_into(sched, obj["pull_from"])
+                mig_meta = migrate.pull_into(sched, pull)
             except migrate.MigrationError as e:
                 return h._send(424, {"error": str(e),
                                      "error_type": e.kind})
@@ -535,6 +579,8 @@ class _ThreadWorker:
         out.pop("event")
         if mig_meta is not None:
             out["migration"] = mig_meta
+        if fleet_meta is not None:
+            out["fleet_pull"] = fleet_meta
         h._send(200, out)
 
     def _handle_resume(self, h, rid: str) -> None:
@@ -843,6 +889,7 @@ class Supervisor:
             if ok:
                 r.probe_misses = 0
                 r.last_health = dict(payload or {})
+                r.probed_t = time.monotonic()
                 r.healthy = True
                 if r.state == STARTING:
                     r.state = LIVE

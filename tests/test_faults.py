@@ -704,8 +704,9 @@ def test_fault_point_registry_pinned():
     bind point (serve.kv.bind), and the migration points
     (router.migrate / replica.kv_export / replica.kv_install), the
     speculative verify point (serve.spec.verify), the host-tier
-    promotion point (serve.kv.promote), and the train->serve
-    resharding point (serve.reshard)."""
+    promotion point (serve.kv.promote), the train->serve
+    resharding point (serve.reshard), and the fleet KV reuse points
+    (router.affinity / replica.kv_pull)."""
     from check_fault_points import EXPECTED_POINTS, check, find_points
 
     assert check(_ROOT) == []
@@ -719,5 +720,6 @@ def test_fault_point_registry_pinned():
         "router.migrate", "replica.kv_export", "replica.kv_install",
         "serve.spec.verify",
         "serve.reshard",
+        "router.affinity", "replica.kv_pull",
     }
     assert set(find_points(_ROOT)) == set(EXPECTED_POINTS)

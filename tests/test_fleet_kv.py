@@ -1,0 +1,516 @@
+"""Fleet-wide KV reuse: prefix-affinity routing + peer-to-peer
+quantized block pull (the ISSUE 17 acceptance suite).
+
+Layers under test, bottom up: the digest half (blake2b prefix hashing,
+bounded recency-first digest build, the lazy ``DigestCache``, healthz
+payload parsing), the affinity half (coverage, load-discounted scoring,
+consistent-hash cold placement), the scheduler's peer surfaces
+(``fleet_digest`` / ``export_prefix`` / ``install_pulled`` with
+``origin="peer"`` tagging and bit-identical decode), the Router's
+three-tier pick (digest-affinity revisits, pull hints at queue-full
+owners, the peer transfer over the ``/kv_export`` int8 wire), the CLI
+plumbing, the fleet benchmark scenario, and the chaos acceptance:
+SIGKILL the block-owning replica mid-pull and prove typed degradation
+to a cold prefill with zero leaks. Fault points drilled here:
+``router.affinity`` (scorer degrades to least-loaded, never a client
+error) and ``replica.kv_pull`` (pull failure degrades to a cold
+prefill, ``kind="kv_pull_failed"``).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+import jax
+
+from nezha_tpu import faults
+from nezha_tpu.faults import FaultPlan
+from nezha_tpu.serve import (Engine, FinishReason, Request, Scheduler,
+                             ServeConfig, fleetcache, migrate)
+from nezha_tpu.serve.router import Router
+from nezha_tpu.serve.supervisor import (RouterConfig, Supervisor,
+                                        ThreadBackend)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+for _sub in ("tools", "benchmarks"):
+    _p = os.path.join(_ROOT, _sub)
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from nezha_tpu.cli.train import TINY_GPT2_KW
+    from nezha_tpu.models.gpt2 import GPT2, GPT2Config
+    model = GPT2(GPT2Config(**TINY_GPT2_KW))
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def _engine(tiny_model, **kw):
+    model, variables = tiny_model
+    base = dict(max_batch_size=2, max_len=64, max_prefill_len=16,
+                kv_block_size=8, kv_dtype="int8", queue_capacity=8)
+    base.update(kw)
+    return Engine(model, variables, ServeConfig(**base))
+
+
+def _prompt(n, vocab=512, salt=0):
+    return [(7 * i + 3 + 11 * salt) % vocab for i in range(n)]
+
+
+# ------------------------------------------------------- digest hashing
+def test_hash_prefix_deterministic_and_incremental():
+    toks = _prompt(40)
+    h1 = fleetcache.hash_prefix(toks[:8])
+    assert h1 == fleetcache.hash_prefix(toks[:8])
+    assert len(h1) == 16 and int(h1, 16) >= 0
+    # one token differs -> a different hash (tokens never on the wire,
+    # yet equal prefixes agree across processes)
+    assert h1 != fleetcache.hash_prefix(toks[:7] + [toks[7] ^ 1])
+    # the incremental one-pass walk equals per-prefix hashing
+    hashes = fleetcache.prefix_hashes(toks, 8)
+    assert hashes == [fleetcache.hash_prefix(toks[:8 * (i + 1)])
+                      for i in range(5)]
+    assert fleetcache.prefix_hashes(toks[:7], 8) == []
+    assert fleetcache.prefix_hashes([], 8) == []
+
+
+def test_digest_payload_build_bound_and_parse(tiny_model):
+    eng = _engine(tiny_model)
+    sched = Scheduler(eng)
+    prompt = _prompt(21)
+    sched.submit(Request(prompt=prompt, max_new_tokens=4,
+                         request_id="d"))
+    sched.run_until_idle()
+    pay = sched.fleet_digest(interval_s=0.001, max_entries=64)
+    assert pay["digest_size"] >= 2 and pay["digest_age_s"] >= 0.0
+    parsed = fleetcache.digest_entries_of(pay)
+    assert parsed is not None
+    bs, entries = parsed
+    assert bs == 8
+    hashes = fleetcache.prefix_hashes(prompt, bs)
+    tiers = dict(entries)
+    assert all(h in tiers for h in hashes)
+    assert set(tiers.values()) == {"device"}
+    # the bound truncates recency-first, it never overflows the wire
+    bounded = sched.fleet_digest(interval_s=0.001, max_entries=1)
+    assert bounded["digest_size"] == 1
+    # parse is defensive: wrong/missing version or malformed entries
+    # mean "no digest", never an exception in the router's scorer
+    assert fleetcache.digest_entries_of({}) is None
+    assert fleetcache.digest_entries_of(
+        {"fleet_digest": {"v": 99, "block_size": 8,
+                          "entries": []}}) is None
+    assert fleetcache.digest_entries_of(
+        {"fleet_digest": {"v": fleetcache.DIGEST_VERSION,
+                          "block_size": 8,
+                          "entries": "nope"}}) is None
+    assert fleetcache.digest_entries_of(
+        {"fleet_digest": "nope"}) is None
+    eng.pool.leak_check()
+
+
+def test_digest_cache_interval_and_validation():
+    with pytest.raises(ValueError):
+        fleetcache.DigestCache(interval_s=0.0)
+    with pytest.raises(ValueError):
+        fleetcache.DigestCache(interval_s=1.0, max_entries=0)
+
+
+# ----------------------------------------------------- affinity scoring
+def test_coverage_longest_first_and_tier():
+    hashes = ["a", "b", "c"]
+    assert fleetcache.coverage({}, hashes) == (0, None)
+    assert fleetcache.coverage({"a": "device"}, hashes) \
+        == (1, "device")
+    # longest covered prefix wins; the tier reported is the deepest
+    # covering entry's
+    assert fleetcache.coverage(
+        {"a": "device", "b": "host"}, hashes) == (2, "host")
+    # the scan is longest-first and trusts the digest to advertise
+    # full chains (a trie node implies its ancestors): the deepest
+    # hit alone answers in one lookup
+    assert fleetcache.coverage({"c": "device"}, hashes) \
+        == (3, "device")
+    assert fleetcache.coverage({"z": "device"}, hashes) == (0, None)
+
+
+def test_score_discounts_load_and_place_cold_consistent():
+    # more covered tokens -> higher score; more load -> lower score
+    assert fleetcache.score(2, 8, 0, 0) > fleetcache.score(1, 8, 0, 0)
+    assert fleetcache.score(2, 8, 0, 0) > fleetcache.score(2, 8, 1, 2)
+    assert fleetcache.score(0, 8, 0, 0) == 0.0
+    toks = _prompt(32)
+    rid = fleetcache.place_cold(toks, 8, [0, 1, 2])
+    assert rid in (0, 1, 2)
+    # deterministic, and independent of candidate ordering
+    assert rid == fleetcache.place_cold(toks, 8, [2, 1, 0])
+    assert fleetcache.place_cold(toks, 8, []) is None
+
+
+# --------------------------------------------- scheduler peer surfaces
+def test_export_prefix_install_pulled_bit_identical(tiny_model):
+    """The peer-transfer halves at scheduler level: A's cached prefix
+    exported over the int8 wire installs into B tagged peer, B's
+    admission prefix-hits it (a fleet PEER hit), and the decoded
+    continuation is bit-identical to A's — the same quantized blocks
+    produce the same greedy tokens."""
+    a, b = _engine(tiny_model), _engine(tiny_model)
+    sa, sb = Scheduler(a), Scheduler(b)
+    prompt = _prompt(29)
+    sa.submit(Request(prompt=prompt, max_new_tokens=6,
+                      request_id="src"))
+    sa.run_until_idle()
+    ref = sa.results["src"].tokens
+    assert len(ref) == 6
+
+    wire = sa.export_prefix(prompt)
+    assert wire["nblocks"] == 3 and wire["nbytes"] > 0
+    tokens, layers, nbytes = migrate.decode_wire(wire)
+    assert tokens == prompt[:24]
+    assert sb.install_pulled(tokens, layers, nbytes) == 3
+    sb.submit(Request(prompt=prompt, max_new_tokens=6,
+                      request_id="dst"))
+    sb.run_until_idle()
+    assert sb.results["dst"].tokens == ref
+    assert b.pool.prefix_hits == 1
+    assert b.pool.fleet_hits["peer"] == 1
+    assert a.pool.fleet_hits["peer"] == 0
+    a.pool.leak_check()
+    b.pool.leak_check()
+
+
+def test_export_prefix_zero_coverage_is_empty_wire(tiny_model):
+    eng = _engine(tiny_model)
+    sched = Scheduler(eng)
+    wire = sched.export_prefix(_prompt(21, salt=9))
+    assert wire["nblocks"] == 0
+    tokens, layers, nbytes = migrate.decode_wire(wire)
+    assert tokens == [] and layers == [] and nbytes == 0
+    # installing an empty wire is a no-op, not an error
+    assert sched.install_pulled(tokens, layers, nbytes) == 0
+    eng.pool.leak_check()
+
+
+# ------------------------------------------------------- cluster layer
+def _worker_args(extra=()):
+    from nezha_tpu.cli.serve import build_parser
+    return build_parser().parse_args(
+        ["--random-init", "--model-preset", "tiny", "--max-batch-size",
+         "2", "--max-len", "64", "--max-prefill-len", "8",
+         "--kv-block-size", "8", "--kv-dtype", "int8",
+         "--queue-capacity", "8", "--digest-interval", "0.05",
+         "--platform", "cpu", *extra])
+
+
+def _cfg(**kw):
+    base = dict(replicas=2, probe_interval_s=0.1, probe_misses=3,
+                route_retries=2, retry_backoff_base_s=0.01,
+                retry_backoff_max_s=0.05, restart_backoff_base_s=0.05,
+                restart_backoff_max_s=0.5, drain_timeout_s=20.0,
+                seed=0, affinity_routing=True, digest_interval_s=0.05)
+    base.update(kw)
+    return RouterConfig(**base)
+
+
+def _cluster(cfg, extra=()):
+    sup = Supervisor(ThreadBackend(_worker_args(extra),
+                                   drain_timeout_s=20.0), cfg)
+    router = Router(sup, cfg)
+    sup.start()
+    assert router.wait_live(cfg.replicas, timeout_s=600), sup.describe()
+    return sup, router
+
+
+def _worker_sched(sup, rid):
+    return sup.replicas()[rid].handle.worker._sched
+
+
+def _leak_check_all(sup):
+    for r in sup.replicas():
+        worker = getattr(r.handle, "worker", None)
+        if worker is None or worker.dead.is_set():
+            continue
+        worker._sched.engine.pool.leak_check()
+
+
+def _route_ok(router, rid_prompt, req_id, **kw):
+    code, obj = router.route({"id": req_id, "prompt_tokens": rid_prompt,
+                              "max_new_tokens": 4, **kw})
+    assert code == 200, obj
+    return obj
+
+
+def _wait_covered(router, sup, prompt, timeout_s=30.0):
+    """Probe until some replica's healthz digest fully covers
+    ``prompt``'s whole-block prefix; -> that replica."""
+    hashes = fleetcache.prefix_hashes(prompt, 8)
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        router.probe_all()
+        for r in sup.live_replicas():
+            parsed = fleetcache.digest_entries_of(r.last_health)
+            if parsed and fleetcache.coverage(
+                    parsed[1], hashes)[0] >= len(hashes):
+                return r
+        time.sleep(0.02)
+    raise AssertionError("digest coverage never appeared on /healthz")
+
+
+def test_cluster_digest_on_healthz_and_affinity_revisit(tiny_model):
+    """End to end over real sockets: the /healthz payload carries the
+    versioned digest + size/age fields, the prober caches it, and a
+    revisit routes back to the owner replica (an affinity win + a
+    device-trie hit) instead of the least-loaded default. The
+    ``router.affinity`` fault point degrades the scorer to plain
+    least-loaded — the request still answers 200."""
+    cfg = _cfg()
+    sup, router = _cluster(cfg)
+    try:
+        prompt = _prompt(29)
+        first = _route_ok(router, prompt, "fleet-v0")
+        owner = _wait_covered(router, sup, prompt)
+        pay = owner.last_health
+        assert pay["digest_size"] >= 3 and pay["digest_age_s"] >= 0.0
+        assert pay["fleet_digest"]["v"] == fleetcache.DIGEST_VERSION
+        assert pay["fleet_digest"]["block_size"] == 8
+
+        wins0 = router.affinity_wins
+        osched = _worker_sched(sup, owner.rid)
+        hits0 = osched.engine.pool.prefix_hits
+        again = _route_ok(router, prompt, "fleet-v1")
+        assert again["tokens"] == first["tokens"]
+        assert osched.engine.pool.prefix_hits == hits0 + 1
+        assert osched.engine.pool.fleet_hits["device"] >= 1
+        # the win ledger counts only picks that beat least-loaded; the
+        # cold placement may already have owned rid 0, so >= not ==
+        assert router.affinity_wins >= wins0
+
+        # fault drill: the scorer trips, the pick degrades, 200 anyway
+        faults.install(FaultPlan.parse("router.affinity:error@1"))
+        deg = _route_ok(router, prompt, "fleet-v2")
+        assert deg["tokens"] == first["tokens"]
+        assert deg.get("fleet_pull") is None
+        faults.clear()
+        _leak_check_all(sup)
+    finally:
+        faults.clear()
+        router.stop()
+        sup.shutdown()
+
+
+def test_cluster_peer_pull_from_saturated_owner(tiny_model):
+    """The tentpole drill: the owner's admission queue is full, so the
+    router places the revisit on the sibling WITH a pull_from pointer;
+    the blocks arrive over /kv_export, install tagged peer, and the
+    decoded output is bit-identical to the owner's. A second pass with
+    ``replica.kv_pull`` tripped proves pull failure degrades to a cold
+    prefill (typed ``kv_pull_failed``) with the same output and no
+    client-visible error."""
+    cfg = _cfg()
+    sup, router = _cluster(cfg)
+    try:
+        prompt = _prompt(29, salt=3)
+        first = _route_ok(router, prompt, "pull-v0")
+        owner = _wait_covered(router, sup, prompt)
+        sibling = next(r for r in sup.live_replicas()
+                       if r.rid != owner.rid)
+        osched = _worker_sched(sup, owner.rid)
+        ssched = _worker_sched(sup, sibling.rid)
+        cap = osched.queue_capacity
+        try:
+            osched.queue_capacity = 0       # deterministic saturation
+            pulls0, bytes0 = router.kv_pulls, router.kv_pull_bytes
+            obj = _route_ok(router, prompt, "pull-v1")
+            fp = obj["fleet_pull"]
+            assert fp["installed"] == 3 and fp["blocks"] == 3
+            assert fp["bytes"] > 0 and fp["seconds"] >= 0
+            assert obj["tokens"] == first["tokens"]
+            assert router.kv_pulls == pulls0 + 1
+            assert router.kv_pull_bytes == bytes0 + fp["bytes"]
+            assert ssched.engine.pool.fleet_hits["peer"] == 1
+
+            # pull-failure drill: blocks already installed on the
+            # sibling would mask the cold path — use a fresh prefix
+            # the sibling has never seen
+            prompt2 = _prompt(29, salt=4)
+            osched.queue_capacity = cap
+            ref2 = _route_ok(router, prompt2, "pull2-v0",
+                             )
+            owner2 = _wait_covered(router, sup, prompt2)
+            osched2 = _worker_sched(sup, owner2.rid)
+            cap2 = osched2.queue_capacity
+            try:
+                osched2.queue_capacity = 0
+                faults.install(
+                    FaultPlan.parse("replica.kv_pull:error@1"))
+                deg = _route_ok(router, prompt2, "pull2-v1")
+                fp2 = deg["fleet_pull"]
+                assert fp2["installed"] == 0
+                assert fp2["error_type"] == "kv_pull_failed"
+                assert "injected" in fp2["degraded"]
+                assert deg["tokens"] == ref2["tokens"]
+                assert router.kv_pulls == pulls0 + 1   # nothing committed
+            finally:
+                osched2.queue_capacity = cap2
+        finally:
+            osched.queue_capacity = cap
+        faults.clear()
+        _leak_check_all(sup)
+    finally:
+        faults.clear()
+        router.stop()
+        sup.shutdown()
+
+
+def test_chaos_kill_owner_mid_pull_degrades_cold(tiny_model):
+    """THE chaos acceptance: SIGKILL the block-owning replica while the
+    sibling is mid-pull (an injected delay stretches the transfer
+    window the kill lands inside). The request still answers 200 — the
+    pull degrades typed to a cold prefill — the output matches the
+    cold reference bit for bit, and every surviving pool balances its
+    books."""
+    cfg = _cfg()
+    sup, router = _cluster(cfg)
+    try:
+        prompt = _prompt(29, salt=5)
+        ref = _route_ok(router, prompt, "chaos-v0")
+        owner = _wait_covered(router, sup, prompt)
+        osched = _worker_sched(sup, owner.rid)
+        osched.queue_capacity = 0
+        # stretch the pull window, then kill the source inside it
+        faults.install(FaultPlan.parse("replica.kv_pull:delay=1.5@1"))
+        result = {}
+
+        def client():
+            result["resp"] = router.route(
+                {"id": "chaos-v1", "prompt_tokens": prompt,
+                 "max_new_tokens": 4})
+
+        t = threading.Thread(target=client)
+        t.start()
+        time.sleep(0.5)                    # inside the delayed pull
+        sup.kill(owner.rid)
+        t.join(timeout=600)
+        assert not t.is_alive()
+        code, obj = result["resp"]
+        assert code == 200, obj
+        fp = obj["fleet_pull"]
+        assert fp["installed"] == 0
+        assert fp["error_type"] == "kv_pull_failed"
+        assert obj["tokens"] == ref["tokens"]
+        faults.clear()
+        assert router.wait_live(2, timeout_s=600), sup.describe()
+        _leak_check_all(sup)
+    finally:
+        faults.clear()
+        router.stop()
+        sup.shutdown()
+
+
+# -------------------------------------------------------- CLI plumbing
+def test_cli_flags_and_worker_passthrough():
+    from nezha_tpu.cli.serve import _worker_argv, build_parser
+    args = build_parser().parse_args(["--random-init"])
+    assert args.affinity_routing is None      # resolved per topology
+    assert args.digest_interval == 2.0
+    assert args.digest_max_entries == 256
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["--affinity-routing", "maybe"])
+    args = build_parser().parse_args(
+        ["--random-init", "--digest-interval", "0.5",
+         "--digest-max-entries", "32"])
+    argv = _worker_argv(args, rid=0, port=9999)
+    assert argv[argv.index("--digest-interval") + 1] == "0.5"
+    assert argv[argv.index("--digest-max-entries") + 1] == "32"
+
+
+def test_router_config_digest_validation():
+    with pytest.raises(ValueError, match="digest_interval_s"):
+        RouterConfig(replicas=2, digest_interval_s=0.0)
+    with pytest.raises(ValueError, match="digest_max_entries"):
+        RouterConfig(replicas=2, digest_max_entries=0)
+    cfg = RouterConfig(replicas=2, digest_interval_s=0.2,
+                       probe_interval_s=0.1)
+    assert cfg.digest_stale_s == pytest.approx(0.6)
+
+
+# ------------------------------------------------------ bench + gates
+def test_serving_benchmark_fleet_record(tiny_model):
+    """benchmarks/serving.py --replicas + --churn-users: the fleet
+    record carries the first/revisit TTFT split, the affinity-win and
+    pull ledgers, and the peer drill commits a pull against the
+    queue-clamped owner."""
+    import serving as bench
+
+    rec = bench.run(bench.build_parser().parse_args(
+        ["--replicas", "2", "--requests", "4", "--concurrency", "1",
+         "--churn-users", "2", "--churn-prefix-len", "16",
+         "--kv-block-size", "16", "--kv-dtype", "int8",
+         "--kv-num-blocks", "8", "--max-batch-size", "2",
+         "--max-prefill-len", "8", "--max-len", "48",
+         "--max-new-tokens", "4", "--sample-fraction", "0",
+         "--queue-capacity", "8", "--digest-interval", "0.1"]))
+    fl = rec["fleet"]
+    assert fl["users"] == 2 and fl["visits"] == 2
+    assert fl["affinity_routing"] == "on"
+    assert fl["ttft_first_visit_s"]["p50"] > 0
+    assert fl["ttft_revisit_s"]["p50"] > 0
+    assert fl["fleet_hits"]["device"] >= 2     # both revisits warm
+    peer = fl["peer_pull"]
+    assert peer["saturated"] is True
+    assert peer["installed"] == 1 and peer["bytes"] > 0
+    assert fl["kv_pulls"] == 1
+    assert fl["kv_pull_bytes"] == peer["bytes"]
+    # misaligned churn prefixes are a typed refusal in fleet mode too
+    with pytest.raises(SystemExit, match="multiple"):
+        bench.run(bench.build_parser().parse_args(
+            ["--replicas", "2", "--churn-users", "2",
+             "--churn-prefix-len", "10", "--kv-block-size", "16",
+             "--kv-dtype", "int8"]))
+
+
+def test_nezha_bench_fleet_kv_gate_rows():
+    """The fleet_kv gate logic (no model run — cooked results): the
+    revisit-vs-cold ratio is a HARD gate at 0.7; affinity wins,
+    committed pulls, and peer-installed blocks must be nonzero; a
+    committed baseline adds a drift gate."""
+    from nezha_tpu.cli import bench as nb
+
+    good = {"fleet_kv": {"revisit_vs_first_ttft_p50": 0.45,
+                         "affinity_wins": 8, "kv_pulls": 1,
+                         "peer_installed": 2}}
+    rows = nb._gate(good, {}, "cpu", 0.30)["serving"]
+    assert rows["fleet_kv.revisit_vs_first_ttft_p50"]["ok"]
+    assert rows["fleet_kv.affinity_wins"]["ok"]
+    assert rows["fleet_kv.kv_pulls"]["ok"]
+    assert rows["fleet_kv.peer_installed"]["ok"]
+
+    bad = {"fleet_kv": {"revisit_vs_first_ttft_p50": 0.9,
+                        "affinity_wins": 0, "kv_pulls": 0,
+                        "peer_installed": 0}}
+    rows = nb._gate(bad, {}, "cpu", 0.30)["serving"]
+    assert not rows["fleet_kv.revisit_vs_first_ttft_p50"]["ok"]
+    assert not rows["fleet_kv.affinity_wins"]["ok"]
+    assert not rows["fleet_kv.kv_pulls"]["ok"]
+    assert not rows["fleet_kv.peer_installed"]["ok"]
+
+    base = {"by_platform": {"cpu": {
+        "fleet_kv": {"revisit_vs_first_ttft_p50": 0.36}}}}
+    rows = nb._gate(good, {"serving": base}, "cpu", 0.30)["serving"]
+    drift = rows["fleet_kv.revisit_vs_first_ttft_p50_vs_baseline"]
+    assert drift["ok"]                      # 0.45/0.36 = 1.25 <= 1.30
+    rows = nb._gate(good, {"serving": base}, "cpu", 0.10)["serving"]
+    assert not rows[
+        "fleet_kv.revisit_vs_first_ttft_p50_vs_baseline"]["ok"]
