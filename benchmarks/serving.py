@@ -76,6 +76,12 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["auto", "kernel", "xla"], default=None,
                    help="decode attention: flash-decode kernel vs the "
                         "composed masked path (the before/after knob)")
+    p.add_argument("--prefill-impl",
+                   choices=["auto", "kernel", "xla"], default=None,
+                   help="paged prefill attention: flash-prefill kernel "
+                        "(int8 pools fuse the block write into its "
+                        "epilogue) vs the composed masked path (the "
+                        "TTFT before/after knob)")
     p.add_argument("--decode-horizon", default="1",
                    help="tokens decoded per compiled step dispatch; a "
                         "comma-separated list (e.g. 1,4,8) sweeps the "
@@ -389,6 +395,7 @@ def _run_one(args, model, variables, decode_horizon: int,
         max_prefill_len=args.max_prefill_len, prefill_buckets=buckets,
         queue_capacity=args.queue_capacity, cache_dtype=jnp.bfloat16,
         decode_impl=args.decode_impl, decode_horizon=decode_horizon,
+        prefill_impl=getattr(args, "prefill_impl", None),
         kv_layout=args.kv_layout, kv_block_size=args.kv_block_size,
         kv_num_blocks=args.kv_num_blocks,
         prefix_cache=args.prefix_cache == "on",
@@ -722,6 +729,7 @@ def _run_one(args, model, variables, decode_horizon: int,
         "latency_s": _percentiles(lats),
         "prefill_buckets": list(engine.cfg.prefill_buckets),
         "decode_impl": args.decode_impl or "auto",
+        "prefill_impl": getattr(args, "prefill_impl", None) or "auto",
         "mesh_devices": getattr(engine, "mesh_devices", 1),
         "compile_cache": engine.compile_stats(),
         # Paged-pool occupancy record: resident-request and
@@ -908,6 +916,8 @@ def _run_replicas(args, decode_horizon: int) -> dict:
         wargv += ["--prefill-buckets", str(args.prefill_buckets)]
     if args.decode_impl:
         wargv += ["--decode-impl", args.decode_impl]
+    if getattr(args, "prefill_impl", None):
+        wargv += ["--prefill-impl", args.prefill_impl]
     if args.platform:
         wargv += ["--platform", args.platform]
     if getattr(args, "speculative", False):

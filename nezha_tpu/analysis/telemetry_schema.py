@@ -95,7 +95,12 @@ _SERVE_COUNTERS = {"serve.admitted_total", "serve.rejected_total",
                    # estimate of the cross-shard collective payload the
                    # mesh moved. Topology-invariant: single-device runs
                    # report 0, never omit it.
-                   "serve.mesh.collective_bytes"}
+                   "serve.mesh.collective_bytes",
+                   # Flash-prefill kernel (PR 18): per-layer int8 K/V
+                   # block writes fused into the kernel epilogue
+                   # instead of the gather/requant round-trip. 0 on
+                   # the XLA prefill path or a non-int8 pool.
+                   "serve.prefill.fused_writes_total"}
 _SERVE_GAUGES = {"serve.queue_depth", "serve.batch_occupancy",
                  "serve.kv.blocks_used",
                  # KV quantization (PR 9): device bytes the resident KV
@@ -110,7 +115,12 @@ _SERVE_GAUGES = {"serve.queue_depth", "serve.batch_occupancy",
                  "serve.kv.host_bytes_resident",
                  # Tensor-sharded serving (PR 14): the mesh size this
                  # engine spans (1 = classic single-device engine).
-                 "serve.mesh.devices"}
+                 "serve.mesh.devices",
+                 # Flash-prefill kernel (PR 18): 1 when paged prefill
+                 # chunks dispatch through the Pallas kernel, 0 on the
+                 # composed XLA path — dashboards label the prefill
+                 # line with the active impl from this alone.
+                 "serve.prefill.kernel_active"}
 _SERVE_HISTOGRAMS = {"serve.ttft_s", "serve.tpot_s",
                      "serve.prefill.bucket_len",
                      # Decode-horizon instruments (PR 5): host time
@@ -211,6 +221,10 @@ _PINNED_SPANS = {
     # pull_from hop (attrs carry src/dst rids, blocks, wire bytes,
     # and whether the replica degraded to a cold prefill).
     "router.kv_pull_s",
+    # Flash-prefill kernel (PR 18): brackets one chunk's dispatch
+    # through the Pallas prefill program (attrs carry the bucket
+    # width). Absent entirely on the XLA prefill path.
+    "serve.prefill.kernel_s",
 }
 
 # Namespaces whose METRIC names (counter/gauge/histogram) the source

@@ -104,6 +104,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "force the kernel (interpret off-TPU), xla = "
                         "force the composed masked path; default: the "
                         "model config's choice (auto)")
+    p.add_argument("--prefill-impl",
+                   choices=["auto", "kernel", "xla"], default=None,
+                   help="paged prefill attention: auto = Pallas "
+                        "flash-prefill kernel on TPU / composed "
+                        "elsewhere, kernel = force the kernel "
+                        "(interpret off-TPU; int8 pools fuse the block "
+                        "write into its epilogue), xla = force the "
+                        "composed masked path; NEZHA_NO_PREFILL_KERNEL=1 "
+                        "is the env escape hatch; default: the model "
+                        "config's choice (auto)")
     p.add_argument("--decode-horizon", type=int, default=1,
                    help="tokens decoded per compiled step dispatch (the "
                         "device-resident sampling loop): 1 = classic "
@@ -410,6 +420,7 @@ def _build_stack(args):
         cache_dtype=jnp.float32 if args.cache_dtype == "f32"
         else jnp.bfloat16,
         decode_impl=args.decode_impl,
+        prefill_impl=args.prefill_impl,
         decode_horizon=args.decode_horizon,
         kv_layout=args.kv_layout,
         kv_block_size=args.kv_block_size,
@@ -1155,6 +1166,8 @@ def _worker_argv(args, rid: int, port: int, role: Optional[str] = None
         argv += ["--prefill-buckets", str(args.prefill_buckets)]
     if args.decode_impl:
         argv += ["--decode-impl", args.decode_impl]
+    if args.prefill_impl:
+        argv += ["--prefill-impl", args.prefill_impl]
     if args.eos_id is not None:
         argv += ["--eos-id", str(args.eos_id)]
     if args.platform:

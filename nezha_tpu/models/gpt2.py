@@ -73,6 +73,19 @@ class GPT2Config:
     # NEZHA_NO_DECODE_KERNEL=1 is the day-1 escape hatch back to the
     # composed path without editing configs.
     decode_impl: str = "auto"
+    # Paged prefill-chunk attention (the serving TTFT path): "auto"
+    # (default) runs the Pallas flash-prefill kernel
+    # (ops/pallas/prefill_attention.py — online softmax over the block
+    # table with per-row start offsets; on int8 pools the block write
+    # fuses into the kernel epilogue, replacing the whole
+    # _quant_prefill_write gather/requant round trip) under the same
+    # backend policy as decode_impl; "kernel" forces it (interpret mode
+    # off-TPU — the parity-test path); "xla" forces the composed
+    # masked path. NEZHA_NO_PREFILL_KERNEL=1 is the escape hatch back
+    # to the composed path without editing configs. Only the paged
+    # cache layout routes here — dense-slot prefill keeps the
+    # attn_impl-resolved path.
+    prefill_impl: str = "auto"
     # "pallas" opts layer norms into the fused kernel (fwd + bwd) on TPU.
     ln_impl: str = "xla"
     # Rematerialize each transformer block in backward (jax.checkpoint):
@@ -205,6 +218,58 @@ def _decode_flash_shmap_mesh(cfg):
                                                            "flash"):
         return None
     if cfg.decode_impl == "kernel":
+        from nezha_tpu.parallel.gspmd import auto_partitioner_mesh
+        mesh = auto_partitioner_mesh()
+        if (mesh is not None and "tp" in mesh.axis_names
+                and cfg.num_heads % mesh.shape["tp"] == 0):
+            return mesh
+        return None
+    return _tp_flash_mesh(cfg.num_heads)
+
+
+def _prefill_flash_ok(cfg) -> bool:
+    """Whether the paged prefill-chunk branch takes the flash-prefill
+    kernel — the same escape-hatch shape as :func:`_decode_flash_ok`:
+    an env kill switch (``NEZHA_NO_PREFILL_KERNEL=1``), an explicit
+    config override (``prefill_impl="kernel"``/``"xla"``), and
+    otherwise the shared ``attn_impl`` resolution, so one flag set
+    governs the whole attention surface."""
+    import os
+
+    if os.environ.get("NEZHA_NO_PREFILL_KERNEL"):
+        return False
+    if cfg.prefill_impl == "kernel":
+        return True
+    if cfg.prefill_impl != "auto":
+        return False
+    impl = cfg.attn_impl
+    if impl == "auto":
+        return _flash_auto_ok()
+    return impl == "flash"
+
+
+def _prefill_flash_shmap_mesh(cfg):
+    """The enclosing auto-partitioner mesh when the flash-PREFILL
+    kernel can run per-shard under a nested ``shard_map`` (the sharded
+    serve engine's path, ops/pallas/prefill_attention.py
+    ``flash_prefill_attention_sharded``); None otherwise. Same gates
+    as :func:`_decode_flash_shmap_mesh` with the prefill knobs
+    (``prefill_impl``, ``NEZHA_NO_PREFILL_KERNEL``) swapped in:
+    ``prefill_impl="kernel"`` honors the force on ANY backend
+    (interpret mode off-TPU — under the partitioner the raw Mosaic
+    call is never an option, so the nested variant IS the forced
+    kernel)."""
+    import os
+
+    if os.environ.get("NEZHA_NO_PREFILL_KERNEL") \
+            or os.environ.get("NEZHA_NO_NESTED_KERNELS"):
+        return None
+    if cfg.prefill_impl == "xla":
+        return None
+    if cfg.prefill_impl == "auto" and cfg.attn_impl not in ("auto",
+                                                            "flash"):
+        return None
+    if cfg.prefill_impl == "kernel":
         from nezha_tpu.parallel.gspmd import auto_partitioner_mesh
         mesh = auto_partitioner_mesh()
         if (mesh is not None and "tp" in mesh.axis_names
@@ -526,6 +591,7 @@ class Attention(Module):
         L = m * bs_kv
         per_row = getattr(pos, "ndim", 0) == 1
         qerr = None
+        out_pf = None   # flash-prefill kernel output, when that path ran
         if per_row and s > 1:
             # Speculative verify window: s tokens per row at PER-ROW
             # offsets, scattered through the block table. Positions
@@ -589,11 +655,67 @@ class Attention(Module):
                 v_pool = vp.at[blk, :, off, :].set(
                     v[:, :, 0, :].astype(vp.dtype))
         else:
-            # Prefill chunk at a traced scalar offset: scatter the s
-            # tokens through the table (pads beyond the prompt land in
-            # the row's own bound blocks and are overwritten by decode
-            # before any mask attends them — same argument as dense).
-            if quant:
+            # Prefill chunk at a traced scalar offset. The flash-
+            # prefill kernel (prefill_impl resolution, mirroring
+            # decode_impl) attends the cached prefix through the block
+            # table with the chunk's own K/V folded causally from the
+            # fresh operands, ONE program for every start offset — and
+            # on int8 pools it fuses the whole block write
+            # (_quant_prefill_write's gather→dequant→insert→requant→
+            # scatter chain) into its epilogue, stale-position zeroing
+            # and the qerr sample included. Composed fallback: scatter
+            # the s tokens through the table (pads beyond the prompt
+            # land in the row's own bound blocks and are overwritten by
+            # decode before any mask attends them — same argument as
+            # dense), then masked attention over the gathered pool.
+            use_pf = _prefill_flash_ok(cfg)
+            pf_mesh = None
+            from nezha_tpu.parallel.gspmd import under_auto_partitioner
+            if under_auto_partitioner():
+                # Same move as decode below: the raw Mosaic call can
+                # never be handed to the auto-partitioner — the nested-
+                # shard_map variant runs it per head shard, or the
+                # composed path partitions.
+                use_pf = False
+                pf_mesh = _prefill_flash_shmap_mesh(cfg)
+            if use_pf or pf_mesh is not None:
+                from nezha_tpu.ops.pallas import (
+                    flash_prefill_attention,
+                    flash_prefill_attention_sharded,
+                )
+                starts = jnp.broadcast_to(
+                    jnp.asarray(pos, jnp.int32), (b,))
+                if quant:
+                    if pf_mesh is not None:
+                        (out_pf, k_pool, v_pool, ks_pool, vs_pool,
+                         qerr) = flash_prefill_attention_sharded(
+                            q, k, v, kp, vp, tab, starts, pf_mesh,
+                            block_scales=(ks_pool, vs_pool))
+                    else:
+                        (out_pf, k_pool, v_pool, ks_pool, vs_pool,
+                         qerr) = flash_prefill_attention(
+                            q, k, v, kp, vp, tab, starts,
+                            block_scales=(ks_pool, vs_pool))
+                else:
+                    # Float pools keep the one-scatter chunk write (it
+                    # is already a single cheap XLA op); the kernel
+                    # reads only prefix positions plus the fresh
+                    # operands, so write and attention commute.
+                    ppos = jnp.minimum(pos + jnp.arange(s), L - 1)
+                    bi = jnp.clip(ppos // bs_kv, 0, m - 1)
+                    blk = tab[:, bi]                           # [b, s]
+                    off = (ppos % bs_kv)[None, :]              # [1, s]
+                    k_pool = kp.at[blk, :, off, :].set(
+                        k.transpose(0, 2, 1, 3).astype(kp.dtype))
+                    v_pool = vp.at[blk, :, off, :].set(
+                        v.transpose(0, 2, 1, 3).astype(vp.dtype))
+                    if pf_mesh is not None:
+                        out_pf = flash_prefill_attention_sharded(
+                            q, k, v, kp, vp, tab, starts, pf_mesh)
+                    else:
+                        out_pf = flash_prefill_attention(
+                            q, k, v, kp, vp, tab, starts)
+            elif quant:
                 k_pool, ks_pool, ek = _quant_prefill_write(
                     kp, ks_pool, tab, pos, k, s)
                 v_pool, vs_pool, ev = _quant_prefill_write(
@@ -624,7 +746,11 @@ class Attention(Module):
                 # mesh can't host it, the composed path partitions.
                 use_decode_kernel = False
                 shmap_mesh = _decode_flash_shmap_mesh(cfg)
-        if use_decode_kernel or shmap_mesh is not None:
+        if out_pf is not None:
+            # The flash-prefill kernel already produced the chunk's
+            # attention (and, on int8 pools, the fused write above).
+            out = out_pf
+        elif use_decode_kernel or shmap_mesh is not None:
             # The kernel takes the POOLS + table directly (block-table
             # gather operand): rows only DMA table entries below their
             # own length, inactive rows skip every block. Int8 pools

@@ -228,10 +228,19 @@ def render_serving_section(summary: Optional[dict]) -> List[str]:
         # the top bucket under short-prompt traffic means the bucket set
         # is too coarse).
         chunks = counters.get("serve.prefill.chunks_total", ph["count"])
+        # Active prefill impl (PR 18): the engine pins the gauge to 1
+        # when chunks dispatch through the Pallas flash-prefill kernel;
+        # an int8 pool additionally counts the per-layer block writes
+        # the kernel epilogue fused in place of the gather/requant
+        # round-trip.
+        impl = ("kernel"
+                if gauges.get("serve.prefill.kernel_active") else "xla")
+        fused = counters.get("serve.prefill.fused_writes_total", 0)
+        fused_part = f"  fused writes {fused:.0f}" if fused else ""
         lines.append(
-            f"  prefill: {chunks:.0f} chunk(s)  "
+            f"  prefill[{impl}]: {chunks:.0f} chunk(s)  "
             f"bucket len p50 {ph['p50']:.0f}  p90 {ph['p90']:.0f}  "
-            f"max {ph['max']:.0f}")
+            f"max {ph['max']:.0f}{fused_part}")
     tokens = counters.get("serve.tokens_total", 0)
     wall = (summary.get("run") or {}).get("wall_seconds")
     if tokens and wall:

@@ -8,9 +8,13 @@ are Mosaic/Pallas kernels tiled for MXU/VPU and VMEM:
 - `flash_decode_attention`: split-K single-token decode attention over a
   pooled KV cache — per-row lengths skip KV blocks instead of masking
   them (the serving hot path).
+- `flash_prefill_attention`: chunked prefill attention through the block
+  table, with the int8 block write fused into the kernel epilogue (the
+  TTFT hot path).
 - `fused_layer_norm`: single-pass normalization on VMEM rows.
 
-All kernels run in interpret mode on CPU (tests) and compile on TPU.
+The shared online-softmax scratch core lives in `common.py`. All kernels
+run in interpret mode on CPU (tests) and compile on TPU.
 """
 
 from nezha_tpu.ops.pallas.decode_attention import (
@@ -19,6 +23,11 @@ from nezha_tpu.ops.pallas.decode_attention import (
 )
 from nezha_tpu.ops.pallas.flash_attention import flash_attention
 from nezha_tpu.ops.pallas.layer_norm import fused_layer_norm
+from nezha_tpu.ops.pallas.prefill_attention import (
+    flash_prefill_attention,
+    flash_prefill_attention_sharded,
+)
 
 __all__ = ["flash_attention", "flash_decode_attention",
-           "flash_decode_attention_sharded", "fused_layer_norm"]
+           "flash_decode_attention_sharded", "flash_prefill_attention",
+           "flash_prefill_attention_sharded", "fused_layer_norm"]

@@ -1,0 +1,98 @@
+"""Shared online-softmax core for the Pallas attention kernels.
+
+Every attention kernel in this package — training flash
+(``flash_attention.py``), single-token decode (``decode_attention.py``)
+and paged prefill (``prefill_attention.py``) — folds KV blocks into the
+same three-piece VMEM scratch: a running row max ``m``, a running
+denominator ``l`` and an fp32 output accumulator ``acc``. The update
+math was duplicated verbatim between the decode ``_block_step`` and the
+flash ``_fwd_kernel`` body; this module is the single source both (and
+the prefill kernel) now call. Grouping it here is a pure factoring:
+the op sequence is bit-identical to what each kernel inlined before,
+so every existing kernel test pins the refactor.
+
+Also hosts the package-wide scalar helpers: the finite ``NEG_BIG``
+"-inf" (fully-masked rows must stay NaN-free), the ``LANES`` lane
+width small per-row operands broadcast to, the CompilerParams rename
+shim and the block-divisor picker.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_BIG = -1e30
+LANES = 128  # per-row scalars ride lane-broadcast: [B, 128]
+
+# jax renamed pltpu.TPUCompilerParams -> CompilerParams; resolve whichever
+# this install ships so the compiled-TPU path works on either side of the
+# rename (the interpret path never touches it).
+compiler_params = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
+
+def pick_block(size: int, target: int) -> int:
+    """Largest divisor of ``size`` that is <= target (block shapes must
+    tile the sequence exactly)."""
+    b = min(size, target)
+    while size % b:
+        b -= 1
+    return b
+
+
+def scratch_init(m_scr, l_scr, acc_scr):
+    """Reset the online-softmax scratch at the first KV block — shared
+    by every kernel variant."""
+    m_scr[:] = jnp.full_like(m_scr, NEG_BIG)
+    l_scr[:] = jnp.zeros_like(l_scr)
+    acc_scr[:] = jnp.zeros_like(acc_scr)
+
+
+def softmax_block_update(s, v, m_scr, l_scr, acc_scr):
+    """Fold one masked score block ``s [rows, bk]`` and its value tile
+    ``v [bk, d]`` into the running ``(max, sum, acc)`` scratch — THE
+    online-softmax step every kernel shares. ``s`` arrives fully masked
+    (causal / length / start-offset masking is the caller's business);
+    softmax statistics and the accumulator stay fp32, P·V dots in the
+    value tile's native dtype."""
+    m_prev = m_scr[:, :1]                                # [rows, 1]
+    l_prev = l_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)                               # [rows, bk]
+    corr = jnp.exp(m_prev - m_new)
+    l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * corr + lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+
+def softmax_finalize(o_ref, m_scr, l_scr, acc_scr, lse_ref=None):
+    """Write the normalized accumulator at the last KV block. The denom
+    guard keeps a row whose scratch never saw a block (zero-length /
+    inactive) at an exact-zero output instead of 0/0. With ``lse_ref``
+    (the training forward) the per-row logsumexp residual is emitted
+    lane-broadcast alongside."""
+    denom = jnp.maximum(l_scr[:, :1], 1e-30)
+    o_ref[0, 0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+    if lse_ref is not None:
+        lse = m_scr[:, :1] + jnp.log(denom)              # [rows, 1]
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref[0, 0].shape)
+
+
+def block_step(q, k, v, length, ki, m_scr, l_scr, acc_scr, *,
+               scale: float, block_k: int):
+    """One length-masked KV block folded into the scratch — the shared
+    core of the decode-kernel variants (dense, paged, paged-int8) and
+    the prefill kernel's prior-block path: the variants differ only in
+    WHERE ``k``/``v`` came from (BlockSpec gather, in-kernel dequant)
+    and in any EXTRA masking applied on top, never in the fold."""
+    s = lax.dot_general(q.astype(k.dtype), k,
+                        (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+    kpos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(kpos < length, s, NEG_BIG)             # partial block
+    softmax_block_update(s, v, m_scr, l_scr, acc_scr)

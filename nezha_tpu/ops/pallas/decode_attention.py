@@ -36,55 +36,28 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from nezha_tpu.ops.pallas.flash_attention import _compiler_params, _pick_block
-
-_NEG_BIG = -1e30
-_LANES = 128  # lengths ride lane-broadcast: [B, 128] int32
-
-
-def _scratch_init(m_scr, l_scr, acc_scr):
-    """Reset the online-softmax scratch at the first KV block — shared
-    by every decode-kernel variant."""
-    m_scr[:] = jnp.full_like(m_scr, _NEG_BIG)
-    l_scr[:] = jnp.zeros_like(l_scr)
-    acc_scr[:] = jnp.zeros_like(acc_scr)
+# The online-softmax core (scratch init / block fold / finalize) is
+# shared with flash_attention.py and prefill_attention.py — see
+# ops/pallas/common.py. The aliases keep this module's kernel bodies
+# reading as before; the math is bit-identical to the pre-factoring
+# inline version.
+from nezha_tpu.ops.pallas.common import (
+    LANES as _LANES,
+    block_step as _block_step,
+    compiler_params as _compiler_params,
+    pick_block as _pick_block,
+    scratch_init as _scratch_init,
+    softmax_finalize,
+)
 
 
 def _finalize(o_ref, l_scr, acc_scr):
-    """Write the normalized accumulator at the last KV block. The denom
-    guard keeps a zero-length (inactive) row — whose scratch never saw
-    a block — at an exact-zero output instead of 0/0."""
-    denom = jnp.maximum(l_scr[:, :1], 1e-30)
-    o_ref[0, 0] = (acc_scr[:] / denom).astype(o_ref.dtype)
-
-
-def _block_step(q, k, v, length, ki, m_scr, l_scr, acc_scr, *,
-                scale: float, block_k: int):
-    """One KV block folded into the online-softmax scratch — the shared
-    math of every decode-kernel variant (dense, paged, paged-int8): the
-    variants differ only in WHERE ``k``/``v`` came from (BlockSpec
-    gather, in-kernel dequant), never in what happens to them."""
-    s = lax.dot_general(q.astype(k.dtype), k,
-                        (((1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.float32) * scale
-    kpos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    s = jnp.where(kpos < length, s, _NEG_BIG)            # partial block
-
-    m_prev = m_scr[:, :1]                                # [1, 1]
-    l_prev = l_scr[:, :1]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    p = jnp.exp(s - m_new)                               # [1, bk]
-    corr = jnp.exp(m_prev - m_new)
-    l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-    acc_scr[:] = acc_scr[:] * corr + lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+    """Write the normalized accumulator at the last KV block (decode
+    emits no lse residual — inference only)."""
+    softmax_finalize(o_ref, None, l_scr, acc_scr)
 
 
 def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, m_scr, l_scr,

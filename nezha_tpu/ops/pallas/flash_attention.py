@@ -45,23 +45,21 @@ from jax.experimental import pallas as pl
 
 from jax.experimental.pallas import tpu as pltpu
 
-_NEG_BIG = -1e30
-_LANES = 128  # lse is stored lane-broadcast: [B, H, S, 128]
-
-# jax renamed pltpu.TPUCompilerParams -> CompilerParams; resolve whichever
-# this install ships so the compiled-TPU path works on either side of the
-# rename (the interpret path never touches it).
-_compiler_params = getattr(pltpu, "CompilerParams", None) \
-    or getattr(pltpu, "TPUCompilerParams")
-
-
-def _pick_block(size: int, target: int) -> int:
-    """Largest divisor of ``size`` that is <= target (block shapes must tile
-    the sequence exactly)."""
-    b = min(size, target)
-    while size % b:
-        b -= 1
-    return b
+# The online-softmax scratch math and the package scalar helpers moved
+# to ops/pallas/common.py (shared with the decode and prefill kernels);
+# the aliases preserve this module's historical import surface
+# (decode_attention once imported _compiler_params/_pick_block from
+# here) and keep the kernel bodies bit-identical to the pre-factoring
+# inline version.
+from nezha_tpu.ops.pallas.common import (
+    LANES as _LANES,
+    NEG_BIG as _NEG_BIG,
+    compiler_params as _compiler_params,
+    pick_block as _pick_block,
+    scratch_init as _scratch_init,
+    softmax_block_update as _softmax_block_update,
+    softmax_finalize as _softmax_finalize,
+)
 
 
 def _auto_blocks(s_q: int, s_k: int):
@@ -98,9 +96,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, lse_ref, m_scr, l_scr,
 
     @pl.when(ki == 0)
     def _init():
-        m_scr[:] = jnp.full_like(m_scr, _NEG_BIG)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
+        _scratch_init(m_scr, l_scr, acc_scr)
 
     # Causal: skip blocks strictly above the diagonal.
     run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
@@ -119,26 +115,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, lse_ref, m_scr, l_scr,
             s = _causal_mask(s, qi, ki, block_q, block_k)
         if len_ref is not None:
             s = _length_mask(s, ki, block_k, len_ref[0, 0])
-
-        m_prev = m_scr[:, :1]                                # [bq, 1]
-        l_prev = l_scr[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)                               # [bq, bk]
-        corr = jnp.exp(m_prev - m_new)                       # [bq, 1]
-        l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * corr + lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        _softmax_block_update(s, v, m_scr, l_scr, acc_scr)
 
     @pl.when(ki == pl.num_programs(3) - 1)
     def _finalize():
-        denom = jnp.maximum(l_scr[:, :1], 1e-30)
-        o_ref[0, 0] = (acc_scr[:] / denom).astype(o_ref.dtype)
-        if lse_ref is not None:
-            lse = m_scr[:, :1] + jnp.log(denom)              # [bq, 1]
-            lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref[0, 0].shape)
+        _softmax_finalize(o_ref, m_scr, l_scr, acc_scr, lse_ref=lse_ref)
 
 
 def _flash_call(q, k, v, causal, scale, block_q, block_k, interpret,

@@ -1,0 +1,480 @@
+"""Paged flash-prefill: chunked prompt attention against a block pool,
+with the int8 cache write fused into the kernel epilogue.
+
+The serving prefill path processes one bucket-width chunk of prompt at a
+traced offset ``start``: its queries attend the row's cached prefix
+``[0, start)`` (earlier chunks / a shared-prefix hit, reached through
+the block table) plus the chunk itself causally. The composed path
+gathers the WHOLE pool per layer (``k_pool[tab]`` — ``M * bs``
+bandwidth whatever the prefix depth), materializes an ``[S, L]`` mask,
+and on int8 pools pays a separate gather→dequant→insert→requant→scatter
+chain per written block (``models/gpt2._quant_prefill_write``). This
+kernel is built for the actual access pattern:
+
+- grid ``(B, H, Q-tiles, M + 1)`` with the KV axis sequential: steps
+  ``t < M`` fold pool block ``t`` (gathered through the scalar-
+  prefetched block table, exactly the decode kernel's index map) into
+  the shared online-softmax scratch, masked to the PREFIX ``[0, start)``
+  and skipped entirely once ``t*bs >= start`` — prefix work tracks the
+  row's real depth, not the table capacity; the final step folds the
+  chunk's own K/V causally from the fresh operands (the pool is never
+  read at chunk positions, so the attention is independent of whether
+  the chunk write landed yet);
+- ``start`` rides per-row as a second scalar-prefetch operand, so
+  chunked continuation and shared-prefix partial prefills (nonzero
+  start) are the SAME compiled program as a cold start — the engine's
+  one-program-per-bucket contract;
+- on int8 pools the block write FUSES into the epilogue: during the
+  last Q-tile sweep each touched pool block is merged in-VMEM (old
+  dequantized content below ``start`` — the block was just gathered for
+  prefix attention anyway — chunk values in ``[start, start+S)``, zeros
+  after: stale previous-occupant garbage must never set the new absmax),
+  requantized with a fresh per-(block, head) fp32 scale, and scattered
+  through a table-indexed OUTPUT BlockSpec aliased onto the pool.
+  Non-writing grid steps route the output index map to the scratch
+  block (block 0 — the same over-cover routing
+  ``_quant_prefill_write`` uses) with zeroed content and unit scale.
+  The whole ``_quant_prefill_write`` chain collapses into the
+  attention kernel: one program, no pool-sized gather/scatter round
+  trip.
+
+The quantization policy is ``ops.quant.quantize_kv_block`` verbatim
+(sanitize → absmax/127 with the zero guard → round/clip), and the
+max-abs dequant error over the written span comes back as a
+``[B, H]`` output so the engine keeps feeding ``serve.kv.quant_error``.
+
+Aliased-write ordering: writes happen only in the LAST Q-tile sweep,
+each touched block is read (for the old-content merge) at the same
+sequential step that writes it, and the KV axis only moves forward —
+no step ever re-reads a block a previous step wrote. Rows of one call
+must not share touched blocks (the engine prefills one row per
+program; prefix blocks are read-only and may be shared freely).
+
+``interpret=None`` auto-selects the Pallas interpreter off-TPU, so CPU
+tests exercise the same kernel code that compiles on hardware.
+Inference-only: no VJP. ``models/gpt2.py`` routes its paged
+prefill-chunk branch here behind ``GPT2Config.prefill_impl``
+(``NEZHA_NO_PREFILL_KERNEL=1`` is the escape hatch back to the
+composed masked path).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from nezha_tpu.ops.pallas.common import (
+    LANES,
+    NEG_BIG,
+    block_step,
+    compiler_params,
+    pick_block,
+    scratch_init,
+    softmax_block_update,
+    softmax_finalize,
+)
+from nezha_tpu.ops.quant import QMAX, SATURATE_MAX
+
+_Q_TILE_TARGET = 256   # q rows per tile (divisor-clamped to the chunk)
+_KC_TILE_TARGET = 256  # chunk-KV rows per self-attention tile
+
+
+def _chunk_self_attention(qi, q_ref, kc_ref, vc_ref, m_scr, l_scr,
+                          acc_scr, *, scale, block_q, block_kc, s_chunk,
+                          cast_dtype):
+    """Fold the chunk's own K/V causally (chunk-local positions — the
+    shared ``start`` offset cancels out of the causal comparison).
+    ``cast_dtype`` routes the fresh tiles through the pool's storage
+    dtype first so a bf16 pool attends exactly the values the composed
+    path reads back after its write. ``qi`` is passed in (program ids
+    must be read at kernel top level, outside any ``pl.when`` body)."""
+    q = q_ref[0, 0]                                          # [bq, d]
+    for kj in range(s_chunk // block_kc):
+        # Tiles strictly above this q tile's causal diagonal are skipped.
+        run = kj * block_kc <= qi * block_q + block_q - 1
+
+        @pl.when(run)
+        def _tile(kj=kj):
+            k = kc_ref[0, 0, kj * block_kc:(kj + 1) * block_kc, :]
+            v = vc_ref[0, 0, kj * block_kc:(kj + 1) * block_kc, :]
+            if cast_dtype is not None:
+                k = k.astype(cast_dtype)
+                v = v.astype(cast_dtype)
+            k = k.astype(q.dtype)
+            v = v.astype(q.dtype)
+            s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+            qpos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            kpos = kj * block_kc + lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(kpos <= qpos, s, NEG_BIG)
+            softmax_block_update(s, v, m_scr, l_scr, acc_scr)
+
+
+def _prefill_kernel(tab_ref, start_ref, q_ref, kc_ref, vc_ref, kp_ref,
+                    vp_ref, o_ref, m_scr, l_scr, acc_scr, *, scale,
+                    s_chunk, block_q, block_kc, bs, m, cast_dtype):
+    """bf16/float pool variant: attention only (the float chunk write is
+    a single cheap XLA scatter the caller keeps)."""
+    b_ = pl.program_id(0)
+    qi = pl.program_id(2)
+    t = pl.program_id(3)
+    start = start_ref[b_]
+
+    @pl.when(t == 0)
+    def _init():
+        scratch_init(m_scr, l_scr, acc_scr)
+
+    # Prefix pool block: masked to [0, start) and skipped entirely once
+    # the block starts at/past the row's prefix depth.
+    @pl.when((t < m) & (t * bs < start))
+    def _prefix():
+        block_step(q_ref[0, 0], kp_ref[0, 0], vp_ref[0, 0], start, t,
+                   m_scr, l_scr, acc_scr, scale=scale, block_k=bs)
+
+    @pl.when(t == m)
+    def _chunk():
+        _chunk_self_attention(qi, q_ref, kc_ref, vc_ref, m_scr, l_scr,
+                              acc_scr, scale=scale, block_q=block_q,
+                              block_kc=block_kc, s_chunk=s_chunk,
+                              cast_dtype=cast_dtype)
+        softmax_finalize(o_ref, m_scr, l_scr, acc_scr)
+
+
+def _quant_merge_write(wpos, start, s_chunk, old_deq, stage, ci,
+                       pool_out, scale_out, bs):
+    """Merge one touched block (old prefix / fresh chunk / stale-zero),
+    requantize with a fresh absmax scale — ``ops.quant.quantize_kv_block``
+    verbatim — and write block + scale. Returns the max-abs dequant
+    error over the written span (``serve.kv.quant_error``'s sample)."""
+    fresh = pl.load(stage, (pl.dslice(ci, bs), slice(None)))
+    merged = jnp.where(wpos < start, old_deq, fresh)         # [bs, d]
+    merged = jnp.nan_to_num(merged, nan=0.0, posinf=SATURATE_MAX,
+                            neginf=-SATURATE_MAX)
+    amax = jnp.max(jnp.abs(merged))
+    sc = jnp.where(amax > 0, amax / QMAX, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(merged / sc), -QMAX, QMAX)
+    pool_out[0, 0] = q.astype(pool_out.dtype)
+    scale_out[0, 0] = sc
+    err = jnp.abs(merged - q * sc)
+    return jnp.max(jnp.where(wpos < start + s_chunk, err, 0.0))
+
+
+def _quant_prefill_kernel(tab_ref, start_ref, q_ref, kc_ref, vc_ref,
+                          kp_ref, vp_ref, ks_ref, vs_ref, o_ref,
+                          kp_out, vp_out, ks_out, vs_out, qerr_ref,
+                          m_scr, l_scr, acc_scr, k_stage, v_stage,
+                          qerr_scr, *, scale, s_chunk, block_q,
+                          block_kc, bs, m):
+    """Int8 pool variant: prefix blocks dequantize in the block loop
+    (the decode kernel's expression — kernel and XLA fallback see
+    identical tiles) and the chunk write fuses into the epilogue."""
+    b_ = pl.program_id(0)
+    qi = pl.program_id(2)
+    t = pl.program_id(3)
+    nq = pl.num_programs(2)
+    start = start_ref[b_]
+    last_q = qi == nq - 1
+
+    @pl.when(t == 0)
+    def _init():
+        scratch_init(m_scr, l_scr, acc_scr)
+
+    @pl.when((qi == 0) & (t == 0))
+    def _err_init():
+        qerr_scr[:] = jnp.zeros_like(qerr_scr)
+
+    @pl.when(last_q & (t == 0))
+    def _stage():
+        # The chunk staged fp32 into a zero-padded buffer: touched
+        # blocks slice their rows at a traced offset, and rows past the
+        # chunk end read the stale-position zeros for free.
+        k_stage[:] = jnp.zeros_like(k_stage)
+        v_stage[:] = jnp.zeros_like(v_stage)
+        k_stage[bs:bs + s_chunk, :] = kc_ref[0, 0].astype(jnp.float32)
+        v_stage[bs:bs + s_chunk, :] = vc_ref[0, 0].astype(jnp.float32)
+
+    @pl.when((t < m) & (t * bs < start))
+    def _prefix():
+        q = q_ref[0, 0]
+        # THE dequant both attention paths share (see
+        # ops/quant.dequantize_kv_block).
+        k = (kp_ref[0, 0].astype(jnp.float32)
+             * ks_ref[0, 0]).astype(q.dtype)
+        v = (vp_ref[0, 0].astype(jnp.float32)
+             * vs_ref[0, 0]).astype(q.dtype)
+        block_step(q, k, v, start, t, m_scr, l_scr, acc_scr,
+                   scale=scale, block_k=bs)
+
+    wb0 = start // bs
+    wb1 = (start + s_chunk - 1) // bs
+    writing = last_q & (t < m) & (t >= wb0) & (t <= wb1)
+
+    @pl.when(writing)
+    def _write():
+        wpos = t * bs + lax.broadcasted_iota(jnp.int32, (bs, 1), 0)
+        ci = t * bs - start + bs                 # stage offset, >= 0
+        old_k = kp_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0]
+        old_v = vp_ref[0, 0].astype(jnp.float32) * vs_ref[0, 0]
+        ek = _quant_merge_write(wpos, start, s_chunk, old_k, k_stage,
+                                ci, kp_out, ks_out, bs)
+        ev = _quant_merge_write(wpos, start, s_chunk, old_v, v_stage,
+                                ci, vp_out, vs_out, bs)
+        qerr_scr[:] = jnp.maximum(qerr_scr[:], jnp.maximum(ek, ev))
+
+    @pl.when(~writing)
+    def _scratch_route():
+        # Non-writing steps land on the scratch block (the output index
+        # map routed them there): zero content, unit scale — exactly
+        # what _quant_prefill_write's over-cover rows scatter.
+        kp_out[0, 0] = jnp.zeros_like(kp_out[0, 0])
+        vp_out[0, 0] = jnp.zeros_like(vp_out[0, 0])
+        ks_out[0, 0] = jnp.float32(1.0)
+        vs_out[0, 0] = jnp.float32(1.0)
+
+    @pl.when(t == m)
+    def _chunk():
+        _chunk_self_attention(qi, q_ref, kc_ref, vc_ref, m_scr, l_scr,
+                              acc_scr, scale=scale, block_q=block_q,
+                              block_kc=block_kc, s_chunk=s_chunk,
+                              cast_dtype=None)
+        softmax_finalize(o_ref, m_scr, l_scr, acc_scr)
+        # The qerr output's index never moves within (b, h): the last
+        # write before the flush — the final q sweep's — wins.
+        qerr_ref[0, 0] = qerr_scr[0, 0]
+
+
+def _prefill_call(q, k_chunk, v_chunk, k_pool, v_pool, block_tables,
+                  starts, scale, interpret, block_scales=None):
+    b, h, s_chunk, d = q.shape
+    bs = k_pool.shape[2]
+    m = block_tables.shape[1]
+    nq_block = pick_block(s_chunk, _Q_TILE_TARGET)
+    nkc_block = pick_block(s_chunk, _KC_TILE_TARGET)
+    nq = s_chunk // nq_block
+    quant = block_scales is not None
+
+    tab = jnp.asarray(block_tables, jnp.int32)
+    starts32 = jnp.asarray(starts, jnp.int32)
+
+    def _gather_idx(b_, h_, qi, t, tab, starts):
+        return (tab[b_, jnp.minimum(t, m - 1)], h_, 0, 0)
+
+    def _gather_scale_idx(b_, h_, qi, t, tab, starts):
+        return (tab[b_, jnp.minimum(t, m - 1)], h_)
+
+    def _write_blk(b_, qi, t, tab, starts):
+        start = starts[b_]
+        wb0 = start // bs
+        wb1 = (start + s_chunk - 1) // bs
+        touched = ((qi == nq - 1) & (t < m) & (t >= wb0) & (t <= wb1))
+        return jnp.where(touched, tab[b_, jnp.minimum(t, m - 1)], 0)
+
+    q_spec = pl.BlockSpec((1, 1, nq_block, d),
+                          lambda b_, h_, qi, t, tab, starts:
+                          (b_, h_, qi, 0))
+    chunk_spec = pl.BlockSpec((1, 1, s_chunk, d),
+                              lambda b_, h_, qi, t, tab, starts:
+                              (b_, h_, 0, 0))
+    pool_spec = pl.BlockSpec((1, 1, bs, d), _gather_idx)
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary",
+                                 "arbitrary"))
+    scratch = [pltpu.VMEM((nq_block, LANES), jnp.float32),
+               pltpu.VMEM((nq_block, LANES), jnp.float32),
+               pltpu.VMEM((nq_block, d), jnp.float32)]
+    grid = (b, h, nq, m + 1)
+
+    if not quant:
+        kernel = functools.partial(
+            _prefill_kernel, scale=scale, s_chunk=s_chunk,
+            block_q=nq_block, block_kc=nkc_block, bs=bs, m=m,
+            cast_dtype=k_pool.dtype)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[q_spec, chunk_spec, chunk_spec, pool_spec,
+                      pool_spec],
+            out_specs=q_spec,
+            scratch_shapes=scratch,
+        )
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            interpret=interpret,
+            **kwargs,
+        )(tab, starts32, q, k_chunk, v_chunk, k_pool, v_pool)
+
+    ks, vs = block_scales
+    kernel = functools.partial(
+        _quant_prefill_kernel, scale=scale, s_chunk=s_chunk,
+        block_q=nq_block, block_kc=nkc_block, bs=bs, m=m)
+    scale_spec = pl.BlockSpec((1, 1), _gather_scale_idx)
+    pool_out_spec = pl.BlockSpec(
+        (1, 1, bs, d),
+        lambda b_, h_, qi, t, tab, starts:
+        (_write_blk(b_, qi, t, tab, starts), h_, 0, 0))
+    scale_out_spec = pl.BlockSpec(
+        (1, 1),
+        lambda b_, h_, qi, t, tab, starts:
+        (_write_blk(b_, qi, t, tab, starts), h_))
+    qerr_spec = pl.BlockSpec((1, 1),
+                             lambda b_, h_, qi, t, tab, starts: (b_, h_))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[q_spec, chunk_spec, chunk_spec, pool_spec, pool_spec,
+                  scale_spec, scale_spec],
+        out_specs=[q_spec, pool_out_spec, pool_out_spec,
+                   scale_out_spec, scale_out_spec, qerr_spec],
+        scratch_shapes=scratch + [
+            pltpu.VMEM((s_chunk + 2 * bs, d), jnp.float32),
+            pltpu.VMEM((s_chunk + 2 * bs, d), jnp.float32),
+            pltpu.VMEM((1, LANES), jnp.float32)],
+    )
+    out, kp_new, vp_new, ks_new, vs_new, qerr = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+            jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
+            jax.ShapeDtypeStruct(ks.shape, jnp.float32),
+            jax.ShapeDtypeStruct(vs.shape, jnp.float32),
+            jax.ShapeDtypeStruct((b, h), jnp.float32),
+        ],
+        # Operand order: tab(0) starts(1) q(2) kc(3) vc(4) kp(5) vp(6)
+        # ks(7) vs(8) — the pools and scales alias their outputs so the
+        # fused write is in place (untouched blocks keep their data).
+        input_output_aliases={5: 1, 6: 2, 7: 3, 8: 4},
+        interpret=interpret,
+        **kwargs,
+    )(tab, starts32, q, k_chunk, v_chunk, k_pool, v_pool,
+      jnp.asarray(ks, jnp.float32), jnp.asarray(vs, jnp.float32))
+    return out, kp_new, vp_new, ks_new, vs_new, jnp.max(qerr)
+
+
+def flash_prefill_attention(q, k_chunk, v_chunk, k_pool, v_pool,
+                            block_tables, starts,
+                            scale: Optional[float] = None,
+                            interpret: Optional[bool] = None,
+                            block_scales=None):
+    """Paged prefill-chunk attention (+ fused int8 write).
+
+    ``q``/``k_chunk``/``v_chunk`` ``[B, H, S, D]`` are the fresh
+    chunk's projections; ``k_pool``/``v_pool`` ``[N, H, bs, D]`` the
+    row's KV block pools reached through ``block_tables [B, M]`` int32;
+    ``starts [B]`` int32 is each row's chunk offset (query ``i`` sits
+    at absolute position ``starts[b] + i`` and attends the cached
+    prefix ``[0, starts[b])`` plus the chunk causally).
+
+    Float pools -> ``out [B, H, S, D]``: attention only — the caller
+    keeps its one-scatter chunk write (the fresh tiles are routed
+    through the pool dtype in-kernel, so the output matches the
+    composed gather-after-write path bit-for-bit in what it attends).
+
+    Int8 pools (``block_scales=(k_scales, v_scales)`` ``[N, H]`` fp32)
+    -> ``(out, k_pool', v_pool', k_scales', v_scales', qerr)``: the
+    chunk write is FUSED — touched blocks are merged (old prefix below
+    ``start``, chunk values, stale positions zeroed), requantized with
+    fresh per-(block, head) absmax scales (``ops.quant.quantize_kv_block``
+    policy verbatim, sanitize included) and scattered in-kernel through
+    an aliased table-indexed output; ``qerr`` is the scalar max-abs
+    dequant error over the written span. Rows must not share touched
+    blocks (prefix blocks may be shared — they are read-only here).
+
+    ``starts + S`` must fit the table capacity ``M * bs``. One compiled
+    program serves every ``start`` at a given (S, M, bs, D) — the
+    engine's frozen program-count contract.
+    """
+    b, h, s_chunk, d = q.shape
+    if k_chunk.shape != q.shape or v_chunk.shape != q.shape:
+        raise ValueError(
+            f"chunk k/v {k_chunk.shape}/{v_chunk.shape} do not match q "
+            f"{q.shape}")
+    if k_pool.shape != v_pool.shape or k_pool.shape[1] != h \
+            or k_pool.shape[3] != d:
+        raise ValueError(
+            f"paged k/v pools {k_pool.shape}/{v_pool.shape} do not "
+            f"match q {q.shape}")
+    if block_tables.shape[0] != b:
+        raise ValueError(
+            f"block_tables {block_tables.shape} does not match batch "
+            f"{b}")
+    if block_scales is not None:
+        ks, vs = block_scales
+        want = (k_pool.shape[0], h)
+        if tuple(ks.shape) != want or tuple(vs.shape) != want:
+            raise ValueError(
+                f"block_scales {ks.shape}/{vs.shape} must be "
+                f"[num_blocks, H] = {want}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    return _prefill_call(q, k_chunk, v_chunk, k_pool, v_pool,
+                         block_tables, starts, scale, interpret,
+                         block_scales=block_scales)
+
+
+def flash_prefill_attention_sharded(q, k_chunk, v_chunk, k_pool, v_pool,
+                                    block_tables, starts, mesh, *,
+                                    scale: Optional[float] = None,
+                                    block_scales=None,
+                                    interpret: Optional[bool] = None):
+    """:func:`flash_prefill_attention` PER SHARD under a nested
+    ``shard_map`` over the mesh's ``tp`` (head) axis — the sharded
+    serve engine's prefill path, same idiom as
+    ``flash_decode_attention_sharded``: heads are embarrassingly
+    parallel (each head's online softmax and each head's block write
+    touch only its own H slice), so q/chunks/pools/scales shard on H
+    while the block table and per-row starts REPLICATE (block
+    identities are mesh-invariant host bookkeeping). ``scale`` defaults
+    per shard to ``1/sqrt(D)`` — D is untouched by head sharding."""
+    from jax.sharding import PartitionSpec as P
+
+    from nezha_tpu.parallel._compat import shard_map
+
+    hspec = P(None, "tp")
+    rep = P()
+
+    if block_scales is not None:
+        ks, vs = block_scales
+
+        def body_q(q_, kc_, vc_, kp_, vp_, t_, st_, ks_, vs_):
+            out, kp_n, vp_n, ks_n, vs_n, qerr = flash_prefill_attention(
+                q_, kc_, vc_, kp_, vp_, t_, st_, scale=scale,
+                interpret=interpret, block_scales=(ks_, vs_))
+            # Each shard's qerr covers only its own heads; the scalar
+            # the engine observes is the max across the head axis.
+            return out, kp_n, vp_n, ks_n, vs_n, lax.pmax(qerr, "tp")
+
+        f = shard_map(body_q, mesh=mesh,
+                      in_specs=(hspec, hspec, hspec, hspec, hspec, rep,
+                                rep, hspec, hspec),
+                      out_specs=(hspec, hspec, hspec, hspec, hspec,
+                                 rep))
+        out, kp_new, vp_new, ks_new, vs_new, qerr = f(
+            q, k_chunk, v_chunk, k_pool, v_pool, block_tables, starts,
+            ks, vs)
+        return out, kp_new, vp_new, ks_new, vs_new, qerr
+
+    def body(q_, kc_, vc_, kp_, vp_, t_, st_):
+        return flash_prefill_attention(
+            q_, kc_, vc_, kp_, vp_, t_, st_, scale=scale,
+            interpret=interpret)
+
+    f = shard_map(body, mesh=mesh,
+                  in_specs=(hspec, hspec, hspec, hspec, hspec, rep,
+                            rep),
+                  out_specs=hspec)
+    return f(q, k_chunk, v_chunk, k_pool, v_pool, block_tables, starts)

@@ -175,6 +175,13 @@ class ServeConfig:
     pad_id: int = 0
     cache_dtype: Any = jnp.bfloat16
     decode_impl: Optional[str] = None
+    # Paged prefill-chunk attention override (None = keep the model
+    # config's prefill_impl): "auto" resolves the flash-prefill kernel
+    # by backend, "kernel" forces it (interpret off-TPU — the parity
+    # path; on int8 pools the block write fuses into the kernel
+    # epilogue), "xla" forces the composed masked path.
+    # NEZHA_NO_PREFILL_KERNEL=1 is the env escape hatch.
+    prefill_impl: Optional[str] = None
     decode_horizon: int = 1
     # KV layout: "paged" (default) is the block-paged pool — per-layer
     # [kv_num_blocks, H, kv_block_size, D] buffers, ref-counted blocks
@@ -306,6 +313,10 @@ class ServeConfig:
             raise ValueError(
                 f"decode_impl must be None, 'auto', 'kernel', or 'xla'; "
                 f"got {self.decode_impl!r}")
+        if self.prefill_impl not in (None, "auto", "kernel", "xla"):
+            raise ValueError(
+                f"prefill_impl must be None, 'auto', 'kernel', or 'xla'; "
+                f"got {self.prefill_impl!r}")
         buckets = tuple(self.prefill_buckets) or default_prefill_buckets(
             self.max_prefill_len)
         if list(buckets) != sorted(set(buckets)):
@@ -381,15 +392,22 @@ class Engine:
             raise ValueError(
                 f"max_len {cfg.max_len} exceeds the model's max_positions "
                 f"{model.cfg.max_positions}")
+        # The decode/prefill attention choices are model-config knobs
+        # (the attention module reads them at trace time); honor the
+        # serving overrides by rebuilding the module tree around a
+        # replaced config — pure structure, the caller's ``variables``
+        # slot straight in.
+        impl_overrides = {}
         if (cfg.decode_impl is not None
                 and cfg.decode_impl != model.cfg.decode_impl):
-            # The decode-attention choice is a model-config knob (the
-            # attention module reads it at trace time); honor the serving
-            # override by rebuilding the module tree around a replaced
-            # config — pure structure, the caller's ``variables`` slot
-            # straight in.
+            impl_overrides["decode_impl"] = cfg.decode_impl
+        if (cfg.prefill_impl is not None
+                and cfg.prefill_impl != getattr(model.cfg, "prefill_impl",
+                                                None)):
+            impl_overrides["prefill_impl"] = cfg.prefill_impl
+        if impl_overrides:
             model = type(model)(
-                dataclasses.replace(model.cfg, decode_impl=cfg.decode_impl),
+                dataclasses.replace(model.cfg, **impl_overrides),
                 policy=model.policy)
         self.model = model
         self.variables = variables
@@ -398,6 +416,23 @@ class Engine:
         self.k_max = min(cfg.k_max, self.vocab)
         self.paged = cfg.kv_layout == "paged"
         self.kv_quant = cfg.kv_dtype == "int8"
+        # Resolve ONCE whether paged prefill chunks dispatch through the
+        # flash-prefill kernel. models.gpt2 re-resolves at trace time
+        # from the same knobs (config + env) — this mirror only drives
+        # telemetry: the pinned ``serve.prefill.kernel_active`` gauge
+        # lets dashboards and `nezha-telemetry` label the prefill line
+        # with the active impl without scraping model config, and it
+        # selects the kernel span / fused-write accounting in
+        # :meth:`prefill`. Guarded: a model without the prefill knobs
+        # (non-GPT2) simply reports the XLA path.
+        try:
+            from nezha_tpu.models.gpt2 import _prefill_flash_ok
+            self.prefill_kernel_active = bool(
+                self.paged and _prefill_flash_ok(model.cfg))
+        except Exception:
+            self.prefill_kernel_active = False
+        obs.gauge("serve.prefill.kernel_active").set(
+            1.0 if self.prefill_kernel_active else 0.0)
         if self.paged:
             self.pool = self._make_paged_pool(
                 model, num_blocks=cfg.kv_num_blocks,
@@ -694,6 +729,11 @@ class Engine:
             self.host_positions[slot] = n
             self.host_budgets[slot] = budget
         obs.counter("serve.prefill.chunks_total").inc(len(chunks))
+        # Re-pin per call, not just at init: benchmark harnesses reset
+        # the registry after warmup, and the impl label must survive
+        # into the measured run's summary.
+        obs.gauge("serve.prefill.kernel_active").set(
+            1.0 if self.prefill_kernel_active else 0.0)
         # Tokens the compiled chunks will actually push through the
         # target model: bucket pads included, a prefix hit's cached
         # span excluded (and a cold fallback's full re-plan included).
@@ -721,7 +761,27 @@ class Engine:
                 state = (self.last_logits, self.positions, self.keys,
                          self.temps, self.top_ks, self.top_ps,
                          self.eos_ids, self.budgets)
-                if self.paged:
+                if self.paged and self.prefill_kernel_active:
+                    # Pinned kernel span: brackets the chunk's DISPATCH
+                    # through the flash-prefill kernel program (async
+                    # under jit — wall time covers Python dispatch plus
+                    # any blocking first-trace compile, the executor's
+                    # usual measurement idiom). On an int8 pool every
+                    # layer fused its K and V block writes into the
+                    # kernel epilogue instead of the gather/requant
+                    # round-trip — count them so the fused-write rate
+                    # is auditable against chunk throughput.
+                    with obs.span("serve.prefill.kernel_s", width=width):
+                        out = self.executor.run(
+                            self._prefill_fns[width], self.variables,
+                            self.pool.caches,
+                            jnp.asarray(self.pool.tables_host),
+                            jnp.asarray(padded), *scalars, *state)
+                    if self.kv_quant:
+                        obs.counter(
+                            "serve.prefill.fused_writes_total").inc(
+                            getattr(self.model.cfg, "num_layers", 1))
+                elif self.paged:
                     out = self.executor.run(
                         self._prefill_fns[width], self.variables,
                         self.pool.caches,

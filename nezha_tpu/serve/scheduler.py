@@ -164,6 +164,13 @@ def register_serve_instruments() -> None:
               "errors", "step_retries"):
         obs.counter(f"serve.{c}_total")
     obs.counter("serve.prefill.chunks_total")
+    # Flash-prefill kernel (PR 18): whether paged prefill chunks go
+    # through the Pallas kernel (gauge re-set by the engine at init)
+    # and the per-layer int8 K/V block writes its epilogue fused in
+    # place of the gather/requant round-trip. Impl-invariant: the XLA
+    # path and bf16 pools report 0s, never omit the names.
+    obs.gauge("serve.prefill.kernel_active")
+    obs.counter("serve.prefill.fused_writes_total")
     # The fault layer's injection count rides in every serving summary
     # (0 when no plan is active) so chaos runs and clean runs share one
     # schema — dashboards can divide errors by injections.

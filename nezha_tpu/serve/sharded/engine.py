@@ -127,6 +127,15 @@ class ShardedEngine(Engine):
         if self.spec is not None:
             self.residual = jax.device_put(self.residual, self._rep_out)
         obs.gauge("serve.mesh.devices").set(m)
+        # The base engine resolved prefill-kernel activeness for the
+        # raw-Mosaic path; under the partitioner the kernel runs as a
+        # nested shard_map instead, so the nested-kernel escape hatch
+        # ALSO kills it here — re-pin the gauge when it does.
+        import os
+        if self.prefill_kernel_active \
+                and os.environ.get("NEZHA_NO_NESTED_KERNELS"):
+            self.prefill_kernel_active = False
+            obs.gauge("serve.prefill.kernel_active").set(0.0)
         # Trace-shape estimate of the cross-shard collective payload
         # per TOKEN through the target model: the SPMD partitioner
         # inserts one activation reduce after each row-parallel proj

@@ -332,7 +332,9 @@ def test_serving_smoke_program_count_and_artifacts(model_and_vars,
     report = render_report(run_dir)
     assert "serving:" in report and "ttft" in report and "tpot" in report
     assert "6 admitted" in report
-    assert "prefill: 7 chunk(s)" in report  # bucket-occupancy line
+    # Bucket-occupancy line, labeled with the active prefill impl
+    # (CPU auto resolves to the composed XLA path).
+    assert "prefill[xla]: 7 chunk(s)" in report
 
     # Every batched decode step is labeled with its own span.
     with open(os.path.join(run_dir, "spans.jsonl")) as f:
