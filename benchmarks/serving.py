@@ -168,6 +168,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "serving, and the error/retry counters land in "
                         "the run-dir artifact next to TTFT/TPOT")
     p.add_argument("--queue-capacity", type=int, default=16)
+    p.add_argument("--priority-mix", default=None,
+                   help="multi-tenant storm traffic: 'class=weight,...' "
+                        "over {interactive,batch,background} — each "
+                        "request draws its priority class from this "
+                        "seeded distribution (default: everything "
+                        "interactive, the classic single-lane load). "
+                        "The record gains a per-class TTFT split")
+    p.add_argument("--priority-scheduling", choices=["on", "off"],
+                   default="on",
+                   help="'off' SUBMITS every request in the default "
+                        "lane (exact pre-WFQ FIFO — the overload_storm "
+                        "suite's control) while the record still "
+                        "splits TTFT by each request's DRAWN class "
+                        "from --priority-mix")
+    p.add_argument("--preemption", choices=["on", "off"], default="off",
+                   help="preempt lower-priority live decodes to the "
+                        "trie/host tier when a higher-priority request "
+                        "cannot get a slot or its blocks")
+    p.add_argument("--preemption-budget", type=int, default=2,
+                   help="max suspensions per request (anti-thrash)")
     p.add_argument("--replicas", type=int, default=1,
                    help="N > 1 drives the multi-replica router "
                         "(supervisor + N in-process replicas, each its "
@@ -245,6 +265,36 @@ def _percentiles(values):
     s = sorted(values)
     return {"p50": percentile_of(s, 50), "p90": percentile_of(s, 90),
             "p99": percentile_of(s, 99)}
+
+
+def _parse_priority_mix(spec: str):
+    """``'class=weight,...'`` -> ``[(class, cumulative_fraction)]``
+    draw table (SystemExit on malformed specs, like every knob)."""
+    from nezha_tpu.serve import PRIORITIES
+    weights = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        cls, eq, w = part.partition("=")
+        cls = cls.strip()
+        try:
+            val = float(w)
+        except ValueError:
+            val = -1.0
+        if not eq or cls not in PRIORITIES or val <= 0:
+            raise SystemExit(
+                f"--priority-mix entries must be 'class=weight' with "
+                f"class in {PRIORITIES} and weight > 0, got {part!r}")
+        weights.append((cls, val))
+    if not weights:
+        raise SystemExit("--priority-mix must name at least one class")
+    total = sum(w for _, w in weights)
+    table, cum = [], 0.0
+    for cls, w in weights:
+        cum += w / total
+        table.append((cls, cum))
+    return table
 
 
 def run(args) -> dict:
@@ -401,6 +451,8 @@ def _run_one(args, model, variables, decode_horizon: int,
         prefix_cache=args.prefix_cache == "on",
         kv_dtype=args.kv_dtype,
         kv_host_blocks=getattr(args, "kv_host_blocks", 0),
+        preemption=getattr(args, "preemption", "off") == "on",
+        preemption_budget=getattr(args, "preemption_budget", 2),
         speculative=spec)
     mesh_m = int(getattr(args, "mesh", 1) or 1)
     if mesh_m > 1:
@@ -480,9 +532,28 @@ def _run_one(args, model, variables, decode_horizon: int,
         if rid in _shared_rids:
             _seeder_submitted["done"] = True
 
+    # Multi-tenant storm traffic (the overload_storm suite): each
+    # request draws its priority class from the seeded --priority-mix
+    # distribution. With --priority-scheduling off the drawn class is
+    # RECORDED (the per-class TTFT split still lands in the record) but
+    # every submit rides the default lane — the exact pre-WFQ bounded
+    # FIFO, the storm suite's head-of-line-blocking control.
+    pri_mix = (_parse_priority_mix(args.priority_mix)
+               if getattr(args, "priority_mix", None) else None)
+    pri_of = {}                        # request_id -> drawn class
+    pri_sched = getattr(args, "priority_scheduling", "on") == "on"
+
+    def _draw_priority(rid: str) -> str:
+        x = rng.random()
+        cls = next((c for c, cum in pri_mix if x < cum),
+                   pri_mix[-1][0])
+        pri_of[rid] = cls
+        return cls if pri_sched else "interactive"
+
     def make_request(i: int) -> Request:
         sampled = rng.random() < args.sample_fraction
         rid = f"bench-{i}"
+        pri = _draw_priority(rid) if pri_mix else "interactive"
         if churn_users:
             u = i % churn_users
             prompt = churn_prefixes[u] + [rng.randrange(vocab),
@@ -493,7 +564,7 @@ def _run_one(args, model, variables, decode_horizon: int,
                            max_new_tokens=args.max_new_tokens,
                            temperature=0.8 if sampled else 0.0,
                            top_k=40 if sampled else None,
-                           seed=i, request_id=rid)
+                           seed=i, request_id=rid, priority=pri)
         if shared_prefix and rng.random() < args.shared_prefix_frac:
             prompt = shared_prefix + [rng.randrange(vocab),
                                       rng.randrange(vocab)]
@@ -513,7 +584,7 @@ def _run_one(args, model, variables, decode_horizon: int,
             max_new_tokens=args.max_new_tokens,
             temperature=0.8 if sampled else 0.0,
             top_k=40 if sampled else None,
-            seed=i, request_id=rid)
+            seed=i, request_id=rid, priority=pri)
 
     # Warm EVERY program off the clock — serving steady state never pays
     # trace+compile, and neither should the measurement: one request per
@@ -636,7 +707,11 @@ def _run_one(args, model, variables, decode_horizon: int,
                     issued += 1
                 sched.step()
                 _track_peaks()
-                finished = issued - sched.queue_depth - len(sched._live)
+                # Preempted requests hold no slot and no queue spot but
+                # are NOT finished — without this term a preemption-on
+                # closed loop would overfeed the queue.
+                finished = (issued - sched.queue_depth
+                            - len(sched._live) - sched.preempted_count)
         else:
             # Poisson arrivals: exponential inter-arrival gaps at --rate.
             # Arrivals hitting a full queue are DROPPED (open-loop clients
@@ -662,7 +737,8 @@ def _run_one(args, model, variables, decode_horizon: int,
                     _track_peaks()
                 else:
                     time.sleep(0.001)
-                finished = issued - sched.queue_depth - len(sched._live)
+                finished = (issued - sched.queue_depth
+                            - len(sched._live) - sched.preempted_count)
     finally:
         faults.install(prev_plan)
         if scrape_stop is not None:
@@ -799,6 +875,33 @@ def _run_one(args, model, variables, decode_horizon: int,
             "accept_rate": accepted / drafted if drafted else 0.0,
             "tokens_per_verify": ((accepted + verifies) / verifies
                                   if verifies else 0.0),
+        }
+    if pri_mix:
+        # TTFT split by DRAWN class over clean finishes — with
+        # --priority-scheduling off this shows what FIFO head-of-line
+        # blocking costs each class; with it on (+ preemption) it is
+        # the overload_storm suite's gated record. Preempt/resume
+        # ledgers ride along (always 0 when --preemption off).
+        by_class = {}
+        for cls in ("interactive", "batch", "background"):
+            rs = [r for r in clean if pri_of.get(r.request_id) == cls]
+            ts = [r.ttft_s for r in rs if r.ttft_s is not None]
+            by_class[cls] = {
+                "drawn": sum(1 for p in pri_of.values() if p == cls),
+                "finished": len(rs),
+                "tokens": sum(len(r.tokens) for r in rs),
+                "ttft_s": _percentiles(ts or [0.0]),
+                "latency_s": _percentiles(
+                    [r.latency_s for r in rs] or [0.0]),
+            }
+        record["priorities"] = {
+            "mix": args.priority_mix,
+            "priority_scheduling": pri_sched,
+            "preemption": getattr(args, "preemption", "off") == "on",
+            "preemption_budget": getattr(args, "preemption_budget", 2),
+            "preemptions": sched.preemptions,
+            "resumes": sched.resumes,
+            "by_class": by_class,
         }
     if churn_users:
         # TTFT by first visit vs revisit over clean finishes: a first

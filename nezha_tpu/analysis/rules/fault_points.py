@@ -71,6 +71,16 @@ EXPECTED_POINTS = frozenset({
     # and the sharded engine REFUSES TO START rather than serving
     # garbage weights.
     "serve.reshard",
+    # Multi-tenant scheduling (PR 19). scheduler.preempt: armed before
+    # every preemption — an injected error is the failed-demotion
+    # drill, the scheduler lets the victim keep decoding and the
+    # target waits for ordinary retirement (typed degradation, never a
+    # client-visible error). supervisor.scale: armed at every elastic
+    # autoscale decision — an injected error skips that scale action;
+    # pressure re-evaluates next tick and the fleet holds its size (a
+    # failed SPAWN afterwards still counts against the PR 6 circuit
+    # breaker via supervisor.spawn).
+    "scheduler.preempt", "supervisor.scale",
 })
 SOURCE_PREFIX = "nezha_tpu/"
 EXCLUDE_PREFIX = "nezha_tpu/faults/"

@@ -100,7 +100,17 @@ _SERVE_COUNTERS = {"serve.admitted_total", "serve.rejected_total",
                    # block writes fused into the kernel epilogue
                    # instead of the gather/requant round-trip. 0 on
                    # the XLA prefill path or a non-int8 pool.
-                   "serve.prefill.fused_writes_total"}
+                   "serve.prefill.fused_writes_total",
+                   # Multi-tenant scheduling (PR 19): decodes suspended
+                   # to the trie/host tier for a higher-priority
+                   # admission, suspends re-admitted, and per-tenant
+                   # typed queue-cap sheds (also counted into
+                   # rejected_total — that counter stays the ALL-sheds
+                   # ledger). Knob-invariant: preemption-off runs
+                   # report 0s, never omit them.
+                   "serve.preemptions_total",
+                   "serve.resumes_total",
+                   "serve.tenant_over_limit_total"}
 _SERVE_GAUGES = {"serve.queue_depth", "serve.batch_occupancy",
                  "serve.kv.blocks_used",
                  # KV quantization (PR 9): device bytes the resident KV
@@ -120,7 +130,10 @@ _SERVE_GAUGES = {"serve.queue_depth", "serve.batch_occupancy",
                  # chunks dispatch through the Pallas kernel, 0 on the
                  # composed XLA path — dashboards label the prefill
                  # line with the active impl from this alone.
-                 "serve.prefill.kernel_active"}
+                 "serve.prefill.kernel_active",
+                 # Multi-tenant scheduling (PR 19): requests currently
+                 # suspended awaiting resume (0 with preemption off).
+                 "serve.preempted_live"}
 _SERVE_HISTOGRAMS = {"serve.ttft_s", "serve.tpot_s",
                      "serve.prefill.bucket_len",
                      # Decode-horizon instruments (PR 5): host time
@@ -134,7 +147,14 @@ _SERVE_HISTOGRAMS = {"serve.ttft_s", "serve.tpot_s",
                      # length per verify window, in DRAFT tokens
                      # (tokens-per-verify = value + 1; count 0 on
                      # non-speculative runs).
-                     "serve.spec.accepted_len"}
+                     "serve.spec.accepted_len",
+                     # Multi-tenant scheduling (PR 19): the per-
+                     # priority-class TTFT split (every first token
+                     # lands in serve.ttft_s AND its class's
+                     # histogram) — the view that shows interactive
+                     # latency holding while batch absorbs preemption.
+                     "serve.ttft_s.interactive", "serve.ttft_s.batch",
+                     "serve.ttft_s.background"}
 
 # Router-run schema (nezha-serve --replicas N / benchmarks/serving.py
 # --replicas): the supervisor/router pair pre-registers this full set,
@@ -152,7 +172,12 @@ _ROUTER_COUNTERS = {"router.retries_total", "router.failovers_total",
                     # pick (coverage win or cold consistent-hash
                     # placement). 0 with affinity routing off.
                     "router.affinity_wins_total"}
-_ROUTER_GAUGES = {"router.replicas_live"}
+_ROUTER_GAUGES = {"router.replicas_live",
+                  # Elastic autoscale (PR 19): the replica count the
+                  # supervisor's control loop is steering toward
+                  # (equal to the configured size when autoscale is
+                  # off).
+                  "router.autoscale_target"}
 _ROUTER_HISTOGRAMS = {"router.route_s",
                       # The queueing-delay split of the disaggregated
                       # pipeline: time to the parked prefill answer vs
@@ -225,6 +250,11 @@ _PINNED_SPANS = {
     # through the Pallas prefill program (attrs carry the bucket
     # width). Absent entirely on the XLA prefill path.
     "serve.prefill.kernel_s",
+    # Multi-tenant scheduling (PR 19): brackets one preemption — trie
+    # indexing of the victim's bound blocks through slot release
+    # (attrs carry the victim's request_id, priority, and emitted
+    # token count). Absent entirely with preemption off.
+    "serve.preempt_s",
 }
 
 # Namespaces whose METRIC names (counter/gauge/histogram) the source

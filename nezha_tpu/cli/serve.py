@@ -196,6 +196,41 @@ def build_parser() -> argparse.ArgumentParser:
                         "to it")
     p.add_argument("--queue-capacity", type=int, default=16,
                    help="admission queue bound (backpressure past it)")
+    p.add_argument("--priority-weights", default=None, metavar="SPEC",
+                   help="WFQ admission-grant weights per priority lane "
+                        "as 'interactive=4,batch=2,background=1' (the "
+                        "default split): per 7 grants under full "
+                        "backlog, 4 go interactive, 2 batch, 1 "
+                        "background — lower lanes slow, never starve. "
+                        "All three classes required, integer weights "
+                        ">= 1")
+    p.add_argument("--tenant-queue-cap", type=int, default=None,
+                   help="max queued requests any ONE tenant may hold; "
+                        "past it the tenant gets a typed "
+                        "tenant_over_limit 503 while others keep "
+                        "admitting (default: no per-tenant cap — only "
+                        "the global --queue-capacity)")
+    p.add_argument("--preemption", choices=["on", "off"], default="off",
+                   help="under slot/block pressure (or a burning "
+                        "interactive --slo), SUSPEND the lowest-"
+                        "priority running decode — its KV blocks move "
+                        "to the prefix trie (LRU-evictable, host-tier "
+                        "demotable) — and resume it bit-identically "
+                        "when pressure clears (docs/RUNBOOK.md §10)")
+    p.add_argument("--preemption-budget", type=int, default=2,
+                   help="times one request may be preempted before it "
+                        "becomes unpreemptable (the anti-thrash bound)")
+    p.add_argument("--autoscale-min", type=int, default=None,
+                   help="with --replicas and --autoscale-max: elastic "
+                        "LOWER bound on the replica count — the "
+                        "supervisor rolling-drains one replica at a "
+                        "time down to it when the fleet goes idle")
+    p.add_argument("--autoscale-max", type=int, default=None,
+                   help="with --replicas and --autoscale-min: elastic "
+                        "UPPER bound — the supervisor spawns one "
+                        "replica at a time up to it under sustained "
+                        "queue/prefill-wait pressure (hysteresis: "
+                        "sustained signal + cooldown between actions)")
     p.add_argument("--max-new-tokens", type=int, default=32,
                    help="default for requests that don't set "
                         "max_new_tokens, and the cap for those that do")
@@ -429,7 +464,12 @@ def _build_stack(args):
         kv_eviction=args.kv_eviction,
         kv_dtype=args.kv_dtype,
         kv_host_blocks=args.kv_host_blocks,
-        speculative=spec)
+        speculative=spec,
+        priority_weights=_parse_priority_weights(
+            getattr(args, "priority_weights", None)),
+        tenant_queue_cap=getattr(args, "tenant_queue_cap", None),
+        preemption=getattr(args, "preemption", "off") == "on",
+        preemption_budget=getattr(args, "preemption_budget", 2))
     if mesh_m > 1:
         from nezha_tpu.serve.sharded import ShardedEngine
         try:
@@ -445,7 +485,40 @@ def _build_stack(args):
     else:
         engine = Engine(model, variables, cfg, draft_model=draft_model,
                         draft_variables=draft_variables)
-    return Scheduler(engine), tokenizer, eos_id
+    scheduler = Scheduler(engine)
+    if getattr(args, "slo", None):
+        # The first serve.ttft_s SLO spec doubles as the scheduler's
+        # preemption control signal (PR 16 -> PR 19): its burn rate,
+        # fed per interactive first token, widens the preemption quota
+        # while the error budget is burning. The watchdog keeps its
+        # own independent trackers.
+        from nezha_tpu import obs
+        for slo_cfg in obs.parse_slo_args(args.slo):
+            if slo_cfg.metric == "serve.ttft_s":
+                scheduler.slo_tracker = obs.SLOTracker(slo_cfg)
+                break
+    return scheduler, tokenizer, eos_id
+
+
+def _parse_priority_weights(spec):
+    """'interactive=4,batch=2,background=1' -> dict (None passes
+    through — ServeConfig then applies the default split)."""
+    if spec is None:
+        return None
+    out = {}
+    for part in str(spec).split(","):
+        name, eq, val = part.partition("=")
+        try:
+            out[name.strip()] = int(val)
+        except ValueError:
+            raise SystemExit(
+                f"--priority-weights must be 'class=int,...' pairs, "
+                f"got {part!r}")
+        if not eq:
+            raise SystemExit(
+                f"--priority-weights must be 'class=int,...' pairs, "
+                f"got {part!r}")
+    return out
 
 
 def _parse_request(obj: dict, args, tokenizer, eos_id, vocab: int):
@@ -488,7 +561,20 @@ def _parse_request(obj: dict, args, tokenizer, eos_id, vocab: int):
     trace_id = obj.get("trace_id")
     if trace_id is not None and not isinstance(trace_id, str):
         raise ValueError(f"trace_id must be a string, got {trace_id!r}")
+    # Multi-tenant scheduling fields (PR 19). Defaults reproduce the
+    # pre-priority wire bit for bit: every request lands in the
+    # interactive lane of the "default" tenant, where WFQ degenerates
+    # to the classic bounded FIFO. Value validation (known class,
+    # non-empty tenant) is submit()'s — it owns the typed 400.
+    priority = obj.get("priority", "interactive")
+    if not isinstance(priority, str):
+        raise ValueError(f"priority must be a string, got {priority!r}")
+    tenant_id = obj.get("tenant_id", "default")
+    if not isinstance(tenant_id, str):
+        raise ValueError(
+            f"tenant_id must be a string, got {tenant_id!r}")
     return Request(
+        priority=priority, tenant_id=tenant_id,
         prompt=prompt, max_new_tokens=max_new,
         temperature=num("temperature", float, 0.0),
         top_k=num("top_k", int), top_p=num("top_p", float),
@@ -682,7 +768,7 @@ def run_http(scheduler, args, tokenizer, eos_id, port: int,
     within --drain-timeout, then shuts the server down."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-    from nezha_tpu.serve import QueueFull
+    from nezha_tpu.serve import QueueFull, TenantOverLimit
 
     drain = drain if drain is not None else threading.Event()
     vocab = scheduler.engine.vocab
@@ -745,6 +831,7 @@ def run_http(scheduler, args, tokenizer, eos_id, port: int,
                 from nezha_tpu import obs
                 payload = obs.stats_snapshot()
                 payload["role"] = getattr(args, "role", "both")
+                payload["tenants"] = scheduler.tenant_queue_depths()
                 return self._send(200, payload)
             if self.path == "/windows":
                 # Mergeable rolled-up window views (the router's fleet
@@ -783,6 +870,11 @@ def run_http(scheduler, args, tokenizer, eos_id, port: int,
                 "occupancy": pool.occupancy,
                 "role": getattr(args, "role", "both"),
                 "parked": scheduler.parked_count,
+                # Per-tenant queue depths + suspended count (PR 19):
+                # the router's autoscale signal reads "queued"; these
+                # give operators the fairness view behind it.
+                "tenants": scheduler.tenant_queue_depths(),
+                "preempted": scheduler.preempted_count,
                 # Host spill tier occupancy (0/0 when --kv-host-blocks
                 # is off or the layout is dense): what the router's
                 # replica table and operators size the tier against.
@@ -875,7 +967,15 @@ def run_http(scheduler, args, tokenizer, eos_id, port: int,
             except QueueFull as e:
                 with events_lock:
                     events.pop(rid, None)
-                return self._send(503, {"error": str(e)})
+                # Typed like every other client-visible failure: the
+                # router sweeps past ANY replica 503, but a direct
+                # client must be able to tell "this tenant is over ITS
+                # cap" from "the whole queue is full".
+                return self._send(503, {
+                    "error": str(e),
+                    "error_type": ("tenant_over_limit"
+                                   if isinstance(e, TenantOverLimit)
+                                   else "queue_full")})
             except ValueError as e:
                 with events_lock:
                     events.pop(rid, None)
@@ -1130,6 +1230,12 @@ def _worker_argv(args, rid: int, port: int, role: Optional[str] = None
              "--prefix-cache", args.prefix_cache,
              "--kv-eviction", args.kv_eviction,
              "--kv-host-blocks", str(args.kv_host_blocks),
+             # Multi-tenant scheduling knobs (PR 19) ride into every
+             # worker: admission, WFQ, and preemption are replica-side
+             # (the router only routes; autoscale stays router-side).
+             "--preemption", getattr(args, "preemption", "off"),
+             "--preemption-budget",
+             str(getattr(args, "preemption_budget", 2)),
              # Digest knobs ride into every worker: the /healthz
              # digest payload is built replica-side (PR 17).
              "--digest-interval",
@@ -1150,6 +1256,10 @@ def _worker_argv(args, rid: int, port: int, role: Optional[str] = None
         argv += ["--slo", str(spec)]
     if args.kv_num_blocks is not None:
         argv += ["--kv-num-blocks", str(args.kv_num_blocks)]
+    if getattr(args, "priority_weights", None):
+        argv += ["--priority-weights", str(args.priority_weights)]
+    if getattr(args, "tenant_queue_cap", None) is not None:
+        argv += ["--tenant-queue-cap", str(args.tenant_queue_cap)]
     if getattr(args, "speculative", False):
         # Speculation rides into every worker: the router is
         # draft-blind (accept/verify is engine-internal).
@@ -1235,7 +1345,9 @@ def run_multi(args, ready_cb=None, drain_event=None) -> int:
         seed=args.seed,
         affinity_routing=(affinity == "on"),
         digest_interval_s=getattr(args, "digest_interval", 2.0),
-        digest_max_entries=getattr(args, "digest_max_entries", 256))
+        digest_max_entries=getattr(args, "digest_max_entries", 256),
+        autoscale_min=getattr(args, "autoscale_min", None),
+        autoscale_max=getattr(args, "autoscale_max", None))
     from nezha_tpu import obs
     try:
         # The router is the trace-minting edge: the sample knob lives
@@ -1306,8 +1418,13 @@ def run_multi(args, ready_cb=None, drain_event=None) -> int:
 def run(args, stdin=None, stdout=None, ready_cb=None,
         drain_event=None) -> int:
     if (getattr(args, "replicas", 1) > 1
+            or getattr(args, "autoscale_min", None) is not None
+            or getattr(args, "autoscale_max", None) is not None
             or getattr(args, "prefill_replicas", 0)
             or getattr(args, "decode_replicas", 0)):
+        # Autoscale bounds force router mode even at --replicas 1: an
+        # elastic fleet that STARTS at one replica still needs the
+        # supervisor/router pair to grow past it.
         return run_multi(args, ready_cb=ready_cb,
                          drain_event=drain_event)
     return run_worker(args, stdin=stdin, stdout=stdout,

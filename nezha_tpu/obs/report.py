@@ -119,6 +119,29 @@ def render_serving_section(summary: Optional[dict]) -> List[str]:
                 f"  {label}: p50 {h['p50'] * 1e3:.1f} ms  "
                 f"p90 {h['p90'] * 1e3:.1f} ms  "
                 f"p99 {h['p99'] * 1e3:.1f} ms  (n={h['count']})")
+    # Per-priority-class TTFT split (PR 19): rendered only for classes
+    # that saw traffic, and only when MORE than one class did — a
+    # single-class run (the default wire) collapses to the line above.
+    split = [(p, hists.get(f"serve.ttft_s.{p}"))
+             for p in ("interactive", "batch", "background")]
+    split = [(p, h) for p, h in split if h and h.get("count")]
+    if len(split) > 1:
+        for p, h in split:
+            lines.append(
+                f"    ttft[{p}]: p50 {h['p50'] * 1e3:.1f} ms  "
+                f"p90 {h['p90'] * 1e3:.1f} ms  "
+                f"p99 {h['p99'] * 1e3:.1f} ms  (n={h['count']})")
+    if counters.get("serve.preemptions_total") or counters.get(
+            "serve.tenant_over_limit_total"):
+        # Multi-tenant scheduling view (PR 19): suspends/resumes and
+        # typed per-tenant sheds — all 0 (line absent) on FIFO runs.
+        lines.append(
+            "  preemption: "
+            f"{counters.get('serve.preemptions_total', 0):.0f} "
+            f"preempted  "
+            f"{counters.get('serve.resumes_total', 0):.0f} resumed  "
+            f"{counters.get('serve.tenant_over_limit_total', 0):.0f} "
+            f"tenant-capped")
     hg = hists.get("serve.host_gap_s")
     if hg and hg.get("count"):
         # The decode-horizon view: host time between consecutive step

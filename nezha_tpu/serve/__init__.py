@@ -101,11 +101,13 @@ from nezha_tpu.serve.migrate import MigrationError
 from nezha_tpu.serve.router import Router, register_router_instruments
 from nezha_tpu.serve.sampling import sample_tokens
 from nezha_tpu.serve.scheduler import (
+    PRIORITIES,
     FinishReason,
     QueueFull,
     Request,
     RequestResult,
     Scheduler,
+    TenantOverLimit,
 )
 from nezha_tpu.serve.slots import (KVBlocksExhausted, PagedSlotPool,
                                    PrefixTrie, SlotPool)
@@ -120,7 +122,8 @@ __all__ = [
     "Engine", "ServeConfig", "SpeculativeConfig", "self_draft",
     "SlotPool", "PagedSlotPool", "PrefixTrie",
     "KVBlocksExhausted", "sample_tokens",
-    "Scheduler", "Request", "RequestResult", "QueueFull", "FinishReason",
+    "Scheduler", "Request", "RequestResult", "QueueFull",
+    "TenantOverLimit", "PRIORITIES", "FinishReason",
     "Router", "RouterConfig", "Supervisor", "ProcessBackend",
     "ThreadBackend", "register_router_instruments", "MigrationError",
 ]

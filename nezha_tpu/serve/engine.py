@@ -236,6 +236,32 @@ class ServeConfig:
     # same paged machinery (int8 welcome); accepted tokens flow into
     # the existing block-consumption path as ordinary emits.
     speculative: Optional[SpeculativeConfig] = None
+    # Multi-tenant scheduling (PR 19). priority_weights selects the
+    # weighted-fair-queueing share of admission grants each priority
+    # lane gets (virtual-time WFQ — lower-priority lanes are SLOWED,
+    # never starved); None keeps the built-in 4:2:1
+    # interactive:batch:background split. Accepts a mapping or a
+    # ("name", weight) pair sequence; normalized to a canonical tuple.
+    # With every request in one lane (the default — Request.priority
+    # defaults to "interactive") WFQ degenerates to the exact bounded
+    # FIFO of the pre-PR-19 scheduler, bit for bit.
+    priority_weights: Optional[Any] = None
+    # Per-tenant admission bound (None = off): a tenant with this many
+    # requests already queued gets the typed TenantOverLimit
+    # (subclass of QueueFull, so HTTP still answers 503) instead of
+    # consuming the shared queue_capacity — one bursty tenant cannot
+    # wedge the door shut for everyone else.
+    tenant_queue_cap: Optional[int] = None
+    # Preemption (off by default — bit-for-bit prior behavior): under
+    # slot/block pressure (or a burning interactive SLO) the scheduler
+    # suspends the lowest-priority running decode, indexes its bound
+    # blocks into the prefix trie (re-promotable; eviction demotes
+    # them through the host tier when one is configured) and resumes
+    # it when pressure clears — admission degrades gracefully instead
+    # of rejecting at the door. preemption_budget bounds how many
+    # times one request may be preempted (anti-thrash).
+    preemption: bool = False
+    preemption_budget: int = 2
 
     def __post_init__(self):
         if self.max_batch_size < 1:
@@ -331,6 +357,33 @@ class ServeConfig:
                 f"prefill_buckets must be >= 1 and end exactly at "
                 f"max_prefill_len={self.max_prefill_len}, got {buckets}")
         object.__setattr__(self, "prefill_buckets", buckets)
+        if self.tenant_queue_cap is not None and self.tenant_queue_cap < 1:
+            raise ValueError(
+                f"tenant_queue_cap must be >= 1 or None, got "
+                f"{self.tenant_queue_cap}")
+        if self.preemption_budget < 0:
+            raise ValueError(
+                f"preemption_budget must be >= 0, got "
+                f"{self.preemption_budget}")
+        if self.priority_weights is not None:
+            pw = self.priority_weights
+            pairs = list(pw.items()) if isinstance(pw, dict) else list(pw)
+            try:
+                norm = {str(name): int(w) for name, w in pairs}
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"priority_weights must map priority names to "
+                    f"integer weights, got {pw!r}")
+            classes = ("interactive", "batch", "background")
+            if set(norm) != set(classes):
+                raise ValueError(
+                    f"priority_weights must name exactly "
+                    f"{classes}, got {sorted(norm)}")
+            if any(w < 1 for w in norm.values()):
+                raise ValueError(
+                    f"priority_weights must all be >= 1, got {norm}")
+            object.__setattr__(self, "priority_weights",
+                               tuple((c, norm[c]) for c in classes))
 
 
 def self_draft(model, variables, num_layers: Optional[int] = None):

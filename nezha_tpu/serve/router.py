@@ -116,6 +116,10 @@ def register_router_instruments() -> None:
     # routing is off.
     obs.counter("router.affinity_wins_total")
     obs.gauge("router.replicas_live")
+    # Elastic autoscale (PR 19): the replica count the supervisor's
+    # control loop steers toward (the configured size when autoscale
+    # is off).
+    obs.gauge("router.autoscale_target")
     obs.histogram("router.route_s")
     # Disaggregated-tier queueing split: time to the PARKED prefill
     # answer (queue wait + prefill at the source) vs the decode

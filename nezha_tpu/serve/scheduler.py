@@ -1,8 +1,11 @@
 """Admission, retirement, and the serving iteration loop.
 
-The scheduler owns everything request-shaped: a BOUNDED FIFO admission
-queue (submit past capacity fails fast — backpressure, not unbounded
-memory), per-request deadlines, and the continuous-batching iteration:
+The scheduler owns everything request-shaped: a bounded admission
+queue served by WEIGHTED FAIR QUEUEING across tenants within priority
+lanes (submit past capacity fails fast — backpressure, not unbounded
+memory; with every request in one lane and one tenant, the default,
+WFQ degenerates to the classic bounded FIFO bit for bit), per-request
+deadlines, and the continuous-batching iteration:
 
     admit waiters into free slots -> decode one BLOCK (up to
     ``decode_horizon`` tokens per row, one compiled dispatch) for all
@@ -78,6 +81,26 @@ class QueueFull(Exception):
     should shed load or retry later (HTTP mode maps this to 503)."""
 
 
+class TenantOverLimit(QueueFull):
+    """One TENANT's queued share hit ``tenant_queue_cap`` — the typed
+    per-tenant backpressure signal (PR 19). A subclass of
+    :class:`QueueFull` so every existing handler still maps it to 503;
+    callers that care about the distinction catch it first. Counted
+    into ``serve.tenant_over_limit_total`` (and, like every shed,
+    ``serve.rejected_total``)."""
+
+
+# Priority classes, highest first — rank 0 outranks rank 2 when the
+# preemption trigger and the WFQ tie-break compare lanes.
+PRIORITIES = ("interactive", "batch", "background")
+_PRIORITY_RANK = {p: i for i, p in enumerate(PRIORITIES)}
+
+# Default WFQ admission-grant split (ServeConfig.priority_weights
+# None): per 7 grants under full backlog, 4 interactive, 2 batch,
+# 1 background — lower lanes are slowed, never starved.
+_DEFAULT_WEIGHTS = (("interactive", 4), ("batch", 2), ("background", 1))
+
+
 class FinishReason:
     EOS = "eos"
     LENGTH = "length"          # max_new_tokens reached
@@ -120,6 +143,12 @@ class Request:
     # already sampled this request OUT: honored as untraced, never
     # re-minted. Untraced requests' lifecycles emit ZERO extra spans.
     trace_id: Optional[str] = None
+    # Multi-tenant scheduling (PR 19): the WFQ lane this request queues
+    # in (one of PRIORITIES; the default keeps every pre-PR-19 caller
+    # in one lane — exact FIFO) and the tenant whose fair share and
+    # queue cap it counts against.
+    priority: str = "interactive"
+    tenant_id: str = "default"
 
 
 @dataclasses.dataclass
@@ -151,6 +180,10 @@ class _Live:
     decode_t0_wall: Optional[float] = None  # prefill done / resume
     first_token_wall: Optional[float] = None
     park_wall: Optional[float] = None       # prefill_only park
+    # Preemption ledger (PR 19): how many times this request has been
+    # suspended mid-decode — capped by ServeConfig.preemption_budget so
+    # one request cannot thrash between slot and host tier forever.
+    preempt_count: int = 0
 
 
 def register_serve_instruments() -> None:
@@ -234,6 +267,19 @@ def register_serve_instruments() -> None:
     obs.gauge("serve.batch_occupancy")
     obs.histogram("serve.ttft_s")
     obs.histogram("serve.tpot_s")
+    # Multi-tenant scheduling (PR 19): preempt/resume lifecycle
+    # counters, the per-tenant cap's typed sheds, the live count of
+    # suspended requests, and the per-priority-class TTFT split (the
+    # registry has no labels, so the split is three pinned names the
+    # report and /metrics render alongside the aggregate). Knob-
+    # invariant: runs with preemption off and one lane report 0s /
+    # empty splits, never omit the names.
+    obs.counter("serve.preemptions_total")
+    obs.counter("serve.resumes_total")
+    obs.counter("serve.tenant_over_limit_total")
+    obs.gauge("serve.preempted_live")
+    for p in PRIORITIES:
+        obs.histogram(f"serve.ttft_s.{p}")
     obs.histogram("serve.prefill.bucket_len")
     # Decode-horizon instruments: the host gap between consecutive step
     # dispatches (what a horizon > 1 amortizes over H tokens) and the
@@ -272,7 +318,11 @@ class Scheduler:
     # runs on HTTP handler threads against the decode loop's step(),
     # and the migration endpoints (export/ack/resume) run on handler
     # threads too.
-    _LOCK_GUARDED = {"_queue": "_lock", "_live": "_lock",
+    _LOCK_GUARDED = {"_lanes": "_lock", "_lane_vt": "_lock",
+                     "_lane_rr": "_lock", "_queued_n": "_lock",
+                     "_vt_now": "_lock", "_preempted": "_lock",
+                     "preemptions": "_lock", "resumes": "_lock",
+                     "_live": "_lock",
                      "results": "_lock", "_host_gap_t": "_lock",
                      "_parked": "_lock", "_digest_cache": "_lock"}
 
@@ -283,7 +333,34 @@ class Scheduler:
         self.on_token = on_token
         self.on_finish = on_finish
         self.queue_capacity = engine.cfg.queue_capacity
-        self._queue: Deque[_Live] = collections.deque()
+        # WFQ admission state (PR 19): priority lane -> tenant -> FIFO
+        # deque, the per-lane virtual-time clock the weighted pick
+        # compares, the per-lane tenant round-robin ring (a tenant is
+        # in its lane dict and ring exactly while its deque is
+        # non-empty), the total queued count, and the virtual time of
+        # the last grant (an idling lane re-enters at this clock so it
+        # can never burst a backlog of unearned credit).
+        self._lanes: Dict[str, Dict[str, Deque[_Live]]] = {}
+        self._lane_vt: Dict[str, float] = {}
+        self._lane_rr: Dict[str, Deque[str]] = {}
+        self._queued_n = 0
+        self._vt_now = 0.0
+        self._weights = dict(engine.cfg.priority_weights
+                             or _DEFAULT_WEIGHTS)
+        # Requests suspended mid-decode by the preemption trigger:
+        # request_id -> _Live (no slot held — their KV sits in the
+        # prefix trie / host tier until resume re-admits them).
+        self._preempted: Dict[str, _Live] = {}
+        # Optional fast-path SLO signal (PR 16's tracker): when set
+        # (cli/serve wires the first interactive serve.ttft_s --slo
+        # spec), _decode feeds it per interactive first token and a
+        # burn rate > 1 lifts the one-preemption-per-admission-pass
+        # quota — assigned once at startup, like on_token/on_finish.
+        self.slo_tracker = None
+        # Plain preemption ledgers (obs counters only count inside a
+        # run; these always do — benchmarks read them directly).
+        self.preemptions = 0
+        self.resumes = 0
         self._live: Dict[int, _Live] = {}          # slot -> request state
         # Parked prefill_only requests awaiting their migration pull
         # (or a local-decode resume): request_id -> (slot, live,
@@ -354,6 +431,14 @@ class Scheduler:
             # have allocated a slot first — instead of bouncing this
             # submit before any resource is held.
             raise ValueError(f"prompt ids must be in [0, {vocab})")
+        if req.priority not in _PRIORITY_RANK:
+            raise ValueError(
+                f"priority must be one of {PRIORITIES}, got "
+                f"{req.priority!r}")
+        if not isinstance(req.tenant_id, str) or not req.tenant_id:
+            raise ValueError(
+                f"tenant_id must be a non-empty string, got "
+                f"{req.tenant_id!r}")
         # Trace adoption: a request arriving with a router-minted trace
         # id keeps it; the empty string means "routed, and the ROUTER's
         # sample knob rolled it out" — the minting edge already
@@ -371,19 +456,29 @@ class Scheduler:
         else:
             trace_id = obs.mint_trace_id()
         with self._lock:
-            if len(self._queue) >= self.queue_capacity:
+            if self._queued_n >= self.queue_capacity:
                 obs.counter("serve.rejected_total").inc()
                 raise QueueFull(
                     f"admission queue at capacity {self.queue_capacity}")
+            cap = cfg.tenant_queue_cap
+            if cap is not None and self._tenant_depth(
+                    req.tenant_id) >= cap:
+                # The per-tenant bound fails typed — one tenant's burst
+                # never reads as a full fleet to everyone else. Still a
+                # shed, so rejected_total keeps meaning ALL sheds.
+                obs.counter("serve.tenant_over_limit_total").inc()
+                obs.counter("serve.rejected_total").inc()
+                raise TenantOverLimit(
+                    f"tenant {req.tenant_id!r} at queue cap {cap}")
             rid = req.request_id or f"req-{next(self._ids)}"
             now = time.monotonic()
-            self._queue.append(_Live(
+            self._queue_push(_Live(
                 req=req, request_id=rid, submit_t=now,
                 deadline_t=None if req.deadline_s is None
                 else now + req.deadline_s,
                 trace_id=trace_id,
                 submit_wall=time.time() if trace_id else None))
-            obs.gauge("serve.queue_depth").set(len(self._queue))
+            obs.gauge("serve.queue_depth").set(self._queued_n)
         return rid
 
     # ------------------------------------------------------- iteration
@@ -393,6 +488,7 @@ class Scheduler:
         with self._lock:
             self._expire_queued()
             self._expire_parked()
+            self._expire_preempted()
             self._admit()
             if self._live:
                 emitted = self._decode()
@@ -400,7 +496,7 @@ class Scheduler:
                 emitted = 0
                 self._host_gap_t = None     # idle: no gap to measure
             self._admit()          # refill slots freed by retirement
-            obs.gauge("serve.queue_depth").set(len(self._queue))
+            obs.gauge("serve.queue_depth").set(self._queued_n)
             obs.gauge("serve.batch_occupancy").set(
                 self.engine.pool.occupancy)
             obs.gauge("serve.kv.blocks_used").set(
@@ -426,7 +522,7 @@ class Scheduler:
 
     def has_work(self) -> bool:
         with self._lock:
-            return bool(self._queue or self._live)
+            return bool(self._queued_n or self._live or self._preempted)
 
     @property
     def parked_count(self) -> int:
@@ -434,27 +530,130 @@ class Scheduler:
             return len(self._parked)
 
     @property
-    def queue_depth(self) -> int:
-        """Current admission-queue length. Pacing clients (the stdio
-        reader, closed-loop benchmarks) should wait for room here
-        instead of hammering submit() — every QueueFull counts into
-        ``serve.rejected_total``, which must mean SHED REQUESTS, not
-        retry polls."""
+    def preempted_count(self) -> int:
         with self._lock:
-            return len(self._queue)
+            return len(self._preempted)
+
+    @property
+    def queue_depth(self) -> int:
+        """Current admission-queue length (all lanes, all tenants).
+        Pacing clients (the stdio reader, closed-loop benchmarks)
+        should wait for room here instead of hammering submit() —
+        every QueueFull counts into ``serve.rejected_total``, which
+        must mean SHED REQUESTS, not retry polls."""
+        with self._lock:
+            return self._queued_n
+
+    def tenant_queue_depths(self) -> Dict[str, int]:
+        """Per-tenant queued counts across every lane — the
+        ``/healthz`` / ``/stats`` view operators size tenant_queue_cap
+        against. Empty when nothing is queued."""
+        with self._lock:
+            out: Dict[str, int] = {}
+            for lane in self._lanes.values():
+                for tenant, dq in lane.items():
+                    out[tenant] = out.get(tenant, 0) + len(dq)
+            return out
+
+    # ----------------------------------------------- WFQ queue plumbing
+    # Invariant: a tenant appears in its lane's dict and round-robin
+    # ring exactly while its deque is non-empty, and a priority key
+    # appears in _lanes/_lane_rr exactly while the lane holds work —
+    # so ring[0] always names a servable tenant. _lane_vt persists
+    # across idleness (clamped forward by _queue_push).
+
+    def _tenant_depth(self, tenant: str) -> int:
+        """[holds: _lock]"""
+        return sum(len(lane[tenant]) for lane in self._lanes.values()
+                   if tenant in lane)
+
+    def _queue_push(self, live: _Live) -> None:
+        """[holds: _lock]"""
+        pri, tenant = live.req.priority, live.req.tenant_id
+        lane = self._lanes.setdefault(pri, {})
+        if not lane:
+            # The lane was idle: re-enter at the current virtual time,
+            # never behind it — an empty lane earns no credit.
+            self._lane_vt[pri] = max(self._lane_vt.get(pri, 0.0),
+                                     self._vt_now)
+        dq = lane.get(tenant)
+        if dq is None:
+            lane[tenant] = dq = collections.deque()
+            self._lane_rr.setdefault(
+                pri, collections.deque()).append(tenant)
+        dq.append(live)
+        self._queued_n += 1
+
+    def _pick_lane(self) -> Optional[str]:
+        """[holds: _lock] The non-empty lane with the smallest virtual
+        time — the weighted-fair pick; PRIORITIES order breaks ties,
+        so interactive wins an exact draw."""
+        best = None
+        for pri in PRIORITIES:
+            if pri not in self._lanes:
+                continue
+            vt = self._lane_vt.get(pri, 0.0)
+            if best is None or vt < best[0]:
+                best = (vt, pri)
+        return None if best is None else best[1]
+
+    def _peek_next(self) -> Optional[_Live]:
+        """[holds: _lock] The request _pop_next would grant next,
+        without granting it (the admission loop's block-budget peek)."""
+        pri = self._pick_lane()
+        if pri is None:
+            return None
+        return self._lanes[pri][self._lane_rr[pri][0]][0]
+
+    def _pop_next(self) -> Optional[_Live]:
+        """[holds: _lock] Grant one admission: pop the WFQ pick,
+        advance its lane's virtual clock by 1/weight, and rotate the
+        lane's tenant ring (equal-share round robin within a lane)."""
+        pri = self._pick_lane()
+        if pri is None:
+            return None
+        ring = self._lane_rr[pri]
+        tenant = ring[0]
+        dq = self._lanes[pri][tenant]
+        live = dq.popleft()
+        self._queued_n -= 1
+        ring.rotate(-1)
+        if not dq:
+            del self._lanes[pri][tenant]
+            ring.remove(tenant)
+            if not self._lanes[pri]:
+                del self._lanes[pri]
+                del self._lane_rr[pri]
+        vt = self._lane_vt.get(pri, 0.0)
+        self._vt_now = max(self._vt_now, vt)
+        self._lane_vt[pri] = vt + 1.0 / self._weights[pri]
+        return live
 
     # -------------------------------------------------------- internals
     def _expire_queued(self) -> None:
         """[holds: _lock] — step() calls this inside the lock."""
         now = time.monotonic()
-        kept: Deque[_Live] = collections.deque()
-        for live in self._queue:
-            if live.deadline_t is not None and now >= live.deadline_t:
-                obs.counter("serve.expired_total").inc()
-                self._finish(live, FinishReason.DEADLINE)
-            else:
-                kept.append(live)
-        self._queue = kept
+        for pri in list(self._lanes):
+            lane = self._lanes[pri]
+            ring = self._lane_rr[pri]
+            for tenant in list(lane):
+                kept: Deque[_Live] = collections.deque()
+                for live in lane[tenant]:
+                    if (live.deadline_t is not None
+                            and now >= live.deadline_t):
+                        obs.counter("serve.expired_total").inc()
+                        self._finish(live, FinishReason.DEADLINE)
+                        self._queued_n -= 1
+                    else:
+                        kept.append(live)
+                if kept:
+                    lane[tenant] = kept
+                else:
+                    del lane[tenant]
+                    ring.remove(tenant)
+            if not lane:
+                del self._lanes[pri]
+                del self._lane_rr[pri]
 
     def _expire_parked(self) -> None:
         """[holds: _lock] — step() calls this inside the lock. The park
@@ -473,37 +672,216 @@ class Scheduler:
             obs.counter("serve.expired_total").inc()
             obs.counter("serve.retired_total").inc()
 
-    def _admit(self) -> None:
-        """[holds: _lock] — step() calls this inside the lock."""
+    def _expire_preempted(self) -> None:
+        """[holds: _lock] — step() calls this inside the lock. A
+        deadline keeps ticking while a request is suspended: it
+        retires here with whatever tokens it already has, counted like
+        any other deadline miss (and into ``retired_total`` — it WAS
+        admitted once)."""
+        now = time.monotonic()
+        expired = [r for r, l in self._preempted.items()
+                   if l.deadline_t is not None and now >= l.deadline_t]
+        for rid in expired:
+            live = self._preempted.pop(rid)
+            obs.counter("serve.expired_total").inc()
+            obs.counter("serve.retired_total").inc()
+            self._finish(live, FinishReason.DEADLINE)
+        if expired:
+            obs.gauge("serve.preempted_live").set(len(self._preempted))
+
+    # ------------------------------------------------------- preemption
+    def _peek_preempted(self) -> Optional[_Live]:
+        """[holds: _lock] The suspended request resume would re-admit
+        next: highest priority first, oldest submit within it."""
+        if not self._preempted:
+            return None
+        return min(self._preempted.values(),
+                   key=lambda l: (_PRIORITY_RANK[l.req.priority],
+                                  l.submit_t, l.request_id))
+
+    def _pop_preempted(self, request_id: str) -> _Live:
+        """[holds: _lock]"""
+        live = self._preempted.pop(request_id)
+        obs.gauge("serve.preempted_live").set(len(self._preempted))
+        return live
+
+    def _slo_burning(self) -> bool:
+        """[holds: _lock] True when the wired interactive-TTFT SLO
+        tracker is burning its error budget faster than it earns it —
+        the PR 16 control signal that lifts the gentle one-preemption-
+        per-pass quota."""
+        return (self.slo_tracker is not None
+                and self.slo_tracker.burn_rate() > 1.0)
+
+    def _maybe_preempt(self, target: _Live, already: int) -> bool:
+        """[holds: _lock] Try to free capacity for ``target`` by
+        preempting one live decode of STRICTLY lower priority (lowest
+        class first, least-progressed row within it) whose
+        ``preemption_budget`` is not exhausted. Gentle by default —
+        one preemption per admission pass — unless the interactive SLO
+        is burning, when the quota opens to the whole batch. False
+        when the knob is off, no victim qualifies, or the
+        ``scheduler.preempt`` drill vetoed the suspend (the victim
+        just keeps decoding)."""
+        cfg = self.engine.cfg
+        if not cfg.preemption:
+            return False
+        if already >= (len(self._live) if self._slo_burning() else 1):
+            return False
+        rank = _PRIORITY_RANK[target.req.priority]
+        victim = None
+        for slot, live in self._live.items():
+            if _PRIORITY_RANK[live.req.priority] <= rank:
+                continue
+            if live.preempt_count >= cfg.preemption_budget:
+                continue
+            key = (-_PRIORITY_RANK[live.req.priority],
+                   len(live.tokens), slot)
+            if victim is None or key < victim[0]:
+                victim = (key, slot, live)
+        if victim is None:
+            return False
+        return self._preempt(victim[1], victim[2])
+
+    def _preempt(self, slot: int, live: _Live) -> bool:
+        """[holds: _lock] Suspend one live decode: index its bound
+        blocks (prompt + every emitted token) into the prefix trie —
+        where admission pressure can LRU-evict them and, with a host
+        tier, demote them through the serve.kv.demotions_total path —
+        free the slot, and park the request in ``_preempted`` for
+        resume. On the dense layout (or with the cache off /
+        kv_eviction="none", where trie refs would pin blocks forever)
+        nothing is indexed: resume pays a cold re-prefill, trading
+        compute instead of leaking capacity. The ``scheduler.preempt``
+        fault point fires FIRST: an injected error is the typed
+        degradation drill — the victim simply keeps decoding."""
+        try:
+            faults.point("scheduler.preempt")
+        except Exception:
+            return False
         pool = self.engine.pool
-        while self._queue and pool.num_free:
+        with obs.span("serve.preempt_s", request_id=live.request_id,
+                      priority=live.req.priority,
+                      tokens=len(live.tokens)):
+            if (self.engine.paged and pool.prefix_cache_enabled
+                    and pool.eviction == "lru"):
+                pool.register_prefix(
+                    slot, list(live.req.prompt) + live.tokens)
+            del self._live[slot]
+            pool.free(slot)
+        live.preempt_count += 1
+        self._preempted[live.request_id] = live
+        self.preemptions += 1
+        obs.counter("serve.preemptions_total").inc()
+        obs.gauge("serve.preempted_live").set(len(self._preempted))
+        return True
+
+    def _resume_one(self, live: _Live) -> None:
+        """[holds: _lock] Re-admit one preempted request: prefill its
+        full context (prompt + emitted tokens) into a fresh slot with
+        the REMAINING token budget and rejoin the batch. Greedy decode
+        is deterministic given the context, so the resumed stream is
+        bit-identical to an uninterrupted run; full blocks indexed at
+        preemption prefix-hit the trie (or promote back from the host
+        tier) instead of recomputing. A prefill failure retires the
+        request typed, exactly like admission."""
+        pool = self.engine.pool
+        self._pop_preempted(live.request_id)
+        slot = pool.alloc()
+        req = live.req
+        context = list(req.prompt) + live.tokens
+        try:
+            with obs.trace_context(live.trace_id):
+                with obs.span("serve.prefill",
+                              request_id=live.request_id,
+                              prompt_len=len(context), resumed=True):
+                    self.engine.prefill(
+                        slot, context, seed=req.seed,
+                        temperature=req.temperature, top_k=req.top_k,
+                        top_p=req.top_p, eos_id=req.eos_id,
+                        max_new_tokens=(req.max_new_tokens
+                                        - len(live.tokens)))
+        except Exception as e:
+            pool.free(slot)
+            obs.counter("serve.errors_total").inc()
+            # Admitted once at first grant — balance with a retirement.
+            obs.counter("serve.retired_total").inc()
+            self._finish(live, FinishReason.ERROR,
+                         error=f"resume prefill failed: "
+                               f"{type(e).__name__}: {e}")
+            return
+        self.resumes += 1
+        obs.counter("serve.resumes_total").inc()
+        if live.trace_id is not None:
+            live.decode_t0_wall = time.time()
+        self._live[slot] = live
+
+    def _admit(self) -> None:
+        """[holds: _lock] — step() calls this inside the lock. One
+        admission pass: grant free slots to the WFQ pick among queued
+        requests and resumable preempted ones (a preempted request
+        outranks a queued pick of equal or lower priority — it is
+        older, already-admitted work whose KV may still be cached),
+        preempting a strictly-lower-priority live decode when the pick
+        cannot get a slot or its blocks any other way."""
+        pool = self.engine.pool
+        preempts = 0
+        while True:
+            cand = self._peek_next()
+            pre = self._peek_preempted()
+            use_pre = pre is not None and (
+                cand is None or _PRIORITY_RANK[pre.req.priority]
+                <= _PRIORITY_RANK[cand.req.priority])
+            target = pre if use_pre else cand
+            if target is None:
+                break
+            if not pool.num_free:
+                # Slot pressure: make room by suspending a lower-
+                # priority live decode — or wait for retirement.
+                if not self._maybe_preempt(target, preempts):
+                    break
+                preempts += 1
+                continue
             if self.engine.paged:
                 # Admission budget is FREE BLOCKS, not free slots: only
-                # admit the queue head if its worst-case (no prefix
-                # hit) prefill binding fits the free list plus what
-                # cache eviction could reclaim. The worst case also
-                # COVERS a host-tier promotion: a promoted span
-                # allocates exactly the device blocks a cold prefill
-                # of that span would have bound (promotion substitutes
-                # a host->device copy for recompute, never extra
+                # admit the pick if its worst-case (no prefix hit)
+                # prefill binding fits the free list plus what cache
+                # eviction could reclaim. The worst case also COVERS a
+                # host-tier promotion: a promoted span allocates
+                # exactly the device blocks a cold prefill of that
+                # span would have bound (promotion substitutes a
+                # host->device copy for recompute, never extra
                 # footprint), so promotable requests need no separate
-                # budget line. Otherwise wait — live rows retire and
-                # release blocks, and FIFO order holds (skipping ahead
-                # would starve long prompts).
-                need = self.engine.prefill_blocks_needed(
-                    len(self._queue[0].req.prompt))
+                # budget line. A resumed request budgets its full
+                # context (prompt + emitted tokens). Otherwise wait —
+                # live rows retire and release blocks, and lane order
+                # holds (skipping ahead would starve long prompts).
+                ctx = len(target.req.prompt) + (len(target.tokens)
+                                                if use_pre else 0)
+                need = self.engine.prefill_blocks_needed(ctx)
                 if pool.available_blocks() < need:
+                    if self._maybe_preempt(target, preempts):
+                        # The victim's blocks moved to the trie (or
+                        # the free list): re-check the budget.
+                        preempts += 1
+                        continue
                     if not self._live:
                         # Nothing in flight will EVER free more blocks
                         # (with kv_eviction="none" the prefix cache
                         # pins its blocks permanently): waiting would
-                        # livelock, so retire the head with a typed
+                        # livelock, so retire the pick with a typed
                         # error instead — later, smaller requests may
                         # still be servable.
-                        live = self._queue.popleft()
+                        if use_pre:
+                            # Already counted admitted once — balance
+                            # the books with a retirement.
+                            self._pop_preempted(target.request_id)
+                            obs.counter("serve.retired_total").inc()
+                        else:
+                            self._pop_next()
                         obs.counter("serve.errors_total").inc()
                         self._finish(
-                            live, FinishReason.ERROR,
+                            target, FinishReason.ERROR,
                             error=f"kv blocks exhausted: need {need}, "
                                   f"{pool.available_blocks()} "
                                   f"reclaimable, {pool.blocks_used} "
@@ -511,67 +889,77 @@ class Scheduler:
                                   f"{pool.eviction!r})")
                         continue
                     break
-            live = self._queue.popleft()
-            slot = pool.alloc()
-            req = live.req
-            if live.trace_id is not None:
-                # Queue wait is only measurable retroactively (submit ->
-                # this admission) — the first stitched-timeline segment
-                # after the router hop.
-                obs.emit_span("serve.queue_wait", live.submit_wall,
-                              time.time(), trace_id=live.trace_id,
-                              request_id=live.request_id)
-            try:
-                # The ambient trace context makes serve.prefill (and the
-                # engine's per-chunk serve.prefill.chunk spans beneath
-                # it) carry the request's trace id; a no-op for
-                # untraced requests.
-                with obs.trace_context(live.trace_id):
-                    with obs.span("serve.prefill",
-                                  request_id=live.request_id,
-                                  prompt_len=len(req.prompt)):
-                        self.engine.prefill(
-                            slot, req.prompt, seed=req.seed,
-                            temperature=req.temperature, top_k=req.top_k,
-                            top_p=req.top_p, eos_id=req.eos_id,
-                            max_new_tokens=req.max_new_tokens)
-            except Exception as e:
-                # submit() pre-validates the request SHAPE, but runtime/
-                # XLA errors (OOM-ish transients, injected faults) can
-                # still surface here — and one bad request must never
-                # kill the decode loop with neighbors in flight. Free
-                # the slot, retire the request as an ERROR, keep
-                # admitting. (The span recorded the exception type.)
+            if use_pre:
+                self._resume_one(target)
+            else:
+                self._admit_one()
+
+    def _admit_one(self) -> None:
+        """[holds: _lock] Grant the WFQ pick its slot and prefill it —
+        the per-request tail of the admission pass (_admit checked the
+        slot and block budgets first)."""
+        pool = self.engine.pool
+        live = self._pop_next()
+        slot = pool.alloc()
+        req = live.req
+        if live.trace_id is not None:
+            # Queue wait is only measurable retroactively (submit ->
+            # this admission) — the first stitched-timeline segment
+            # after the router hop.
+            obs.emit_span("serve.queue_wait", live.submit_wall,
+                          time.time(), trace_id=live.trace_id,
+                          request_id=live.request_id)
+        try:
+            # The ambient trace context makes serve.prefill (and the
+            # engine's per-chunk serve.prefill.chunk spans beneath
+            # it) carry the request's trace id; a no-op for
+            # untraced requests.
+            with obs.trace_context(live.trace_id):
+                with obs.span("serve.prefill",
+                              request_id=live.request_id,
+                              prompt_len=len(req.prompt)):
+                    self.engine.prefill(
+                        slot, req.prompt, seed=req.seed,
+                        temperature=req.temperature, top_k=req.top_k,
+                        top_p=req.top_p, eos_id=req.eos_id,
+                        max_new_tokens=req.max_new_tokens)
+        except Exception as e:
+            # submit() pre-validates the request SHAPE, but runtime/
+            # XLA errors (OOM-ish transients, injected faults) can
+            # still surface here — and one bad request must never
+            # kill the decode loop with neighbors in flight. Free
+            # the slot, retire the request as an ERROR, keep
+            # admitting. (The span recorded the exception type.)
+            pool.free(slot)
+            obs.counter("serve.errors_total").inc()
+            self._finish(live, FinishReason.ERROR,
+                         error=f"prefill failed: "
+                               f"{type(e).__name__}: {e}")
+            return
+        obs.counter("serve.admitted_total").inc()
+        if req.prefill_only:
+            # Disaggregation: park the prefilled slot for the
+            # migration pull instead of decoding. The request
+            # finishes PREFILLED (its waiter gets the handle); the
+            # slot holds its prompt blocks until kv_ack / resume /
+            # TTL. A duplicate id would orphan the first park's
+            # slot, so it is a typed error.
+            if live.request_id in self._parked:
                 pool.free(slot)
                 obs.counter("serve.errors_total").inc()
                 self._finish(live, FinishReason.ERROR,
-                             error=f"prefill failed: "
-                                   f"{type(e).__name__}: {e}")
-                continue
-            obs.counter("serve.admitted_total").inc()
-            if req.prefill_only:
-                # Disaggregation: park the prefilled slot for the
-                # migration pull instead of decoding. The request
-                # finishes PREFILLED (its waiter gets the handle); the
-                # slot holds its prompt blocks until kv_ack / resume /
-                # TTL. A duplicate id would orphan the first park's
-                # slot, so it is a typed error.
-                if live.request_id in self._parked:
-                    pool.free(slot)
-                    obs.counter("serve.errors_total").inc()
-                    self._finish(live, FinishReason.ERROR,
-                                 error=f"request {live.request_id!r} "
-                                       f"already parked")
-                    continue
-                if live.trace_id is not None:
-                    live.park_wall = time.time()
-                self._parked[live.request_id] = (
-                    slot, live, time.monotonic() + self.parked_ttl_s)
-                self._finish(live, FinishReason.PREFILLED)
-                continue
+                             error=f"request {live.request_id!r} "
+                                   f"already parked")
+                return
             if live.trace_id is not None:
-                live.decode_t0_wall = time.time()
-            self._live[slot] = live
+                live.park_wall = time.time()
+            self._parked[live.request_id] = (
+                slot, live, time.monotonic() + self.parked_ttl_s)
+            self._finish(live, FinishReason.PREFILLED)
+            return
+        if live.trace_id is not None:
+            live.decode_t0_wall = time.time()
+        self._live[slot] = live
 
     def _decode(self) -> int:
         """[holds: _lock] — step() calls this inside the lock."""
@@ -680,6 +1068,26 @@ class Scheduler:
                         live.first_token_wall = (t0_wall
                                                  + dt * (i + 1) / denom)
                     obs.histogram("serve.ttft_s").observe(live.ttft_s)
+                    # Per-priority-class split (pinned): one histogram
+                    # per lane so the report/exposition can show
+                    # interactive latency separately from the batch
+                    # traffic it preempts.
+                    obs.histogram(
+                        f"serve.ttft_s.{live.req.priority}").observe(
+                            live.ttft_s)
+                    if (self.slo_tracker is not None
+                            and live.req.priority == "interactive"):
+                        # Feed the wired interactive-TTFT SLO tracker
+                        # per first token: its burn rate is the PR 16
+                        # control signal that widens the preemption
+                        # quota in _maybe_preempt.
+                        cfg = self.slo_tracker.cfg
+                        ok = {"<": live.ttft_s < cfg.threshold,
+                              "<=": live.ttft_s <= cfg.threshold,
+                              ">": live.ttft_s > cfg.threshold,
+                              ">=": live.ttft_s >= cfg.threshold,
+                              }[cfg.op]
+                        self.slo_tracker.observe(ok)
                 # Per-token decode latency: the block cost split over
                 # the tokens it produced, observed once per token —
                 # horizon=1 degenerates to the classic one-dt-per-token
@@ -964,8 +1372,8 @@ class Scheduler:
 
         with self._lock:
             n = 0
-            while self._queue:
-                live = self._queue.popleft()
+            while self._queued_n:
+                live = self._pop_next()
                 _count()
                 self._finish(live, reason, error=error)
                 n += 1
@@ -976,6 +1384,16 @@ class Scheduler:
                 _count()
                 self._finish(live, reason, error=error)
                 n += 1
+            # Preempted requests hold no slot or blocks (their KV, if
+            # any survived, lives in the trie/host tier) — retire them
+            # with whatever tokens they already emitted.
+            for rid in list(self._preempted):
+                live = self._preempted.pop(rid)
+                obs.counter("serve.retired_total").inc()
+                _count()
+                self._finish(live, reason, error=error)
+                n += 1
+            obs.gauge("serve.preempted_live").set(0)
             # Parked migrations: their "prefilled" answers were already
             # delivered, so this is pure resource release — a drained
             # source simply stops being pullable (the router's next

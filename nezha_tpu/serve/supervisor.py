@@ -118,6 +118,23 @@ class RouterConfig:
     affinity_routing: bool = False
     digest_interval_s: float = 2.0
     digest_max_entries: int = 256
+    # Elastic autoscaling (PR 19): when BOTH bounds are set the monitor
+    # scales the live replica count between them from fleet pressure —
+    # average /healthz queue depth per live replica and the
+    # router.prefill_wait_s p90 (the PR 12 trace segment). A signal
+    # must hold for autoscale_sustain_ticks consecutive monitor ticks
+    # before acting, and actions are spaced by autoscale_cooldown_s —
+    # the two-sided hysteresis that keeps a bursty queue from flapping
+    # the fleet. Scale-up spawns through the PR 6 machinery (a failed
+    # spawn counts against that replica's circuit breaker); scale-down
+    # rolling-drains ONE replica gracefully. None/None (default)
+    # disables the loop entirely.
+    autoscale_min: Optional[int] = None
+    autoscale_max: Optional[int] = None
+    autoscale_up_queue: float = 4.0      # queued per live replica
+    autoscale_up_wait_s: float = 1.0     # prefill_wait p90 bound
+    autoscale_sustain_ticks: int = 3
+    autoscale_cooldown_s: float = 5.0
 
     def __post_init__(self):
         if self.replicas < 1:
@@ -148,9 +165,44 @@ class RouterConfig:
                     "a prefill tier needs at least one decode-capable "
                     "replica (role 'decode' or 'both')")
         object.__setattr__(self, "roles", roles)
+        if (self.autoscale_min is None) != (self.autoscale_max is None):
+            raise ValueError(
+                "autoscale needs BOTH bounds (autoscale_min and "
+                "autoscale_max) or neither")
+        if self.autoscale_max is not None:
+            if self.autoscale_min < 1:
+                raise ValueError("autoscale_min must be >= 1")
+            if self.autoscale_max < self.autoscale_min:
+                raise ValueError(
+                    f"autoscale_max ({self.autoscale_max}) must be >= "
+                    f"autoscale_min ({self.autoscale_min})")
+            if not (self.autoscale_min <= self.replicas
+                    <= self.autoscale_max):
+                raise ValueError(
+                    f"replicas={self.replicas} must start inside "
+                    f"[autoscale_min, autoscale_max] = "
+                    f"[{self.autoscale_min}, {self.autoscale_max}]")
+            if roles:
+                raise ValueError(
+                    "autoscale requires a homogeneous pool — it cannot "
+                    "grow/shrink a fixed roles topology")
+            if self.autoscale_sustain_ticks < 1:
+                raise ValueError("autoscale_sustain_ticks must be >= 1")
+            if self.autoscale_cooldown_s < 0:
+                raise ValueError("autoscale_cooldown_s must be >= 0")
+            if self.autoscale_up_queue <= 0:
+                raise ValueError("autoscale_up_queue must be > 0")
+            if self.autoscale_up_wait_s <= 0:
+                raise ValueError("autoscale_up_wait_s must be > 0")
 
     def role_of(self, rid: int) -> str:
         return self.roles[rid] if self.roles else "both"
+
+    @property
+    def autoscale_enabled(self) -> bool:
+        """True when both elastic bounds are set — the monitor then
+        runs the autoscale control loop every tick."""
+        return self.autoscale_max is not None
 
     @property
     def disaggregated(self) -> bool:
@@ -416,6 +468,9 @@ class _ThreadWorker:
                     payload = obs.stats_snapshot()
                     payload["role"] = getattr(worker.args, "role",
                                               "both")
+                    if worker._ready.is_set():
+                        payload["tenants"] = (
+                            worker._sched.tenant_queue_depths())
                     return self._send(200, payload)
                 if self.path == "/windows":
                     # Mergeable window views (sketch bucket counts
@@ -447,7 +502,12 @@ class _ThreadWorker:
                     "queued": sched.queue_depth,
                     "occupancy": pool.occupancy,
                     "role": getattr(worker.args, "role", "both"),
-                    "parked": sched.parked_count}
+                    "parked": sched.parked_count,
+                    # Per-tenant queue depths + suspended count
+                    # (PR 19): same surface run_http mounts, so the
+                    # router sees one replica protocol.
+                    "tenants": sched.tenant_queue_depths(),
+                    "preempted": sched.preempted_count}
                 # Fleet digest piggyback (PR 17): the prober is the
                 # transport — no extra endpoint, no extra round trip.
                 payload.update(sched.fleet_digest(
@@ -721,7 +781,9 @@ class Supervisor:
     # lock-discipline rule): the monitor tick, the router's prober, and
     # HTTP handler threads all touch the replica records and ledgers.
     _LOCK_GUARDED = {"_replicas": "_lock", "_draining": "_lock",
-                     "restarts": "_lock", "_rng": "_lock"}
+                     "restarts": "_lock", "_rng": "_lock",
+                     "_as_up_ticks": "_lock", "_as_down_ticks": "_lock",
+                     "_as_cooldown_t": "_lock", "_as_target": "_lock"}
 
     def __init__(self, backend, cfg: RouterConfig):
         self.backend = backend
@@ -735,8 +797,17 @@ class Supervisor:
         self._monitor: Optional[threading.Thread] = None
         self.restarts = 0     # obs counters only count inside a run;
         #                       this plain ledger always does
+        # Elastic autoscale state (PR 19): consecutive-tick pressure
+        # counters (the sustain side of the hysteresis), the monotonic
+        # time before which no further action may fire (the cooldown
+        # side), and the current target scale the gauge reports.
+        self._as_up_ticks = 0
+        self._as_down_ticks = 0
+        self._as_cooldown_t = 0.0
+        self._as_target = cfg.replicas
         from nezha_tpu.serve.router import register_router_instruments
         register_router_instruments()
+        obs.gauge("router.autoscale_target").set(self._as_target)
 
     # ------------------------------------------------------- lifecycle
     def start(self) -> None:
@@ -771,6 +842,8 @@ class Supervisor:
                     self._note_death(r, now, "startup timed out")
                 elif r.state == DEAD and now >= r.next_restart_t:
                     self._restart(r, now)
+        if self.cfg.autoscale_enabled:
+            self.autoscale_tick(now)
 
     def shutdown(self) -> None:
         """Stop the monitor and kill whatever is still running (the
@@ -923,7 +996,145 @@ class Supervisor:
         if handle is not None:
             handle.kill()
 
+    # -------------------------------------------------------- autoscale
+    def autoscale_target(self) -> int:
+        with self._lock:
+            return self._as_target
+
+    def autoscale_tick(self, now: Optional[float] = None
+                       ) -> Optional[str]:
+        """One elastic control step (PR 19): read fleet pressure —
+        total /healthz-reported queue depth per live replica, plus the
+        ``router.prefill_wait_s`` p90 trace segment — and scale the
+        replica count within ``[autoscale_min, autoscale_max]``.
+        Two-sided hysteresis: a signal must hold for
+        ``autoscale_sustain_ticks`` CONSECUTIVE ticks (a mixed reading
+        resets both counters — the deadband), actions are spaced by
+        ``autoscale_cooldown_s``, and exactly one replica moves per
+        action. The ``supervisor.scale`` fault point fires at the
+        decision: an injected error is the typed degradation drill —
+        the action is skipped, pressure re-evaluates next tick, and the
+        fleet stays at its current size. Returns "up"/"down"/None so
+        tests can assert the ladder without timing games."""
+        cfg = self.cfg
+        if not cfg.autoscale_enabled:
+            return None
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self._draining:
+                return None
+            active = [r for r in self._replicas
+                      if r.state not in (STOPPED, FAILED)]
+            live = [r for r in active
+                    if r.state == LIVE and r.healthy]
+            queued = sum(int((r.last_health or {}).get("queued", 0))
+                         for r in live)
+            per_live = queued / max(1, len(live))
+            wait_p90 = obs.histogram(
+                "router.prefill_wait_s").percentile(90)
+            hot = (per_live >= cfg.autoscale_up_queue
+                   or (wait_p90 is not None
+                       and wait_p90 >= cfg.autoscale_up_wait_s))
+            idle = (queued == 0 and live
+                    and all(r.in_flight == 0 for r in live))
+            if hot:
+                self._as_up_ticks += 1
+                self._as_down_ticks = 0
+            elif idle:
+                self._as_down_ticks += 1
+                self._as_up_ticks = 0
+            else:
+                # Deadband: neither hot nor fully idle — hold scale and
+                # make BOTH signals re-earn their sustain run.
+                self._as_up_ticks = 0
+                self._as_down_ticks = 0
+            obs.gauge("router.autoscale_target").set(self._as_target)
+            if now < self._as_cooldown_t:
+                return None
+            n = len(active)
+            decision = None
+            if (self._as_up_ticks >= cfg.autoscale_sustain_ticks
+                    and n < cfg.autoscale_max):
+                decision = "up"
+            elif (self._as_down_ticks >= cfg.autoscale_sustain_ticks
+                    and n > cfg.autoscale_min):
+                decision = "down"
+            if decision is None:
+                return None
+            try:
+                faults.point("supervisor.scale")
+            except Exception:
+                return None
+            self._as_up_ticks = 0
+            self._as_down_ticks = 0
+            self._as_cooldown_t = now + cfg.autoscale_cooldown_s
+            self._as_target = n + 1 if decision == "up" else n - 1
+            obs.gauge("router.autoscale_target").set(self._as_target)
+            if decision == "up":
+                self._scale_up(now)
+                return "up"
+            # Scale-down: gracefully drain the HIGHEST-rid active
+            # replica (LIFO keeps the stable base of the fleet the
+            # long-lived members) on its own thread — the per-replica
+            # drain blocks up to the drain budget and must not stall
+            # the monitor loop.
+            victim = active[-1]
+            victim.state = DRAINING
+        threading.Thread(
+            target=self._drain_one,
+            args=(victim, cfg.drain_timeout_s),
+            daemon=True, name=f"nezha-scale-down-{victim.rid}").start()
+        return "down"
+
+    def _scale_up(self, now: float) -> None:
+        """[holds: _lock] Add one replica: re-arm a previously drained
+        (STOPPED) record if one exists — keeping the rid==index
+        invariant the router's ledgers rely on — else append a fresh
+        one. Spawn failures route into the PR 6 backoff/breaker
+        accounting exactly like a restart."""
+        r = next((x for x in self._replicas if x.state == STOPPED), None)
+        if r is None:
+            r = Replica(rid=len(self._replicas), role="both")
+            self._replicas.append(r)
+        else:
+            r.restart_failures = 0
+        try:
+            self._spawn(r)
+        except Exception as e:
+            self._spawn_failed(r, e, now)
+
     # ------------------------------------------------------------ drain
+    def _drain_one(self, r: Replica,
+                   timeout_s: float,
+                   progress: Optional[Callable[[int], None]] = None
+                   ) -> None:
+        """Gracefully stop ONE replica: graceful terminate, up to
+        ``timeout_s`` for its in-flight work, then the hard stop — the
+        per-replica body both :meth:`rolling_drain` and the autoscale
+        scale-down share. Safe to call with the replica already marked
+        DRAINING (the scale-down path does, inside its decision
+        lock)."""
+        with self._lock:
+            handle = r.handle
+            if r.state in (STOPPED, FAILED) or handle is None:
+                return
+            r.state = DRAINING
+            self._update_live_gauge()
+        if handle.alive():
+            handle.terminate()
+            # The worker runs its own drain inside; +5s covers its
+            # shutdown tail so a healthy drain never gets killed at
+            # exactly the budget.
+            if not handle.wait(timeout_s + 5.0):
+                handle.kill()
+                handle.wait(5.0)
+        with self._lock:
+            r.state = STOPPED
+            r.healthy = False
+            self._update_live_gauge()
+        if progress is not None:
+            progress(self.live_count())
+
     def rolling_drain(self, timeout_s: Optional[float] = None,
                       progress: Optional[Callable[[int], None]] = None
                       ) -> None:
@@ -939,22 +1150,4 @@ class Supervisor:
         with self._lock:
             self._draining = True
         for r in self._replicas:
-            with self._lock:
-                handle = r.handle
-                if r.state in (STOPPED, FAILED) or handle is None:
-                    continue
-                r.state = DRAINING
-            if handle.alive():
-                handle.terminate()
-                # The worker runs its own drain inside; +5s covers its
-                # shutdown tail so a healthy drain never gets killed at
-                # exactly the budget.
-                if not handle.wait(timeout_s + 5.0):
-                    handle.kill()
-                    handle.wait(5.0)
-            with self._lock:
-                r.state = STOPPED
-                r.healthy = False
-                self._update_live_gauge()
-            if progress is not None:
-                progress(self.live_count())
+            self._drain_one(r, timeout_s, progress)

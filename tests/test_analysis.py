@@ -367,7 +367,9 @@ def test_lock_discipline_real_declarations_present():
     for cls in (Scheduler, Router, Supervisor, _ThreadWorker,
                 Histogram, Registry):
         assert getattr(cls, "_LOCK_GUARDED"), cls.__name__
-    assert Scheduler._LOCK_GUARDED["_queue"] == "_lock"
+    assert Scheduler._LOCK_GUARDED["_lanes"] == "_lock"
+    assert Scheduler._LOCK_GUARDED["_preempted"] == "_lock"
+    assert Supervisor._LOCK_GUARDED["_as_target"] == "_lock"
     assert Router._LOCK_GUARDED["retries"] == "_ledger_lock"
 
 

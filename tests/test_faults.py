@@ -705,8 +705,9 @@ def test_fault_point_registry_pinned():
     (router.migrate / replica.kv_export / replica.kv_install), the
     speculative verify point (serve.spec.verify), the host-tier
     promotion point (serve.kv.promote), the train->serve
-    resharding point (serve.reshard), and the fleet KV reuse points
-    (router.affinity / replica.kv_pull)."""
+    resharding point (serve.reshard), the fleet KV reuse points
+    (router.affinity / replica.kv_pull), and the multi-tenant
+    scheduling points (scheduler.preempt / supervisor.scale)."""
     from check_fault_points import EXPECTED_POINTS, check, find_points
 
     assert check(_ROOT) == []
@@ -721,5 +722,6 @@ def test_fault_point_registry_pinned():
         "serve.spec.verify",
         "serve.reshard",
         "router.affinity", "replica.kv_pull",
+        "scheduler.preempt", "supervisor.scale",
     }
     assert set(find_points(_ROOT)) == set(EXPECTED_POINTS)
