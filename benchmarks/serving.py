@@ -82,6 +82,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "(int8 pools fuse the block write into its "
                         "epilogue) vs the composed masked path (the "
                         "TTFT before/after knob)")
+    p.add_argument("--prefill-mode",
+                   choices=["replicated", "sequence"],
+                   default="replicated",
+                   help="prefill chunk parallelism: replicated = every "
+                        "mesh device computes the full chunk; sequence "
+                        "= shard the chunk over the 1xM mesh's "
+                        "sequence axis (needs --mesh M > 1 — the "
+                        "long-context before/after knob)")
+    p.add_argument("--long-prefill-buckets", default=None,
+                   help="comma-separated extra prefill pad widths "
+                        "above --max-prefill-len (inside --max-len) so "
+                        "long prompts prefill in a few wide chunks")
+    p.add_argument("--seq-prefill-variant",
+                   choices=["auto", "ulysses", "ring"], default="auto",
+                   help="sequence-mode attention algorithm (auto = "
+                        "ulysses)")
     p.add_argument("--decode-horizon", default="1",
                    help="tokens decoded per compiled step dispatch; a "
                         "comma-separated list (e.g. 1,4,8) sweeps the "
@@ -325,6 +341,11 @@ def run(args) -> dict:
     from nezha_tpu.cli.common import setup_jax
     setup_jax(args)
 
+    if (getattr(args, "prefill_mode", "replicated") == "sequence"
+            and int(getattr(args, "mesh", 1) or 1) < 2):
+        raise SystemExit("--prefill-mode sequence requires --mesh M "
+                         "with M > 1 (the chunk is sharded over the "
+                         "mesh's sequence axis)")
     if getattr(args, "mesh", 1) > 1 and (args.replicas > 1
                                          or args.disaggregate):
         raise SystemExit("--mesh > 1 applies to the single-replica "
@@ -451,6 +472,13 @@ def _run_one(args, model, variables, decode_horizon: int,
         prefix_cache=args.prefix_cache == "on",
         kv_dtype=args.kv_dtype,
         kv_host_blocks=getattr(args, "kv_host_blocks", 0),
+        prefill_mode=getattr(args, "prefill_mode", "replicated"),
+        long_prefill_buckets=tuple(
+            int(b) for b in
+            str(args.long_prefill_buckets).split(","))
+        if getattr(args, "long_prefill_buckets", None) else (),
+        seq_prefill_variant=getattr(args, "seq_prefill_variant",
+                                    "auto"),
         preemption=getattr(args, "preemption", "off") == "on",
         preemption_budget=getattr(args, "preemption_budget", 2),
         speculative=spec)

@@ -81,6 +81,13 @@ EXPECTED_POINTS = frozenset({
     # failed SPAWN afterwards still counts against the PR 6 circuit
     # breaker via supervisor.spawn).
     "scheduler.preempt", "supervisor.scale",
+    # Sequence-sharded prefill (PR 20, serve/sharded/engine.py): armed
+    # at the head of every prefill() under prefill_mode=sequence —
+    # an injected error raises typed InjectedFault into the
+    # scheduler's standard prefill-error envelope: ONLY the victim
+    # request retires (FinishReason.ERROR), zero slot/block/scale
+    # leaks on any shard, and the engine keeps serving.
+    "serve.prefill.seq",
 })
 SOURCE_PREFIX = "nezha_tpu/"
 EXCLUDE_PREFIX = "nezha_tpu/faults/"

@@ -101,6 +101,11 @@ _SERVE_COUNTERS = {"serve.admitted_total", "serve.rejected_total",
                    # instead of the gather/requant round-trip. 0 on
                    # the XLA prefill path or a non-int8 pool.
                    "serve.prefill.fused_writes_total",
+                   # Sequence-sharded prefill (PR 20): ppermute hops
+                   # the ring variant's chunks paid. Mode-invariant:
+                   # replicated and ulysses runs report 0, never omit
+                   # it.
+                   "serve.prefill.ring_hops_total",
                    # Multi-tenant scheduling (PR 19): decodes suspended
                    # to the trie/host tier for a higher-priority
                    # admission, suspends re-admitted, and per-tenant
@@ -131,6 +136,12 @@ _SERVE_GAUGES = {"serve.queue_depth", "serve.batch_occupancy",
                  # composed XLA path — dashboards label the prefill
                  # line with the active impl from this alone.
                  "serve.prefill.kernel_active",
+                 # Sequence-sharded prefill (PR 20): the mesh shards
+                 # each prefill chunk spans — 0 in replicated mode, M
+                 # in sequence mode on a 1xM mesh. Dashboards label
+                 # the prefill line's parallelism mode from this
+                 # alone.
+                 "serve.prefill.seq_shards",
                  # Multi-tenant scheduling (PR 19): requests currently
                  # suspended awaiting resume (0 with preemption off).
                  "serve.preempted_live"}
@@ -250,6 +261,11 @@ _PINNED_SPANS = {
     # through the Pallas prefill program (attrs carry the bucket
     # width). Absent entirely on the XLA prefill path.
     "serve.prefill.kernel_s",
+    # Sequence-sharded prefill (PR 20): brackets one whole prefill()
+    # under prefill_mode=sequence — every chunk of the prompt sharded
+    # over the mesh's sequence axis. Absent entirely in replicated
+    # mode.
+    "serve.prefill.seq_s",
     # Multi-tenant scheduling (PR 19): brackets one preemption — trie
     # indexing of the victim's bound blocks through slot release
     # (attrs carry the victim's request_id, priority, and emitted

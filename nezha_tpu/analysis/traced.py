@@ -44,6 +44,16 @@ TRACING_CALLS: Set[str] = {
     "associative_scan",
 }
 
+# Mesh collectives that only ever execute under a shard_map/pmap
+# lowering: a function whose body ISSUES one is a traced body even
+# when no in-module shard_map call references it by name — the ring-
+# attention library helpers (parallel/ring.py, serve/sharded/
+# seq_prefill.py) are handed to shard_map cross-module, so the ring
+# hop loops they build would otherwise sit outside the hot-path
+# rules' scope. The reason string deliberately says "shard_map" so
+# the mesh-host-side-tables rule roots at these bodies too.
+COLLECTIVE_CALLS: Set[str] = {"ppermute", "all_to_all", "pshuffle"}
+
 _FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
 
 
@@ -84,6 +94,14 @@ def traced_functions(mod: Module) -> Dict[ast.AST, str]:
             for dec in node.decorator_list:
                 if _decorator_traces(dec):
                     mark(node, "decorated with a tracing transform")
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    last = (dotted_name(sub.func) or "").rsplit(
+                        ".", 1)[-1]
+                    if last in COLLECTIVE_CALLS:
+                        mark(node, f"issues mesh collective {last}() "
+                                   f"(shard_map-lowered body)")
+                        break
         if isinstance(node, ast.Call):
             cn = dotted_name(node.func) or ""
             if cn.rsplit(".", 1)[-1] in TRACING_CALLS:

@@ -114,6 +114,32 @@ def build_parser() -> argparse.ArgumentParser:
                         "composed masked path; NEZHA_NO_PREFILL_KERNEL=1 "
                         "is the env escape hatch; default: the model "
                         "config's choice (auto)")
+    p.add_argument("--prefill-mode", choices=["replicated", "sequence"],
+                   default="replicated",
+                   help="prefill chunk parallelism: replicated = every "
+                        "mesh device computes the full chunk (default); "
+                        "sequence = shard the chunk over the sequence "
+                        "axis of the 1xM mesh (ring/ulysses attention, "
+                        "blocks land head-sharded in the paged pool — "
+                        "long-context prompts, docs/RUNBOOK.md §8). "
+                        "Requires --mesh M > 1; "
+                        "NEZHA_NO_SEQ_PREFILL=1 is the env escape "
+                        "hatch back to replicated")
+    p.add_argument("--long-prefill-buckets", default=None,
+                   help="comma-separated extra prefill pad widths "
+                        "ABOVE --max-prefill-len (one compiled program "
+                        "each, still inside --max-len) so an 8k/32k "
+                        "prompt prefills in a few wide chunks instead "
+                        "of hundreds of --max-prefill-len strides; "
+                        "default: none")
+    p.add_argument("--seq-prefill-variant",
+                   choices=["auto", "ulysses", "ring"], default="auto",
+                   help="sequence-mode attention algorithm: ulysses = "
+                        "all-to-all head exchange (bitwise-identical "
+                        "outputs, needs heads %% mesh == 0); ring = "
+                        "ppermute ring hops (greedy-equivalent); auto "
+                        "= ulysses (docs/RUNBOOK.md §8 selection "
+                        "table)")
     p.add_argument("--decode-horizon", type=int, default=1,
                    help="tokens decoded per compiled step dispatch (the "
                         "device-resident sampling loop): 1 = classic "
@@ -423,6 +449,24 @@ def _build_stack(args):
             raise SystemExit(
                 f"--prefill-buckets must be comma-separated ints, got "
                 f"{args.prefill_buckets!r}")
+    long_buckets = ()
+    if getattr(args, "long_prefill_buckets", None):
+        try:
+            long_buckets = tuple(
+                int(b) for b in
+                str(args.long_prefill_buckets).split(","))
+        except ValueError:
+            raise SystemExit(
+                f"--long-prefill-buckets must be comma-separated ints, "
+                f"got {args.long_prefill_buckets!r}")
+    prefill_mode = getattr(args, "prefill_mode", "replicated")
+    if prefill_mode == "sequence" and mesh_m < 2:
+        # Typed refusal BEFORE any engine build: sequence sharding
+        # splits the chunk over mesh devices, so a 1-device mesh has
+        # nothing to shard over.
+        raise SystemExit(
+            "--prefill-mode sequence requires --mesh M with M > 1 "
+            "(the chunk is sharded over the mesh's sequence axis)")
     spec = None
     draft_model = draft_variables = None
     if not getattr(args, "speculative", False) and (
@@ -447,29 +491,40 @@ def _build_stack(args):
             dargs.hf_dir = args.draft_hf_dir
             dargs.random_init = False
             draft_model, draft_variables = load_gpt2_for_inference(dargs)
-    cfg = ServeConfig(
-        max_batch_size=args.max_batch_size, max_len=max_len,
-        max_prefill_len=args.max_prefill_len,
-        prefill_buckets=buckets, k_max=args.k_max,
-        queue_capacity=args.queue_capacity,
-        cache_dtype=jnp.float32 if args.cache_dtype == "f32"
-        else jnp.bfloat16,
-        decode_impl=args.decode_impl,
-        prefill_impl=args.prefill_impl,
-        decode_horizon=args.decode_horizon,
-        kv_layout=args.kv_layout,
-        kv_block_size=args.kv_block_size,
-        kv_num_blocks=args.kv_num_blocks,
-        prefix_cache=args.prefix_cache == "on",
-        kv_eviction=args.kv_eviction,
-        kv_dtype=args.kv_dtype,
-        kv_host_blocks=args.kv_host_blocks,
-        speculative=spec,
-        priority_weights=_parse_priority_weights(
-            getattr(args, "priority_weights", None)),
-        tenant_queue_cap=getattr(args, "tenant_queue_cap", None),
-        preemption=getattr(args, "preemption", "off") == "on",
-        preemption_budget=getattr(args, "preemption_budget", 2))
+    try:
+        cfg = ServeConfig(
+            max_batch_size=args.max_batch_size, max_len=max_len,
+            max_prefill_len=args.max_prefill_len,
+            prefill_buckets=buckets,
+            long_prefill_buckets=long_buckets,
+            prefill_mode=prefill_mode,
+            seq_prefill_variant=getattr(args, "seq_prefill_variant",
+                                        "auto"),
+            k_max=args.k_max,
+            queue_capacity=args.queue_capacity,
+            cache_dtype=jnp.float32 if args.cache_dtype == "f32"
+            else jnp.bfloat16,
+            decode_impl=args.decode_impl,
+            prefill_impl=args.prefill_impl,
+            decode_horizon=args.decode_horizon,
+            kv_layout=args.kv_layout,
+            kv_block_size=args.kv_block_size,
+            kv_num_blocks=args.kv_num_blocks,
+            prefix_cache=args.prefix_cache == "on",
+            kv_eviction=args.kv_eviction,
+            kv_dtype=args.kv_dtype,
+            kv_host_blocks=args.kv_host_blocks,
+            speculative=spec,
+            priority_weights=_parse_priority_weights(
+                getattr(args, "priority_weights", None)),
+            tenant_queue_cap=getattr(args, "tenant_queue_cap", None),
+            preemption=getattr(args, "preemption", "off") == "on",
+            preemption_budget=getattr(args, "preemption_budget", 2))
+    except ValueError as e:
+        # ServeConfig's own validation (bucket ordering, long buckets
+        # outside (max_prefill_len, max_len], unknown modes) as the
+        # CLI's typed refusal.
+        raise SystemExit(f"serve config: {e}")
     if mesh_m > 1:
         from nezha_tpu.serve.sharded import ShardedEngine
         try:
@@ -1248,6 +1303,13 @@ def _worker_argv(args, rid: int, port: int, role: Optional[str] = None
              str(getattr(args, "watchdog_interval", 0.0) or 0.0),
              "--seed", str(args.seed),
              "--mesh", str(getattr(args, "mesh", 1) or 1),
+             # Long-context prefill knobs ride into every worker: the
+             # router is chunk-blind — sequence sharding happens on
+             # each worker's own mesh (PR 20).
+             "--prefill-mode",
+             getattr(args, "prefill_mode", "replicated"),
+             "--seq-prefill-variant",
+             getattr(args, "seq_prefill_variant", "auto"),
              "--http", str(port)]
     # SLOs ride into every worker: each process-backend replica
     # evaluates them against its own registry and streams typed events
@@ -1274,6 +1336,9 @@ def _worker_argv(args, rid: int, port: int, role: Optional[str] = None
         argv += ["--tokenizer", args.tokenizer]
     if args.prefill_buckets:
         argv += ["--prefill-buckets", str(args.prefill_buckets)]
+    if getattr(args, "long_prefill_buckets", None):
+        argv += ["--long-prefill-buckets",
+                 str(args.long_prefill_buckets)]
     if args.decode_impl:
         argv += ["--decode-impl", args.decode_impl]
     if args.prefill_impl:

@@ -668,17 +668,47 @@ class Attention(Module):
             # land in the row's own bound blocks and are overwritten by
             # decode before any mask attends them — same argument as
             # dense), then masked attention over the gathered pool.
-            use_pf = _prefill_flash_ok(cfg)
+            # Sequence-sharded prefill: a trace-time scope the sharded
+            # engine enters while tracing its bucket programs
+            # (prefill_mode="sequence"). The sys.modules probe keeps
+            # the check free unless the seq-prefill module was ever
+            # imported — single-device serving never pays for it.
+            import sys as _sys
+            _spm = _sys.modules.get(
+                "nezha_tpu.serve.sharded.seq_prefill")
+            _sp = (_spm.seq_prefill_params()
+                   if _spm is not None else None)
+            use_pf = False
             pf_mesh = None
-            from nezha_tpu.parallel.gspmd import under_auto_partitioner
-            if under_auto_partitioner():
-                # Same move as decode below: the raw Mosaic call can
-                # never be handed to the auto-partitioner — the nested-
-                # shard_map variant runs it per head shard, or the
-                # composed path partitions.
-                use_pf = False
-                pf_mesh = _prefill_flash_shmap_mesh(cfg)
-            if use_pf or pf_mesh is not None:
+            if _sp is None:
+                use_pf = _prefill_flash_ok(cfg)
+                from nezha_tpu.parallel.gspmd import (
+                    under_auto_partitioner)
+                if under_auto_partitioner():
+                    # Same move as decode below: the raw Mosaic call
+                    # can never be handed to the auto-partitioner —
+                    # the nested-shard_map variant runs it per head
+                    # shard, or the composed path partitions.
+                    use_pf = False
+                    pf_mesh = _prefill_flash_shmap_mesh(cfg)
+            if _sp is not None:
+                # The nested shard_map owns BOTH the pool write and
+                # the chunk attention; the kernel-vs-composed choice
+                # mirrors prefill_impl exactly (the shmap-mesh
+                # resolver honors NEZHA_NO_PREFILL_KERNEL and
+                # NEZHA_NO_NESTED_KERNELS, and is backend-aware).
+                starts = jnp.broadcast_to(
+                    jnp.asarray(pos, jnp.int32), (b,))
+                use_k = _prefill_flash_shmap_mesh(cfg) is not None
+                (out_pf, k_pool, v_pool, ks_n, vs_n,
+                 qerr) = _spm.seq_prefill_attention(
+                    q, k, v, kp, vp, tab, starts, mesh=_sp.mesh,
+                    variant=_sp.variant, use_kernel=use_k,
+                    block_scales=((ks_pool, vs_pool) if quant
+                                  else None))
+                if quant:
+                    ks_pool, vs_pool = ks_n, vs_n
+            elif use_pf or pf_mesh is not None:
                 from nezha_tpu.ops.pallas import (
                     flash_prefill_attention,
                     flash_prefill_attention_sharded,

@@ -258,12 +258,21 @@ def render_serving_section(summary: Optional[dict]) -> List[str]:
         # round-trip.
         impl = ("kernel"
                 if gauges.get("serve.prefill.kernel_active") else "xla")
+        # Sequence-sharded prefill (PR 20): the seq_shards gauge is M
+        # when chunks shard over the mesh's sequence axis, 0 in
+        # replicated mode — the report labels the line's parallelism
+        # mode from it alone (ring hops additionally show the
+        # ppermute-variant traffic).
+        shards = gauges.get("serve.prefill.seq_shards", 0)
+        mode = f"seq x{shards:.0f}" if shards else "replicated"
         fused = counters.get("serve.prefill.fused_writes_total", 0)
         fused_part = f"  fused writes {fused:.0f}" if fused else ""
+        hops = counters.get("serve.prefill.ring_hops_total", 0)
+        hops_part = f"  ring hops {hops:.0f}" if hops else ""
         lines.append(
-            f"  prefill[{impl}]: {chunks:.0f} chunk(s)  "
+            f"  prefill[{impl}, {mode}]: {chunks:.0f} chunk(s)  "
             f"bucket len p50 {ph['p50']:.0f}  p90 {ph['p90']:.0f}  "
-            f"max {ph['max']:.0f}{fused_part}")
+            f"max {ph['max']:.0f}{fused_part}{hops_part}")
     tokens = counters.get("serve.tokens_total", 0)
     wall = (summary.get("run") or {}).get("wall_seconds")
     if tokens and wall:

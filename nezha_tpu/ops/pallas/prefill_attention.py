@@ -87,17 +87,31 @@ _KC_TILE_TARGET = 256  # chunk-KV rows per self-attention tile
 
 def _chunk_self_attention(qi, q_ref, kc_ref, vc_ref, m_scr, l_scr,
                           acc_scr, *, scale, block_q, block_kc, s_chunk,
-                          cast_dtype):
+                          cast_dtype, qoff=None):
     """Fold the chunk's own K/V causally (chunk-local positions — the
     shared ``start`` offset cancels out of the causal comparison).
     ``cast_dtype`` routes the fresh tiles through the pool's storage
     dtype first so a bf16 pool attends exactly the values the composed
     path reads back after its write. ``qi`` is passed in (program ids
-    must be read at kernel top level, outside any ``pl.when`` body)."""
+    must be read at kernel top level, outside any ``pl.when`` body).
+
+    ``qoff`` (traced per-row scalar, or None) shifts the queries by a
+    GLOBAL offset relative to the chunk's start: query ``i`` sits at
+    chunk-local position ``i + qoff``, so the causal comparison runs in
+    global coordinates — the sequence-sharded prefill path hands each
+    mesh shard a SLICE of the chunk's queries against the full chunk
+    K/V. ``None`` keeps the original statically-skipped diagonal (the
+    compiled default path is unchanged byte-for-byte)."""
     q = q_ref[0, 0]                                          # [bq, d]
     for kj in range(s_chunk // block_kc):
-        # Tiles strictly above this q tile's causal diagonal are skipped.
-        run = kj * block_kc <= qi * block_q + block_q - 1
+        if qoff is None:
+            # Tiles strictly above this q tile's causal diagonal are
+            # skipped at TRACE time — a static Python bool.
+            run = kj * block_kc <= qi * block_q + block_q - 1
+        else:
+            # The diagonal moves with the traced offset: the skip is a
+            # per-program predicate, still zero work for future tiles.
+            run = kj * block_kc <= qi * block_q + block_q - 1 + qoff
 
         @pl.when(run)
         def _tile(kj=kj):
@@ -112,6 +126,8 @@ def _chunk_self_attention(qi, q_ref, kc_ref, vc_ref, m_scr, l_scr,
                                 preferred_element_type=jnp.float32) * scale
             qpos = qi * block_q + lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
+            if qoff is not None:
+                qpos = qpos + qoff
             kpos = kj * block_kc + lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
             s = jnp.where(kpos <= qpos, s, NEG_BIG)
@@ -145,6 +161,45 @@ def _prefill_kernel(tab_ref, start_ref, q_ref, kc_ref, vc_ref, kp_ref,
                               acc_scr, scale=scale, block_q=block_q,
                               block_kc=block_kc, s_chunk=s_chunk,
                               cast_dtype=cast_dtype)
+        softmax_finalize(o_ref, m_scr, l_scr, acc_scr)
+
+
+def _prefill_qoff_kernel(tab_ref, start_ref, qoff_ref, q_ref, kc_ref,
+                         vc_ref, kp_ref, vp_ref, o_ref, m_scr, l_scr,
+                         acc_scr, *, scale, s_chunk, block_q, block_kc,
+                         bs, m, cast_dtype):
+    """Float-pool variant with PER-ROW GLOBAL QUERY OFFSETS: query ``i``
+    of row ``b`` sits at absolute position ``qoffs[b] + i`` while the
+    chunk K/V operands occupy ``[starts[b], starts[b] + s_chunk)`` and
+    the pool prefix ``[0, starts[b])``. Requires ``qoffs >= starts``
+    (every query postdates the whole prefix, so the prefix fold needs
+    no extra mask — the invariant the default kernel already relies
+    on). This is the sequence-sharded prefill building block: one mesh
+    shard's slice of the chunk's queries runs ONE program against the
+    full chunk + its local pool shard, per (mesh, bucket) — chunked
+    continuation and shared-prefix starts ride the same traced scalars
+    as the default path."""
+    b_ = pl.program_id(0)
+    qi = pl.program_id(2)
+    t = pl.program_id(3)
+    start = start_ref[b_]
+    qoff = qoff_ref[b_] - start      # chunk-local offset of query 0
+
+    @pl.when(t == 0)
+    def _init():
+        scratch_init(m_scr, l_scr, acc_scr)
+
+    @pl.when((t < m) & (t * bs < start))
+    def _prefix():
+        block_step(q_ref[0, 0], kp_ref[0, 0], vp_ref[0, 0], start, t,
+                   m_scr, l_scr, acc_scr, scale=scale, block_k=bs)
+
+    @pl.when(t == m)
+    def _chunk():
+        _chunk_self_attention(qi, q_ref, kc_ref, vc_ref, m_scr, l_scr,
+                              acc_scr, scale=scale, block_q=block_q,
+                              block_kc=block_kc, s_chunk=s_chunk,
+                              cast_dtype=cast_dtype, qoff=qoff)
         softmax_finalize(o_ref, m_scr, l_scr, acc_scr)
 
 
@@ -249,6 +304,65 @@ def _quant_prefill_kernel(tab_ref, start_ref, q_ref, kc_ref, vc_ref,
         # The qerr output's index never moves within (b, h): the last
         # write before the flush — the final q sweep's — wins.
         qerr_ref[0, 0] = qerr_scr[0, 0]
+
+
+def _prefill_qoff_call(q, k_chunk, v_chunk, k_pool, v_pool,
+                       block_tables, starts, q_offsets, scale,
+                       interpret):
+    """Float-path build with per-row global query offsets: the query
+    extent ``S_q`` may differ from the chunk-K/V extent ``S_kc`` (a
+    sequence shard holds ``S_kc / world`` queries against the full
+    chunk), and a THIRD scalar-prefetch operand carries ``q_offsets``.
+    The default build stays byte-identical — this is a separate
+    program, keyed by its own (S_q, S_kc, M, bs, D) signature."""
+    b, h, s_q, d = q.shape
+    s_chunk = k_chunk.shape[2]
+    bs = k_pool.shape[2]
+    m = block_tables.shape[1]
+    nq_block = pick_block(s_q, _Q_TILE_TARGET)
+    nkc_block = pick_block(s_chunk, _KC_TILE_TARGET)
+    nq = s_q // nq_block
+
+    tab = jnp.asarray(block_tables, jnp.int32)
+    starts32 = jnp.asarray(starts, jnp.int32)
+    qoffs32 = jnp.asarray(q_offsets, jnp.int32)
+
+    def _gather_idx(b_, h_, qi, t, tab, starts, qoffs):
+        return (tab[b_, jnp.minimum(t, m - 1)], h_, 0, 0)
+
+    q_spec = pl.BlockSpec((1, 1, nq_block, d),
+                          lambda b_, h_, qi, t, tab, starts, qoffs:
+                          (b_, h_, qi, 0))
+    chunk_spec = pl.BlockSpec((1, 1, s_chunk, d),
+                              lambda b_, h_, qi, t, tab, starts, qoffs:
+                              (b_, h_, 0, 0))
+    pool_spec = pl.BlockSpec((1, 1, bs, d), _gather_idx)
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary",
+                                 "arbitrary"))
+    scratch = [pltpu.VMEM((nq_block, LANES), jnp.float32),
+               pltpu.VMEM((nq_block, LANES), jnp.float32),
+               pltpu.VMEM((nq_block, d), jnp.float32)]
+    kernel = functools.partial(
+        _prefill_qoff_kernel, scale=scale, s_chunk=s_chunk,
+        block_q=nq_block, block_kc=nkc_block, bs=bs, m=m,
+        cast_dtype=k_pool.dtype)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, h, nq, m + 1),
+        in_specs=[q_spec, chunk_spec, chunk_spec, pool_spec, pool_spec],
+        out_specs=q_spec,
+        scratch_shapes=scratch,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+        **kwargs,
+    )(tab, starts32, qoffs32, q, k_chunk, v_chunk, k_pool, v_pool)
 
 
 def _prefill_call(q, k_chunk, v_chunk, k_pool, v_pool, block_tables,
@@ -368,7 +482,7 @@ def flash_prefill_attention(q, k_chunk, v_chunk, k_pool, v_pool,
                             block_tables, starts,
                             scale: Optional[float] = None,
                             interpret: Optional[bool] = None,
-                            block_scales=None):
+                            block_scales=None, q_offsets=None):
     """Paged prefill-chunk attention (+ fused int8 write).
 
     ``q``/``k_chunk``/``v_chunk`` ``[B, H, S, D]`` are the fresh
@@ -396,9 +510,33 @@ def flash_prefill_attention(q, k_chunk, v_chunk, k_pool, v_pool,
     ``starts + S`` must fit the table capacity ``M * bs``. One compiled
     program serves every ``start`` at a given (S, M, bs, D) — the
     engine's frozen program-count contract.
+
+    ``q_offsets [B]`` int32 (float pools only) decouples the QUERY
+    origin from the chunk origin: query ``i`` of row ``b`` sits at
+    absolute position ``q_offsets[b] + i`` while the chunk K/V still
+    occupy ``[starts[b], starts[b] + S_kc)``. ``q`` may then carry
+    fewer rows than the chunk (``S_q != S_kc``) — the sequence-sharded
+    prefill hands each mesh shard its slice of the chunk's queries
+    against the full chunk. Requires ``starts[b] <= q_offsets[b]``
+    per row (queries never predate the prefix boundary). One compiled
+    program per (S_q, S_kc, M, bs, D) — chunked continuation and
+    shared-prefix starts stay traced scalars.
     """
     b, h, s_chunk, d = q.shape
-    if k_chunk.shape != q.shape or v_chunk.shape != q.shape:
+    if q_offsets is not None:
+        if block_scales is not None:
+            raise ValueError(
+                "q_offsets is a read-layout feature of the float path; "
+                "int8 pools fuse the block write and need the full "
+                "chunk's queries resident (use the per-shard fused "
+                "write on head-resharded operands instead)")
+        if k_chunk.shape[:2] != q.shape[:2] \
+                or k_chunk.shape[3] != d \
+                or v_chunk.shape != k_chunk.shape:
+            raise ValueError(
+                f"chunk k/v {k_chunk.shape}/{v_chunk.shape} do not "
+                f"match q {q.shape} on (B, H, D)")
+    elif k_chunk.shape != q.shape or v_chunk.shape != q.shape:
         raise ValueError(
             f"chunk k/v {k_chunk.shape}/{v_chunk.shape} do not match q "
             f"{q.shape}")
@@ -421,6 +559,10 @@ def flash_prefill_attention(q, k_chunk, v_chunk, k_pool, v_pool,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    if q_offsets is not None:
+        return _prefill_qoff_call(q, k_chunk, v_chunk, k_pool, v_pool,
+                                  block_tables, starts, q_offsets,
+                                  scale, interpret)
     return _prefill_call(q, k_chunk, v_chunk, k_pool, v_pool,
                          block_tables, starts, scale, interpret,
                          block_scales=block_scales)
@@ -430,7 +572,8 @@ def flash_prefill_attention_sharded(q, k_chunk, v_chunk, k_pool, v_pool,
                                     block_tables, starts, mesh, *,
                                     scale: Optional[float] = None,
                                     block_scales=None,
-                                    interpret: Optional[bool] = None):
+                                    interpret: Optional[bool] = None,
+                                    q_offsets=None):
     """:func:`flash_prefill_attention` PER SHARD under a nested
     ``shard_map`` over the mesh's ``tp`` (head) axis — the sharded
     serve engine's prefill path, same idiom as
@@ -467,6 +610,19 @@ def flash_prefill_attention_sharded(q, k_chunk, v_chunk, k_pool, v_pool,
             q, k_chunk, v_chunk, k_pool, v_pool, block_tables, starts,
             ks, vs)
         return out, kp_new, vp_new, ks_new, vs_new, qerr
+
+    if q_offsets is not None:
+        def body_off(q_, kc_, vc_, kp_, vp_, t_, st_, qo_):
+            return flash_prefill_attention(
+                q_, kc_, vc_, kp_, vp_, t_, st_, scale=scale,
+                interpret=interpret, q_offsets=qo_)
+
+        f = shard_map(body_off, mesh=mesh,
+                      in_specs=(hspec, hspec, hspec, hspec, hspec, rep,
+                                rep, rep),
+                      out_specs=hspec)
+        return f(q, k_chunk, v_chunk, k_pool, v_pool, block_tables,
+                 starts, q_offsets)
 
     def body(q_, kc_, vc_, kp_, vp_, t_, st_):
         return flash_prefill_attention(

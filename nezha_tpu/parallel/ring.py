@@ -108,6 +108,78 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
     return out.astype(q.dtype)
 
 
+def ring_attention_lse(q, k, v, axis_name: str, causal: bool = True,
+                       scale: float | None = None,
+                       use_flash: bool | None = None):
+    """:func:`ring_attention` that ALSO returns the per-row global
+    log-sum-exp ``[B, H, S_local]`` fp32 — the merge handle a caller
+    needs to fold this ring's result with attention computed elsewhere
+    (the sequence-sharded serve prefill merges the chunk's ring output
+    with per-shard paged-prefix attention via ``jnp.logaddexp``
+    weights). Inference-only: no VJP (the flash path reuses the
+    forward hop fold directly, bypassing the ring-level custom_vjp).
+
+    Fully-masked rows (possible when ``causal=False`` is never the
+    case here, but a caller may merge an EMPTY prefix) carry
+    ``lse ~= -1e30`` so their merge weight underflows to exactly 0.
+    """
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu"
+    if use_flash:
+        out, (_, _, _, _, lse) = _ring_flash_fwd(q, k, v, axis_name,
+                                                 causal, scale)
+        return out, lse
+    world = axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    local_pos = jnp.arange(s_local)
+    q_pos = idx * s_local + local_pos
+    perm = [(i, (i + 1) % world) for i in range(world)]
+
+    def attend_block(m, l, acc, k_cur, v_cur, src):
+        # Same fold as ring_attention.attend_block — kept in lockstep.
+        k_pos = src * s_local + local_pos
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_cur,
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            allowed = k_pos[None, :] <= q_pos[:, None]
+            scores = jnp.where(allowed[None, None], scores, _NEG_BIG)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jnp.einsum(
+            "bhqk,bhkd->bhqd", p.astype(v_cur.dtype), v_cur,
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    def body(i, carry):
+        m, l, acc, k_cur, v_cur = carry
+        src = (idx - i) % world
+        if causal:
+            m, l, acc = lax.cond(
+                src > idx,
+                lambda ops_: ops_[:3],
+                lambda ops_: attend_block(*ops_),
+                (m, l, acc, k_cur, v_cur, src))
+        else:
+            m, l, acc = attend_block(m, l, acc, k_cur, v_cur, src)
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return m, l, acc, k_next, v_next
+
+    m0 = jnp.full((b, h, s_local, 1), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((b, h, s_local, 1), jnp.float32)
+    acc0 = jnp.zeros((b, h, s_local, d), jnp.float32)
+    m, l, acc, _, _ = lax.fori_loop(0, world, body, (m0, l0, acc0, k, v))
+
+    out = (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    lse = (m + jnp.log(jnp.maximum(l, 1e-30)))[..., 0]
+    return out, lse
+
+
 # ---------------------------------------------------------------------------
 # Flash-ring: per-hop Pallas flash blocks under a ring-level custom VJP.
 #

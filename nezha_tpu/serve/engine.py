@@ -182,6 +182,28 @@ class ServeConfig:
     # epilogue), "xla" forces the composed masked path.
     # NEZHA_NO_PREFILL_KERNEL=1 is the env escape hatch.
     prefill_impl: Optional[str] = None
+    # Long-context prefill (PR 20). prefill_mode="sequence" shards each
+    # prefill chunk's attention over the serve mesh (ShardedEngine
+    # only — the single-device engine rejects it): ulysses all-to-all
+    # when H % M == 0 (bitwise parity with the replicated path) or
+    # ppermute ring hops (serve/sharded/seq_prefill.py).
+    # "replicated" is the pre-PR-20 path, bit for bit.
+    # NEZHA_NO_SEQ_PREFILL=1 is the env escape hatch (the sharded
+    # engine silently falls back to replicated — long buckets keep
+    # serving the same prompts either way).
+    prefill_mode: str = "replicated"
+    # Extra static chunk widths ABOVE max_prefill_len (each >
+    # max_prefill_len, <= max_len, strictly increasing): one more
+    # compiled prefill program each, letting an 8k-32k document prompt
+    # prefill in a handful of wide dispatches instead of hundreds of
+    # max_prefill_len strides. () keeps the classic plan byte-for-byte.
+    # Under prefill_mode="sequence" every bucket width (short AND long)
+    # must divide by the mesh size.
+    long_prefill_buckets: Tuple[int, ...] = ()
+    # Sequence-sharding layout: "auto" (ulysses when H % M == 0, which
+    # the sharded engine's head-divisibility requirement guarantees),
+    # "ulysses", or "ring" (docs/RUNBOOK.md §8 selection table).
+    seq_prefill_variant: str = "auto"
     decode_horizon: int = 1
     # KV layout: "paged" (default) is the block-paged pool — per-layer
     # [kv_num_blocks, H, kv_block_size, D] buffers, ref-counted blocks
@@ -262,6 +284,15 @@ class ServeConfig:
     # times one request may be preempted (anti-thrash).
     preemption: bool = False
     preemption_budget: int = 2
+
+    @property
+    def all_prefill_buckets(self) -> Tuple[int, ...]:
+        """Every compiled prefill width, ascending: the classic buckets
+        (<= max_prefill_len) followed by the long-context buckets. The
+        frozen program contract counts these: steady state is
+        ``1 step + len(all_prefill_buckets)`` programs per engine."""
+        return tuple(self.prefill_buckets) + tuple(
+            self.long_prefill_buckets)
 
     def __post_init__(self):
         if self.max_batch_size < 1:
@@ -357,6 +388,26 @@ class ServeConfig:
                 f"prefill_buckets must be >= 1 and end exactly at "
                 f"max_prefill_len={self.max_prefill_len}, got {buckets}")
         object.__setattr__(self, "prefill_buckets", buckets)
+        if self.prefill_mode not in ("replicated", "sequence"):
+            raise ValueError(
+                f"prefill_mode must be 'replicated' or 'sequence', got "
+                f"{self.prefill_mode!r}")
+        if self.seq_prefill_variant not in ("auto", "ulysses", "ring"):
+            raise ValueError(
+                f"seq_prefill_variant must be 'auto', 'ulysses', or "
+                f"'ring', got {self.seq_prefill_variant!r}")
+        lb = tuple(self.long_prefill_buckets)
+        if lb:
+            if list(lb) != sorted(set(lb)):
+                raise ValueError(
+                    f"long_prefill_buckets must be strictly increasing, "
+                    f"got {lb}")
+            if lb[0] <= self.max_prefill_len or lb[-1] > self.max_len:
+                raise ValueError(
+                    f"long_prefill_buckets must lie in "
+                    f"(max_prefill_len={self.max_prefill_len}, "
+                    f"max_len={self.max_len}], got {lb}")
+        object.__setattr__(self, "long_prefill_buckets", lb)
         if self.tenant_queue_cap is not None and self.tenant_queue_cap < 1:
             raise ValueError(
                 f"tenant_queue_cap must be >= 1 or None, got "
@@ -439,12 +490,24 @@ class Engine:
     amortization this engine exists to improve.
     """
 
+    # Whether this engine class can serve prefill_mode="sequence".
+    # Only the mesh-sharded engine can — sequence sharding needs a
+    # multi-device "tp" axis to spread the chunk over.
+    _seq_prefill_capable = False
+
     def __init__(self, model, variables, cfg: ServeConfig = ServeConfig(),
                  draft_model=None, draft_variables=None):
         if cfg.max_len > model.cfg.max_positions:
             raise ValueError(
                 f"max_len {cfg.max_len} exceeds the model's max_positions "
                 f"{model.cfg.max_positions}")
+        if (cfg.prefill_mode == "sequence"
+                and not self._seq_prefill_capable):
+            raise ValueError(
+                "prefill_mode='sequence' requires the mesh-sharded "
+                "engine (nezha-serve --mesh M with M > 1) — the "
+                "single-device engine has no sequence axis to shard "
+                "over")
         # The decode/prefill attention choices are model-config knobs
         # (the attention module reads them at trace time); honor the
         # serving overrides by rebuilding the module tree around a
@@ -527,8 +590,11 @@ class Engine:
         # dispatch-per-token amortization against this.
         self.step_calls = 0
         # Tokens the most recent prefill's compiled chunks pushed
-        # through the target model (set per prefill call).
+        # through the target model (set per prefill call), and how many
+        # chunk dispatches it took (the sequence-sharded engine's
+        # ring-hop accounting multiplies by this).
         self.last_prefill_tokens = 0
+        self.last_prefill_chunks = 0
         # Donate the pooled caches (positional arg 1 in EVERY program):
         # without donation every decoded token would copy the whole
         # [B_max, H, L_max, D] K/V pool per layer just to write one row —
@@ -536,17 +602,20 @@ class Engine:
         # latency-bound loop. The engine rebinds the returned buffers
         # immediately, so the invalidated inputs are never reused.
         self.executor = Executor(donate_argnums=(1,))
-        # One prefill program per bucket width (compiled lazily: the
-        # executor keys on the function object, so each closure is its
-        # own cache entry the first time a prompt lands in its bucket).
-        # The paged variants take the block tables as one extra operand
-        # — shapes are static, so the "1 step + len(prefill_buckets)
-        # programs" contract is layout-invariant.
-        self._prefill_fns = {w: self._wrap_program(
+        # One prefill program per bucket width — long-context buckets
+        # included (compiled lazily: the executor keys on the function
+        # object, so each closure is its own cache entry the first time
+        # a prompt lands in its bucket). The paged variants take the
+        # block tables as one extra operand — shapes are static, so the
+        # "1 step + len(all_prefill_buckets) programs" contract is
+        # layout-invariant. Prefill programs route through the
+        # dedicated _wrap_prefill_program hook: the sharded engine in
+        # sequence mode nests the seq-prefill scope around the trace.
+        self._prefill_fns = {w: self._wrap_prefill_program(
                                     _build_prefill(self.model, w,
                                                    paged=self.paged,
                                                    quantized=self.kv_quant))
-                             for w in cfg.prefill_buckets}
+                             for w in cfg.all_prefill_buckets}
         # Speculative decoding: a DRAFT engine rides along — its own
         # model (explicit, or an early-exit self-draft sharing the
         # target's weights), its own KV pool MIRRORING the target
@@ -600,9 +669,9 @@ class Engine:
             self.pool.mirror = self.draft_pool
             self.draft_executor = Executor(donate_argnums=(1,))
             self._draft_prefill_fns = {
-                w: self._wrap_program(
+                w: self._wrap_prefill_program(
                     _build_draft_prefill(dm, w, paged=self.paged))
-                for w in cfg.prefill_buckets}
+                for w in cfg.all_prefill_buckets}
             # Carried residual-distribution flag: True where the row's
             # last_logits hold the rejection residual (already-filtered
             # log-probs — sampled raw, never re-filtered).
@@ -621,7 +690,7 @@ class Engine:
 
     # ----------------------------------------------- subsystem hooks
     # The tensor-sharded engine (serve/sharded/engine.py) specializes
-    # the engine at exactly two seams — where pools are built and where
+    # the engine at a handful of seams — where pools are built and where
     # built programs are handed to the executor — so every other line
     # of the admission/decode machinery stays layout-blind. Single-
     # device serving goes through the identity versions below.
@@ -654,37 +723,68 @@ class Engine:
         byte-for-byte what it was."""
         return fn
 
+    def _wrap_prefill_program(self, fn):
+        """Prefill-program hook (target AND draft bucket programs):
+        defaults to :meth:`_wrap_program`, so every engine keeps its
+        existing wrapping. The sharded engine in
+        ``prefill_mode="sequence"`` overrides this to ALSO enter the
+        seq-prefill scope around the trace — the model's prefill-chunk
+        branch then builds the nested sequence-sharded shard_map
+        (serve/sharded/seq_prefill.py) while step/decode programs stay
+        untouched."""
+        return self._wrap_program(fn)
+
     # -------------------------------------------------------- host API
     def bucket_for(self, n: int) -> int:
         """The static pad width the TAIL chunk of an ``n``-token prompt
-        runs at: the smallest bucket >= n for single-chunk prompts,
-        else the smallest bucket >= the chunked remainder. Benchmarks
-        group TTFT by this value."""
-        p_max = self.cfg.max_prefill_len
-        rem = n if n <= p_max else (n % p_max or p_max)
-        return next(w for w in self.cfg.prefill_buckets if w >= rem)
+        runs at (the smallest bucket >= n for single-chunk prompts;
+        with long buckets configured, possibly a pad-up long tail — see
+        :meth:`_plan_chunks`). Benchmarks group TTFT by this value."""
+        return self._plan_chunks(n)[-1][2]
 
     def _plan_chunks(self, n: int,
                      start: int = 0) -> List[Tuple[int, int, int]]:
         """Chunk plan for prefilling positions ``[start, n)`` of an
-        ``n``-token prompt: ``(offset, real_len, pad_width)`` triples —
-        full ``max_prefill_len`` strides then a bucketed tail. With a
-        shared-prefix ``start`` only the un-cached suffix is planned
-        (partial-prefix prefill reuses the same bucket machinery). A
-        padded tail that would spill past ``max_len`` slides back over
-        real tokens (rewriting positions recomputes identical K/V; the
-        paged pool COWs any shared block the slide re-enters)."""
-        p_max = self.cfg.max_prefill_len
+        ``n``-token prompt: ``(offset, real_len, pad_width)`` triples.
+        Greedy largest-fit over ALL buckets: while the remainder
+        exceeds ``max_prefill_len``, either pad UP into the smallest
+        bucket covering the whole remainder (only when the pad waste is
+        below one stride — an 8 001-token prompt takes one 8192-wide
+        dispatch, a 100-token remainder never balloons to 8k) or stride
+        by the largest bucket that fits (long buckets stride in big
+        steps); then the classic bucketed tail. With
+        ``long_prefill_buckets=()`` this reduces EXACTLY to the old
+        plan: full ``max_prefill_len`` strides then a bucketed tail.
+        With a shared-prefix ``start`` only the un-cached suffix is
+        planned (partial-prefix prefill reuses the same bucket
+        machinery). A padded tail that would spill past ``max_len``
+        slides back over real tokens (rewriting positions recomputes
+        identical K/V; the paged pool COWs any shared block the slide
+        re-enters)."""
+        cfg = self.cfg
+        p_max = cfg.max_prefill_len
+        buckets = cfg.all_prefill_buckets
         chunks: List[Tuple[int, int, int]] = []
         off = start
+        width = None
         while n - off > p_max:
-            chunks.append((off, p_max, p_max))
-            off += p_max
+            rem = n - off
+            up = [w for w in buckets if w >= rem]
+            stride = max(w for w in buckets if w <= rem)
+            if up and up[0] - rem < stride:
+                # Pad-up tail: one wide dispatch covers the whole
+                # remainder and wastes less than one more stride would
+                # have advanced.
+                width = up[0]
+                break
+            chunks.append((off, stride, stride))
+            off += stride
         rem = n - off
-        width = self.bucket_for(rem)
-        if off + width > self.cfg.max_len:
+        if width is None:
+            width = next(w for w in buckets if w >= rem)
+        if off + width > cfg.max_len:
             # A padded tail would spill past the slot's KV capacity
-            # (max_len not a multiple of max_prefill_len, prompt near
+            # (max_len not a multiple of the stride, prompt near
             # capacity) — and dynamic_update_slice would CLAMP the write
             # start, corrupting the already-written prefix. Slide the
             # window back to cover the last `width` REAL tokens instead:
@@ -793,6 +893,7 @@ class Engine:
         # The sharded engine's collective-payload estimate reads this
         # after the call — prefill_span() would overcount hits.
         self.last_prefill_tokens = sum(w for _, _, w in chunks)
+        self.last_prefill_chunks = len(chunks)
         qerrs: List[Any] = []
         for off, ln, width in chunks:
             obs.histogram("serve.prefill.bucket_len").observe(width)

@@ -204,6 +204,13 @@ def register_serve_instruments() -> None:
     # path and bf16 pools report 0s, never omit the names.
     obs.gauge("serve.prefill.kernel_active")
     obs.counter("serve.prefill.fused_writes_total")
+    # Sequence-sharded prefill (PR 20): the mesh shards each prefill
+    # chunk spans (0 = replicated mode, M = sequence mode on a 1xM
+    # mesh; gauge re-set by the engine) and the ppermute hops ring-
+    # variant chunks paid. Mode-invariant: replicated and ulysses runs
+    # report 0s, never omit the names.
+    obs.gauge("serve.prefill.seq_shards")
+    obs.counter("serve.prefill.ring_hops_total")
     # The fault layer's injection count rides in every serving summary
     # (0 when no plan is active) so chaos runs and clean runs share one
     # schema — dashboards can divide errors by injections.

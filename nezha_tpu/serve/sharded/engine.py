@@ -26,7 +26,7 @@ GSPMD stack and the nested-``shard_map`` flash idiom already key on):
   block tables replicated (ops/pallas/decode_attention.py).
 
 The frozen program contract is preserved PER MESH: steady state is
-still ``1 step + len(prefill_buckets)`` executor entries with misses
+still ``1 step + len(all_prefill_buckets)`` executor entries with misses
 frozen after warmup — the executor keys on function identity + shapes,
 and the wrapped closures are built once per engine. Greedy outputs are
 bit-identical to the single-device engine on a fitting config
@@ -45,6 +45,7 @@ into whatever mesh the destination runs.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional, Sequence
 
 import numpy as np
@@ -53,7 +54,7 @@ import jax
 
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from nezha_tpu import obs
+from nezha_tpu import faults, obs
 from nezha_tpu.parallel.gspmd import auto_partitioner_scope
 from nezha_tpu.parallel.mesh import make_mesh
 from nezha_tpu.serve.engine import Engine, ServeConfig
@@ -69,6 +70,8 @@ class ShardedEngine(Engine):
     axis, not a protocol change. ``mesh_devices=1`` is a valid
     degenerate mesh (useful for A/B parity runs on one device)."""
 
+    _seq_prefill_capable = True
+
     def __init__(self, model, variables, cfg: ServeConfig = ServeConfig(),
                  *, mesh_devices: int, devices: Optional[Sequence] = None,
                  rules=None, draft_model=None, draft_variables=None):
@@ -79,6 +82,35 @@ class ShardedEngine(Engine):
             raise ValueError(
                 "the sharded engine requires kv_layout='paged' — the "
                 "dense layout has no head-sharded pool")
+        # Sequence-sharded prefill (PR 20). NEZHA_NO_SEQ_PREFILL=1 is
+        # the runtime escape hatch: fall back to the replicated prefill
+        # path — the long buckets keep serving the same prompts, only
+        # the chunk attention stops sharding over the sequence axis.
+        import os
+        if (cfg.prefill_mode == "sequence"
+                and os.environ.get("NEZHA_NO_SEQ_PREFILL")):
+            cfg = dataclasses.replace(cfg, prefill_mode="replicated")
+        self._seq_active = cfg.prefill_mode == "sequence"
+        self._seq_variant = None
+        if self._seq_active:
+            if m < 2:
+                raise ValueError(
+                    "prefill_mode='sequence' requires mesh_devices > 1 "
+                    "— there is no sequence axis to shard over on a "
+                    "degenerate 1-device mesh")
+            bad = [w for w in cfg.all_prefill_buckets if w % m]
+            if bad:
+                raise ValueError(
+                    f"prefill_mode='sequence' needs every prefill "
+                    f"bucket width divisible by mesh_devices={m}; "
+                    f"offending buckets: {bad} (size prefill_buckets/"
+                    f"long_prefill_buckets accordingly)")
+            # "auto" resolves to ulysses: the engine's head-
+            # divisibility requirement above guarantees H % M == 0,
+            # and ulysses is the bitwise-parity layout (RUNBOOK §8).
+            self._seq_variant = ("ulysses"
+                                 if cfg.seq_prefill_variant == "auto"
+                                 else cfg.seq_prefill_variant)
         avail = list(devices) if devices is not None else jax.devices()
         if m > len(avail):
             raise ValueError(
@@ -127,6 +159,12 @@ class ShardedEngine(Engine):
         if self.spec is not None:
             self.residual = jax.device_put(self.residual, self._rep_out)
         obs.gauge("serve.mesh.devices").set(m)
+        # How many sequence shards each prefill chunk spreads over
+        # (0 = replicated prefill). Re-pinned per prefill call, like
+        # kernel_active — bench harnesses reset the registry after
+        # warmup.
+        obs.gauge("serve.prefill.seq_shards").set(
+            float(m) if self._seq_active else 0.0)
         # The base engine resolved prefill-kernel activeness for the
         # raw-Mosaic path; under the partitioner the kernel runs as a
         # nested shard_map instead, so the nested-kernel escape hatch
@@ -195,9 +233,46 @@ class ShardedEngine(Engine):
 
         return sharded_program
 
+    def _wrap_prefill_program(self, fn):
+        """In sequence mode the bucket programs trace with the
+        seq-prefill scope nested inside the partitioner scope, so the
+        model's prefill-chunk branch builds the nested sequence-sharded
+        shard_map (serve/sharded/seq_prefill.py — importing it here is
+        also what arms the model's ``sys.modules`` probe). Step/decode
+        programs never come through this hook and stay untouched;
+        replicated mode is the plain :meth:`_wrap_program`, byte for
+        byte."""
+        if not self._seq_active:
+            return self._wrap_program(fn)
+        from nezha_tpu.serve.sharded import seq_prefill
+
+        inner = self._wrap_program(fn)
+        mesh, variant = self.mesh, self._seq_variant
+
+        def seq_program(*args):
+            with seq_prefill.seq_prefill_scope(mesh, variant):
+                return inner(*args)
+
+        return seq_program
+
     # ------------------------------------------------------- dispatch
     def prefill(self, slot: int, tokens, **kwargs) -> None:
-        super().prefill(slot, tokens, **kwargs)
+        if self._seq_active:
+            # Chunk-retirement drill point for sequence mode: a seeded
+            # fault here must retire ONLY the victim request with zero
+            # slot/block/scale leaks on every shard (tests/chaos).
+            faults.point("serve.prefill.seq")
+            obs.gauge("serve.prefill.seq_shards").set(
+                float(self.mesh_devices))
+            with obs.span("serve.prefill.seq_s"):
+                super().prefill(slot, tokens, **kwargs)
+            if self._seq_variant == "ring":
+                # One ring rotation per chunk: every shard's block
+                # travels the full ring, world hops per chunk program.
+                obs.counter("serve.prefill.ring_hops_total").inc(
+                    self.mesh_devices * self.last_prefill_chunks)
+        else:
+            super().prefill(slot, tokens, **kwargs)
         if self._coll_bytes_per_token:
             # The tokens the compiled chunks ACTUALLY pushed through
             # the target model (bucket pads included, a prefix hit's

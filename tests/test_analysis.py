@@ -170,6 +170,42 @@ def test_host_sync_pallas_partial_binding(tmp_path):
     assert [f.symbol for f in found] == ["_kernel"]
 
 
+def test_host_sync_collective_ring_bodies(tmp_path):
+    """The sequence-sharded prefill extension (ISSUE 20): a function
+    that ISSUES lax.ppermute / lax.all_to_all is a traced body even
+    when no in-module shard_map references it (the ring-attention
+    library helpers are handed to shard_map cross-module), and the
+    ring hop loop it builds is in scope transitively — a host sync
+    inside a hop is a finding. Collective-free host code stays out of
+    scope."""
+    index = _tree(tmp_path, {"ring.py": """
+        import time
+        import jax.numpy as jnp
+        from jax import lax
+
+        def ring_attend(q, k, axis):
+            def hop(i, carry):
+                q_cur, acc = carry
+                time.time()                # finding: host clock in hop
+                q_cur = lax.ppermute(q_cur, axis, [(0, 1), (1, 0)])
+                return q_cur, acc + q_cur
+            return lax.fori_loop(0, 2, hop, (q, jnp.zeros_like(q)))
+
+        def ulysses_exchange(x, axis):
+            y = lax.all_to_all(x, axis, 1, 2, tiled=True)
+            print("trace-time only")       # finding: IO in a2a body
+            return y
+
+        def host_plan(widths):
+            print("host-side is fine")     # no collectives: NOT traced
+            return sorted(widths)
+    """})
+    found = _rule_findings(index, "host-sync-in-hot-path")
+    assert {f.detail for f in found} == {"time.time()", "print()"}
+    assert {f.symbol for f in found} == {"ring_attend.hop",
+                                         "ulysses_exchange"}
+
+
 # -------------------------------------------- mesh-host-side-tables rule
 def test_mesh_host_side_tables_rule_fixture(tmp_path):
     """The sharded-serving split: host-side pool bookkeeping
@@ -219,6 +255,36 @@ def test_mesh_host_side_tables_rule_fixture(tmp_path):
             return caches                        # jit body, no mutation
     """})
     assert _rule_findings(clean, "mesh-host-side-tables") == []
+
+
+def test_mesh_host_side_tables_collective_bodies(tmp_path):
+    """The sequence-sharded prefill extension (ISSUE 20): a helper
+    that issues mesh collectives (the seq_prefill ring/ulysses shard
+    bodies — handed to shard_map cross-module, so no in-module
+    shard_map call roots them) is still in scope: a block-table or
+    free-list mutation inside one is a finding."""
+    index = _tree(tmp_path, {"seq.py": """
+        import jax.numpy as jnp
+        from jax import lax
+
+        def _ring_shard(pool, q, kd, axis):
+            pool._free_blocks.append(3)    # finding: fork per shard
+            def hop(i, carry):
+                return lax.ppermute(carry, axis, [(0, 1), (1, 0)])
+            return lax.fori_loop(0, 2, hop, kd)
+
+        def _ulysses_shard(pool, q, tab, axis):
+            qh = lax.all_to_all(q, axis, 1, 2, tiled=True)
+            pool.tables_host[0, 0] = 9     # finding: table write
+            return qh + tab
+
+        def host_rebind(pool, slot):
+            pool.tables_host[slot, :] = 0  # host-side: legal
+            pool._free_blocks.append(slot)
+    """})
+    found = _rule_findings(index, "mesh-host-side-tables")
+    assert {f.detail for f in found} == {"_free_blocks", "tables_host"}
+    assert {f.symbol for f in found} == {"_ring_shard", "_ulysses_shard"}
 
 
 def test_mesh_host_side_tables_real_tree_clean():

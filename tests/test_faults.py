@@ -706,8 +706,9 @@ def test_fault_point_registry_pinned():
     speculative verify point (serve.spec.verify), the host-tier
     promotion point (serve.kv.promote), the train->serve
     resharding point (serve.reshard), the fleet KV reuse points
-    (router.affinity / replica.kv_pull), and the multi-tenant
-    scheduling points (scheduler.preempt / supervisor.scale)."""
+    (router.affinity / replica.kv_pull), the multi-tenant
+    scheduling points (scheduler.preempt / supervisor.scale), and the
+    sequence-sharded prefill point (serve.prefill.seq)."""
     from check_fault_points import EXPECTED_POINTS, check, find_points
 
     assert check(_ROOT) == []
@@ -723,5 +724,6 @@ def test_fault_point_registry_pinned():
         "serve.reshard",
         "router.affinity", "replica.kv_pull",
         "scheduler.preempt", "supervisor.scale",
+        "serve.prefill.seq",
     }
     assert set(find_points(_ROOT)) == set(EXPECTED_POINTS)

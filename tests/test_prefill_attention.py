@@ -331,6 +331,73 @@ def test_sharded_mesh2_matches_unsharded():
     assert float(got8[5]) == float(ref8[5])
 
 
+# ----------------------------------------------- q_offsets (PR 20)
+@pytest.mark.parametrize("starts", [(0, 0), (8, 24), (5, 13)],
+                         ids=["cold", "block-aligned", "mid-block"])
+def test_q_offsets_full_chunk_bitwise_default(starts):
+    """``q_offsets=starts`` with a full-width query slab is the same
+    computation as the legacy two-prefetch program — output BITWISE
+    equal (the sequence-sharded engine's parity guarantee bottoms out
+    here: a shard seeing the whole chunk reproduces the replicated
+    path exactly)."""
+    rng = np.random.RandomState(5)
+    bs, m, s_chunk = 8, 12, 16
+    q, kc, vc, pk, pv, tab = _case(rng, starts, bs=bs, m=m,
+                                   s_chunk=s_chunk)
+    A, st32 = jnp.asarray, jnp.asarray(starts, jnp.int32)
+    ref = flash_prefill_attention(A(q), A(kc), A(vc), A(pk), A(pv),
+                                  A(tab), st32, interpret=True)
+    got = flash_prefill_attention(A(q), A(kc), A(vc), A(pk), A(pv),
+                                  A(tab), st32, q_offsets=st32,
+                                  interpret=True)
+    assert np.array_equal(np.asarray(got), np.asarray(ref))
+
+
+@pytest.mark.parametrize("starts", [(8, 24), (5, 13)],
+                         ids=["block-aligned", "mid-block"])
+def test_q_offsets_shard_slices_bitwise(starts):
+    """The sequence-shard read layout: each of two query half-slabs
+    (``S_q = S_kc / 2``) at ``q_offsets = starts + k * S_q`` against
+    the FULL chunk K/V equals the corresponding slice of the
+    full-width output bitwise — chunked continuations and mid-block
+    shared-prefix starts both stay traced scalars in ONE program per
+    (S_q, S_kc) signature."""
+    rng = np.random.RandomState(6)
+    bs, m, s_chunk = 8, 12, 16
+    q, kc, vc, pk, pv, tab = _case(rng, starts, bs=bs, m=m,
+                                   s_chunk=s_chunk)
+    A, st32 = jnp.asarray, jnp.asarray(starts, jnp.int32)
+    full = np.asarray(flash_prefill_attention(
+        A(q), A(kc), A(vc), A(pk), A(pv), A(tab), st32, interpret=True))
+    half = s_chunk // 2
+    for k in range(2):
+        got = flash_prefill_attention(
+            A(q[:, :, k * half:(k + 1) * half]), A(kc), A(vc), A(pk),
+            A(pv), A(tab), st32, q_offsets=st32 + k * half,
+            interpret=True)
+        assert np.array_equal(np.asarray(got),
+                              full[:, :, k * half:(k + 1) * half])
+
+
+def test_q_offsets_rejects_int8_pools():
+    """``q_offsets`` is a read-layout feature of the float path; the
+    int8 fused write needs the full chunk's queries resident, so the
+    combination is a typed refusal, not silent corruption."""
+    rng = np.random.RandomState(7)
+    starts, bs, m, s_chunk = (8, 24), 8, 12, 16
+    q, kc, vc, pk, pv, tab = _case(rng, starts, bs=bs, m=m,
+                                   s_chunk=s_chunk)
+    pk8 = rng.randint(-127, 128, pk.shape).astype(np.int8)
+    pv8 = rng.randint(-127, 128, pv.shape).astype(np.int8)
+    ks = np.ones(pk.shape[:2], np.float32)
+    vs = np.ones(pv.shape[:2], np.float32)
+    A, st32 = jnp.asarray, jnp.asarray(starts, jnp.int32)
+    with pytest.raises(ValueError, match="float path"):
+        flash_prefill_attention(
+            A(q), A(kc), A(vc), A(pk8), A(pv8), A(tab), st32,
+            block_scales=(A(ks), A(vs)), q_offsets=st32, interpret=True)
+
+
 # ------------------------------------------------------- engine parity
 @pytest.mark.parametrize("dtype", ["bf16", "int8"])
 def test_engine_greedy_parity_and_frozen_programs(engines, dtype):
@@ -451,7 +518,7 @@ def test_chaos_kernel_prefill_zero_leaks_and_telemetry(model_and_vars,
     assert "serve.prefill.kernel_s" in span_names
     from nezha_tpu.obs.report import render_report
     report = render_report(run_dir)
-    assert "prefill[kernel]:" in report
+    assert "prefill[kernel, replicated]:" in report
     assert "fused writes" in report
     # Dropping the new instruments must FAIL the pinned schema.
     del summary["counters"]["serve.prefill.fused_writes_total"]

@@ -333,8 +333,10 @@ def test_serving_smoke_program_count_and_artifacts(model_and_vars,
     assert "serving:" in report and "ttft" in report and "tpot" in report
     assert "6 admitted" in report
     # Bucket-occupancy line, labeled with the active prefill impl
-    # (CPU auto resolves to the composed XLA path).
-    assert "prefill[xla]: 7 chunk(s)" in report
+    # (CPU auto resolves to the composed XLA path) and the chunk
+    # parallelism mode (replicated = classic, seq xM = sequence-
+    # sharded over a mesh).
+    assert "prefill[xla, replicated]: 7 chunk(s)" in report
 
     # Every batched decode step is labeled with its own span.
     with open(os.path.join(run_dir, "spans.jsonl")) as f:
